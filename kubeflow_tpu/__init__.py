@@ -1,0 +1,25 @@
+"""kubeflow_tpu — a TPU-native ML platform with the Kubeflow capability surface.
+
+A ground-up rebuild of the Kubeflow stack (training operator, serving, HPO,
+pipelines, workspaces) designed TPU-first: declarative specs reconciled by
+in-process controllers, a JAX/XLA SPMD data plane over `jax.sharding.Mesh`
+(DP/FSDP/TP/PP/EP/SP), Pallas kernels for the hot ops, `jax.distributed`
+bootstrap in place of NCCL/MPI rendezvous, and orbax checkpointing.
+
+Capability parity map (see SURVEY.md §2; reference citations are upstream
+symbols — the reference mount was empty at survey time, SURVEY.md §0):
+
+- ``core``      — declarative API objects + object store (≈ pkg/apis/* + kube-apiserver)
+- ``runtime``   — TPU slice topology, gang allocator, process manager (≈ scheduler/kubelet/volcano)
+- ``models``    — Llama/Gemma/Mixtral/ViT/CLIP functional JAX models (data plane)
+- ``ops``       — Pallas TPU kernels (flash/ring attention, rmsnorm, MoE dispatch)
+- ``parallel``  — mesh/sharding policies, pipeline schedules, collectives
+- ``train``     — train step, trainer loop, checkpointing, metrics
+- ``operator``  — JAXJob controller (≈ kubeflow/training-operator)
+- ``serve``     — continuous-batching inference engine + InferenceService (≈ kserve)
+- ``tune``      — HPO experiments + suggestion algorithms (≈ kubeflow/katib)
+- ``pipelines`` — DAG DSL/compiler/executor + metadata lineage (≈ kubeflow/pipelines + MLMD)
+- ``workspace`` — notebook sessions, profiles, pod defaults (≈ kubeflow/kubeflow monorepo)
+"""
+
+__version__ = "0.1.0"

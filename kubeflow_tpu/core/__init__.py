@@ -1,0 +1,34 @@
+"""Declarative API objects, object store, and manifest handling.
+
+TPU-native analog of the Kubernetes API machinery the reference builds on:
+typed specs (≈ CRDs), an in-process versioned object store with watch streams
+(≈ kube-apiserver + etcd), and YAML manifests (≈ `kubectl apply`).
+"""
+
+from kubeflow_tpu.core.object import (
+    ApiObject,
+    Condition,
+    ObjectMeta,
+    StoredObject,
+    utcnow,
+)
+from kubeflow_tpu.core.store import ObjectStore, WatchEvent, EventType
+from kubeflow_tpu.core.registry import kind_registry, register_kind, lookup_kind
+from kubeflow_tpu.core.manifest import load_manifest, load_manifests, dump_manifest
+
+__all__ = [
+    "ApiObject",
+    "Condition",
+    "ObjectMeta",
+    "StoredObject",
+    "ObjectStore",
+    "WatchEvent",
+    "EventType",
+    "kind_registry",
+    "register_kind",
+    "lookup_kind",
+    "load_manifest",
+    "load_manifests",
+    "dump_manifest",
+    "utcnow",
+]

@@ -1,0 +1,71 @@
+"""Event recording (≈ k8s record.EventRecorder).
+
+The reference emits Events for every controller action ("Created pod X",
+"Exceeded backoff limit") — SURVEY.md §5 observability. Here events live in a
+bounded in-memory log per recorder, queryable by object ref, and mirrored to
+structured logging."""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Optional
+
+from kubeflow_tpu.core.object import ApiObject, utcnow
+
+logger = logging.getLogger("kubeflow_tpu.events")
+
+
+@dataclass
+class Event:
+    object_ref: str
+    type: str          # "Normal" | "Warning"
+    reason: str
+    message: str
+    count: int = 1
+    first_timestamp: datetime = field(default_factory=utcnow)
+    last_timestamp: datetime = field(default_factory=utcnow)
+
+
+class EventRecorder:
+    def __init__(self, max_events: int = 10000):
+        self._lock = threading.Lock()
+        self._events: collections.deque[Event] = collections.deque(maxlen=max_events)
+
+    def event(self, obj: ApiObject, etype: str, reason: str, message: str) -> None:
+        ref = obj.key
+        with self._lock:
+            # Dedup only the immediately-preceding identical event (same
+            # object, type, reason, message) by bumping count — strictly
+            # consecutive so the log keeps recurrence ordering, and O(1).
+            last = self._events[-1] if self._events else None
+            if (last is not None and last.object_ref == ref and last.type == etype
+                    and last.reason == reason and last.message == message):
+                last.count += 1
+                last.last_timestamp = utcnow()
+            else:
+                self._events.append(Event(object_ref=ref, type=etype, reason=reason, message=message))
+        log = logger.warning if etype == "Warning" else logger.info
+        log("%s %s %s: %s", ref, etype, reason, message)
+
+    def normal(self, obj: ApiObject, reason: str, message: str) -> None:
+        self.event(obj, "Normal", reason, message)
+
+    def warning(self, obj: ApiObject, reason: str, message: str) -> None:
+        self.event(obj, "Warning", reason, message)
+
+    def for_object(self, obj_or_ref) -> list[Event]:
+        ref = obj_or_ref if isinstance(obj_or_ref, str) else obj_or_ref.key
+        with self._lock:
+            return [e for e in self._events if e.object_ref == ref]
+
+    def all(self) -> list[Event]:
+        with self._lock:
+            return list(self._events)
+
+
+# A default process-wide recorder; controllers may take their own.
+default_recorder = EventRecorder()

@@ -1,0 +1,311 @@
+"""JAXJob API types — the TPU-native replacement for the reference's
+TFJob/PyTorchJob/MPIJob family.
+
+Upstream shape (SURVEY.md §2.2; (U) training-operator pkg/apis/kubeflow.org/v1):
+``ReplicaSpec{replicas, template, restartPolicy}``, ``RunPolicy{cleanPodPolicy,
+ttlSecondsAfterFinished, activeDeadlineSeconds, backoffLimit,
+schedulingPolicy}``, ``ElasticPolicy``, conditions Created/Running/Restarting/
+Succeeded/Failed, ``ReplicaStatus{active,succeeded,failed}``.
+
+TPU-native differences (by design, not translation):
+- One job kind (JAXJob), one replica role that matters (``worker``) — JAX SPMD
+  has no PS/chief/launcher split; rendezvous is ``jax.distributed`` with
+  worker-0 as coordinator, replacing MASTER_ADDR/TF_CONFIG/hostfile+mpirun.
+- The pod template becomes a ``WorkloadSpec`` (Python entrypoint + config) and
+  a ``TPUResourceSpec`` (chips per worker, topology request) — no containers.
+- ``ParallelismSpec`` is first-class on the job: mesh axes (dcn/pipeline/fsdp/
+  data/expert/seq/model) the data plane builds its `jax.sharding.Mesh` from.
+- Checkpoint/resume is in RunPolicy (the reference delegates it to user code).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+from kubeflow_tpu.core.object import ApiObject, ConditionMixin, ObjectMeta
+from kubeflow_tpu.core.registry import register_kind
+
+WORKER = "worker"  # the single replica role; kept as a dict key for API parity
+
+
+class RestartPolicy(str, enum.Enum):
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"  # retryable exit codes >=128 restart; others fail
+
+
+class CleanPodPolicy(str, enum.Enum):
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class JobConditionType(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SUSPENDED = "Suspended"
+
+
+class SchedulingPolicy(BaseModel):
+    """Gang scheduling knobs (≈ RunPolicy.SchedulingPolicy + volcano PodGroup)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    min_available: Optional[int] = None  # default: all replicas (strict gang)
+    queue: str = "default"
+    priority: int = 0
+    timeout_seconds: Optional[float] = None  # max time waiting for placement
+
+
+class CheckpointPolicy(BaseModel):
+    """First-class checkpoint/resume (reference delegates this to user pods)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = True
+    interval_steps: int = 100
+    directory: Optional[str] = None      # default: <workdir>/<job-uid>/ckpt
+    max_to_keep: int = 3
+    resume_from: Optional[str] = None    # explicit checkpoint path to restore
+    save_on_failure: bool = True         # emergency checkpoint on failure signal
+
+
+class RunPolicy(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    clean_pod_policy: CleanPodPolicy = CleanPodPolicy.RUNNING
+    ttl_seconds_after_finished: Optional[float] = None
+    active_deadline_seconds: Optional[float] = None
+    backoff_limit: int = 3
+    scheduling_policy: SchedulingPolicy = Field(default_factory=SchedulingPolicy)
+    checkpoint: CheckpointPolicy = Field(default_factory=CheckpointPolicy)
+    suspend: bool = False
+
+
+class ElasticPolicy(BaseModel):
+    """Elastic training (≈ PyTorchJob ElasticPolicy → torchrun c10d rdzv).
+
+    TPU-native semantics: a resize re-gangs the job on a new mesh and resumes
+    from the latest checkpoint with resharded restore (orbax handles topology
+    change)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    max_restarts: int = 10
+
+    @model_validator(mode="after")
+    def _check(self) -> "ElasticPolicy":
+        if self.min_replicas > self.max_replicas:
+            raise ValueError("min_replicas > max_replicas")
+        return self
+
+
+class TPUResourceSpec(BaseModel):
+    """Per-worker accelerator request (replaces `nvidia.com/gpu` counts)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    tpu_chips: int = 1
+    memory_gb: Optional[float] = None
+    topology: Optional[str] = None  # e.g. "2x2x1" sub-slice request
+
+
+class WorkloadSpec(BaseModel):
+    """What a worker runs (replaces the pod template's container).
+
+    ``entrypoint`` is either a registered trainer name (e.g. "llm_pretrain")
+    or a dotted "module:function" path; ``config`` is passed to it. ``env`` is
+    merged over the bootstrap env the controller injects (coordinator address,
+    process id/count — the jax.distributed rendezvous)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    entrypoint: str
+    config: dict[str, Any] = Field(default_factory=dict)
+    env: dict[str, str] = Field(default_factory=dict)
+    working_dir: Optional[str] = None
+
+
+class ReplicaSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    replicas: int = 1
+    restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE
+    template: WorkloadSpec
+    resources: TPUResourceSpec = Field(default_factory=TPUResourceSpec)
+
+
+class ParallelismSpec(BaseModel):
+    """Mesh-axis degrees for the SPMD data plane.
+
+    Axis order (outer→inner) mirrors physical locality: DCN between slices,
+    then pipeline, data/fsdp, expert/seq, model innermost (model-parallel
+    collectives are latency-bound → nearest neighbors on ICI)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    dcn: int = 1        # data parallel across slices (DCN transport)
+    pipeline: int = 1   # pipeline stages
+    data: int = 1       # pure data parallel (replicated params)
+    fsdp: int = 1       # sharded-data-parallel (params sharded on dim 0)
+    expert: int = 1     # MoE expert parallel
+    seq: int = 1        # sequence/context parallel (ring attention)
+    model: int = 1      # tensor parallel
+
+    @property
+    def total(self) -> int:
+        return (self.dcn * self.pipeline * self.data * self.fsdp
+                * self.expert * self.seq * self.model)
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "dcn": self.dcn, "pipeline": self.pipeline, "data": self.data,
+            "fsdp": self.fsdp, "expert": self.expert, "seq": self.seq,
+            "model": self.model,
+        }
+
+
+class JAXJobSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    replica_specs: dict[str, ReplicaSpec]
+    run_policy: RunPolicy = Field(default_factory=RunPolicy)
+    elastic_policy: Optional[ElasticPolicy] = None
+    parallelism: ParallelismSpec = Field(default_factory=ParallelismSpec)
+
+    @property
+    def worker(self) -> ReplicaSpec:
+        return self.replica_specs[WORKER]
+
+    @model_validator(mode="after")
+    def _check(self) -> "JAXJobSpec":
+        if WORKER not in self.replica_specs:
+            raise ValueError(f"replica_specs must contain {WORKER!r}")
+        w = self.replica_specs[WORKER]
+        if w.replicas < 1:
+            raise ValueError("worker.replicas must be >= 1")
+        if self.elastic_policy is not None:
+            if not (self.elastic_policy.min_replicas <= w.replicas
+                    <= self.elastic_policy.max_replicas):
+                raise ValueError("worker.replicas outside elastic [min,max]")
+        total_chips = w.replicas * w.resources.tpu_chips
+        if self.parallelism.total not in (1, total_chips):
+            raise ValueError(
+                f"parallelism product {self.parallelism.total} != total chips {total_chips}"
+            )
+        return self
+
+
+class ReplicaStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+class JobMetrics(BaseModel):
+    """Data-plane metrics surfaced on job status (reference can't see these)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    step: int = 0
+    tokens_per_sec_per_chip: Optional[float] = None
+    step_time_ms: Optional[float] = None
+    mfu: Optional[float] = None
+    loss: Optional[float] = None
+    last_checkpoint_step: Optional[int] = None
+
+
+class JAXJobStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    replica_statuses: dict[str, ReplicaStatus] = Field(default_factory=dict)
+    start_time: Optional[Any] = None
+    completion_time: Optional[Any] = None
+    restart_count: int = 0
+    coordinator_address: Optional[str] = None
+    gang_name: Optional[str] = None
+    metrics: JobMetrics = Field(default_factory=JobMetrics)
+
+    @property
+    def phase(self) -> str:
+        for t in (JobConditionType.FAILED, JobConditionType.SUCCEEDED,
+                  JobConditionType.SUSPENDED, JobConditionType.RESTARTING,
+                  JobConditionType.RUNNING, JobConditionType.CREATED):
+            if self.has_condition(t.value):
+                return t.value
+        return "Pending"
+
+
+@register_kind
+class JAXJob(ApiObject):
+    KIND = "JAXJob"
+    API_VERSION = "training.tpu.kubeflow.dev/v1"
+
+    spec: JAXJobSpec
+    status: JAXJobStatus = Field(default_factory=JAXJobStatus)
+
+
+# -- Worker: the "pod" analog --------------------------------------------------
+
+class WorkerPhase(str, enum.Enum):
+    PENDING = "Pending"
+    SCHEDULED = "Scheduled"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class WorkerSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    job: str                   # owning JAXJob "namespace/name"
+    replica_type: str = WORKER
+    replica_index: int = 0
+    num_workers: int = 1       # world size (process count)
+    template: WorkloadSpec
+    resources: TPUResourceSpec = Field(default_factory=TPUResourceSpec)
+    coordinator_address: Optional[str] = None  # worker-0 rendezvous address
+    gang_name: Optional[str] = None
+    restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE
+
+
+class WorkerStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    phase: WorkerPhase = WorkerPhase.PENDING
+    pid: Optional[int] = None
+    exit_code: Optional[int] = None
+    message: str = ""
+    slice_name: Optional[str] = None
+    chip_ids: list[int] = Field(default_factory=list)
+    last_heartbeat: Optional[Any] = None
+    start_time: Optional[Any] = None
+    finish_time: Optional[Any] = None
+
+
+@register_kind
+class Worker(ApiObject):
+    """One worker process bound to TPU chips (≈ a Pod with replica-type/index
+    labels `training.kubeflow.org/replica-{type,index}` in the reference)."""
+
+    KIND = "Worker"
+    API_VERSION = "training.tpu.kubeflow.dev/v1"
+
+    spec: WorkerSpec
+    status: WorkerStatus = Field(default_factory=WorkerStatus)
+
+
+def worker_name(job_name: str, replica_type: str, index: int) -> str:
+    """Stable worker naming (≈ "<job>-<type>-<index>" pod names upstream)."""
+    return f"{job_name}-{replica_type}-{index}"

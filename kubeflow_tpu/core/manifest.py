@@ -1,0 +1,48 @@
+"""YAML manifest loading/dumping (≈ `kubectl apply -f` UX).
+
+Multi-document YAML files map to lists of typed ApiObjects via the kind
+registry."""
+
+from __future__ import annotations
+
+import io
+import pathlib
+from typing import Any, Union
+
+import yaml
+
+from kubeflow_tpu.core.object import ApiObject
+from kubeflow_tpu.core.registry import lookup_kind
+
+
+def load_manifest(doc: Union[str, dict[str, Any]]) -> ApiObject:
+    """Load a single manifest from a YAML string or pre-parsed dict."""
+    if isinstance(doc, str):
+        doc = yaml.safe_load(doc)
+    if not isinstance(doc, dict):
+        raise ValueError(f"manifest must be a mapping, got {type(doc)}")
+    kind = doc.get("kind")
+    if not kind:
+        raise ValueError("manifest missing 'kind'")
+    cls = lookup_kind(kind)
+    return cls.from_manifest(doc)
+
+
+def load_manifests(source: Union[str, pathlib.Path]) -> list[ApiObject]:
+    """Load all documents from a YAML string or file path."""
+    if isinstance(source, pathlib.Path) or (
+        isinstance(source, str) and "\n" not in source and source.endswith((".yaml", ".yml"))
+    ):
+        text = pathlib.Path(source).read_text()
+    else:
+        text = str(source)
+    out = []
+    for doc in yaml.safe_load_all(io.StringIO(text)):
+        if doc is None:
+            continue
+        out.append(load_manifest(doc))
+    return out
+
+
+def dump_manifest(obj: ApiObject) -> str:
+    return yaml.safe_dump(obj.to_manifest(), sort_keys=False)

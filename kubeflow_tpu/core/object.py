@@ -1,0 +1,139 @@
+"""Base object model for all platform API objects.
+
+Mirrors the Kubernetes object convention the reference's CRDs follow
+(apiVersion/kind/metadata/spec/status with typed conditions) — see SURVEY.md
+§2.2 (upstream: kubeflow.org/v1 shared types `JobCondition`, `ReplicaStatus`;
+apimachinery `ObjectMeta`). Rebuilt here as pydantic models so specs are
+validated at admission time rather than by a webhook zoo.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, ClassVar, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+def utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+class ObjectMeta(BaseModel):
+    """Object identity + bookkeeping (≈ metav1.ObjectMeta)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = Field(default_factory=dict)
+    annotations: dict[str, str] = Field(default_factory=dict)
+    uid: Optional[str] = None
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: Optional[datetime.datetime] = None
+    deletion_timestamp: Optional[datetime.datetime] = None
+    owner: Optional[str] = None  # "Kind/namespace/name" of the owning object
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class Condition(BaseModel):
+    """Typed status condition (≈ JobCondition in the reference's shared types).
+
+    The reference drives all user-facing job state through an ordered list of
+    conditions (Created/Running/Restarting/Succeeded/Failed); we keep the same
+    shape so status semantics carry over 1:1.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    type: str
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition_time: datetime.datetime = Field(default_factory=utcnow)
+
+
+class ConditionMixin(BaseModel):
+    """Shared condition bookkeeping for status objects."""
+
+    conditions: list[Condition] = Field(default_factory=list)
+
+    def get_condition(self, ctype: str) -> Optional[Condition]:
+        for c in self.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+    def has_condition(self, ctype: str, status: bool = True) -> bool:
+        c = self.get_condition(ctype)
+        return c is not None and c.status == status
+
+    def set_condition(
+        self, ctype: str, status: bool = True, reason: str = "", message: str = ""
+    ) -> Condition:
+        cond = self.get_condition(ctype)
+        if cond is not None:
+            if cond.status != status or cond.reason != reason or cond.message != message:
+                cond.status = status
+                cond.reason = reason
+                cond.message = message
+                cond.last_transition_time = utcnow()
+            return cond
+        cond = Condition(type=ctype, status=status, reason=reason, message=message)
+        self.conditions.append(cond)
+        return cond
+
+
+class ApiObject(BaseModel):
+    """Base class for every declarative platform object.
+
+    Subclasses set ``kind`` (ClassVar) and define ``spec``/``status`` fields.
+    ``api_version`` pins the schema family like the reference's group/version
+    strings (kubeflow.org/v1, serving.kserve.io/v1beta1, ...).
+    """
+
+    model_config = ConfigDict(extra="forbid", validate_assignment=True)
+
+    KIND: ClassVar[str] = "ApiObject"
+    API_VERSION: ClassVar[str] = "tpu.kubeflow.dev/v1"
+
+    metadata: ObjectMeta
+
+    @property
+    def kind(self) -> str:
+        return type(self).KIND
+
+    @property
+    def key(self) -> str:
+        return f"{self.kind}/{self.metadata.namespace}/{self.metadata.name}"
+
+    def to_manifest(self) -> dict[str, Any]:
+        d = self.model_dump(mode="json", exclude_none=True)
+        return {"apiVersion": type(self).API_VERSION, "kind": self.kind, **d}
+
+    @classmethod
+    def from_manifest(cls, doc: dict[str, Any]) -> "ApiObject":
+        doc = dict(doc)
+        doc.pop("apiVersion", None)
+        kind = doc.pop("kind", None)
+        if kind is not None and kind != cls.KIND:
+            raise ValueError(f"manifest kind {kind!r} != {cls.KIND!r}")
+        return cls.model_validate(doc)
+
+
+# "Kind/namespace/name" reference helpers -------------------------------------
+
+def object_ref(obj: ApiObject) -> str:
+    return obj.key
+
+
+def parse_ref(ref: str) -> tuple[str, str, str]:
+    kind, namespace, name = ref.split("/", 2)
+    return kind, namespace, name
+
+
+StoredObject = ApiObject

@@ -1,0 +1,148 @@
+"""Pipeline/PipelineRun API types — KFP-analog specs.
+
+Upstream shape (SURVEY.md §2.5; (U) kubeflow/pipelines): the SDK compiles a
+Python DSL to an IR (PipelineSpec proto → YAML); the API server stores
+pipelines/versions/runs and compiles IR → Argo Workflow; ScheduledWorkflow
+drives recurring runs. Here the IR is a typed DAG of component executions and
+the executor is in-process (pipelines/ package); these objects are the stored
+API surface.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from kubeflow_tpu.core.object import ApiObject, ConditionMixin
+from kubeflow_tpu.core.registry import register_kind
+
+
+class ComponentIR(BaseModel):
+    """One node type: a Python component (entrypoint + typed io)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    entrypoint: str                       # "module:function" or registered name
+    inputs: dict[str, str] = Field(default_factory=dict)    # name -> type name
+    outputs: dict[str, str] = Field(default_factory=dict)
+    cache_enabled: bool = True
+    resources: dict[str, Any] = Field(default_factory=dict)  # e.g. {"tpu_chips": 1}
+
+
+class TaskIR(BaseModel):
+    """One DAG node: a component invocation with wired inputs."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    component: str                        # ComponentIR name
+    # input name -> {"constant": v} | {"task_output": "task.output"} | {"param": "p"}
+    arguments: dict[str, dict[str, Any]] = Field(default_factory=dict)
+    depends_on: list[str] = Field(default_factory=list)
+    # control flow (≈ dsl.Condition / ParallelFor)
+    condition: Optional[str] = None       # task runs iff expr over params/outputs is truthy
+    iterate_over: Optional[dict[str, Any]] = None  # {"input": name, "items": ... | {"param": p}}
+    exit_handler: bool = False
+
+
+class PipelineIR(BaseModel):
+    """Compiled pipeline (≈ KFP v2 IR PipelineSpec YAML)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    description: str = ""
+    parameters: dict[str, Any] = Field(default_factory=dict)   # name -> default
+    components: dict[str, ComponentIR] = Field(default_factory=dict)
+    tasks: dict[str, TaskIR] = Field(default_factory=dict)
+
+
+class PipelineSpecModel(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    ir: PipelineIR
+    version: str = "v1"
+
+
+@register_kind
+class Pipeline(ApiObject):
+    KIND = "Pipeline"
+    API_VERSION = "pipelines.tpu.kubeflow.dev/v1"
+
+    spec: PipelineSpecModel
+
+
+class RunPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class TaskExecutionStatus(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    phase: RunPhase = RunPhase.PENDING
+    cached: bool = False
+    skipped: bool = False          # condition evaluated false
+    execution_id: Optional[int] = None   # metadata-store execution id
+    outputs: dict[str, Any] = Field(default_factory=dict)
+    error: str = ""
+
+
+class PipelineRunSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    pipeline: Optional[str] = None        # stored Pipeline name, or inline IR:
+    ir: Optional[PipelineIR] = None
+    parameters: dict[str, Any] = Field(default_factory=dict)
+    cache_enabled: bool = True
+
+
+class PipelineRunStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    phase: RunPhase = RunPhase.PENDING
+    tasks: dict[str, TaskExecutionStatus] = Field(default_factory=dict)
+    outputs: dict[str, Any] = Field(default_factory=dict)
+
+
+@register_kind
+class PipelineRun(ApiObject):
+    KIND = "PipelineRun"
+    API_VERSION = "pipelines.tpu.kubeflow.dev/v1"
+
+    spec: PipelineRunSpec
+    status: PipelineRunStatus = Field(default_factory=PipelineRunStatus)
+
+
+class ScheduledRunSpec(BaseModel):
+    """Recurring runs (≈ ScheduledWorkflow CRD): fixed interval or cron-lite."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    pipeline: str
+    interval_seconds: Optional[float] = None
+    cron: Optional[str] = None            # "m h dom mon dow" subset
+    parameters: dict[str, Any] = Field(default_factory=dict)
+    max_concurrency: int = 1
+    enabled: bool = True
+
+
+class ScheduledRunStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    last_triggered: Optional[Any] = None
+    runs_started: int = 0
+
+
+@register_kind
+class ScheduledRun(ApiObject):
+    KIND = "ScheduledRun"
+    API_VERSION = "pipelines.tpu.kubeflow.dev/v1"
+
+    spec: ScheduledRunSpec
+    status: ScheduledRunStatus = Field(default_factory=ScheduledRunStatus)

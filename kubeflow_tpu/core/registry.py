@@ -1,0 +1,37 @@
+"""Kind registry: maps manifest ``kind`` strings to spec classes.
+
+Analog of the CRD registration the reference does via apimachinery scheme
+builders (each repo's pkg/apis/.../register.go)."""
+
+from __future__ import annotations
+
+from typing import Type
+
+from kubeflow_tpu.core.object import ApiObject
+
+kind_registry: dict[str, Type[ApiObject]] = {}
+
+
+def register_kind(cls: Type[ApiObject]) -> Type[ApiObject]:
+    """Class decorator registering an ApiObject subclass by its KIND."""
+    existing = kind_registry.get(cls.KIND)
+    if existing is not None and existing is not cls:
+        raise ValueError(f"kind {cls.KIND!r} already registered to {existing}")
+    kind_registry[cls.KIND] = cls
+    return cls
+
+
+def lookup_kind(kind: str) -> Type[ApiObject]:
+    _ensure_kinds_loaded()
+    if kind not in kind_registry:
+        raise KeyError(f"unknown kind {kind!r}; known: {sorted(kind_registry)}")
+    return kind_registry[kind]
+
+
+def _ensure_kinds_loaded() -> None:
+    """Import every module that registers kinds (lazy to avoid import cycles)."""
+    import kubeflow_tpu.core.jobs  # noqa: F401
+    import kubeflow_tpu.core.serving  # noqa: F401
+    import kubeflow_tpu.core.tuning  # noqa: F401
+    import kubeflow_tpu.core.pipeline_specs  # noqa: F401
+    import kubeflow_tpu.core.workspace_specs  # noqa: F401

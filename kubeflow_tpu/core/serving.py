@@ -1,0 +1,131 @@
+"""InferenceService API types — KServe-analog serving specs.
+
+Upstream shape (SURVEY.md §2.3; (U) kserve pkg/apis/serving/v1beta1):
+``InferenceService{predictor{model{modelFormat,storageUri,runtime},
+minReplicas,maxReplicas,scaleTarget,canaryTrafficPercent}, transformer,
+explainer}`` plus ``ServingRuntime`` mapping modelFormat→runtime.
+
+TPU-native differences: the predictor runtime is a JAX continuous-batching
+engine (paged KV cache) rather than a container image; scaling unit is a
+model-server process pinned to chips; canary is a traffic split between
+generations of the same service.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+from kubeflow_tpu.core.object import ApiObject, ConditionMixin
+from kubeflow_tpu.core.registry import register_kind
+from kubeflow_tpu.core.jobs import ParallelismSpec, TPUResourceSpec
+
+
+class ModelFormat(str, enum.Enum):
+    LLM = "llm"               # decoder LLM → continuous-batching engine
+    ORBAX = "orbax"           # generic orbax checkpoint + registered model fn
+    VISION = "vision"         # ViT/CLIP-style encoder
+    CUSTOM = "custom"         # user-registered Model class
+
+
+class ModelSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid", protected_namespaces=())
+
+    model_format: ModelFormat = ModelFormat.LLM
+    storage_uri: Optional[str] = None   # file:///..., ckpt://..., hf://... (gated)
+    runtime: Optional[str] = None       # explicit ServingRuntime name
+    model_name: Optional[str] = None    # name exposed on the protocol surface
+    config: dict[str, Any] = Field(default_factory=dict)  # model arch/config
+
+
+class BatchingSpec(BaseModel):
+    """Continuous-batching engine knobs (≈ vLLM engine args in the HF runtime)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    max_batch_size: int = 8          # decode batch slots
+    max_seq_len: int = 2048
+    page_size: int = 128             # KV cache page (tokens)
+    max_pages: Optional[int] = None  # default: sized from HBM budget
+    chunked_prefill_tokens: int = 512
+    prefill_buckets: list[int] = Field(default_factory=lambda: [128, 512, 2048])
+
+
+class PredictorSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    model: ModelSpec
+    min_replicas: int = 1
+    max_replicas: int = 1
+    scale_target: int = 4            # target in-flight requests per replica (≈ KPA concurrency)
+    scale_metric: str = "concurrency"
+    canary_traffic_percent: Optional[int] = None
+    resources: TPUResourceSpec = Field(default_factory=TPUResourceSpec)
+    parallelism: ParallelismSpec = Field(default_factory=ParallelismSpec)
+    batching: BatchingSpec = Field(default_factory=BatchingSpec)
+
+    @model_validator(mode="after")
+    def _check(self) -> "PredictorSpec":
+        if self.min_replicas < 0 or self.max_replicas < max(self.min_replicas, 1):
+            raise ValueError("invalid replica bounds")
+        if self.canary_traffic_percent is not None and not (
+            0 <= self.canary_traffic_percent <= 100
+        ):
+            raise ValueError("canary_traffic_percent must be in [0,100]")
+        return self
+
+
+class TransformerSpec(BaseModel):
+    """Pre/post-processing hop (≈ kserve transformer): a registered callable."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    handler: str                     # registered name or "module:function"
+    config: dict[str, Any] = Field(default_factory=dict)
+
+
+class InferenceServiceSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    predictor: PredictorSpec
+    transformer: Optional[TransformerSpec] = None
+
+
+class InferenceServiceStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    url: Optional[str] = None
+    ready_replicas: int = 0
+    desired_replicas: int = 0
+    traffic: dict[str, int] = Field(default_factory=dict)  # generation -> percent
+    latest_ready_generation: Optional[int] = None
+
+
+@register_kind
+class InferenceService(ApiObject):
+    KIND = "InferenceService"
+    API_VERSION = "serving.tpu.kubeflow.dev/v1"
+
+    spec: InferenceServiceSpec
+    status: InferenceServiceStatus = Field(default_factory=InferenceServiceStatus)
+
+
+class ServingRuntimeSpec(BaseModel):
+    """Maps a model format to an engine implementation + defaults
+    (≈ ServingRuntime/ClusterServingRuntime CRDs)."""
+
+    model_config = ConfigDict(extra="forbid", protected_namespaces=())
+
+    supported_formats: list[ModelFormat]
+    engine: str                      # registered engine factory name
+    defaults: dict[str, Any] = Field(default_factory=dict)
+
+
+@register_kind
+class ServingRuntime(ApiObject):
+    KIND = "ServingRuntime"
+    API_VERSION = "serving.tpu.kubeflow.dev/v1"
+
+    spec: ServingRuntimeSpec

@@ -1,0 +1,343 @@
+"""In-process versioned object store with watch streams.
+
+Plays the role kube-apiserver+etcd play for the reference's controllers
+(SURVEY.md §2 layer L3): CRUD with optimistic concurrency (resourceVersion),
+monotonically versioned events, and watch streams that controllers consume.
+Thread-safe; watches are bounded queues so a stuck consumer cannot wedge
+writers.
+
+Design notes (TPU-native rebuild, not a port): there is no etcd/network hop —
+controllers, the store, and the scheduler live in one process per control
+plane, which is the honest analog for a single-host TPU-slice controller. The
+interface is deliberately narrow (get/list/create/update/delete/watch) so a
+real distributed backend could replace it.
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional, Type, TypeVar
+
+from kubeflow_tpu.core.object import ApiObject, utcnow
+
+T = TypeVar("T", bound=ApiObject)
+
+
+class EventType(str, enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: EventType
+    object: ApiObject
+    resource_version: int
+
+
+class ConflictError(RuntimeError):
+    """Optimistic-concurrency failure (stale resource_version)."""
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(RuntimeError):
+    pass
+
+
+@dataclass
+class _Watcher:
+    q: "queue.Queue[Optional[WatchEvent]]"
+    kinds: Optional[frozenset[str]]
+    namespace: Optional[str]
+    closed: bool = False
+
+
+class ObjectStore:
+    """Versioned object store. Keys are (kind, namespace, name)."""
+
+    def __init__(self, watch_queue_size: int = 4096):
+        self._lock = threading.RLock()
+        self._objects: dict[tuple[str, str, str], ApiObject] = {}
+        self._rv = 0
+        self._watchers: list[_Watcher] = []
+        self._watch_queue_size = watch_queue_size
+
+    # -- CRUD -----------------------------------------------------------------
+
+    def create(self, obj: T) -> T:
+        with self._lock:
+            k = (obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if k in self._objects:
+                raise AlreadyExistsError(f"{obj.key} already exists")
+            self._rv += 1
+            obj = obj.model_copy(deep=True)
+            obj.metadata.uid = obj.metadata.uid or uuid.uuid4().hex[:12]
+            obj.metadata.resource_version = self._rv
+            obj.metadata.generation = 1
+            obj.metadata.creation_timestamp = utcnow()
+            self._objects[k] = obj
+            self._notify(WatchEvent(EventType.ADDED, obj, self._rv))
+            return obj.model_copy(deep=True)
+
+    def get(self, cls: Type[T], name: str, namespace: str = "default") -> T:
+        with self._lock:
+            k = (cls.KIND, namespace, name)
+            if k not in self._objects:
+                raise NotFoundError(f"{cls.KIND}/{namespace}/{name} not found")
+            return self._objects[k].model_copy(deep=True)  # type: ignore[return-value]
+
+    def try_get(self, cls: Type[T], name: str, namespace: str = "default") -> Optional[T]:
+        try:
+            return self.get(cls, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        cls: Type[T],
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[T]:
+        with self._lock:
+            out = []
+            for (kind, ns, _), obj in sorted(self._objects.items()):
+                if kind != cls.KIND:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and any(
+                    obj.metadata.labels.get(k) != v for k, v in label_selector.items()
+                ):
+                    continue
+                out.append(obj.model_copy(deep=True))
+            return out  # type: ignore[return-value]
+
+    def update(self, obj: T, *, check_version: bool = True) -> T:
+        """Update with optimistic concurrency; bumps generation on spec change."""
+        with self._lock:
+            k = (obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if k not in self._objects:
+                raise NotFoundError(f"{obj.key} not found")
+            current = self._objects[k]
+            if check_version and obj.metadata.resource_version != current.metadata.resource_version:
+                raise ConflictError(
+                    f"{obj.key}: stale resource_version "
+                    f"{obj.metadata.resource_version} != {current.metadata.resource_version}"
+                )
+            self._rv += 1
+            obj = obj.model_copy(deep=True)
+            obj.metadata.resource_version = self._rv
+            obj.metadata.uid = current.metadata.uid
+            obj.metadata.creation_timestamp = current.metadata.creation_timestamp
+            old_spec = getattr(current, "spec", None)
+            new_spec = getattr(obj, "spec", None)
+            if old_spec != new_spec:
+                obj.metadata.generation = current.metadata.generation + 1
+            else:
+                obj.metadata.generation = current.metadata.generation
+            self._objects[k] = obj
+            self._notify(WatchEvent(EventType.MODIFIED, obj, self._rv))
+            return obj.model_copy(deep=True)
+
+    def update_status(self, obj: T) -> T:
+        """Status-subresource style update: retries on spec-side conflicts by
+        re-reading and reapplying status (controllers own status, users own spec)."""
+        with self._lock:
+            k = (obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if k not in self._objects:
+                raise NotFoundError(f"{obj.key} not found")
+            current = self._objects[k].model_copy(deep=True)
+            if hasattr(current, "status"):
+                current.status = getattr(obj, "status")
+            return self.update(current, check_version=False)
+
+    def delete(self, cls: Type[T], name: str, namespace: str = "default") -> T:
+        with self._lock:
+            k = (cls.KIND, namespace, name)
+            if k not in self._objects:
+                raise NotFoundError(f"{cls.KIND}/{namespace}/{name} not found")
+            obj = self._objects.pop(k)
+            self._rv += 1
+            obj = obj.model_copy(deep=True)
+            obj.metadata.deletion_timestamp = utcnow()
+            self._notify(WatchEvent(EventType.DELETED, obj, self._rv))
+            return obj  # type: ignore[return-value]
+
+    def apply(self, obj: T) -> T:
+        """Create-or-update by key (≈ kubectl apply). Controllers own status:
+        an apply never clobbers the stored status subresource."""
+        with self._lock:
+            k = (obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if k not in self._objects:
+                return self.create(obj)
+            current = self._objects[k]
+            obj = obj.model_copy(deep=True)
+            obj.metadata.resource_version = current.metadata.resource_version
+            if hasattr(current, "status") and hasattr(obj, "status"):
+                obj.status = getattr(current, "status").model_copy(deep=True)
+            return self.update(obj)
+
+    # -- ownership / garbage collection --------------------------------------
+
+    def list_owned(self, owner: ApiObject) -> list[ApiObject]:
+        ref = owner.key
+        with self._lock:
+            return [
+                o.model_copy(deep=True)
+                for o in self._objects.values()
+                if o.metadata.owner == ref
+            ]
+
+    def delete_owned(self, owner: ApiObject) -> int:
+        """Cascade-delete children (≈ ownerReference garbage collection)."""
+        n = 0
+        for child in self.list_owned(owner):
+            try:
+                self.delete(type(child), child.metadata.name, child.metadata.namespace)
+                n += 1
+            except NotFoundError:
+                pass
+        return n
+
+    # -- watch ----------------------------------------------------------------
+
+    def watch(
+        self,
+        kinds: Optional[list[str]] = None,
+        namespace: Optional[str] = None,
+        replay: bool = True,
+    ) -> "Watch":
+        """Open a watch stream. With ``replay=True``, current objects are
+        replayed as synthetic ADDED events first (≈ informer list+watch)."""
+        w = _Watcher(
+            q=queue.Queue(maxsize=self._watch_queue_size),
+            kinds=frozenset(kinds) if kinds is not None else None,
+            namespace=namespace,
+        )
+        with self._lock:
+            if replay:
+                for (kind, ns, _), obj in sorted(self._objects.items()):
+                    if w.kinds is not None and kind not in w.kinds:
+                        continue
+                    if w.namespace is not None and ns != w.namespace:
+                        continue
+                    w.q.put(WatchEvent(EventType.ADDED, obj.model_copy(deep=True),
+                                       obj.metadata.resource_version))
+            self._watchers.append(w)
+        return Watch(self, w)
+
+    def _notify(self, ev: WatchEvent) -> None:
+        dropped = []
+        for w in list(self._watchers):
+            if w.closed:
+                continue
+            if w.kinds is not None and ev.object.kind not in w.kinds:
+                continue
+            if w.namespace is not None and ev.object.metadata.namespace != w.namespace:
+                continue
+            try:
+                w.q.put_nowait(
+                    WatchEvent(ev.type, ev.object.model_copy(deep=True), ev.resource_version)
+                )
+            except queue.Full:
+                # Slow consumer: drop it rather than wedging the store; the
+                # consumer sees the stream end and must re-list (same contract
+                # as an expired apiserver watch). Make room for the end-of-
+                # stream sentinel — the queue is full by definition here.
+                w.closed = True
+                dropped.append(w)
+                try:
+                    w.q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    w.q.put_nowait(None)
+                except queue.Full:
+                    pass
+        for w in dropped:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+    def _remove_watcher(self, w: _Watcher) -> None:
+        with self._lock:
+            w.closed = True
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+
+class Watch:
+    """Iterable watch stream handle.
+
+    A stream can end for two reasons: the consumer called :meth:`close`, or
+    the store dropped it as a slow consumer. Either way :attr:`ended` becomes
+    True — pollers using :meth:`next`/:meth:`drain` must check it and re-list,
+    exactly like an expired apiserver watch."""
+
+    def __init__(self, store: ObjectStore, watcher: _Watcher):
+        self._store = store
+        self._watcher = watcher
+        self._ended = False
+
+    @property
+    def ended(self) -> bool:
+        """True once the stream is over (closed or dropped); no more events."""
+        return self._ended
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while not self._ended:
+            ev = self._watcher.q.get()
+            if ev is None:
+                self._ended = True
+                return
+            yield ev
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Next event, or None on timeout OR stream end (check .ended)."""
+        if self._ended:
+            return None
+        try:
+            ev = self._watcher.q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if ev is None:
+            self._ended = True
+            return None
+        return ev
+
+    def drain(self) -> list[WatchEvent]:
+        out = []
+        while not self._ended:
+            try:
+                ev = self._watcher.q.get_nowait()
+            except queue.Empty:
+                return out
+            if ev is None:
+                self._ended = True
+                break
+            out.append(ev)
+        return out
+
+    def close(self) -> None:
+        self._store._remove_watcher(self._watcher)
+        # Wake any consumer blocked in q.get(); tolerate a full queue — the
+        # consumer will drain real events first and next() treats the flag
+        # as authoritative once set.
+        self._ended = True
+        try:
+            self._watcher.q.put_nowait(None)
+        except queue.Full:
+            pass
+
+    def __enter__(self) -> "Watch":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,208 @@
+"""Experiment/Suggestion/Trial API types — Katib-analog HPO specs.
+
+Upstream shape (SURVEY.md §2.4; (U) katib pkg/apis/controller.kubeflow.org/
+v1beta1): ``Experiment{parameters[{name,type,feasibleSpace}], objective{type,
+goal,metricName}, algorithm{name,settings}, parallelTrialCount, maxTrialCount,
+maxFailedTrialCount, trialTemplate, resumePolicy, earlyStopping}``;
+``Suggestion`` (per-experiment assignment state); ``Trial`` (one per run).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, List, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+from kubeflow_tpu.core.object import ApiObject, ConditionMixin
+from kubeflow_tpu.core.registry import register_kind
+
+
+class ParameterType(str, enum.Enum):
+    DOUBLE = "double"
+    INT = "int"
+    CATEGORICAL = "categorical"
+    DISCRETE = "discrete"
+
+
+class FeasibleSpace(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    min: Optional[float] = None
+    max: Optional[float] = None
+    step: Optional[float] = None
+    # field keeps katib's name `list`; typing.List avoids the name shadowing
+    list: Optional[List[Union[str, float, int]]] = None
+    log_scale: bool = False
+
+
+class ParameterSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    type: ParameterType
+    feasible_space: FeasibleSpace
+
+    @model_validator(mode="after")
+    def _check(self) -> "ParameterSpec":
+        fs = self.feasible_space
+        if self.type in (ParameterType.DOUBLE, ParameterType.INT):
+            if fs.min is None or fs.max is None:
+                raise ValueError(f"{self.name}: numeric parameter needs min/max")
+            if fs.min > fs.max:
+                raise ValueError(f"{self.name}: min > max")
+        else:
+            if not fs.list:
+                raise ValueError(f"{self.name}: categorical/discrete needs list")
+        return self
+
+
+class ObjectiveType(str, enum.Enum):
+    MINIMIZE = "minimize"
+    MAXIMIZE = "maximize"
+
+
+class ObjectiveSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    type: ObjectiveType
+    metric_name: str
+    goal: Optional[float] = None
+    additional_metric_names: list[str] = Field(default_factory=list)
+
+
+class AlgorithmSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str = "random"  # random|grid|hyperband|tpe|gp_ei|cmaes
+    settings: dict[str, Any] = Field(default_factory=dict)
+
+
+class EarlyStoppingSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str = "medianstop"  # medianstop only, like katib's default
+    settings: dict[str, Any] = Field(default_factory=dict)
+
+
+class TrialTemplate(BaseModel):
+    """Template materialized into a trial worker JAXJob.
+
+    ``manifest`` is a JAXJob manifest dict with ``${trialParameters.<name>}``
+    placeholders substituted per-trial (same substitution contract as katib's
+    trialTemplate)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    manifest: dict[str, Any]
+    primary_metric_source: str = "stdout"  # stdout|file|push
+    metrics_file: Optional[str] = None
+
+
+class ResumePolicy(str, enum.Enum):
+    NEVER = "Never"
+    FROM_SUGGESTION = "FromSuggestion"  # ≈ katib FromVolume: keep algorithm state
+
+
+class ExperimentSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    parameters: list[ParameterSpec]
+    objective: ObjectiveSpec
+    algorithm: AlgorithmSpec = Field(default_factory=AlgorithmSpec)
+    parallel_trial_count: int = 3
+    max_trial_count: int = 12
+    max_failed_trial_count: int = 3
+    trial_template: TrialTemplate
+    early_stopping: Optional[EarlyStoppingSpec] = None
+    resume_policy: ResumePolicy = ResumePolicy.NEVER
+
+
+class OptimalTrial(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    trial_name: Optional[str] = None
+    parameter_assignments: dict[str, Any] = Field(default_factory=dict)
+    objective_value: Optional[float] = None
+    observations: dict[str, float] = Field(default_factory=dict)
+
+
+class ExperimentStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    trials: int = 0
+    trials_succeeded: int = 0
+    trials_failed: int = 0
+    trials_running: int = 0
+    trials_pruned: int = 0
+    current_optimal_trial: OptimalTrial = Field(default_factory=OptimalTrial)
+
+
+@register_kind
+class Experiment(ApiObject):
+    KIND = "Experiment"
+    API_VERSION = "tune.tpu.kubeflow.dev/v1"
+
+    spec: ExperimentSpec
+    status: ExperimentStatus = Field(default_factory=ExperimentStatus)
+
+
+class SuggestionSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    experiment: str  # owning experiment name
+    requests: int = 0  # total suggestions requested so far
+
+
+class TrialAssignment(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    name: str  # trial name the assignment is for
+    parameters: dict[str, Any]
+
+
+class SuggestionStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    assignments: list[TrialAssignment] = Field(default_factory=list)
+    algorithm_state: dict[str, Any] = Field(default_factory=dict)
+
+
+@register_kind
+class Suggestion(ApiObject):
+    KIND = "Suggestion"
+    API_VERSION = "tune.tpu.kubeflow.dev/v1"
+
+    spec: SuggestionSpec
+    status: SuggestionStatus = Field(default_factory=SuggestionStatus)
+
+
+class TrialSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    experiment: str
+    parameter_assignments: dict[str, Any]
+    worker_manifest: dict[str, Any]  # substituted JAXJob manifest
+    objective: ObjectiveSpec
+
+
+class TrialStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    observations: dict[str, list[tuple[int, float]]] = Field(default_factory=dict)
+    # metric -> [(step, value), ...]
+    final_objective: Optional[float] = None
+    pruned: bool = False
+
+    def latest(self, metric: str) -> Optional[float]:
+        obs = self.observations.get(metric)
+        return obs[-1][1] if obs else None
+
+
+@register_kind
+class Trial(ApiObject):
+    KIND = "Trial"
+    API_VERSION = "tune.tpu.kubeflow.dev/v1"
+
+    spec: TrialSpec
+    status: TrialStatus = Field(default_factory=TrialStatus)

@@ -1,0 +1,123 @@
+"""Notebook/Profile/PodDefault API types — workspace specs.
+
+Upstream shape (SURVEY.md §2.1; (U) kubeflow/kubeflow components):
+- Notebook CRD → StatefulSet + Service with idle culling via last-activity
+  (notebook-controller).
+- Profile CRD → per-user namespace + RBAC + quota (profile-controller).
+- PodDefault CRD → label-matched injection of env/volumes (admission-webhook).
+
+TPU-native mapping: a Notebook is a JAX-ready kernel/REPL session process with
+chips attached; a Profile is a namespace + quota record enforced by the gang
+allocator; PodDefaults inject env/config into any Worker whose labels match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from kubeflow_tpu.core.object import ApiObject, ConditionMixin
+from kubeflow_tpu.core.registry import register_kind
+from kubeflow_tpu.core.jobs import TPUResourceSpec
+
+
+class NotebookSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    image: str = "jax-notebook"           # kernel profile name (≈ container image)
+    resources: TPUResourceSpec = Field(default_factory=TPUResourceSpec)
+    env: dict[str, str] = Field(default_factory=dict)
+    volumes: list[str] = Field(default_factory=list)   # workspace dirs to mount
+    idle_cull_seconds: Optional[float] = 3600.0        # ≈ culler idle timeout
+    pod_default_labels: dict[str, str] = Field(default_factory=dict)
+
+
+class NotebookStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    phase: str = "Pending"        # Pending|Running|Culled|Failed
+    url: Optional[str] = None
+    pid: Optional[int] = None
+    last_activity: Optional[Any] = None
+
+
+@register_kind
+class Notebook(ApiObject):
+    KIND = "Notebook"
+    API_VERSION = "workspace.tpu.kubeflow.dev/v1"
+
+    spec: NotebookSpec
+    status: NotebookStatus = Field(default_factory=NotebookStatus)
+
+
+class QuotaSpec(BaseModel):
+    """ResourceQuota analog: caps on what a profile's namespace may consume."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    max_tpu_chips: Optional[int] = None
+    max_jobs: Optional[int] = None
+    max_notebooks: Optional[int] = None
+
+
+class ProfileSpec(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    owner: str                                  # user id/email
+    contributors: list[str] = Field(default_factory=list)  # ≈ KFAM contributors
+    quota: QuotaSpec = Field(default_factory=QuotaSpec)
+
+
+class ProfileStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    namespace_ready: bool = False
+    chips_in_use: int = 0
+
+
+@register_kind
+class Profile(ApiObject):
+    KIND = "Profile"
+    API_VERSION = "workspace.tpu.kubeflow.dev/v1"
+
+    spec: ProfileSpec
+    status: ProfileStatus = Field(default_factory=ProfileStatus)
+
+
+class PodDefaultSpec(BaseModel):
+    """Label-selector-matched injection into Workers/Notebooks
+    (≈ PodDefault mutating webhook)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    selector: dict[str, str] = Field(default_factory=dict)  # label match
+    env: dict[str, str] = Field(default_factory=dict)
+    volumes: list[str] = Field(default_factory=list)
+    annotations: dict[str, str] = Field(default_factory=dict)
+
+
+@register_kind
+class PodDefault(ApiObject):
+    KIND = "PodDefault"
+    API_VERSION = "workspace.tpu.kubeflow.dev/v1"
+
+    spec: PodDefaultSpec
+
+
+def matches_selector(labels: dict[str, str], selector: dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def apply_pod_defaults(
+    labels: dict[str, str],
+    env: dict[str, str],
+    defaults: list[PodDefault],
+) -> dict[str, str]:
+    """Merge matching PodDefaults' env over ``env`` (explicit env wins)."""
+    merged: dict[str, str] = {}
+    for pd in defaults:
+        if matches_selector(labels, pd.spec.selector):
+            merged.update(pd.spec.env)
+    merged.update(env)
+    return merged

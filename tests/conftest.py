@@ -1,0 +1,48 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Tests validate multi-chip sharding semantics without TPU hardware by running
+JAX on 8 virtual CPU devices (the driver separately dry-runs the multi-chip
+path; bench.py runs on the real chip). Must run before jax initializes."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def store():
+    from kubeflow_tpu.core.store import ObjectStore
+
+    return ObjectStore()
+
+
+@pytest.fixture()
+def tiny_job():
+    """A minimal valid JAXJob for controller tests."""
+    from kubeflow_tpu.core.jobs import (
+        JAXJob, JAXJobSpec, ReplicaSpec, WorkloadSpec, ParallelismSpec,
+        TPUResourceSpec,
+    )
+    from kubeflow_tpu.core.object import ObjectMeta
+
+    return JAXJob(
+        metadata=ObjectMeta(name="tiny", namespace="default"),
+        spec=JAXJobSpec(
+            replica_specs={
+                "worker": ReplicaSpec(
+                    replicas=2,
+                    template=WorkloadSpec(entrypoint="noop", config={"steps": 2}),
+                    resources=TPUResourceSpec(tpu_chips=1),
+                )
+            },
+            parallelism=ParallelismSpec(data=2),
+        ),
+    )

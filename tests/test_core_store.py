@@ -1,0 +1,137 @@
+"""Object store semantics: CRUD, optimistic concurrency, watch, ownership.
+
+Mirrors the reference's reliance on apiserver semantics (resourceVersion
+conflicts, informer list+watch replay) — SURVEY.md §4 pattern (b)."""
+
+import pytest
+
+from kubeflow_tpu.core.jobs import JAXJob, Worker, WorkerSpec, WorkloadSpec
+from kubeflow_tpu.core.object import ObjectMeta
+from kubeflow_tpu.core.store import (
+    AlreadyExistsError, ConflictError, EventType, NotFoundError, ObjectStore,
+)
+
+
+def make_worker(name="w0", job="default/tiny", index=0):
+    return Worker(
+        metadata=ObjectMeta(name=name),
+        spec=WorkerSpec(job=job, replica_index=index,
+                        template=WorkloadSpec(entrypoint="noop")),
+    )
+
+
+def test_create_get_roundtrip(store, tiny_job):
+    created = store.create(tiny_job)
+    assert created.metadata.uid
+    assert created.metadata.resource_version == 1
+    got = store.get(JAXJob, "tiny")
+    assert got.spec == tiny_job.spec
+    assert got.metadata.creation_timestamp is not None
+
+
+def test_create_duplicate_fails(store, tiny_job):
+    store.create(tiny_job)
+    with pytest.raises(AlreadyExistsError):
+        store.create(tiny_job)
+
+
+def test_get_missing_raises(store):
+    with pytest.raises(NotFoundError):
+        store.get(JAXJob, "nope")
+    assert store.try_get(JAXJob, "nope") is None
+
+
+def test_update_conflict_on_stale_version(store, tiny_job):
+    a = store.create(tiny_job)
+    b = store.get(JAXJob, "tiny")
+    a.spec.replica_specs["worker"].replicas = 4
+    a.spec.parallelism.data = 4
+    store.update(a)
+    b.spec.replica_specs["worker"].replicas = 8
+    with pytest.raises(ConflictError):
+        store.update(b)
+
+
+def test_generation_bumps_on_spec_change_only(store, tiny_job):
+    a = store.create(tiny_job)
+    assert a.metadata.generation == 1
+    a.status.set_condition("Created")
+    a = store.update_status(a)
+    assert a.metadata.generation == 1  # status-only: no generation bump
+    a.spec.replica_specs["worker"].template.config["steps"] = 5
+    a = store.update(a, check_version=False)
+    assert a.metadata.generation == 2
+
+
+def test_returned_objects_are_copies(store, tiny_job):
+    created = store.create(tiny_job)
+    created.metadata.labels["mutated"] = "yes"
+    assert "mutated" not in store.get(JAXJob, "tiny").metadata.labels
+
+
+def test_list_with_namespace_and_labels(store):
+    for i, ns in enumerate(["a", "a", "b"]):
+        w = make_worker(name=f"w{i}")
+        w.metadata.namespace = ns
+        w.metadata.labels = {"idx": str(i % 2)}
+        store.create(w)
+    assert len(store.list(Worker)) == 3
+    assert len(store.list(Worker, namespace="a")) == 2
+    assert len(store.list(Worker, label_selector={"idx": "0"})) == 2
+
+
+def test_watch_replay_and_live_events(store, tiny_job):
+    store.create(tiny_job)
+    with store.watch(kinds=["JAXJob"]) as w:
+        ev = w.next(timeout=1)
+        assert ev.type == EventType.ADDED and ev.object.metadata.name == "tiny"
+        job = store.get(JAXJob, "tiny")
+        job.status.set_condition("Created")
+        store.update_status(job)
+        ev = w.next(timeout=1)
+        assert ev.type == EventType.MODIFIED
+        store.delete(JAXJob, "tiny")
+        ev = w.next(timeout=1)
+        assert ev.type == EventType.DELETED
+
+
+def test_watch_kind_filter(store, tiny_job):
+    with store.watch(kinds=["Worker"]) as w:
+        store.create(tiny_job)
+        store.create(make_worker())
+        ev = w.next(timeout=1)
+        assert ev.object.kind == "Worker"
+        assert w.next(timeout=0.05) is None
+
+
+def test_ownership_cascade_delete(store, tiny_job):
+    job = store.create(tiny_job)
+    for i in range(3):
+        w = make_worker(name=f"tiny-worker-{i}", index=i)
+        w.metadata.owner = job.key
+        store.create(w)
+    assert len(store.list_owned(job)) == 3
+    assert store.delete_owned(job) == 3
+    assert store.list(Worker) == []
+
+
+def test_slow_watcher_dropped_without_breaking_writers(tiny_job):
+    """Overflowing a watch queue must drop the watcher, never raise on the
+    writing side (regression: sentinel put into a full queue raised Full)."""
+    store = ObjectStore(watch_queue_size=2)
+    w = store.watch(kinds=["JAXJob"])
+    for i in range(6):
+        j = tiny_job.model_copy(deep=True)
+        j.metadata.name = f"tiny-{i}"
+        store.create(j)  # must not raise
+    events = w.drain()
+    assert len(events) <= 2
+    assert len(store.list(JAXJob)) == 6
+
+
+def test_apply_create_or_update(store, tiny_job):
+    store.apply(tiny_job)
+    tiny_job.spec.replica_specs["worker"].template.config["steps"] = 9
+    out = store.apply(tiny_job)
+    assert out.spec.replica_specs["worker"].template.config["steps"] == 9
+    assert out.metadata.generation == 2
