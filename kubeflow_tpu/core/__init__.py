@@ -13,7 +13,7 @@ from kubeflow_tpu.core.object import (
     utcnow,
 )
 from kubeflow_tpu.core.store import ObjectStore, WatchEvent, EventType
-from kubeflow_tpu.core.registry import kind_registry, register_kind, lookup_kind
+from kubeflow_tpu.core.registry import known_kinds, register_kind, lookup_kind
 from kubeflow_tpu.core.manifest import load_manifest, load_manifests, dump_manifest
 
 __all__ = [
@@ -24,7 +24,7 @@ __all__ = [
     "ObjectStore",
     "WatchEvent",
     "EventType",
-    "kind_registry",
+    "known_kinds",
     "register_kind",
     "lookup_kind",
     "load_manifest",

@@ -190,6 +190,11 @@ class JAXJobSpec(BaseModel):
     def _check(self) -> "JAXJobSpec":
         if WORKER not in self.replica_specs:
             raise ValueError(f"replica_specs must contain {WORKER!r}")
+        unknown = set(self.replica_specs) - {WORKER}
+        if unknown:
+            # Single-role design: SPMD JAX has no PS/chief/launcher split.
+            # Rejecting here beats silently never scheduling the extra roles.
+            raise ValueError(f"unknown replica roles {sorted(unknown)}; only {WORKER!r}")
         w = self.replica_specs[WORKER]
         if w.replicas < 1:
             raise ValueError("worker.replicas must be >= 1")

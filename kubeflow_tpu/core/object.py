@@ -112,7 +112,9 @@ class ApiObject(BaseModel):
         return f"{self.kind}/{self.metadata.namespace}/{self.metadata.name}"
 
     def to_manifest(self) -> dict[str, Any]:
-        d = self.model_dump(mode="json", exclude_none=True)
+        # No exclude_none: an explicit None over a non-None default (e.g.
+        # idle_cull_seconds=None to disable culling) must survive round-trip.
+        d = self.model_dump(mode="json")
         return {"apiVersion": type(self).API_VERSION, "kind": self.kind, **d}
 
     @classmethod

@@ -13,7 +13,7 @@ from __future__ import annotations
 import enum
 from typing import Any, Optional
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, model_validator
 
 from kubeflow_tpu.core.object import ApiObject, ConditionMixin
 from kubeflow_tpu.core.registry import register_kind
@@ -101,6 +101,12 @@ class PipelineRunSpec(BaseModel):
     parameters: dict[str, Any] = Field(default_factory=dict)
     cache_enabled: bool = True
 
+    @model_validator(mode="after")
+    def _one_of(self) -> "PipelineRunSpec":
+        if (self.pipeline is None) == (self.ir is None):
+            raise ValueError("exactly one of 'pipeline' or 'ir' must be set")
+        return self
+
 
 class PipelineRunStatus(ConditionMixin):
     model_config = ConfigDict(extra="forbid")
@@ -130,6 +136,12 @@ class ScheduledRunSpec(BaseModel):
     parameters: dict[str, Any] = Field(default_factory=dict)
     max_concurrency: int = 1
     enabled: bool = True
+
+    @model_validator(mode="after")
+    def _one_of(self) -> "ScheduledRunSpec":
+        if (self.interval_seconds is None) == (self.cron is None):
+            raise ValueError("exactly one of 'interval_seconds' or 'cron' must be set")
+        return self
 
 
 class ScheduledRunStatus(ConditionMixin):

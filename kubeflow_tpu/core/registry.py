@@ -28,6 +28,13 @@ def lookup_kind(kind: str) -> Type[ApiObject]:
     return kind_registry[kind]
 
 
+def known_kinds() -> dict[str, Type[ApiObject]]:
+    """All registered kinds, forcing lazy module loads (use this, not the raw
+    ``kind_registry`` dict, which may be partially populated)."""
+    _ensure_kinds_loaded()
+    return dict(kind_registry)
+
+
 def _ensure_kinds_loaded() -> None:
     """Import every module that registers kinds (lazy to avoid import cycles)."""
     import kubeflow_tpu.core.jobs  # noqa: F401

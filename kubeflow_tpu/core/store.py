@@ -229,9 +229,23 @@ class ObjectStore:
                         continue
                     if w.namespace is not None and ns != w.namespace:
                         continue
-                    w.q.put(WatchEvent(EventType.ADDED, obj.model_copy(deep=True),
-                                       obj.metadata.resource_version))
-            self._watchers.append(w)
+                    try:
+                        # Never block while holding the store lock: an
+                        # overflowing replay ends the stream immediately
+                        # (consumer must use a larger queue and re-list).
+                        w.q.put_nowait(WatchEvent(
+                            EventType.ADDED, obj.model_copy(deep=True),
+                            obj.metadata.resource_version))
+                    except queue.Full:
+                        w.closed = True
+                        try:
+                            w.q.get_nowait()
+                        except queue.Empty:
+                            pass
+                        w.q.put_nowait(None)
+                        break
+            if not w.closed:
+                self._watchers.append(w)
         return Watch(self, w)
 
     def _notify(self, ev: WatchEvent) -> None:

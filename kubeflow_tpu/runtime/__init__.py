@@ -1,0 +1,32 @@
+"""TPU cluster runtime: topology, gang allocation, mesh construction, worker
+process management, and the jax.distributed bootstrap.
+
+This is the TPU-native replacement for the layer the reference delegates to
+Kubernetes (scheduler/kubelet), Volcano gang scheduling, and per-framework
+rendezvous env injection (MASTER_ADDR / TF_CONFIG / hostfile+mpirun) — see
+SURVEY.md §2.6 and §3.1. Here the rendezvous is `jax.distributed.initialize`
+with worker-0 as coordinator, and placement is slice-granular all-or-nothing
+gang allocation.
+"""
+
+from kubeflow_tpu.runtime.topology import (
+    ChipGeneration, SliceTopology, Cluster, detect_local_cluster,
+)
+from kubeflow_tpu.runtime.allocator import GangAllocator, GangRequest, GangAllocation
+from kubeflow_tpu.runtime.mesh import MESH_AXES, build_mesh, mesh_from_parallelism
+from kubeflow_tpu.runtime.bootstrap import WorkerEnv, bootstrap_worker
+
+__all__ = [
+    "ChipGeneration",
+    "SliceTopology",
+    "Cluster",
+    "detect_local_cluster",
+    "GangAllocator",
+    "GangRequest",
+    "GangAllocation",
+    "MESH_AXES",
+    "build_mesh",
+    "mesh_from_parallelism",
+    "WorkerEnv",
+    "bootstrap_worker",
+]

@@ -1,0 +1,182 @@
+"""Gang allocator: all-or-nothing placement of worker gangs onto TPU slices.
+
+TPU-native replacement for Volcano/scheduler-plugins gang scheduling in the
+reference ((U) training-operator pkg/controller.v1/common/pod.go PodGroup
+creation, minMember semantics — SURVEY.md §2.2#20): a gang either gets every
+chip it asked for on one slice (contiguous ICI domain) or stays queued —
+partial placement would deadlock ICI collectives, the exact failure gang
+scheduling exists to prevent.
+
+Queueing: priority (desc) then FIFO. Preemption is not automatic; callers may
+release a gang and re-enqueue a lower-priority one (the operator owns policy).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+
+
+@dataclass(frozen=True)
+class GangRequest:
+    """A request for num_workers processes x chips_per_worker chips,
+    co-located on a single slice (one ICI domain)."""
+
+    name: str                       # gang identity, e.g. "default/llama-pretrain"
+    num_workers: int
+    chips_per_worker: int = 1
+    priority: int = 0
+    queue: str = "default"
+    slice_name: Optional[str] = None   # pin to a specific slice
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_workers * self.chips_per_worker
+
+
+@dataclass
+class GangAllocation:
+    request: GangRequest
+    slice_name: str
+    # worker index -> chip ids on the slice (contiguous runs: ICI neighbors)
+    chip_assignment: dict[int, list[int]]
+
+    @property
+    def all_chips(self) -> list[int]:
+        return [c for chips in self.chip_assignment.values() for c in chips]
+
+
+class InsufficientCapacityError(RuntimeError):
+    """The request can never fit the cluster (not merely busy)."""
+
+
+class GangAllocator:
+    """Thread-safe all-or-nothing allocator over a slice inventory."""
+
+    def __init__(self, cluster: Cluster,
+                 quota_check: Optional[Callable[[GangRequest], Optional[str]]] = None):
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self._free: dict[str, set[int]] = {
+            s.name: set(range(s.num_chips)) for s in cluster.slices
+        }
+        self._allocations: dict[str, GangAllocation] = {}
+        self._pending: list[GangRequest] = []
+        self._seq = itertools.count()
+        self._order: dict[str, int] = {}   # FIFO tiebreak per gang name
+        self._quota_check = quota_check
+
+    # -- queries ---------------------------------------------------------------
+
+    def allocation(self, name: str) -> Optional[GangAllocation]:
+        with self._lock:
+            return self._allocations.get(name)
+
+    def pending(self) -> list[GangRequest]:
+        with self._lock:
+            return list(self._pending)
+
+    def free_chips(self, slice_name: str) -> int:
+        with self._lock:
+            return len(self._free.get(slice_name, ()))
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def submit(self, req: GangRequest) -> Optional[GangAllocation]:
+        """Enqueue and attempt placement. Returns the allocation if the gang
+        was placed immediately, None if queued. Raises if it can never fit."""
+        with self._lock:
+            if req.name in self._allocations:
+                return self._allocations[req.name]
+            if not self._fits_anywhere(req):
+                raise InsufficientCapacityError(
+                    f"gang {req.name}: {req.total_chips} chips "
+                    f"(slice={req.slice_name or 'any'}) exceeds cluster capacity"
+                )
+            if req.name not in self._order:
+                self._order[req.name] = next(self._seq)
+            if all(p.name != req.name for p in self._pending):
+                self._pending.append(req)
+            self._schedule_locked()
+            return self._allocations.get(req.name)
+
+    def release(self, name: str) -> bool:
+        """Free a gang's chips (or drop it from the queue); schedules waiters."""
+        with self._lock:
+            alloc = self._allocations.pop(name, None)
+            self._pending = [p for p in self._pending if p.name != name]
+            self._order.pop(name, None)
+            if alloc is None:
+                return False
+            self._free[alloc.slice_name].update(alloc.all_chips)
+            self._schedule_locked()
+            return True
+
+    def poll(self) -> list[GangAllocation]:
+        """Re-run scheduling; returns allocations newly placed this call."""
+        with self._lock:
+            before = set(self._allocations)
+            self._schedule_locked()
+            return [a for n, a in self._allocations.items() if n not in before]
+
+    # -- internals -------------------------------------------------------------
+
+    def _fits_anywhere(self, req: GangRequest) -> bool:
+        for s in self._cluster.slices:
+            if req.slice_name and s.name != req.slice_name:
+                continue
+            if s.num_chips >= req.total_chips:
+                return True
+        return False
+
+    def _schedule_locked(self) -> None:
+        # Priority desc, then submission order — strict: a blocked high-priority
+        # gang blocks lower ones on the same resources (no backfill yet, which
+        # keeps starvation impossible; backfill is a policy layer above).
+        self._pending.sort(key=lambda r: (-r.priority, self._order[r.name]))
+        placed: list[str] = []
+        for req in self._pending:
+            if self._quota_check is not None:
+                if self._quota_check(req) is not None:
+                    continue   # over quota: stays pending, doesn't block others
+            alloc = self._try_place(req)
+            if alloc is None:
+                break          # strict ordering: head-of-line blocks
+            self._allocations[req.name] = alloc
+            placed.append(req.name)
+        self._pending = [p for p in self._pending if p.name not in placed]
+
+    def _try_place(self, req: GangRequest) -> Optional[GangAllocation]:
+        for s in self._cluster.slices:
+            if req.slice_name and s.name != req.slice_name:
+                continue
+            free = self._free[s.name]
+            if len(free) < req.total_chips:
+                continue
+            # Prefer a contiguous run of chip ids (ids are laid out so that
+            # consecutive ids are ICI neighbors on the flattened torus), so a
+            # gang's collectives ride neighbor links. Fall back to any chips.
+            chips = self._contiguous_run(free, req.total_chips) or sorted(free)[: req.total_chips]
+            assignment = {
+                w: chips[w * req.chips_per_worker : (w + 1) * req.chips_per_worker]
+                for w in range(req.num_workers)
+            }
+            free.difference_update(chips)
+            return GangAllocation(request=req, slice_name=s.name, chip_assignment=assignment)
+        return None
+
+    @staticmethod
+    def _contiguous_run(free: set[int], n: int) -> Optional[list[int]]:
+        ids = sorted(free)
+        run: list[int] = []
+        for i in ids:
+            if run and i != run[-1] + 1:
+                run = []
+            run.append(i)
+            if len(run) == n:
+                return run
+        return None

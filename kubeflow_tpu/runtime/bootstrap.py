@@ -1,0 +1,183 @@
+"""Worker-side bootstrap: the TPU-native rendezvous protocol.
+
+This replaces the reference's per-framework env rendezvous — MASTER_ADDR/
+MASTER_PORT/RANK/WORLD_SIZE for PyTorchJob ((U) training-operator
+pkg/controller.v1/pytorch/envvar.go SetClusterSpec), TF_CONFIG for TFJob, and
+hostfile+ssh+mpirun for MPIJob — with a single env contract feeding
+``jax.distributed.initialize`` (SURVEY.md §2.6 "Distributed communication
+backend" row):
+
+    KFTPU_COORDINATOR_ADDRESS  worker-0's host:port (the coordination service)
+    KFTPU_NUM_PROCESSES        world size
+    KFTPU_PROCESS_ID           this worker's rank
+    KFTPU_JOB                  owning job "namespace/name"
+    KFTPU_REPLICA_INDEX        replica index (== process id for JAXJob)
+    KFTPU_ENTRYPOINT           registered entrypoint or "module:function"
+    KFTPU_CONFIG_JSON          entrypoint config (JSON)
+    KFTPU_PARALLELISM_JSON     mesh axis sizes (JSON)
+    KFTPU_PLATFORM             "axon" (real/sim chip) | "cpu" (virtual devices)
+    KFTPU_VIRTUAL_DEVICES      when platform=cpu: per-process device count
+    KFTPU_HEARTBEAT_FILE       file this worker touches every few seconds
+    KFTPU_WORKDIR              working/checkpoint directory
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+ENV_PREFIX = "KFTPU_"
+
+# Exit-code contract (RestartPolicy=ExitCode semantics, matching the
+# reference's convention: retryable >= 128, permanent < 128).
+EXIT_OK = 0
+EXIT_PERMANENT = 1
+EXIT_CONFIG_ERROR = 2
+EXIT_RETRYABLE = 128
+EXIT_PREEMPTED = 143  # SIGTERM
+
+
+@dataclasses.dataclass
+class WorkerEnv:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    job: str
+    replica_index: int
+    entrypoint: str
+    config: dict[str, Any]
+    parallelism: dict[str, int]
+    platform: str = "cpu"
+    virtual_devices: int = 1
+    heartbeat_file: Optional[str] = None
+    workdir: Optional[str] = None
+    rendezvous_timeout_seconds: float = 60.0
+
+    def to_env(self) -> dict[str, str]:
+        return {
+            "KFTPU_COORDINATOR_ADDRESS": self.coordinator_address,
+            "KFTPU_NUM_PROCESSES": str(self.num_processes),
+            "KFTPU_PROCESS_ID": str(self.process_id),
+            "KFTPU_JOB": self.job,
+            "KFTPU_REPLICA_INDEX": str(self.replica_index),
+            "KFTPU_ENTRYPOINT": self.entrypoint,
+            "KFTPU_CONFIG_JSON": json.dumps(self.config),
+            "KFTPU_PARALLELISM_JSON": json.dumps(self.parallelism),
+            "KFTPU_PLATFORM": self.platform,
+            "KFTPU_VIRTUAL_DEVICES": str(self.virtual_devices),
+            "KFTPU_RENDEZVOUS_TIMEOUT": str(self.rendezvous_timeout_seconds),
+            **({"KFTPU_HEARTBEAT_FILE": self.heartbeat_file} if self.heartbeat_file else {}),
+            **({"KFTPU_WORKDIR": self.workdir} if self.workdir else {}),
+        }
+
+    @classmethod
+    def from_env(cls, env: Optional[dict[str, str]] = None) -> "WorkerEnv":
+        e = env if env is not None else os.environ
+        try:
+            return cls(
+                coordinator_address=e["KFTPU_COORDINATOR_ADDRESS"],
+                num_processes=int(e["KFTPU_NUM_PROCESSES"]),
+                process_id=int(e["KFTPU_PROCESS_ID"]),
+                job=e.get("KFTPU_JOB", "default/unknown"),
+                replica_index=int(e.get("KFTPU_REPLICA_INDEX", e["KFTPU_PROCESS_ID"])),
+                entrypoint=e["KFTPU_ENTRYPOINT"],
+                config=json.loads(e.get("KFTPU_CONFIG_JSON", "{}")),
+                parallelism=json.loads(e.get("KFTPU_PARALLELISM_JSON", "{}")),
+                platform=e.get("KFTPU_PLATFORM", "cpu"),
+                virtual_devices=int(e.get("KFTPU_VIRTUAL_DEVICES", "1")),
+                heartbeat_file=e.get("KFTPU_HEARTBEAT_FILE"),
+                workdir=e.get("KFTPU_WORKDIR"),
+                rendezvous_timeout_seconds=float(e.get("KFTPU_RENDEZVOUS_TIMEOUT", "60")),
+            )
+        except (KeyError, ValueError) as exc:
+            raise SystemExit(EXIT_CONFIG_ERROR) from exc
+
+
+class Heartbeat:
+    """Touches a file every ``interval`` seconds from a daemon thread.
+
+    The failure detector: the controller declares a worker dead when the file
+    mtime goes stale (coordinator heartbeats in jax.distributed cover the
+    collective path; this covers the hung-Python / wedged-host case)."""
+
+    def __init__(self, path: str, interval: float = 2.0):
+        self.path = path
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.beat()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="heartbeat")
+        self._thread.start()
+
+    def beat(self) -> None:
+        with open(self.path, "w") as f:
+            f.write(str(time.time()))
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.beat()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def bootstrap_worker(wenv: Optional[WorkerEnv] = None):
+    """Initialize JAX distributed + build the mesh. Returns (env, mesh).
+
+    Must be called before any JAX device access in the worker process."""
+    wenv = wenv or WorkerEnv.from_env()
+
+    import jax
+
+    if wenv.platform == "cpu":
+        # Force this worker's own virtual-device count, replacing any
+        # inherited flag (e.g. the test runner's 8-device setting).
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={wenv.virtual_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        # The axon sitecustomize force-sets jax_platforms="axon,cpu"; the env
+        # var alone cannot override it (see memory: axon-jax-env-facts).
+        jax.config.update("jax_platforms", "cpu")
+
+    if wenv.num_processes > 1:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=wenv.coordinator_address,
+                num_processes=wenv.num_processes,
+                process_id=wenv.process_id,
+                initialization_timeout=int(wenv.rendezvous_timeout_seconds),
+            )
+        except Exception as exc:
+            # A partial gang (missing peer, dead coordinator) is transient at
+            # the job level: exit retryable so RestartPolicy=ExitCode re-gangs
+            # instead of failing the job (SURVEY.md §2.6 failure semantics).
+            # NOTE: the coordination client may LOG(FATAL) (process abort)
+            # before Python sees an exception — the operator therefore also
+            # treats ANY worker death before the gang reaches Running as a
+            # retryable gang failure, regardless of exit code.
+            print(f"rendezvous failed: {exc}", flush=True)
+            raise SystemExit(EXIT_RETRYABLE)
+
+    from kubeflow_tpu.runtime.mesh import build_mesh
+
+    mesh = build_mesh(wenv.parallelism) if wenv.parallelism else None
+    return wenv, mesh
+
+
+def free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

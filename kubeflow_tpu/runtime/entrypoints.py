@@ -1,0 +1,96 @@
+"""Entrypoint registry: what a Worker runs.
+
+Replaces the reference's container images: a WorkloadSpec.entrypoint names
+either a registered function here or a "module:function" dotted path. The
+callable signature is ``fn(ctx: WorkerContext) -> int | None`` (None == 0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import time
+from typing import Any, Callable, Optional
+
+from kubeflow_tpu.runtime.bootstrap import WorkerEnv
+
+
+@dataclasses.dataclass
+class WorkerContext:
+    env: WorkerEnv
+    mesh: Any = None             # jax.sharding.Mesh | None
+    heartbeat: Any = None        # Heartbeat | None
+
+    @property
+    def config(self) -> dict[str, Any]:
+        return self.env.config
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.env.process_id == 0
+
+
+EntrypointFn = Callable[[WorkerContext], Optional[int]]
+
+_registry: dict[str, EntrypointFn] = {}
+
+
+def register_entrypoint(name: str):
+    def deco(fn: EntrypointFn) -> EntrypointFn:
+        _registry[name] = fn
+        return fn
+    return deco
+
+
+def resolve_entrypoint(name: str) -> EntrypointFn:
+    _ensure_builtin()
+    if name in _registry:
+        return _registry[name]
+    if ":" in name:
+        module, attr = name.split(":", 1)
+        fn = getattr(importlib.import_module(module), attr)
+        return fn
+    raise KeyError(f"unknown entrypoint {name!r}; registered: {sorted(_registry)}")
+
+
+def _ensure_builtin() -> None:
+    # Trainer entrypoints self-register on import.
+    try:
+        import kubeflow_tpu.train.entrypoints  # noqa: F401
+    except ImportError:
+        pass
+
+
+# -- trivial built-ins used by tests and smoke runs ----------------------------
+
+@register_entrypoint("noop")
+def noop(ctx: WorkerContext) -> int:
+    return 0
+
+
+@register_entrypoint("sleep")
+def sleep(ctx: WorkerContext) -> int:
+    time.sleep(float(ctx.config.get("seconds", 1.0)))
+    return 0
+
+
+@register_entrypoint("fail")
+def fail(ctx: WorkerContext) -> int:
+    return int(ctx.config.get("exit_code", 1))
+
+
+@register_entrypoint("flaky")
+def flaky(ctx: WorkerContext) -> int:
+    """Fails with a retryable code until attempt file reaches a threshold —
+    used to test ExitCode restart semantics deterministically."""
+    import os
+
+    path = ctx.config["attempt_file"]
+    fail_times = int(ctx.config.get("fail_times", 1))
+    n = 0
+    if os.path.exists(path):
+        n = int(open(path).read() or 0)
+    open(path, "w").write(str(n + 1))
+    if n < fail_times:
+        return int(ctx.config.get("exit_code", 130))  # retryable (>=128)
+    return 0

@@ -1,0 +1,77 @@
+"""Device mesh construction from a ParallelismSpec.
+
+Axis design (SURVEY.md §2.6 "TPU-native equivalent" column): one canonical
+axis order, outermost → innermost by physical distance, so that
+latency-sensitive collectives land on nearest ICI neighbors:
+
+    dcn       — between slices (data-parallel over DCN; megascale-style)
+    pipeline  — stages (ppermute to ICI neighbors)
+    data      — replicated data parallel (gradient psum)
+    fsdp      — sharded data parallel (all-gather/reduce-scatter of params)
+    expert    — MoE expert parallel (all-to-all)
+    seq       — sequence/context parallel (ring attention KV ppermute)
+    model     — tensor parallel (per-layer psum/psum_scatter; innermost)
+
+All seven axes always exist on the mesh (size-1 axes cost nothing and keep
+PartitionSpec rules uniform). `jax.make_mesh` performs topology-aware device
+assignment on real TPU; on CPU it degrades to row-major order.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from kubeflow_tpu.core.jobs import ParallelismSpec
+
+MESH_AXES: tuple[str, ...] = (
+    "dcn", "pipeline", "data", "fsdp", "expert", "seq", "model",
+)
+
+
+def build_mesh(
+    axis_sizes: dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the canonical 7-axis mesh.
+
+    ``axis_sizes`` maps axis name → degree; missing axes default to 1. The
+    product must equal the device count."""
+    sizes = tuple(int(axis_sizes.get(a, 1)) for a in MESH_AXES)
+    n = int(np.prod(sizes))
+    if devices is None:
+        devices = jax.devices()
+    if n != len(devices):
+        raise ValueError(
+            f"mesh axes {dict(zip(MESH_AXES, sizes))} product {n} "
+            f"!= device count {len(devices)}"
+        )
+    try:
+        # Topology-aware assignment (ICI-locality) — works on real TPU slices.
+        return jax.make_mesh(sizes, MESH_AXES, devices=devices)
+    except TypeError:
+        # Older signature without devices kwarg.
+        dev_array = np.asarray(devices).reshape(sizes)
+        return Mesh(dev_array, MESH_AXES)
+
+
+def mesh_from_parallelism(
+    spec: ParallelismSpec,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    return build_mesh(spec.axis_sizes(), devices)
+
+
+def infer_parallelism(num_devices: int, *, prefer: str = "fsdp") -> ParallelismSpec:
+    """Default policy when a job doesn't pin axes: put everything on one axis
+    (fsdp by default — the right default for LLM pretraining at this scale)."""
+    return ParallelismSpec(**{prefer: num_devices})
+
+
+def batch_sharding_axes() -> tuple[str, ...]:
+    """Mesh axes the global batch dimension is sharded over (pipeline is NOT
+    one of them — microbatches flow through stages instead)."""
+    return ("dcn", "data", "fsdp")

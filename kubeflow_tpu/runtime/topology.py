@@ -1,0 +1,111 @@
+"""TPU slice topology model.
+
+The reference is topology-blind (SURVEY.md §2.6: `nvidia.com/gpu` resource
+counts, no ICI awareness). TPU-native scheduling is slice-granular: a job
+takes a whole sub-slice whose ICI torus shape determines the mesh. This module
+models generations (v4/v5e/v5p/v6e), slices, and their host/chip structure,
+and detects the local (sim or real) environment as a one-slice cluster.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class ChipGeneration(BaseModel):
+    """Hardware constants per TPU generation (public figures)."""
+
+    model_config = ConfigDict(extra="forbid", frozen=True)
+
+    name: str
+    hbm_gb: float
+    bf16_tflops: float           # peak dense bf16 TFLOP/s per chip
+    chips_per_host: int
+    torus_dims: int              # 3 for v4/v5p (3D torus), 2 for v5e/v6e
+
+
+GENERATIONS: dict[str, ChipGeneration] = {
+    "v4": ChipGeneration(name="v4", hbm_gb=32, bf16_tflops=275, chips_per_host=4, torus_dims=3),
+    "v5e": ChipGeneration(name="v5e", hbm_gb=16, bf16_tflops=197, chips_per_host=4, torus_dims=2),
+    "v5p": ChipGeneration(name="v5p", hbm_gb=95, bf16_tflops=459, chips_per_host=4, torus_dims=3),
+    "v6e": ChipGeneration(name="v6e", hbm_gb=32, bf16_tflops=918, chips_per_host=4, torus_dims=2),
+    # The axon PJRT sim presents "TPU v5 lite" == v5e.
+    "sim": ChipGeneration(name="sim", hbm_gb=16, bf16_tflops=197, chips_per_host=8, torus_dims=2),
+    "cpu": ChipGeneration(name="cpu", hbm_gb=4, bf16_tflops=0.1, chips_per_host=8, torus_dims=2),
+}
+
+
+class SliceTopology(BaseModel):
+    """One TPU slice: a contiguous ICI domain (e.g. v5p 4x4x4, v5e 4x2)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    name: str
+    generation: str = "v5e"
+    dims: tuple[int, ...] = (1,)      # ICI torus/mesh dims, e.g. (4, 4, 4)
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    @property
+    def gen(self) -> ChipGeneration:
+        return GENERATIONS[self.generation]
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.gen.chips_per_host)
+
+    @classmethod
+    def parse(cls, name: str, spec: str, generation: str = "v5e") -> "SliceTopology":
+        """Parse "4x4x4"-style topology strings (the CRD-facing format)."""
+        dims = tuple(int(d) for d in spec.lower().split("x"))
+        if not dims or any(d < 1 for d in dims):
+            raise ValueError(f"bad topology spec {spec!r}")
+        return cls(name=name, generation=generation, dims=dims)
+
+
+@dataclasses.dataclass
+class Cluster:
+    """Inventory of slices available to the control plane."""
+
+    slices: list[SliceTopology]
+
+    @property
+    def total_chips(self) -> int:
+        return sum(s.num_chips for s in self.slices)
+
+    def get_slice(self, name: str) -> Optional[SliceTopology]:
+        for s in self.slices:
+            if s.name == name:
+                return s
+        return None
+
+
+def detect_local_cluster(num_chips: Optional[int] = None, generation: Optional[str] = None) -> Cluster:
+    """Detect the local environment as a one-slice cluster.
+
+    Uses jax.device_count() when available; overridable for tests/emulation
+    (a bigger virtual cluster than physically present is explicitly allowed —
+    the process manager runs workers on the sim regardless)."""
+    if num_chips is None:
+        try:
+            import jax
+
+            num_chips = jax.local_device_count()
+            plat = jax.devices()[0].platform
+            generation = generation or ("cpu" if plat == "cpu" else "sim")
+        except Exception:
+            num_chips = 1
+            generation = generation or "sim"
+    generation = generation or "sim"
+    # Factor chip count into a near-square 2D mesh shape (v5e-style).
+    a = int(math.sqrt(num_chips))
+    while a > 1 and num_chips % a:
+        a -= 1
+    dims = (a, num_chips // a) if a > 1 else (num_chips,)
+    return Cluster(slices=[SliceTopology(name="local", generation=generation, dims=dims)])

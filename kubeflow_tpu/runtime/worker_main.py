@@ -1,0 +1,63 @@
+"""Worker process entry: ``python -m kubeflow_tpu.runtime.worker_main``.
+
+The kubelet+container analog: reads the KFTPU_* rendezvous env, starts the
+heartbeat, bootstraps jax.distributed + mesh, resolves and runs the
+entrypoint, and exits with the contract code (0 ok, <128 permanent,
+>=128 retryable — RestartPolicy=ExitCode semantics)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import traceback
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=os.environ.get("KFTPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s [w%(process)d] %(message)s",
+        stream=sys.stderr,
+    )
+    from kubeflow_tpu.runtime.bootstrap import (
+        EXIT_CONFIG_ERROR, EXIT_PERMANENT, EXIT_PREEMPTED, Heartbeat, WorkerEnv,
+        bootstrap_worker,
+    )
+    from kubeflow_tpu.runtime.entrypoints import WorkerContext, resolve_entrypoint
+
+    # SIGTERM → exit 143 (retryable): a preemption, not a program bug.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(EXIT_PREEMPTED))
+
+    wenv = WorkerEnv.from_env()
+    hb = None
+    if wenv.heartbeat_file:
+        hb = Heartbeat(wenv.heartbeat_file)
+        hb.start()
+    if wenv.workdir:
+        os.makedirs(wenv.workdir, exist_ok=True)
+        os.chdir(wenv.workdir)
+
+    try:
+        fn = resolve_entrypoint(wenv.entrypoint)
+    except Exception:
+        traceback.print_exc()
+        return EXIT_CONFIG_ERROR
+
+    try:
+        wenv, mesh = bootstrap_worker(wenv)
+        ctx = WorkerContext(env=wenv, mesh=mesh, heartbeat=hb)
+        rc = fn(ctx)
+        return 0 if rc is None else int(rc)
+    except SystemExit as e:
+        return int(e.code or 0)
+    except Exception:
+        traceback.print_exc()
+        return EXIT_PERMANENT
+    finally:
+        if hb is not None:
+            hb.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

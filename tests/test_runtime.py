@@ -1,0 +1,187 @@
+"""Runtime tests: topology, gang allocator, mesh building, worker processes.
+
+Gang semantics mirror the reference's PodGroup minMember all-or-nothing
+contract (SURVEY.md §2.2#20); mesh tests run on the 8-device virtual CPU
+platform from conftest."""
+
+import os
+import time
+
+import pytest
+
+from kubeflow_tpu.core.jobs import ParallelismSpec
+from kubeflow_tpu.runtime.allocator import (
+    GangAllocator, GangRequest, InsufficientCapacityError,
+)
+from kubeflow_tpu.runtime.bootstrap import WorkerEnv, free_port
+from kubeflow_tpu.runtime.mesh import MESH_AXES, build_mesh, mesh_from_parallelism
+from kubeflow_tpu.runtime.procman import LocalProcessManager
+from kubeflow_tpu.runtime.topology import Cluster, SliceTopology, detect_local_cluster
+
+
+# -- topology ------------------------------------------------------------------
+
+def test_topology_parse_and_counts():
+    s = SliceTopology.parse("s0", "4x4x4", generation="v5p")
+    assert s.num_chips == 64
+    assert s.num_hosts == 16
+    assert s.gen.torus_dims == 3
+    with pytest.raises(ValueError):
+        SliceTopology.parse("bad", "4x0")
+
+
+def test_detect_local_cluster_virtual():
+    c = detect_local_cluster()
+    assert c.total_chips == 8  # conftest forces 8 virtual CPU devices
+    assert c.slices[0].dims == (2, 4)
+
+
+# -- gang allocator ------------------------------------------------------------
+
+def two_slice_cluster():
+    return Cluster(slices=[
+        SliceTopology(name="v5p-a", generation="v5p", dims=(2, 2, 2)),   # 8 chips
+        SliceTopology(name="v5e-b", generation="v5e", dims=(2, 2)),      # 4 chips
+    ])
+
+
+def test_gang_all_or_nothing():
+    alloc = GangAllocator(two_slice_cluster())
+    a = alloc.submit(GangRequest(name="j1", num_workers=4, chips_per_worker=2))
+    assert a is not None and a.slice_name == "v5p-a"
+    assert sorted(a.all_chips) == list(range(8))
+    # 6 chips free total (4 on v5e-b) but j2 wants 6 on ONE slice → queued
+    b = alloc.submit(GangRequest(name="j2", num_workers=6, chips_per_worker=1))
+    assert b is None
+    assert [p.name for p in alloc.pending()] == ["j2"]
+    # j1 releases → j2 places
+    alloc.release("j1")
+    assert alloc.allocation("j2") is not None
+
+
+def test_gang_never_fits_raises():
+    alloc = GangAllocator(two_slice_cluster())
+    with pytest.raises(InsufficientCapacityError):
+        alloc.submit(GangRequest(name="huge", num_workers=9, chips_per_worker=1))
+    # pinned to a too-small slice: also impossible
+    with pytest.raises(InsufficientCapacityError):
+        alloc.submit(GangRequest(name="pinned", num_workers=5, chips_per_worker=1,
+                                 slice_name="v5e-b"))
+
+
+def test_gang_priority_and_fifo():
+    alloc = GangAllocator(two_slice_cluster())
+    alloc.submit(GangRequest(name="hog", num_workers=8, chips_per_worker=1))
+    alloc.submit(GangRequest(name="low1", num_workers=8, chips_per_worker=1, priority=0))
+    alloc.submit(GangRequest(name="hi", num_workers=8, chips_per_worker=1, priority=5))
+    alloc.release("hog")
+    # high priority jumps the FIFO queue
+    assert alloc.allocation("hi") is not None
+    assert alloc.allocation("low1") is None
+
+
+def test_gang_contiguous_chip_runs():
+    alloc = GangAllocator(two_slice_cluster())
+    alloc.submit(GangRequest(name="a", num_workers=2, chips_per_worker=2))
+    alloc.submit(GangRequest(name="b", num_workers=1, chips_per_worker=4))
+    alloc.release("a")
+    c = alloc.submit(GangRequest(name="c", num_workers=1, chips_per_worker=4))
+    # c should take the contiguous freed run [0..3]
+    assert sorted(c.all_chips) == [0, 1, 2, 3]
+
+
+def test_gang_quota_hook_skips_not_blocks():
+    def quota(req: GangRequest):
+        return "over quota" if req.name.startswith("q-") else None
+
+    alloc = GangAllocator(two_slice_cluster(), quota_check=quota)
+    assert alloc.submit(GangRequest(name="q-denied", num_workers=1)) is None
+    # a quota-blocked gang must not head-of-line-block others
+    assert alloc.submit(GangRequest(name="ok", num_workers=1)) is not None
+
+
+def test_gang_idempotent_submit():
+    alloc = GangAllocator(two_slice_cluster())
+    r = GangRequest(name="j", num_workers=2)
+    a1 = alloc.submit(r)
+    a2 = alloc.submit(r)
+    assert a1.chip_assignment == a2.chip_assignment
+    assert alloc.free_chips("v5p-a") == 6
+
+
+# -- mesh ----------------------------------------------------------------------
+
+def test_mesh_axes_canonical_order():
+    assert MESH_AXES == ("dcn", "pipeline", "data", "fsdp", "expert", "seq", "model")
+
+
+def test_build_mesh_8_devices():
+    mesh = build_mesh({"fsdp": 4, "model": 2})
+    assert mesh.shape["fsdp"] == 4 and mesh.shape["model"] == 2
+    assert mesh.shape["dcn"] == 1
+    assert mesh.devices.size == 8
+
+
+def test_mesh_from_parallelism_spec():
+    mesh = mesh_from_parallelism(ParallelismSpec(data=2, seq=4))
+    assert mesh.shape["data"] == 2 and mesh.shape["seq"] == 4
+
+
+def test_mesh_size_mismatch_raises():
+    with pytest.raises(ValueError):
+        build_mesh({"fsdp": 3})
+
+
+# -- worker env protocol -------------------------------------------------------
+
+def test_worker_env_roundtrip():
+    w = WorkerEnv(
+        coordinator_address="127.0.0.1:1234", num_processes=4, process_id=2,
+        job="ns/j", replica_index=2, entrypoint="noop",
+        config={"steps": 3}, parallelism={"fsdp": 4},
+        heartbeat_file="/tmp/hb", workdir="/tmp/wd",
+    )
+    again = WorkerEnv.from_env(w.to_env())
+    assert again == w
+
+
+# -- process manager -----------------------------------------------------------
+
+def worker_env(tmp_path, name, entrypoint="noop", config=None, nproc=1, pid=0):
+    return WorkerEnv(
+        coordinator_address=f"127.0.0.1:{free_port()}",
+        num_processes=nproc, process_id=pid, job="default/t", replica_index=pid,
+        entrypoint=entrypoint, config=config or {}, parallelism={},
+        platform="cpu", virtual_devices=1,
+        heartbeat_file=str(tmp_path / f"{name}.hb"),
+    )
+
+
+@pytest.mark.slow
+def test_procman_lifecycle(tmp_path):
+    pm = LocalProcessManager(log_dir=str(tmp_path / "logs"))
+    h = pm.launch("w0", worker_env(tmp_path, "w0", "sleep", {"seconds": 30}))
+    assert h.pid > 0
+    deadline = time.time() + 15
+    while h.heartbeat_age() is None and time.time() < deadline:
+        time.sleep(0.1)
+    assert h.heartbeat_age() is not None and h.heartbeat_age() < 10
+    # SIGTERM → retryable exit 143 per the contract
+    rc = pm.kill("w0")
+    assert rc == 143
+    pm.reap("w0")
+    assert pm.get("w0") is None
+
+
+@pytest.mark.slow
+def test_procman_exit_codes(tmp_path):
+    pm = LocalProcessManager()
+    pm.launch("ok", worker_env(tmp_path, "ok", "noop"))
+    pm.launch("bad", worker_env(tmp_path, "bad", "fail", {"exit_code": 7}))
+    pm.launch("cfg", worker_env(tmp_path, "cfg", "no_such_entrypoint"))
+    deadline = time.time() + 60
+    while any(pm.poll(n) is None for n in ("ok", "bad", "cfg")) and time.time() < deadline:
+        time.sleep(0.2)
+    assert pm.poll("ok") == 0
+    assert pm.poll("bad") == 7
+    assert pm.poll("cfg") == 2  # config error
