@@ -1,0 +1,28 @@
+"""Functional JAX model zoo: decoder LLMs (Llama/Gemma/Mixtral) and vision
+(ViT/CLIP) — the data plane the reference delegates to user containers
+(SURVEY.md §1 half 2; BASELINE.json configs).
+
+Design: pure functions over param pytrees (nested dicts), with a parallel
+"spec" pytree of logical axis names consumed by `kubeflow_tpu.parallel`.
+Layers are stacked and `lax.scan`-ned (compile time O(1) in depth), remat
+policies are config-driven, activations run in bfloat16 with fp32 params by
+default — the MXU-friendly layout.
+"""
+
+from kubeflow_tpu.models.config import DecoderConfig, PRESETS, preset
+from kubeflow_tpu.models.decoder import (
+    init_decoder_params,
+    decoder_param_specs,
+    decoder_forward,
+    decoder_loss,
+)
+
+__all__ = [
+    "DecoderConfig",
+    "PRESETS",
+    "preset",
+    "init_decoder_params",
+    "decoder_param_specs",
+    "decoder_forward",
+    "decoder_loss",
+]

@@ -1,0 +1,245 @@
+"""The decoder LLM: init, forward, loss — scan-over-layers, remat, logical
+sharding specs.
+
+Covers Llama-3 (RoPE+GQA+RMSNorm+SwiGLU), Gemma ((1+w) norms, embed scale,
+GeGLU, tied embeddings, logit softcap) and Mixtral (MoE blocks) through
+DecoderConfig flags. Layers are stacked on a leading axis and traversed with
+`lax.scan` so compile time is depth-independent; the block is rematerialized
+per the config policy (trades HBM for FLOPs — SURVEY.md task guidance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.config import DecoderConfig
+from kubeflow_tpu.models import layers as L
+from kubeflow_tpu.parallel.sharding import LogicalRules, DEFAULT_RULES, with_logical_constraint
+
+Params = dict[str, Any]
+
+
+def _init_block(key, cfg: DecoderConfig):
+    k_attn, k_mlp = jax.random.split(key)
+    attn_p, attn_s = L.init_attention(k_attn, cfg)
+    if cfg.is_moe:
+        mlp_p, mlp_s = L.init_moe(k_mlp, cfg)
+    else:
+        mlp_p, mlp_s = L.init_mlp(k_mlp, cfg)
+    ln1, ln1_s = L.init_rmsnorm(cfg)
+    ln2, ln2_s = L.init_rmsnorm(cfg)
+    params = {"attn": attn_p, "mlp": mlp_p, "ln1": ln1, "ln2": ln2}
+    specs = {"attn": attn_s, "mlp": mlp_s, "ln1": ln1_s, "ln2": ln2_s}
+    return params, specs
+
+
+def init_decoder_params(key: jax.Array, cfg: DecoderConfig) -> Params:
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    tok, _ = L.init_embedding(k_embed, cfg)
+
+    if cfg.scan_layers:
+        # Stack per-layer params on a leading axis via vmapped init.
+        layer_keys = jax.random.split(k_layers, cfg.n_layers)
+        stacked = jax.vmap(lambda k: _init_block(k, cfg)[0])(layer_keys)
+        layers_params = stacked
+    else:
+        layers_params = [
+            _init_block(k, cfg)[0] for k in jax.random.split(k_layers, cfg.n_layers)
+        ]
+
+    final_norm, _ = L.init_rmsnorm(cfg)
+    params: Params = {"embed": tok, "layers": layers_params, "final_norm": final_norm}
+    if not cfg.tie_embeddings:
+        params["lm_head"] = L._init(k_head, (cfg.hidden, cfg.vocab_size),
+                                    cfg.weight_dtype)
+    return params
+
+
+def decoder_param_specs(cfg: DecoderConfig) -> Params:
+    """Logical-axis spec tree mirroring init_decoder_params' structure.
+
+    The stacked layer axis prepends the "layers" logical axis to every
+    per-layer leaf when scanning."""
+    _, block_specs = _init_block(jax.random.PRNGKey(0), cfg)  # structure only
+
+    if cfg.scan_layers:
+        def stack_spec(s):
+            return ("layers",) + s
+        layer_specs = jax.tree.map(
+            stack_spec, block_specs,
+            is_leaf=lambda x: isinstance(x, tuple) and all(
+                isinstance(e, (str, type(None))) for e in x),
+        )
+    else:
+        layer_specs = [block_specs] * cfg.n_layers
+
+    specs: Params = {
+        "embed": ("vocab", "embed_table"),
+        "layers": layer_specs,
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = ("embed", "vocab")
+    return specs
+
+
+def _block_forward(block_params, x, positions, cfg: DecoderConfig,
+                   kv_cache=None, attn_impl="xla", mesh=None, rules=DEFAULT_RULES):
+    h = L.rmsnorm(x, block_params["ln1"], cfg)
+    attn_out, new_cache = L.attention_block(
+        block_params["attn"], h, positions, cfg,
+        kv_cache=kv_cache, attn_impl=attn_impl)
+    x = x + attn_out
+    h = L.rmsnorm(x, block_params["ln2"], cfg)
+    if cfg.is_moe:
+        mlp_out, aux = L.moe_block(block_params["mlp"], h, cfg)
+    else:
+        mlp_out, aux = L.mlp_block(block_params["mlp"], h, cfg), jnp.float32(0)
+    x = x + mlp_out
+    if mesh is not None:
+        x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"), mesh, rules)
+    return x, new_cache, aux
+
+
+def _remat(fn, policy: str):
+    if policy == "none":
+        return fn
+    if policy == "full":
+        return jax.checkpoint(fn, policy=None)
+    if policy == "nothing_saveable":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.nothing_saveable)
+    if policy == "dots_saveable":
+        return jax.checkpoint(fn, policy=jax.checkpoint_policies.dots_saveable)
+    raise ValueError(f"unknown remat policy {policy!r}")
+
+
+def decoder_forward(
+    params: Params,
+    tokens: jax.Array,                 # [B, S] int32
+    cfg: DecoderConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    kv_caches: Optional[dict] = None,  # {"k","v": [L,B,Smax,K,Dh], "len": scalar}
+    attn_impl: str = "xla",
+    mesh=None,
+    rules: LogicalRules = DEFAULT_RULES,
+):
+    """Returns (logits [B,S,V] float32, new_kv_caches|None, aux_loss)."""
+    if positions is None:
+        # Decode with a cache: absolute positions continue from the cache
+        # length (RoPE angles and the causal mask must agree on the offset).
+        offset = kv_caches["len"] if kv_caches is not None else 0
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :] + offset,
+            tokens.shape)
+
+    dt = cfg.activation_dtype
+    x = params["embed"].astype(dt)[tokens]
+    if mesh is not None:
+        x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"), mesh, rules)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden ** 0.5, dt)
+
+    aux_total = jnp.float32(0)
+    new_caches = None
+
+    if cfg.scan_layers:
+        def scan_body(carry, scan_in):
+            x = carry
+            block_params, cache = scan_in
+            out, new_cache, aux = _block_forward(
+                block_params, x, positions, cfg,
+                kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules)
+            return out, (new_cache, aux)
+
+        body = _remat(scan_body, cfg.remat_policy)
+        if kv_caches is not None:
+            # scan consumes the stacked [L, ...] cache leaves alongside params
+            def scan_with_cache(carry, scan_in):
+                block_params, (ck, cv) = scan_in
+                cache = {"k": ck, "v": cv, "len": kv_caches["len"]}
+                out, (new_cache, aux) = body(carry, (block_params, cache))
+                return out, ((new_cache["k"], new_cache["v"]), aux)
+            x, ((nk, nv), auxs) = jax.lax.scan(
+                scan_with_cache, x,
+                (params["layers"], (kv_caches["k"], kv_caches["v"])))
+            new_caches = {"k": nk, "v": nv,
+                          "len": kv_caches["len"] + tokens.shape[1]}
+        else:
+            def scan_no_cache(carry, block_params):
+                out, (_, aux) = body(carry, (block_params, None))
+                return out, aux
+            x, auxs = jax.lax.scan(scan_no_cache, x, params["layers"])
+        aux_total = jnp.sum(auxs)
+    else:
+        per_layer_aux = []
+        new_k, new_v = [], []
+        for i, block_params in enumerate(params["layers"]):
+            cache = None
+            if kv_caches is not None:
+                cache = {"k": kv_caches["k"][i], "v": kv_caches["v"][i],
+                         "len": kv_caches["len"]}
+            x, new_cache, aux = _block_forward(
+                block_params, x, positions, cfg,
+                kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules)
+            per_layer_aux.append(aux)
+            if new_cache is not None:
+                new_k.append(new_cache["k"])
+                new_v.append(new_cache["v"])
+        aux_total = jnp.sum(jnp.stack(per_layer_aux))
+        if kv_caches is not None:
+            new_caches = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
+                          "len": kv_caches["len"] + tokens.shape[1]}
+
+    x = L.rmsnorm(x, params["final_norm"], cfg)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dt),
+                        preferred_element_type=jnp.float32)
+    if cfg.logits_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+    return logits, new_caches, aux_total
+
+
+def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int) -> dict:
+    """Contiguous decode cache, stacked over layers."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.activation_dtype),
+        "v": jnp.zeros(shape, cfg.activation_dtype),
+        "len": jnp.int32(0),
+    }
+
+
+def decoder_loss(
+    params: Params,
+    tokens: jax.Array,        # [B, S+1]: input = [:, :-1], target = [:, 1:]
+    cfg: DecoderConfig,
+    *,
+    loss_mask: Optional[jax.Array] = None,   # [B, S] 1.0 = count this target
+    attn_impl: str = "xla",
+    mesh=None,
+    rules: LogicalRules = DEFAULT_RULES,
+    aux_loss_weight: float = 0.01,
+):
+    """Next-token cross-entropy in fp32. Returns (loss, metrics)."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, _, aux = decoder_forward(
+        params, inputs, cfg, attn_impl=attn_impl, mesh=mesh, rules=rules)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if loss_mask is None:
+        loss_mask = jnp.ones_like(nll)
+    denom = jnp.maximum(loss_mask.sum(), 1.0)
+    ce = (nll * loss_mask).sum() / denom
+    loss = ce + (aux_loss_weight * aux if cfg.is_moe else 0.0)
+    metrics = {
+        "ce_loss": ce,
+        "aux_loss": aux,
+        "tokens": denom,
+        "accuracy": ((logits.argmax(-1) == targets) * loss_mask).sum() / denom,
+    }
+    return loss, metrics

@@ -1,0 +1,199 @@
+"""Decoder building blocks: RMSNorm, RoPE, GQA attention, (Swi/Ge)GLU MLP,
+MoE block — pure functions over param dicts with logical-axis spec helpers.
+
+Every init returns ``(params, specs)`` where ``specs`` mirrors the param tree
+with tuples of logical axis names (consumed by parallel.sharding). Compute
+follows the TPU dtype policy: params in ``param_dtype`` (fp32), activations
+and matmuls in ``dtype`` (bf16, MXU-native), reductions/softmax/norms in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.config import DecoderConfig
+from kubeflow_tpu.ops.attention import multi_head_attention
+
+
+def _init(key, shape, dtype, scale: Optional[float] = None):
+    """Truncated-normal init with 1/sqrt(fan_in) default scale."""
+    fan_in = shape[0] if len(shape) > 1 else shape[-1]
+    scale = scale if scale is not None else fan_in ** -0.5
+    return (jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+            * scale).astype(dtype)
+
+
+# -- RMSNorm -------------------------------------------------------------------
+
+def init_rmsnorm(cfg: DecoderConfig):
+    w = jnp.zeros((cfg.hidden,), cfg.weight_dtype) if cfg.norm_plus_one \
+        else jnp.ones((cfg.hidden,), cfg.weight_dtype)
+    return w, ("norm",)
+
+
+def rmsnorm(x: jax.Array, w: jax.Array, cfg: DecoderConfig) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    xf = xf * jax.lax.rsqrt(var + cfg.norm_eps)
+    wf = (1.0 + w.astype(jnp.float32)) if cfg.norm_plus_one else w.astype(jnp.float32)
+    return (xf * wf).astype(x.dtype)
+
+
+# -- RoPE ----------------------------------------------------------------------
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B,S,H,D], positions: [B,S] (absolute)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)   # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs        # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]                             # [B,S,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# -- Attention block -----------------------------------------------------------
+
+def init_attention(key, cfg: DecoderConfig):
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    d = cfg.hidden
+    params = {
+        "wq": _init(kq, (d, cfg.n_heads, cfg.head_dim), cfg.weight_dtype),
+        "wk": _init(kk, (d, cfg.n_kv_heads, cfg.head_dim), cfg.weight_dtype),
+        "wv": _init(kv, (d, cfg.n_kv_heads, cfg.head_dim), cfg.weight_dtype),
+        "wo": _init(ko, (cfg.n_heads, cfg.head_dim, d), cfg.weight_dtype,
+                    scale=(cfg.n_heads * cfg.head_dim) ** -0.5),
+    }
+    specs = {
+        "wq": ("embed", "heads", "head_dim"),
+        "wk": ("embed", "kv_heads", "head_dim"),
+        "wv": ("embed", "kv_heads", "head_dim"),
+        "wo": ("heads", "head_dim", "embed"),
+    }
+    return params, specs
+
+
+def attention_block(
+    p: dict,
+    x: jax.Array,                       # [B,S,D]
+    positions: jax.Array,               # [B,S]
+    cfg: DecoderConfig,
+    *,
+    kv_cache: Optional[dict] = None,    # {"k","v": [B,Smax,K,Dh]}, + "len": scalar
+    attn_impl: str = "xla",
+):
+    """Returns (out [B,S,D], new_kv_cache|None)."""
+    dt = cfg.activation_dtype
+    q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(dt))
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    new_cache = None
+    if kv_cache is not None:
+        # Contiguous cache decode path: write new K/V at position `len`.
+        start = kv_cache["len"]
+        ck = jax.lax.dynamic_update_slice_in_dim(kv_cache["k"], k, start, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(kv_cache["v"], v, start, axis=1)
+        new_cache = {"k": ck, "v": cv, "len": start + x.shape[1]}
+        # Causal mask with q_offset covers both the cached prefix and
+        # intra-block causality; attn_impl is honored (the pallas kernel
+        # supports q_offset masking too).
+        out = multi_head_attention(
+            q, ck, cv, causal=True, q_offset=start, impl=attn_impl,
+        )
+    else:
+        out = multi_head_attention(q, k, v, causal=True, impl=attn_impl)
+    out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))
+    return out, new_cache
+
+
+# -- MLP -----------------------------------------------------------------------
+
+def init_mlp(key, cfg: DecoderConfig):
+    kg, ku, kd = jax.random.split(key, 3)
+    d, m = cfg.hidden, cfg.mlp_dim
+    params = {
+        "gate": _init(kg, (d, m), cfg.weight_dtype),
+        "up": _init(ku, (d, m), cfg.weight_dtype),
+        "down": _init(kd, (m, d), cfg.weight_dtype, scale=m ** -0.5),
+    }
+    specs = {"gate": ("embed", "mlp"), "up": ("embed", "mlp"), "down": ("mlp", "embed")}
+    return params, specs
+
+
+def _act(x: jax.Array, name: str) -> jax.Array:
+    if name == "silu":
+        return jax.nn.silu(x)
+    if name == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown activation {name!r}")
+
+
+def mlp_block(p: dict, x: jax.Array, cfg: DecoderConfig) -> jax.Array:
+    dt = cfg.activation_dtype
+    gate = _act(jnp.einsum("bsd,dm->bsm", x, p["gate"].astype(dt)), cfg.hidden_act)
+    up = jnp.einsum("bsd,dm->bsm", x, p["up"].astype(dt))
+    return jnp.einsum("bsm,md->bsd", gate * up, p["down"].astype(dt))
+
+
+# -- MoE -----------------------------------------------------------------------
+
+def init_moe(key, cfg: DecoderConfig):
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    d, m, e = cfg.hidden, cfg.mlp_dim, cfg.num_experts
+    params = {
+        "router": _init(kr, (d, e), cfg.weight_dtype),
+        "gate": _init(kg, (e, d, m), cfg.weight_dtype, scale=d ** -0.5),
+        "up": _init(ku, (e, d, m), cfg.weight_dtype, scale=d ** -0.5),
+        "down": _init(kd, (e, m, d), cfg.weight_dtype, scale=m ** -0.5),
+    }
+    specs = {
+        "router": ("embed", None),
+        "gate": ("expert", "embed", "expert_mlp"),
+        "up": ("expert", "embed", "expert_mlp"),
+        "down": ("expert", "expert_mlp", "embed"),
+    }
+    return params, specs
+
+
+def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig):
+    """Top-k MoE (Mixtral semantics: softmax over the selected k logits).
+
+    Einsum-dense formulation: every expert computes every token and a one-hot
+    combine weights the results. FLOP-inefficient (E/k overcompute) but fully
+    static-shaped and correct — the oracle for the ragged all-to-all expert-
+    parallel dispatch (parallel/expert.py) which replaces it on real runs.
+
+    Returns (out, aux_loss)."""
+    dt = cfg.activation_dtype
+    e, k = cfg.num_experts, cfg.experts_per_token
+    router_logits = jnp.einsum("bsd,de->bse", x, p["router"].astype(dt)).astype(jnp.float32)
+    topk_logits, topk_idx = jax.lax.top_k(router_logits, k)          # [B,S,k]
+    topk_w = jax.nn.softmax(topk_logits, axis=-1)                    # mixtral: softmax over top-k
+    onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)          # [B,S,k,E]
+    combine = jnp.einsum("bske,bsk->bse", onehot, topk_w)            # [B,S,E]
+
+    gate = _act(jnp.einsum("bsd,edm->ebsm", x, p["gate"].astype(dt)), cfg.hidden_act)
+    up = jnp.einsum("bsd,edm->ebsm", x, p["up"].astype(dt))
+    expert_out = jnp.einsum("ebsm,emd->ebsd", gate * up, p["down"].astype(dt))
+    out = jnp.einsum("ebsd,bse->bsd", expert_out, combine.astype(dt))
+
+    # Load-balancing aux loss (Switch-style): E * sum(frac_tokens * frac_router_prob)
+    probs = jax.nn.softmax(router_logits, axis=-1)                   # [B,S,E]
+    frac_tokens = jnp.mean(onehot.sum(axis=2), axis=(0, 1))          # [E]
+    frac_probs = jnp.mean(probs, axis=(0, 1))                        # [E]
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return out, aux
+
+
+# -- Embedding -----------------------------------------------------------------
+
+def init_embedding(key, cfg: DecoderConfig):
+    tok = _init(key, (cfg.vocab_size, cfg.hidden), cfg.weight_dtype, scale=1.0)
+    return tok, ("vocab", "embed_table")

@@ -1,0 +1,12 @@
+"""TPU kernel layer: attention and other hot ops with switchable impls.
+
+Every op exposes a pure-XLA reference implementation (runs anywhere, used for
+CPU tests and as the numerics oracle) and, where it pays, a Pallas TPU kernel
+(`impl="pallas"`) or a distributed variant (ring attention). The seam keeps
+models oblivious to which implementation runs — the op registry picks based
+on platform and config.
+"""
+
+from kubeflow_tpu.ops.attention import multi_head_attention
+
+__all__ = ["multi_head_attention"]

@@ -1,0 +1,26 @@
+"""Parallelism: logical-axis sharding rules, constraint helpers, collectives.
+
+The reference orchestrates parallelism via env bootstrap and leaves the math
+to NCCL inside user containers (SURVEY.md §2.6). Here both halves are owned:
+mesh axes come from `runtime.mesh`, and this package maps *logical* tensor
+axes (batch/embed/heads/mlp/vocab/expert/...) onto them so models declare
+intent once and DP/FSDP/TP/EP/SP all fall out of rule tables.
+"""
+
+from kubeflow_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    LogicalRules,
+    logical_to_mesh_axes,
+    named_sharding,
+    shard_params,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "DEFAULT_RULES",
+    "LogicalRules",
+    "logical_to_mesh_axes",
+    "named_sharding",
+    "shard_params",
+    "with_logical_constraint",
+]

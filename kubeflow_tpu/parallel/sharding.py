@@ -1,0 +1,154 @@
+"""Logical-axis sharding rules.
+
+Models annotate every parameter/activation with *logical* axis names; a rule
+table maps logical names → mesh axes (or None = replicated). Changing the
+parallelism strategy (pure DP ↔ FSDP ↔ FSDP+TP ↔ +EP/SP) is a rule-table
+change, not a model change — the TPU-native idiom (GSPMD partitioning; cf.
+the public MaxText/flax logical-partitioning pattern), replacing the
+reference's per-framework launcher plumbing.
+
+Default rule intent:
+- ``batch``      → sharded over all data-parallel axes (dcn, data, fsdp)
+- ``embed``      → FSDP-sharded (params' model dim over fsdp; ZeRO-3 analog)
+- ``heads/mlp/kv/vocab`` → tensor-parallel over ``model`` (Megatron splits)
+- ``expert``     → expert-parallel over ``expert``
+- ``act_seq``    → sequence-parallel over ``seq`` (ring attention)
+- ``layers``     → replicated (the scan axis)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Axis = Optional[Union[str, tuple[str, ...]]]
+LogicalRules = tuple[tuple[str, Axis], ...]
+
+DEFAULT_RULES: LogicalRules = (
+    ("batch", ("dcn", "data", "fsdp")),
+    ("act_seq", "seq"),
+    ("act_embed", None),
+    ("embed", "fsdp"),
+    ("heads", "model"),
+    ("kv_heads", "model"),
+    ("head_dim", None),
+    ("mlp", "model"),
+    ("vocab", "model"),
+    # The embedding table's hidden dim stays unsharded: sharding it over fsdp
+    # makes the token-gather output spec reuse fsdp (already consumed by the
+    # batch dim), which GSPMD propagation rejects. Vocab-parallel (Megatron
+    # style) is the TP-correct layout; FSDP-sharding the table is a TODO that
+    # needs a manual all-gather before the gather op.
+    ("embed_table", None),
+    ("expert", "expert"),
+    ("expert_mlp", "model"),
+    ("layers", None),
+    ("stage", "pipeline"),
+    ("norm", None),
+)
+
+
+def logical_to_mesh_axes(
+    logical_axes: Sequence[Optional[str]],
+    rules: LogicalRules = DEFAULT_RULES,
+) -> PartitionSpec:
+    """Map a tuple of logical axis names to a PartitionSpec via the rules.
+
+    A mesh axis may be used at most once in a spec (GSPMD constraint): later
+    logical axes that would reuse an already-consumed mesh axis fall back to
+    replication on that axis."""
+    table = dict(rules)
+    used: set[str] = set()
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        if name not in table:
+            raise KeyError(f"no sharding rule for logical axis {name!r}")
+        mesh_axes = table[name]
+        if mesh_axes is None:
+            out.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        free = tuple(a for a in mesh_axes if a not in used)
+        used.update(free)
+        if not free:
+            out.append(None)
+        elif len(free) == 1:
+            out.append(free[0])
+        else:
+            out.append(free)
+    return PartitionSpec(*out)
+
+
+def named_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: LogicalRules = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh_axes(logical_axes, rules))
+
+
+def _is_spec_leaf(x: Any) -> bool:
+    return isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x)
+
+
+def _drop_nondivisible(spec: PartitionSpec, shape: tuple[int, ...],
+                       mesh: Mesh) -> PartitionSpec:
+    """Replicate any dim whose size isn't divisible by its mesh-axis product
+    (e.g. 2 GQA kv heads under model=4 tensor parallelism)."""
+    out = []
+    for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axes is None:
+            out.append(None)
+            continue
+        axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+        degree = 1
+        for a in axes_t:
+            degree *= mesh.shape[a]
+        out.append(axes if degree > 0 and dim % degree == 0 else None)
+    return PartitionSpec(*out)
+
+
+def shard_params(params: Any, specs: Any, mesh: Mesh,
+                 rules: LogicalRules = DEFAULT_RULES) -> Any:
+    """Build a NamedSharding pytree matching ``params`` from the parallel
+    ``specs`` pytree of logical-axis tuples.
+
+    ``params`` may be real arrays, ShapeDtypeStructs, or None. When shapes
+    are available, dims that don't divide their mesh-axis product are
+    replicated instead of erroring."""
+    if params is None:
+        return jax.tree.map(
+            lambda spec: named_sharding(mesh, spec, rules),
+            specs, is_leaf=_is_spec_leaf)
+
+    def one(spec, leaf):
+        ps = logical_to_mesh_axes(spec, rules)
+        ps = _drop_nondivisible(ps, tuple(leaf.shape), mesh)
+        return NamedSharding(mesh, ps)
+
+    # specs first: is_leaf must stop descent at the spec tuples.
+    return jax.tree.map(one, specs, params, is_leaf=_is_spec_leaf)
+
+
+def with_logical_constraint(
+    x: jax.Array,
+    logical_axes: Sequence[Optional[str]],
+    mesh: Optional[Mesh] = None,
+    rules: LogicalRules = DEFAULT_RULES,
+) -> jax.Array:
+    """`with_sharding_constraint` in logical-axis terms. Inside jit under a
+    mesh context the mesh is implicit; no-op when no mesh is active."""
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    try:
+        if mesh is not None:
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        # No mesh context (e.g. single-device eager) — constraint is advisory.
+        return x

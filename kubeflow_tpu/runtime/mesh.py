@@ -49,11 +49,17 @@ def build_mesh(
             f"mesh axes {dict(zip(MESH_AXES, sizes))} product {n} "
             f"!= device count {len(devices)}"
         )
+    # Auto axis types = classic GSPMD propagation (annotate params/inputs,
+    # XLA infers the rest and inserts collectives). JAX 0.9's default
+    # Explicit mode rejects ops whose output sharding is ambiguous (sharded
+    # attention einsums, vocab-parallel gathers), which is exactly the work
+    # we delegate to the compiler.
     try:
-        # Topology-aware assignment (ICI-locality) — works on real TPU slices.
-        return jax.make_mesh(sizes, MESH_AXES, devices=devices)
-    except TypeError:
-        # Older signature without devices kwarg.
+        axis_types = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+        return jax.make_mesh(sizes, MESH_AXES, devices=devices,
+                             axis_types=axis_types)
+    except (TypeError, AttributeError):
+        # Older JAX: no AxisType / no devices kwarg — plain Mesh is Auto there.
         dev_array = np.asarray(devices).reshape(sizes)
         return Mesh(dev_array, MESH_AXES)
 

@@ -1,0 +1,20 @@
+"""Training data plane: sharded train step, optimizer, data, checkpointing,
+and the trainer loop that JAXJob workers run.
+
+The hot path (SURVEY.md §3.1 "Rebuild hot path") is ``train_step =
+jit(loss→grad→update)`` over the job's mesh, with tokens/sec/chip and MFU
+measured around it.
+"""
+
+from kubeflow_tpu.train.optim import make_optimizer, OptimizerConfig
+from kubeflow_tpu.train.step import TrainTask, setup_train
+from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+__all__ = [
+    "make_optimizer",
+    "OptimizerConfig",
+    "TrainTask",
+    "setup_train",
+    "Trainer",
+    "TrainerConfig",
+]

@@ -1,0 +1,60 @@
+"""Checkpointing via orbax: sharded, multi-process-safe save/restore.
+
+First-class in this platform (the reference delegates checkpointing to user
+code entirely — SURVEY.md §5): the trainer saves on an interval and on
+failure signals; restore reshards to the *current* mesh, which is what makes
+elastic resize (new topology, same logical state) work.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None) -> Optional[Any]:
+        """Restore latest (or given) step onto the shardings carried by
+        ``abstract_state`` (a pytree of jax.ShapeDtypeStruct with .sharding
+        set — see make_abstract_state). Returns None when nothing saved.
+
+        Because the target shardings describe the *current* mesh, a restore
+        after a topology change reshards automatically (elastic resize)."""
+        target = step if step is not None else self._mgr.latest_step()
+        if target is None:
+            return None
+        return self._mgr.restore(target, args=ocp.args.StandardRestore(abstract_state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    @staticmethod
+    def make_abstract_state(init_fn, shardings) -> Any:
+        """Abstract (shape/dtype/sharding) mirror of ``init_fn()``'s output."""
+        shapes = jax.eval_shape(init_fn)
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            shapes, shardings)
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

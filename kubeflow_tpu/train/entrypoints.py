@@ -1,0 +1,37 @@
+"""Registered trainer entrypoints — what JAXJob WorkloadSpecs name.
+
+``llm_pretrain`` is the flagship (BASELINE config 1: Llama-class SPMD
+pretraining). Workers receive the mesh from the runtime bootstrap; config
+comes from WorkloadSpec.config verbatim (TrainerConfig fields).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeflow_tpu.runtime.entrypoints import WorkerContext, register_entrypoint
+
+
+@register_entrypoint("llm_pretrain")
+def llm_pretrain(ctx: WorkerContext) -> int:
+    import jax
+
+    from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+    cfg = TrainerConfig.from_dict(ctx.config)
+    mesh = ctx.mesh
+    if mesh is None:
+        from kubeflow_tpu.runtime.mesh import build_mesh
+
+        mesh = build_mesh({"fsdp": jax.device_count()})
+    metrics_path = None
+    if ctx.env.workdir:
+        metrics_path = os.path.join(ctx.env.workdir, "metrics.jsonl")
+    trainer = Trainer(
+        cfg, mesh,
+        process_id=ctx.env.process_id,
+        num_processes=ctx.env.num_processes,
+        metrics_path=metrics_path,
+    )
+    trainer.run()
+    return 0

@@ -1,0 +1,54 @@
+"""Optimizer factory: AdamW + warmup-cosine + global-norm clipping.
+
+Config-driven so Experiment (HPO) trials can sweep it via flat dicts."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "adamw"
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10000
+    min_lr_ratio: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+    clip_norm: Optional[float] = 1.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OptimizerConfig":
+        return cls(**{k: v for k, v in d.items()
+                      if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
+    warmup = optax.linear_schedule(0.0, cfg.learning_rate, max(cfg.warmup_steps, 1))
+    decay_steps = max(cfg.total_steps - cfg.warmup_steps, 1)
+    cosine = optax.cosine_decay_schedule(
+        cfg.learning_rate, decay_steps, alpha=cfg.min_lr_ratio)
+    return optax.join_schedules([warmup, cosine], [cfg.warmup_steps])
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    sched = make_schedule(cfg)
+    if cfg.name == "adamw":
+        opt = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                          weight_decay=cfg.weight_decay)
+    elif cfg.name == "adam":
+        opt = optax.adam(sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+    elif cfg.name == "sgd":
+        opt = optax.sgd(sched, momentum=0.9)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    chain = [opt]
+    if cfg.clip_norm is not None:
+        chain = [optax.clip_by_global_norm(cfg.clip_norm), opt]
+    return optax.chain(*chain)

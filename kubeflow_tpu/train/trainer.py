@@ -1,0 +1,145 @@
+"""The trainer loop a JAXJob worker runs, plus its config.
+
+Ties together: mesh (from the worker bootstrap), sharded train state, data
+sharding per process, step loop, orbax checkpoint/resume with data
+fast-forward, and metric emission. This loop IS the reference's "user
+container training script" — but owned by the platform, so checkpointing,
+metrics, and elasticity are guaranteed rather than hoped for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from kubeflow_tpu.models.config import DecoderConfig, preset
+from kubeflow_tpu.train.checkpoint import CheckpointManager
+from kubeflow_tpu.train.data import DataConfig, make_data_source
+from kubeflow_tpu.train.metrics import MetricsEmitter, Throughput
+from kubeflow_tpu.train.optim import OptimizerConfig
+from kubeflow_tpu.train.step import setup_train
+
+logger = logging.getLogger("kubeflow_tpu.train")
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    model: str = "tiny"                       # preset name
+    model_overrides: dict = dataclasses.field(default_factory=dict)
+    optimizer: dict = dataclasses.field(default_factory=dict)
+    data: dict = dataclasses.field(default_factory=dict)
+    steps: int = 100
+    log_every: int = 10
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 100
+    max_checkpoints: int = 3
+    seed: int = 0
+    attn_impl: str = "xla"
+    generation: str = "v5e"                   # hardware gen for MFU math
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainerConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+class Trainer:
+    def __init__(self, cfg: TrainerConfig, mesh, *,
+                 process_id: int = 0, num_processes: int = 1,
+                 metrics_path: Optional[str] = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.process_id = process_id
+        self.num_processes = num_processes
+
+        self.model_cfg: DecoderConfig = preset(cfg.model, **cfg.model_overrides)
+        opt_cfg = OptimizerConfig.from_dict(
+            {"total_steps": cfg.steps, **cfg.optimizer})
+        data_cfg = DataConfig(**{
+            "vocab_size": self.model_cfg.vocab_size,
+            "seq_len": self.model_cfg.max_seq_len,
+            **cfg.data,
+        })
+        if data_cfg.vocab_size > self.model_cfg.vocab_size:
+            raise ValueError("data vocab exceeds model vocab")
+        self.data_cfg = data_cfg
+        self.data = make_data_source(data_cfg, shard=process_id,
+                                     num_shards=num_processes)
+
+        self.task = setup_train(
+            self.model_cfg, opt_cfg, mesh, seed=cfg.seed,
+            attn_impl=cfg.attn_impl)
+
+        self.ckpt: Optional[CheckpointManager] = None
+        if cfg.checkpoint_dir:
+            self.ckpt = CheckpointManager(cfg.checkpoint_dir, cfg.max_checkpoints)
+
+        self.emitter = MetricsEmitter(jsonl_path=metrics_path)
+        self.throughput = Throughput(
+            tokens_per_step=data_cfg.global_batch * data_cfg.seq_len,
+            num_chips=mesh.devices.size,
+            flops_per_token=self.model_cfg.flops_per_token(),
+            generation=cfg.generation,
+        )
+
+    # -- checkpoint/resume -----------------------------------------------------
+
+    def try_resume(self) -> int:
+        """Restore latest checkpoint if present; returns the resume step."""
+        if self.ckpt is None:
+            return 0
+        restored = self.ckpt.restore(self._abstract_state())
+        if restored is None:
+            return 0
+        self.task.state = restored
+        step = int(jax.device_get(restored["step"]))
+        logger.info("resumed from checkpoint at step %d", step)
+        return step
+
+    def _abstract_state(self):
+        from kubeflow_tpu.train.step import make_state_init
+
+        return CheckpointManager.make_abstract_state(
+            make_state_init(self.model_cfg, self.task.optimizer),
+            self.task.state_shardings)
+
+    def save(self, step: int, *, force: bool = False) -> None:
+        if self.ckpt is not None:
+            self.ckpt.save(step, self.task.state, force=force)
+
+    # -- the loop --------------------------------------------------------------
+
+    def make_global_batch(self, local_batch: np.ndarray):
+        return jax.make_array_from_process_local_data(
+            self.task.batch_sharding, local_batch)
+
+    def run(self, *, on_step=None) -> dict:
+        start = self.try_resume()
+        last_metrics: dict = {}
+        last_tick_step = start
+        for step in range(start, self.cfg.steps):
+            batch = self.make_global_batch(self.data.batch_at(step))
+            self.task.state, metrics = self.task.step_fn(self.task.state, batch)
+            if (step + 1) % self.cfg.log_every == 0 or step + 1 == self.cfg.steps:
+                metrics = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+                metrics.update(self.throughput.tick(step + 1 - last_tick_step))
+                last_tick_step = step + 1
+                last_metrics = metrics
+                if self.process_id == 0:
+                    self.emitter.emit(step + 1, metrics)
+            if self.cfg.checkpoint_every and (step + 1) % self.cfg.checkpoint_every == 0:
+                self.save(step + 1)
+            if on_step is not None:
+                on_step(step + 1, last_metrics)
+        if self.ckpt is not None:
+            if self.ckpt.latest_step() != self.cfg.steps:
+                self.save(self.cfg.steps, force=True)
+            self.ckpt.wait()
+            self.ckpt.close()
+        self.emitter.close()
+        return last_metrics

@@ -1,0 +1,95 @@
+"""Trainer tests: loss decreases, determinism/fast-forward, checkpoint
+resume, throughput metrics — on the 8-device virtual mesh."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.runtime.mesh import build_mesh
+from kubeflow_tpu.train.data import DataConfig, SyntheticLM
+from kubeflow_tpu.train.trainer import Trainer, TrainerConfig
+
+
+def make_trainer(tmp_path, steps=30, ckpt=False, **kw):
+    cfg = TrainerConfig(
+        model="tiny",
+        model_overrides={"n_layers": 2, "hidden": 64},
+        # total_steps pinned so the LR schedule is identical across trainers
+        # with different run lengths (resume tests compare them bitwise).
+        optimizer={"learning_rate": 3e-3, "warmup_steps": 5, "total_steps": 100},
+        data={"global_batch": 8, "seq_len": 32, "vocab_size": 256},
+        steps=steps,
+        log_every=10,
+        checkpoint_dir=str(tmp_path / "ckpt") if ckpt else None,
+        checkpoint_every=10,
+        **kw,
+    )
+    mesh = build_mesh({"fsdp": 8})
+    return Trainer(cfg, mesh, metrics_path=str(tmp_path / "m.jsonl"))
+
+
+def test_synthetic_data_deterministic_fast_forward():
+    cfg = DataConfig(global_batch=4, seq_len=16, vocab_size=64, seed=3)
+    a = SyntheticLM(cfg)
+    b = SyntheticLM(cfg)
+    np.testing.assert_array_equal(a.batch_at(7), b.batch_at(7))
+    assert not np.array_equal(a.batch_at(7), a.batch_at(8))
+    # sharding partitions the batch deterministically
+    s0 = SyntheticLM(cfg, shard=0, num_shards=2)
+    s1 = SyntheticLM(cfg, shard=1, num_shards=2)
+    assert s0.local_batch == 2
+    assert not np.array_equal(s0.batch_at(0), s1.batch_at(0))
+
+
+@pytest.mark.slow
+def test_loss_decreases(tmp_path):
+    tr = make_trainer(tmp_path, steps=40)
+    first = None
+
+    def on_step(step, metrics):
+        nonlocal first
+        if step == 10 and metrics:
+            first = metrics["loss"]
+
+    last = tr.run(on_step=on_step)
+    assert first is not None
+    assert last["loss"] < first * 0.9, (first, last["loss"])
+    assert last["tokens_per_sec_per_chip"] > 0
+    assert last["step_time_ms"] > 0
+    # metrics jsonl written
+    lines = [json.loads(l) for l in open(tmp_path / "m.jsonl")]
+    assert lines[-1]["step"] == 40
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_exact(tmp_path):
+    # Train 20 steps with checkpoints every 10.
+    tr1 = make_trainer(tmp_path, steps=20, ckpt=True)
+    m1 = tr1.run()
+    # Fresh trainer resumes from step 20 checkpoint and continues to 25.
+    tr2 = make_trainer(tmp_path, steps=25, ckpt=True)
+    start = tr2.try_resume()
+    assert start == 20
+    # Run the remaining steps; state must continue (loss finite, step advances).
+    m2 = tr2.run()
+    assert int(jax.device_get(tr2.task.state["step"])) == 25
+
+    # Bitwise check: a third trainer restoring step-20 must produce identical
+    # step-21 state to a straight 21-step run (determinism of resume).
+    tr3 = make_trainer(tmp_path, steps=21, ckpt=True)
+    # force restore of step 20 (latest is now 25)
+    restored = tr3.ckpt.restore(tr3._abstract_state(), step=20)
+    tr3.task.state = restored
+    batch = tr3.make_global_batch(tr3.data.batch_at(20))
+    s21, _ = tr3.task.step_fn(tr3.task.state, batch)
+
+    tr4 = make_trainer(tmp_path, steps=21)
+    for step in range(21):
+        b = tr4.make_global_batch(tr4.data.batch_at(step))
+        tr4.task.state, _ = tr4.task.step_fn(tr4.task.state, b)
+    p_a = jax.device_get(s21["params"]["final_norm"])
+    p_b = jax.device_get(tr4.task.state["params"]["final_norm"])
+    np.testing.assert_allclose(p_a, p_b, atol=1e-6)
