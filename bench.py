@@ -1,0 +1,96 @@
+"""Headline benchmark: JAXJob training throughput, tokens/sec/chip.
+
+Runs the full sharded train step (fwd+bwd+Adam, donated state, bf16 compute)
+on every local device and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference (a Kubernetes orchestration platform) publishes no performance
+numbers (BASELINE.md), so vs_baseline is reported against this repo's own
+v0 measurement convention (1.0 = this run IS the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def run_bench():
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.runtime.mesh import build_mesh
+    from kubeflow_tpu.runtime.topology import GENERATIONS
+    from kubeflow_tpu.train.data import DataConfig, make_data_source
+    from kubeflow_tpu.train.optim import OptimizerConfig
+    from kubeflow_tpu.train.step import setup_train
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    n = len(devices)
+
+    if on_tpu:
+        # Llama-3 architecture sized to fit one v5e chip's HBM with fp32
+        # Adam state (~0.6B params): the per-chip unit of the 8B recipe.
+        cfg = preset(
+            "llama3-8b",
+            n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
+            mlp_dim=8192, vocab_size=32000, max_seq_len=2048,
+        )
+        model_tag = "llama3-0.6b"
+        per_chip_batch, warmup, steps = 4, 3, 20
+    else:
+        cfg = preset("tiny")
+        model_tag = "tiny"
+        per_chip_batch, warmup, steps = 8, 2, 10
+
+    mesh = build_mesh({"fsdp": n}, devices)
+    data_cfg = DataConfig(vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
+                          global_batch=per_chip_batch * n)
+    source = make_data_source(data_cfg)
+    task = setup_train(cfg, OptimizerConfig(total_steps=warmup + steps), mesh)
+
+    def step(i, state):
+        batch = jax.device_put(source.batch_at(i), task.batch_sharding)
+        state, metrics = task.step_fn(state, batch)
+        # Fetching the loss scalar forces execution of the whole step: on the
+        # axon remote-TPU tunnel, block_until_ready returns before the chain
+        # actually runs, so a host round-trip is the only reliable fence.
+        return state, float(metrics["loss"])
+
+    state = task.state
+    for i in range(warmup):
+        state, loss = step(i, state)
+
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + steps):
+        state, loss = step(i, state)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = data_cfg.global_batch * data_cfg.seq_len
+    tps_chip = tokens_per_step * steps / dt / n
+    gen = GENERATIONS["v5e"]
+    mfu = (cfg.flops_per_token() * tps_chip) / (gen.bf16_tflops * 1e12)
+
+    return {
+        "metric": f"jaxjob_train_tokens_per_sec_per_chip[{model_tag},"
+                  f"seq{data_cfg.seq_len},{'tpu' if on_tpu else 'cpu'}x{n}]",
+        "value": round(tps_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "detail": {
+            "step_time_ms": round(dt / steps * 1e3, 2),
+            "mfu_vs_v5e_peak": round(mfu, 4) if on_tpu else None,
+            "loss": round(loss, 4),
+            "params": cfg.num_params(),
+        },
+    }
+
+
+if __name__ == "__main__":
+    result = run_bench()
+    print(json.dumps(result))
+    sys.exit(0)

@@ -17,7 +17,9 @@ import jax.numpy as jnp
 
 from kubeflow_tpu.models.config import DecoderConfig
 from kubeflow_tpu.models import layers as L
-from kubeflow_tpu.parallel.sharding import LogicalRules, DEFAULT_RULES, with_logical_constraint
+from kubeflow_tpu.parallel.sharding import (
+    LogicalRules, DEFAULT_RULES, _is_spec_leaf, with_logical_constraint,
+)
 
 Params = dict[str, Any]
 
@@ -63,16 +65,23 @@ def decoder_param_specs(cfg: DecoderConfig) -> Params:
 
     The stacked layer axis prepends the "layers" logical axis to every
     per-layer leaf when scanning."""
-    _, block_specs = _init_block(jax.random.PRNGKey(0), cfg)  # structure only
+    # Trace under eval_shape so no params materialize (llama3-70b's block is
+    # ~GBs); the static spec tree is captured on the side during the trace.
+    captured = {}
+
+    def _shape_only():
+        params, specs = _init_block(jax.random.PRNGKey(0), cfg)
+        captured["specs"] = specs
+        return params
+
+    jax.eval_shape(_shape_only)
+    block_specs = captured["specs"]
 
     if cfg.scan_layers:
         def stack_spec(s):
             return ("layers",) + s
-        layer_specs = jax.tree.map(
-            stack_spec, block_specs,
-            is_leaf=lambda x: isinstance(x, tuple) and all(
-                isinstance(e, (str, type(None))) for e in x),
-        )
+        layer_specs = jax.tree.map(stack_spec, block_specs,
+                                   is_leaf=_is_spec_leaf)
     else:
         layer_specs = [block_specs] * cfg.n_layers
 
@@ -178,14 +187,17 @@ def decoder_forward(
     else:
         per_layer_aux = []
         new_k, new_v = [], []
+        block_fn = _remat(
+            lambda bp, x, cache: _block_forward(
+                bp, x, positions, cfg,
+                kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules),
+            cfg.remat_policy)
         for i, block_params in enumerate(params["layers"]):
             cache = None
             if kv_caches is not None:
                 cache = {"k": kv_caches["k"][i], "v": kv_caches["v"][i],
                          "len": kv_caches["len"]}
-            x, new_cache, aux = _block_forward(
-                block_params, x, positions, cfg,
-                kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules)
+            x, new_cache, aux = block_fn(block_params, x, cache)
             per_layer_aux.append(aux)
             if new_cache is not None:
                 new_k.append(new_cache["k"])

@@ -145,9 +145,10 @@ def with_logical_constraint(
     """`with_sharding_constraint` in logical-axis terms. Inside jit under a
     mesh context the mesh is implicit; no-op when no mesh is active."""
     spec = logical_to_mesh_axes(logical_axes, rules)
+    if mesh is not None:
+        # Explicit mesh: a failure here is a real annotation bug — propagate.
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     try:
-        if mesh is not None:
-            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
         return jax.lax.with_sharding_constraint(x, spec)
     except (ValueError, RuntimeError):
         # No mesh context (e.g. single-device eager) — constraint is advisory.

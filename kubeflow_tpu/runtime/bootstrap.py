@@ -137,15 +137,23 @@ def bootstrap_worker(wenv: Optional[WorkerEnv] = None):
     Must be called before any JAX device access in the worker process."""
     wenv = wenv or WorkerEnv.from_env()
 
-    import jax
-
     if wenv.platform == "cpu":
         # Force this worker's own virtual-device count, replacing any
-        # inherited flag (e.g. the test runner's 8-device setting).
+        # inherited flag (e.g. the test runner's 8-device setting). Set
+        # before any jax import so the CPU client sees it.
         flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
                  if "xla_force_host_platform_device_count" not in f]
         flags.append(f"--xla_force_host_platform_device_count={wenv.virtual_devices}")
         os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    if wenv.num_processes == 1 and not wenv.parallelism:
+        # Control-plane-only worker (noop/sleep/fail…): skip the jax import
+        # entirely — fast start, and SIGTERM isn't masked by native loads.
+        return wenv, None
+
+    import jax
+
+    if wenv.platform == "cpu":
         # The axon sitecustomize force-sets jax_platforms="axon,cpu"; the env
         # var alone cannot override it (see memory: axon-jax-env-facts).
         jax.config.update("jax_platforms", "cpu")

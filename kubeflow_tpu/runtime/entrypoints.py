@@ -43,6 +43,10 @@ def register_entrypoint(name: str):
 
 
 def resolve_entrypoint(name: str) -> EntrypointFn:
+    # Trivial built-ins resolve without the train-stack (jax) import: keeps
+    # control-plane workers light and promptly signal-responsive.
+    if name in _registry:
+        return _registry[name]
     _ensure_builtin()
     if name in _registry:
         return _registry[name]

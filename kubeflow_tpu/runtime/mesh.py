@@ -59,7 +59,13 @@ def build_mesh(
         return jax.make_mesh(sizes, MESH_AXES, devices=devices,
                              axis_types=axis_types)
     except (TypeError, AttributeError):
-        # Older JAX: no AxisType / no devices kwarg — plain Mesh is Auto there.
+        pass
+    try:
+        # JAX without AxisType but with make_mesh: keep the topology-aware
+        # device assignment (losing it silently reorders ICI neighbors).
+        return jax.make_mesh(sizes, MESH_AXES, devices=devices)
+    except (TypeError, AttributeError):
+        # Oldest fallback: raw reshape — plain Mesh is Auto there.
         dev_array = np.asarray(devices).reshape(sizes)
         return Mesh(dev_array, MESH_AXES)
 

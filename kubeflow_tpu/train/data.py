@@ -41,8 +41,7 @@ class SyntheticLM:
 
     def batch_at(self, step: int) -> np.ndarray:
         """[local_batch, seq_len+1] int32 tokens for this host at `step`."""
-        rng = np.random.default_rng(
-            (self.cfg.seed * 1_000_003 + step) * 97 + self.shard)
+        rng = np.random.default_rng([self.cfg.seed, step, self.shard])
         b, s, v = self.local_batch, self.cfg.seq_len + 1, self.cfg.vocab_size
         toks = np.empty((b, s), np.int32)
         toks[:, 0] = rng.integers(0, v, b)

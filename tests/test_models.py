@@ -91,10 +91,10 @@ def test_spec_tree_matches_param_tree():
         cfg = preset(name)
         params = init_decoder_params(jax.random.PRNGKey(0), cfg)
         specs = decoder_param_specs(cfg)
+        from kubeflow_tpu.parallel.sharding import _is_spec_leaf
+
         pleaves, ptree = jax.tree.flatten(params)
-        is_spec = lambda x: isinstance(x, tuple) and all(
-            isinstance(e, (str, type(None))) for e in x)
-        sleaves, stree = jax.tree.flatten(specs, is_leaf=is_spec)
+        sleaves, stree = jax.tree.flatten(specs, is_leaf=_is_spec_leaf)
         assert len(pleaves) == len(sleaves)
         for p, s in zip(pleaves, sleaves):
             assert p.ndim == len(s), (p.shape, s)
