@@ -236,6 +236,7 @@ class JAXJobStatus(ConditionMixin):
 
     replica_statuses: dict[str, ReplicaStatus] = Field(default_factory=dict)
     start_time: Optional[Any] = None
+    pending_since: Optional[Any] = None  # entered the placement queue
     completion_time: Optional[Any] = None
     restart_count: int = 0
     coordinator_address: Optional[str] = None
@@ -283,6 +284,14 @@ class WorkerSpec(BaseModel):
     coordinator_address: Optional[str] = None  # worker-0 rendezvous address
     gang_name: Optional[str] = None
     restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE
+    # Mesh axis sizes the worker's bootstrap builds its Mesh from (empty =
+    # no mesh / control-plane-only worker). Injected by the JAXJob controller
+    # from the job's ParallelismSpec — the analog of SetClusterSpec env.
+    parallelism: dict[str, int] = Field(default_factory=dict)
+    # Chips assigned by the gang allocator (indices on the owning slice).
+    chip_ids: list[int] = Field(default_factory=list)
+    slice_name: Optional[str] = None
+    attempt: int = 0  # job restart_count at creation; distinguishes gang epochs
 
 
 class WorkerStatus(ConditionMixin):
@@ -292,8 +301,6 @@ class WorkerStatus(ConditionMixin):
     pid: Optional[int] = None
     exit_code: Optional[int] = None
     message: str = ""
-    slice_name: Optional[str] = None
-    chip_ids: list[int] = Field(default_factory=list)
     last_heartbeat: Optional[Any] = None
     start_time: Optional[Any] = None
     finish_time: Optional[Any] = None

@@ -1,0 +1,13 @@
+"""Operator layer: controllers that reconcile declarative objects into
+running worker processes (SURVEY.md §7 phase 4; ≈ the reference's
+controller-runtime reconcilers, (U) training-operator pkg/controller.v1)."""
+
+from kubeflow_tpu.operator.controller import Controller, Reconciler, ReconcileResult
+from kubeflow_tpu.operator.jaxjob_controller import JAXJobController
+from kubeflow_tpu.operator.worker_runtime import WorkerRuntime
+from kubeflow_tpu.operator.control_plane import ControlPlane, ControlPlaneConfig
+
+__all__ = [
+    "Controller", "Reconciler", "ReconcileResult", "JAXJobController",
+    "WorkerRuntime", "ControlPlane", "ControlPlaneConfig",
+]

@@ -1,0 +1,480 @@
+"""JAXJob controller: reconciles JAXJobs into gang-scheduled Worker objects.
+
+The TPU-native unification of the reference's per-framework job controllers
+((U) training-operator pkg/controller.v1/{pytorch,tensorflow,mpi}/*_controller.go
+over the shared engine pkg/controller.v1/common/job.go — SURVEY.md §2.2#15-16,
+§3.1). What carries over: level-triggered reconcile, per-replica child
+creation, status aggregation into conditions, RestartPolicy/backoffLimit/
+activeDeadline/ttl/suspend semantics, gang scheduling.
+
+What is deliberately different (TPU-native):
+
+- **Whole-gang restart.** The reference restarts individual pods; an SPMD
+  gang cannot absorb that — a dead process wedges every collective and a new
+  process cannot rejoin a live `jax.distributed` cluster. Any worker failure
+  therefore tears down the whole gang and relaunches it (from the latest
+  checkpoint — resume is first-class in RunPolicy, not user code).
+- **Placement before pods.** The reference creates pods and lets Volcano hold
+  them; here the gang allocator answers *before* any Worker object exists, so
+  a queued job is visibly Pending with zero side effects.
+- **Coordinator assignment.** Rendezvous env (coordinator address = worker-0,
+  process ids) replaces MASTER_ADDR/TF_CONFIG/hostfile injection
+  ((U) pytorch/envvar.go SetClusterSpec).
+- **Failure detection is leased.** Worker heartbeat staleness (marked by the
+  worker runtime) is a retryable failure like a preemption, not a job error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from kubeflow_tpu.core.events import EventRecorder, default_recorder
+from kubeflow_tpu.core.jobs import (
+    WORKER, CleanPodPolicy, JAXJob, JobConditionType, ReplicaStatus,
+    RestartPolicy, Worker, WorkerPhase, WorkerSpec, WorkerStatus, worker_name,
+)
+from kubeflow_tpu.core.object import ObjectMeta, utcnow
+from kubeflow_tpu.core.store import (
+    AlreadyExistsError, NotFoundError, ObjectStore, WatchEvent,
+)
+from kubeflow_tpu.operator.controller import ReconcileResult
+from kubeflow_tpu.runtime.allocator import (
+    GangAllocator, GangRequest, InsufficientCapacityError,
+)
+from kubeflow_tpu.runtime.bootstrap import free_port
+
+# Labels on Worker objects (≈ training.kubeflow.org/replica-{type,index}).
+LABEL_JOB = "training.tpu.kubeflow.dev/job-name"
+LABEL_REPLICA_TYPE = "training.tpu.kubeflow.dev/replica-type"
+LABEL_REPLICA_INDEX = "training.tpu.kubeflow.dev/replica-index"
+
+_PLACEMENT_POLL = 0.5   # seconds between queue-position re-checks
+_FINISHED_PHASES = (WorkerPhase.SUCCEEDED, WorkerPhase.FAILED)
+
+
+def _is_retryable_exit(code: Optional[int]) -> bool:
+    """Exit-code contract: >=128 (signals/preemption/rendezvous) retryable.
+
+    ``None`` (no exit code: heartbeat-stale kill, lost process) is retryable —
+    it is the shape of an infrastructure failure, not a program bug."""
+    return code is None or code >= 128
+
+
+class JAXJobController:
+    """Reconciler for JAXJob (+ owned Worker) objects."""
+
+    kinds = [JAXJob.KIND, Worker.KIND]
+
+    def __init__(self, store: ObjectStore, allocator: GangAllocator, *,
+                 base_dir: str, recorder: Optional[EventRecorder] = None,
+                 metrics_sync_interval: Optional[float] = 1.0):
+        self.store = store
+        self.allocator = allocator
+        self.base_dir = base_dir
+        self.recorder = recorder or default_recorder
+        # Periodic resync while workers run: lifts fresh data-plane metrics
+        # onto job status between watch events (None = event-driven only).
+        self.metrics_sync_interval = metrics_sync_interval
+
+    # -- event routing ---------------------------------------------------------
+
+    def key_for(self, ev: WatchEvent) -> Optional[str]:
+        obj = ev.object
+        if obj.kind == JAXJob.KIND:
+            return obj.metadata.key
+        if obj.kind == Worker.KIND:
+            return obj.spec.job  # route child events to the owning job
+        return None
+
+    # -- reconcile -------------------------------------------------------------
+
+    def reconcile(self, key: str) -> Optional[ReconcileResult]:
+        namespace, name = key.split("/", 1)
+        job = self.store.try_get(JAXJob, name, namespace)
+        if job is None:
+            # Job deleted: tear down whatever it left behind.
+            self.allocator.release(key)
+            for w in self._workers(key):
+                self._delete_worker(w)
+            return None
+
+        if job.status.phase in ("Succeeded", "Failed"):
+            return self._reconcile_finished(job)
+
+        if job.spec.run_policy.suspend:
+            return self._reconcile_suspended(job)
+
+        # Admission bookkeeping.
+        if not job.status.has_condition(JobConditionType.CREATED.value):
+            job.status.set_condition(JobConditionType.CREATED.value,
+                                     reason="JobCreated")
+            self.recorder.normal(job, "JobCreated", "job admitted")
+        if job.status.start_time is None:
+            job.status.start_time = utcnow()
+        # Coming back from suspension: clear the marker so phase recomputes.
+        if job.status.has_condition(JobConditionType.SUSPENDED.value):
+            job.status.set_condition(JobConditionType.SUSPENDED.value,
+                                     status=False, reason="Resumed")
+
+        # Active deadline (≈ RunPolicy.activeDeadlineSeconds).
+        deadline = job.spec.run_policy.active_deadline_seconds
+        if deadline is not None and job.status.start_time is not None:
+            elapsed = (utcnow() - job.status.start_time).total_seconds()
+            if elapsed >= deadline:
+                return self._fail(job, "DeadlineExceeded",
+                                  f"active deadline {deadline}s exceeded")
+            result_deadline = deadline - elapsed
+        else:
+            result_deadline = None
+
+        # Elastic / spec resize: desired shape changed under a live gang
+        # (worker count, chips per worker, or mesh axes) → tear down and
+        # re-gang at the new shape (resharded resume from checkpoint).
+        spec = job.spec.worker
+        desired_parallelism = (job.spec.parallelism.axis_sizes()
+                               if job.spec.parallelism.total > 1 else {})
+        alloc = self.allocator.allocation(key)
+        if alloc is not None and (
+                alloc.request.num_workers != spec.replicas
+                or alloc.request.chips_per_worker != spec.resources.tpu_chips
+                or any(w.spec.parallelism != desired_parallelism
+                       for w in self._workers(key))):
+            return self._resize(job, alloc)
+
+        # Gang placement (all-or-nothing; queue = visible Pending).
+        if alloc is None:
+            try:
+                alloc = self.allocator.submit(GangRequest(
+                    name=key,
+                    num_workers=spec.replicas,
+                    chips_per_worker=spec.resources.tpu_chips,
+                    priority=job.spec.run_policy.scheduling_policy.priority,
+                    queue=job.spec.run_policy.scheduling_policy.queue,
+                ))
+            except InsufficientCapacityError as exc:
+                return self._fail(job, "InsufficientCapacity", str(exc))
+            if alloc is None:
+                # Timeout counts from entering the queue (this wait), not job
+                # admission — a resumed/resized job waits afresh.
+                if job.status.pending_since is None:
+                    job.status.pending_since = utcnow()
+                timeout = job.spec.run_policy.scheduling_policy.timeout_seconds
+                if timeout is not None:
+                    waited = (utcnow() - job.status.pending_since).total_seconds()
+                    if waited >= timeout:
+                        self.allocator.release(key)
+                        return self._fail(job, "PlacementTimeout",
+                                          f"no placement after {waited:.0f}s")
+                self.recorder.normal(job, "Pending", "waiting for gang placement")
+                self._update_status(job)
+                return ReconcileResult(requeue_after=_PLACEMENT_POLL)
+            self.recorder.normal(
+                job, "GangScheduled",
+                f"placed on slice {alloc.slice_name}: {alloc.request.total_chips} chips")
+        job.status.pending_since = None
+
+        if job.status.gang_name is None:
+            job.status.gang_name = key
+        if job.status.coordinator_address is None:
+            job.status.coordinator_address = f"127.0.0.1:{free_port()}"
+
+        # Materialize Worker objects for the current attempt.
+        workers = self._workers(key)
+        current = [w for w in workers if w.spec.attempt == job.status.restart_count]
+        stale = [w for w in workers if w.spec.attempt != job.status.restart_count]
+        for w in stale:  # leftovers of a torn-down attempt still draining
+            self._delete_worker(w)
+        have = {w.spec.replica_index for w in current}
+        for i in range(spec.replicas):
+            if i not in have:
+                current.append(self._create_worker(job, alloc, i))
+
+        # Aggregate → ReplicaStatus + conditions (≈ common/status.go).
+        rs = ReplicaStatus()
+        for w in current:
+            if w.status.phase == WorkerPhase.SUCCEEDED:
+                rs.succeeded += 1
+            elif w.status.phase == WorkerPhase.FAILED:
+                rs.failed += 1
+            else:
+                rs.active += 1
+        job.status.replica_statuses = {WORKER: rs}
+
+        self._sync_metrics(job, current)
+
+        failed = [w for w in current if w.status.phase == WorkerPhase.FAILED]
+        if failed:
+            return self._handle_failures(job, current, failed)
+
+        if rs.succeeded == spec.replicas:
+            return self._succeed(job)
+
+        if rs.active == spec.replicas and all(
+                w.status.phase == WorkerPhase.RUNNING for w in current):
+            if not job.status.has_condition(JobConditionType.RUNNING.value):
+                self.recorder.normal(job, "JobRunning", "all workers running")
+            job.status.set_condition(JobConditionType.RUNNING.value,
+                                     reason="AllWorkersRunning")
+            job.status.set_condition(JobConditionType.RESTARTING.value,
+                                     status=False, reason="Recovered")
+
+        self._update_status(job)
+        # Requeue for whichever comes first: deadline expiry or the periodic
+        # metrics resync (worker events also wake us immediately).
+        delays = [d for d in (result_deadline, self.metrics_sync_interval)
+                  if d is not None]
+        return ReconcileResult(requeue_after=min(delays) if delays else None)
+
+    # -- terminal / suspended states -------------------------------------------
+
+    def _reconcile_finished(self, job: JAXJob) -> Optional[ReconcileResult]:
+        key = job.metadata.key
+        self.allocator.release(key)
+        policy = job.spec.run_policy.clean_pod_policy
+        for w in self._workers(key):
+            if policy == CleanPodPolicy.ALL:
+                self._delete_worker(w)
+            elif policy == CleanPodPolicy.RUNNING and w.status.phase not in _FINISHED_PHASES:
+                self._delete_worker(w)
+
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None:
+            done_at = job.status.completion_time or utcnow()
+            remaining = ttl - (utcnow() - done_at).total_seconds()
+            if remaining <= 0:
+                # Cascade: children first, then the job itself.
+                for w in self._workers(key):
+                    self._delete_worker(w)
+                try:
+                    self.store.delete(JAXJob, job.metadata.name, job.metadata.namespace)
+                except NotFoundError:
+                    pass
+                return None
+            return ReconcileResult(requeue_after=remaining)
+        return None
+
+    def _reconcile_suspended(self, job: JAXJob) -> Optional[ReconcileResult]:
+        key = job.metadata.key
+        for w in self._workers(key):
+            self._delete_worker(w)
+        self.allocator.release(key)
+        if not job.status.has_condition(JobConditionType.SUSPENDED.value):
+            self.recorder.normal(job, "JobSuspended",
+                                 "workers stopped, gang released")
+        job.status.set_condition(JobConditionType.SUSPENDED.value,
+                                 reason="SuspendRequested")
+        job.status.set_condition(JobConditionType.RUNNING.value,
+                                 status=False, reason="Suspended")
+        job.status.replica_statuses = {WORKER: ReplicaStatus()}
+        self._update_status(job)
+        return None
+
+    # -- failure / restart machinery -------------------------------------------
+
+    def _handle_failures(self, job: JAXJob, workers: list[Worker],
+                         failed: list[Worker]) -> Optional[ReconcileResult]:
+        spec = job.spec.worker
+        policy = spec.restart_policy
+        reached_running = job.status.has_condition(JobConditionType.RUNNING.value)
+
+        def describe(w: Worker) -> str:
+            return (f"{w.metadata.name}: exit={w.status.exit_code} "
+                    f"{w.status.message}".strip())
+
+        retryable: bool
+        if policy == RestartPolicy.NEVER:
+            retryable = False
+        elif policy in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE):
+            retryable = True
+        else:  # EXIT_CODE
+            # Root-cause attribution: when one worker dies, its gang peers
+            # die too (their collectives lose a participant) with exit codes
+            # that say nothing about the real cause. The EARLIEST failure is
+            # the root cause; only its exit code decides retryability.
+            root = min(failed, key=lambda w: (w.status.finish_time is None,
+                                              w.status.finish_time))
+            retryable = _is_retryable_exit(root.status.exit_code)
+            # A gang that died before ever running is a rendezvous/placement
+            # failure — infrastructure, not the program (bootstrap.py notes
+            # the coordination client can abort without a clean exit code).
+            if not retryable and not reached_running:
+                retryable = True
+
+        if not retryable:
+            return self._fail(job, "WorkerFailed",
+                              "; ".join(describe(w) for w in failed))
+
+        max_restarts = job.spec.run_policy.backoff_limit
+        if job.spec.elastic_policy is not None:
+            max_restarts = max(max_restarts, job.spec.elastic_policy.max_restarts)
+        if job.status.restart_count >= max_restarts:
+            return self._fail(
+                job, "BackoffLimitExceeded",
+                f"restarted {job.status.restart_count}x; last: "
+                + "; ".join(describe(w) for w in failed))
+
+        # Whole-gang restart: every worker goes; chips stay allocated.
+        self.recorder.warning(
+            job, "GangRestart",
+            f"attempt {job.status.restart_count + 1}: "
+            + "; ".join(describe(w) for w in failed))
+        for w in workers:
+            self._delete_worker(w)
+        job.status.restart_count += 1
+        job.status.coordinator_address = f"127.0.0.1:{free_port()}"
+        job.status.set_condition(JobConditionType.RESTARTING.value,
+                                 reason="GangRestart")
+        job.status.set_condition(JobConditionType.RUNNING.value,
+                                 status=False, reason="Restarting")
+        self._update_status(job)
+        # Recreate on the next pass so worker deletion events settle first.
+        return ReconcileResult(requeue_after=0.05)
+
+    def _resize(self, job: JAXJob, alloc) -> Optional[ReconcileResult]:
+        key = job.metadata.key
+        new = job.spec.worker.replicas
+        self.recorder.normal(
+            job, "Resizing",
+            f"{alloc.request.num_workers} -> {new} workers; re-ganging")
+        for w in self._workers(key):
+            self._delete_worker(w)
+        self.allocator.release(key)
+        job.status.gang_name = None
+        job.status.coordinator_address = None
+        job.status.set_condition(JobConditionType.RESTARTING.value,
+                                 reason="Resized")
+        job.status.set_condition(JobConditionType.RUNNING.value,
+                                 status=False, reason="Resizing")
+        self._update_status(job)
+        return ReconcileResult(requeue_after=0.05)
+
+    def _succeed(self, job: JAXJob) -> Optional[ReconcileResult]:
+        job.status.set_condition(JobConditionType.SUCCEEDED.value,
+                                 reason="AllWorkersSucceeded")
+        job.status.set_condition(JobConditionType.RUNNING.value,
+                                 status=False, reason="Finished")
+        job.status.completion_time = utcnow()
+        self.recorder.normal(job, "JobSucceeded", "all workers succeeded")
+        self._update_status(job)
+        return self._reconcile_finished(job)
+
+    def _fail(self, job: JAXJob, reason: str, message: str) -> Optional[ReconcileResult]:
+        job.status.set_condition(JobConditionType.FAILED.value,
+                                 reason=reason, message=message)
+        job.status.set_condition(JobConditionType.RUNNING.value,
+                                 status=False, reason="Failed")
+        job.status.completion_time = utcnow()
+        self.recorder.warning(job, reason, message)
+        self._update_status(job)
+        return self._reconcile_finished(job)
+
+    # -- children --------------------------------------------------------------
+
+    def _workers(self, job_key: str) -> list[Worker]:
+        namespace, name = job_key.split("/", 1)
+        return self.store.list(Worker, namespace=namespace,
+                               label_selector={LABEL_JOB: name})
+
+    def job_dir(self, job: JAXJob) -> str:
+        return os.path.join(self.base_dir, job.metadata.namespace,
+                            job.metadata.name)
+
+    def _create_worker(self, job: JAXJob, alloc, index: int) -> Worker:
+        spec = job.spec.worker
+        name = worker_name(job.metadata.name, WORKER, index)
+        jdir = self.job_dir(job)
+        template = spec.template.model_copy(deep=True)
+        if template.working_dir is None:
+            template.working_dir = os.path.join(jdir, f"worker-{index}")
+        # First-class checkpointing: default the trainer's checkpoint dir into
+        # the job dir so every attempt resumes from the same place (the
+        # reference leaves this to user pods — SURVEY.md §5 checkpoint/resume).
+        ckpt = job.spec.run_policy.checkpoint
+        if ckpt.enabled and "checkpoint_dir" not in template.config:
+            template.config["checkpoint_dir"] = (
+                ckpt.directory or os.path.join(jdir, "ckpt"))
+            template.config.setdefault("checkpoint_every", ckpt.interval_steps)
+            template.config.setdefault("max_checkpoints", ckpt.max_to_keep)
+        parallelism = (job.spec.parallelism.axis_sizes()
+                       if job.spec.parallelism.total > 1 else {})
+        w = Worker(
+            metadata=ObjectMeta(
+                name=name, namespace=job.metadata.namespace,
+                labels={LABEL_JOB: job.metadata.name,
+                        LABEL_REPLICA_TYPE: WORKER,
+                        LABEL_REPLICA_INDEX: str(index)},
+                owner=job.key,
+            ),
+            spec=WorkerSpec(
+                job=job.metadata.key,
+                replica_index=index,
+                num_workers=spec.replicas,
+                template=template,
+                resources=spec.resources,
+                coordinator_address=job.status.coordinator_address,
+                gang_name=job.status.gang_name,
+                restart_policy=spec.restart_policy,
+                parallelism=parallelism,
+                chip_ids=list(alloc.chip_assignment.get(index, [])),
+                slice_name=alloc.slice_name,
+                attempt=job.status.restart_count,
+            ),
+            status=WorkerStatus(phase=WorkerPhase.PENDING),
+        )
+        try:
+            created = self.store.create(w)
+        except AlreadyExistsError:
+            return self.store.get(Worker, name, job.metadata.namespace)
+        self.recorder.normal(job, "CreatedWorker", f"created {name}")
+        return created
+
+    def _delete_worker(self, w: Worker) -> None:
+        try:
+            self.store.delete(Worker, w.metadata.name, w.metadata.namespace)
+        except NotFoundError:
+            pass
+
+    # -- status plumbing -------------------------------------------------------
+
+    def _sync_metrics(self, job: JAXJob, workers: list[Worker]) -> None:
+        """Lift data-plane metrics (worker-0's metrics.jsonl tail) onto the
+        job status — the platform-visible analog of tokens/sec the reference
+        never surfaces (SURVEY.md §5 observability)."""
+        for w in workers:
+            if w.spec.replica_index != 0 or not w.spec.template.working_dir:
+                continue
+            path = os.path.join(w.spec.template.working_dir, "metrics.jsonl")
+            line = _tail_line(path)
+            if not line:
+                return
+            try:
+                m = json.loads(line)
+            except ValueError:
+                return
+            job.status.metrics.step = int(m.get("step", job.status.metrics.step))
+            for field in ("tokens_per_sec_per_chip", "step_time_ms", "mfu", "loss"):
+                if m.get(field) is not None:
+                    setattr(job.status.metrics, field, float(m[field]))
+            return
+
+    def _update_status(self, job: JAXJob) -> None:
+        try:
+            self.store.update_status(job)
+        except NotFoundError:
+            pass
+
+
+def _tail_line(path: str, max_bytes: int = 8192) -> Optional[str]:
+    """Last complete line of a file, cheaply (no full read)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - max_bytes))
+            chunk = f.read().decode("utf-8", "replace")
+    except OSError:
+        return None
+    lines = [ln for ln in chunk.splitlines() if ln.strip()]
+    return lines[-1] if lines else None

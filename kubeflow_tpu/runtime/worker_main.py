@@ -51,12 +51,31 @@ def main() -> int:
         return 0 if rc is None else int(rc)
     except SystemExit as e:
         return int(e.code or 0)
-    except Exception:
+    except Exception as exc:
         traceback.print_exc()
-        return EXIT_PERMANENT
+        return _classify_exit(exc)
     finally:
         if hb is not None:
             hb.stop()
+
+
+def _classify_exit(exc: Exception) -> int:
+    """Distributed-runtime failures (dead coordinator, aborted collective,
+    lost peer) are infrastructure: exit retryable so the controller re-gangs.
+    Everything else is a program bug: exit permanent. Matched on type/module
+    because XLA surfaces these as generic RuntimeError subclasses."""
+    from kubeflow_tpu.runtime.bootstrap import EXIT_PERMANENT, EXIT_RETRYABLE
+
+    # Type/module only — never the message, or a user ValueError("bad
+    # connection string") would masquerade as infrastructure.
+    qualname = f"{type(exc).__module__}.{type(exc).__name__}".lower()
+    infra_markers = (
+        "xlaruntimeerror", "coordination", "distributed",
+        "deadlineexceeded", "unavailable", "grpc",
+    )
+    if any(m in qualname for m in infra_markers):
+        return EXIT_RETRYABLE
+    return EXIT_PERMANENT
 
 
 if __name__ == "__main__":

@@ -1,0 +1,299 @@
+"""JAXJob controller semantics, envtest-style: no processes — tests drive
+Worker statuses by hand, exactly how the reference tests reconcilers against
+envtest with no kubelet (SURVEY.md §4.2)."""
+
+import pytest
+
+from kubeflow_tpu.core.jobs import (
+    JAXJob, JAXJobSpec, JobConditionType, ReplicaSpec, RestartPolicy,
+    TPUResourceSpec, Worker, WorkerPhase, WorkloadSpec, ParallelismSpec,
+    ElasticPolicy, RunPolicy, SchedulingPolicy, CheckpointPolicy,
+    worker_name,
+)
+from kubeflow_tpu.core.object import ObjectMeta
+from kubeflow_tpu.operator.control_plane import ControlPlane, ControlPlaneConfig
+from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+
+
+@pytest.fixture()
+def cp(tmp_path):
+    plane = ControlPlane(ControlPlaneConfig(
+        base_dir=str(tmp_path),
+        cluster=Cluster(slices=[SliceTopology(name="s0", generation="v5e",
+                                              dims=(2, 2))]),
+        launch_processes=False,
+        metrics_sync_interval=None,
+    ))
+    yield plane
+
+
+def make_job(name="job", replicas=2, chips=1, **spec_kw) -> JAXJob:
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        spec=JAXJobSpec(
+            replica_specs={"worker": ReplicaSpec(
+                replicas=replicas,
+                template=WorkloadSpec(entrypoint="noop"),
+                resources=TPUResourceSpec(tpu_chips=chips),
+                **({"restart_policy": spec_kw.pop("restart_policy")}
+                   if "restart_policy" in spec_kw else {}),
+            )},
+            **spec_kw,
+        ),
+    )
+
+
+def workers_of(cp, name="job") -> list[Worker]:
+    ws = cp.store.list(Worker, label_selector={
+        "training.tpu.kubeflow.dev/job-name": name})
+    return sorted(ws, key=lambda w: w.spec.replica_index)
+
+
+def set_phase(cp, w: Worker, phase: WorkerPhase, exit_code=None, message=""):
+    w = cp.store.get(Worker, w.metadata.name, w.metadata.namespace)
+    w.status.phase = phase
+    w.status.exit_code = exit_code
+    w.status.message = message
+    cp.store.update_status(w)
+
+
+def run_all(cp, job, *phases):
+    """Drive all workers through the given phases, stepping between."""
+    for phase in phases:
+        for w in workers_of(cp, job.metadata.name):
+            set_phase(cp, w, phase, exit_code=0 if phase == WorkerPhase.SUCCEEDED else None)
+        cp.step()
+
+
+class TestPlacementAndLaunch:
+    def test_creates_workers_with_rendezvous(self, cp):
+        job = cp.submit(make_job(replicas=2, parallelism=ParallelismSpec(data=2)))
+        cp.step()
+        ws = workers_of(cp)
+        assert len(ws) == 2
+        job = cp.get_job("job")
+        assert job.status.phase == "Pending" or job.status.has_condition("Created")
+        coord = job.status.coordinator_address
+        assert coord and coord.startswith("127.0.0.1:")
+        for i, w in enumerate(ws):
+            assert w.spec.replica_index == i
+            assert w.spec.num_workers == 2
+            assert w.spec.coordinator_address == coord
+            assert w.spec.parallelism == {"dcn": 1, "pipeline": 1, "data": 2,
+                                          "fsdp": 1, "expert": 1, "seq": 1,
+                                          "model": 1}
+            assert w.spec.slice_name == "s0"
+            assert len(w.spec.chip_ids) == 1
+        # chips are disjoint
+        chips = [c for w in ws for c in w.spec.chip_ids]
+        assert len(set(chips)) == 2
+
+    def test_all_running_sets_running_condition(self, cp):
+        job = cp.submit(make_job())
+        cp.step()
+        for w in workers_of(cp):
+            set_phase(cp, w, WorkerPhase.RUNNING)
+        cp.step()
+        job = cp.get_job("job")
+        assert job.status.phase == "Running"
+        assert job.status.replica_statuses["worker"].active == 2
+
+    def test_success_releases_gang(self, cp):
+        job = cp.submit(make_job())
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING, WorkerPhase.SUCCEEDED)
+        job = cp.get_job("job")
+        assert job.status.phase == "Succeeded"
+        assert job.status.completion_time is not None
+        assert cp.allocator.allocation("default/job") is None
+        assert cp.allocator.free_chips("s0") == 4
+
+    def test_checkpoint_config_injected(self, cp):
+        job = make_job()
+        job.spec.run_policy.checkpoint = CheckpointPolicy(
+            enabled=True, interval_steps=7)
+        cp.submit(job)
+        cp.step()
+        w = workers_of(cp)[0]
+        assert w.spec.template.config["checkpoint_dir"].endswith("default/job/ckpt")
+        assert w.spec.template.config["checkpoint_every"] == 7
+
+
+class TestQueueing:
+    def test_second_job_queues_until_capacity(self, cp):
+        # Slice has 4 chips; each job wants 4.
+        j1 = cp.submit(make_job("a", replicas=4))
+        cp.step()
+        j2 = cp.submit(make_job("b", replicas=4))
+        cp.step()
+        assert len(workers_of(cp, "a")) == 4
+        assert workers_of(cp, "b") == []
+        assert cp.allocator.pending()[0].name == "default/b"
+        # finish job a → b gets placed
+        run_all(cp, j1, WorkerPhase.RUNNING, WorkerPhase.SUCCEEDED)
+        cp.step()
+        assert len(workers_of(cp, "b")) == 4
+
+    def test_impossible_job_fails_fast(self, cp):
+        cp.submit(make_job(replicas=8))  # 8 chips > 4-chip cluster
+        cp.step()
+        job = cp.get_job("job")
+        assert job.status.phase == "Failed"
+        assert job.status.get_condition("Failed").reason == "InsufficientCapacity"
+
+    def test_placement_timeout(self, cp):
+        j1 = cp.submit(make_job("a", replicas=4))
+        cp.step()
+        j2 = make_job("b", replicas=4)
+        j2.spec.run_policy.scheduling_policy = SchedulingPolicy(timeout_seconds=0.0)
+        cp.submit(j2)
+        cp.step()
+        job = cp.get_job("b")
+        assert job.status.phase == "Failed"
+        assert job.status.get_condition("Failed").reason == "PlacementTimeout"
+
+
+class TestFailureSemantics:
+    def test_permanent_failure_fails_job(self, cp):
+        job = cp.submit(make_job(restart_policy=RestartPolicy.EXIT_CODE))
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING)
+        ws = workers_of(cp)
+        set_phase(cp, ws[0], WorkerPhase.FAILED, exit_code=1, message="bug")
+        cp.step()
+        job = cp.get_job("job")
+        assert job.status.phase == "Failed"
+        assert "exit=1" in job.status.get_condition("Failed").message
+        assert cp.allocator.allocation("default/job") is None
+
+    def test_retryable_failure_restarts_whole_gang(self, cp):
+        job = cp.submit(make_job(restart_policy=RestartPolicy.EXIT_CODE))
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING)
+        old_coord = cp.get_job("job").status.coordinator_address
+        old_uids = {w.metadata.uid for w in workers_of(cp)}
+        ws = workers_of(cp)
+        set_phase(cp, ws[1], WorkerPhase.FAILED, exit_code=137)  # preemption
+        cp.step()
+        job = cp.get_job("job")
+        assert job.status.restart_count == 1
+        ws = workers_of(cp)
+        assert len(ws) == 2  # recreated
+        assert {w.metadata.uid for w in ws}.isdisjoint(old_uids)
+        assert all(w.spec.attempt == 1 for w in ws)
+        # new rendezvous epoch: coordinator port rotated
+        assert cp.get_job("job").status.coordinator_address != old_coord
+        # gang kept its chips throughout
+        assert cp.allocator.allocation("default/job") is not None
+
+    def test_never_policy_fails_on_any_exit(self, cp):
+        job = cp.submit(make_job(restart_policy=RestartPolicy.NEVER))
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING)
+        set_phase(cp, workers_of(cp)[0], WorkerPhase.FAILED, exit_code=143)
+        cp.step()
+        assert cp.get_job("job").status.phase == "Failed"
+
+    def test_prerunning_death_is_retryable_even_with_bad_code(self, cp):
+        # Rendezvous aborts can exit <128; before Running they're retryable.
+        job = cp.submit(make_job(restart_policy=RestartPolicy.EXIT_CODE))
+        cp.step()
+        set_phase(cp, workers_of(cp)[0], WorkerPhase.FAILED, exit_code=1)
+        cp.step()
+        job = cp.get_job("job")
+        assert job.status.phase != "Failed"
+        assert job.status.restart_count == 1
+
+    def test_backoff_limit_exceeded(self, cp):
+        j = make_job(restart_policy=RestartPolicy.ON_FAILURE)
+        j.spec.run_policy.backoff_limit = 1
+        cp.submit(j)
+        cp.step()
+        for _ in range(2):
+            run_all(cp, j, WorkerPhase.RUNNING)
+            set_phase(cp, workers_of(cp)[0], WorkerPhase.FAILED, exit_code=130)
+            cp.step()
+        job = cp.get_job("job")
+        assert job.status.phase == "Failed"
+        assert job.status.get_condition("Failed").reason == "BackoffLimitExceeded"
+        assert job.status.restart_count == 1
+
+    def test_heartbeat_stale_is_retryable(self, cp):
+        job = cp.submit(make_job(restart_policy=RestartPolicy.EXIT_CODE))
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING)
+        set_phase(cp, workers_of(cp)[0], WorkerPhase.FAILED,
+                  exit_code=None, message="heartbeat stale; killed")
+        cp.step()
+        job = cp.get_job("job")
+        assert job.status.phase != "Failed"
+        assert job.status.restart_count == 1
+
+
+class TestLifecyclePolicies:
+    def test_suspend_and_resume(self, cp):
+        job = cp.submit(make_job())
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING)
+        j = cp.get_job("job")
+        j.spec.run_policy.suspend = True
+        cp.store.update(j)
+        cp.step()
+        j = cp.get_job("job")
+        assert j.status.phase == "Suspended"
+        assert workers_of(cp) == []
+        assert cp.allocator.allocation("default/job") is None
+        # resume
+        j.spec.run_policy.suspend = False
+        cp.store.update(j)
+        cp.step()
+        assert len(workers_of(cp)) == 2
+        assert cp.get_job("job").status.phase not in ("Suspended",)
+
+    def test_active_deadline(self, cp):
+        j = make_job()
+        j.spec.run_policy.active_deadline_seconds = 0.0
+        cp.submit(j)
+        cp.step()
+        job = cp.get_job("job")
+        assert job.status.phase == "Failed"
+        assert job.status.get_condition("Failed").reason == "DeadlineExceeded"
+
+    def test_ttl_deletes_job(self, cp):
+        j = make_job()
+        j.spec.run_policy.ttl_seconds_after_finished = 0.0
+        job = cp.submit(j)
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING, WorkerPhase.SUCCEEDED)
+        cp.step()
+        assert cp.get_job("job") is None
+        assert workers_of(cp) == []
+
+    def test_job_deletion_cleans_up(self, cp):
+        job = cp.submit(make_job())
+        cp.step()
+        assert len(workers_of(cp)) == 2
+        cp.store.delete(JAXJob, "job")
+        cp.step()
+        assert workers_of(cp) == []
+        assert cp.allocator.allocation("default/job") is None
+
+
+class TestElastic:
+    def test_resize_regangs_at_new_size(self, cp):
+        j = make_job(replicas=2, elastic_policy=ElasticPolicy(
+            min_replicas=1, max_replicas=4))
+        job = cp.submit(j)
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING)
+        j = cp.get_job("job")
+        j.spec.replica_specs["worker"].replicas = 4
+        cp.store.update(j)
+        cp.step()
+        ws = workers_of(cp)
+        assert len(ws) == 4
+        assert all(w.spec.num_workers == 4 for w in ws)
+        alloc = cp.allocator.allocation("default/job")
+        assert alloc.request.num_workers == 4
+        # resize is not a failure: no backoff consumed
+        assert cp.get_job("job").status.restart_count == 0
