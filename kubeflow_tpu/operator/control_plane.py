@@ -63,8 +63,12 @@ class ControlPlane:
             self.store, self.allocator,
             base_dir=self.config.base_dir, recorder=self.recorder,
             metrics_sync_interval=self.config.metrics_sync_interval)
+        from kubeflow_tpu.serve.isvc_controller import ISVCController
+
+        self.isvc_reconciler = ISVCController(self.store, recorder=self.recorder)
         self.controllers: list[Controller] = [
             Controller(self.store, self.jaxjob_reconciler, name="jaxjob"),
+            Controller(self.store, self.isvc_reconciler, name="isvc"),
         ]
         self.runtime: Optional[WorkerRuntime] = None
         if self.config.launch_processes:
@@ -112,6 +116,7 @@ class ControlPlane:
             self._runtime_thread = None
         if self.runtime is not None:
             self.runtime.shutdown()
+        self.isvc_reconciler.shutdown()
 
     def step(self) -> int:
         """Deterministic single-threaded pump (test mode)."""

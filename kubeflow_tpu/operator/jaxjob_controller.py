@@ -263,6 +263,7 @@ class JAXJobController:
         if not job.status.has_condition(JobConditionType.SUSPENDED.value):
             self.recorder.normal(job, "JobSuspended",
                                  "workers stopped, gang released")
+        job.status.pending_since = None   # a resumed job waits afresh
         job.status.set_condition(JobConditionType.SUSPENDED.value,
                                  reason="SuspendRequested")
         job.status.set_condition(JobConditionType.RUNNING.value,

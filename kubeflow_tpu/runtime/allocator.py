@@ -99,7 +99,14 @@ class GangAllocator:
                 )
             if req.name not in self._order:
                 self._order[req.name] = next(self._seq)
-            if all(p.name != req.name for p in self._pending):
+            for i, p in enumerate(self._pending):
+                if p.name == req.name:
+                    # Latest submit wins: a queued gang resubmitted with a new
+                    # shape (elastic resize while Pending) replaces its entry,
+                    # keeping its queue position.
+                    self._pending[i] = req
+                    break
+            else:
                 self._pending.append(req)
             self._schedule_locked()
             return self._allocations.get(req.name)

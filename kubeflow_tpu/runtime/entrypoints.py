@@ -58,9 +58,13 @@ def resolve_entrypoint(name: str) -> EntrypointFn:
 
 
 def _ensure_builtin() -> None:
-    # Trainer entrypoints self-register on import.
+    # Trainer/server entrypoints self-register on import.
     try:
         import kubeflow_tpu.train.entrypoints  # noqa: F401
+    except ImportError:
+        pass
+    try:
+        import kubeflow_tpu.serve.model_server  # noqa: F401
     except ImportError:
         pass
 

@@ -66,14 +66,23 @@ def _classify_exit(exc: Exception) -> int:
     because XLA surfaces these as generic RuntimeError subclasses."""
     from kubeflow_tpu.runtime.bootstrap import EXIT_PERMANENT, EXIT_RETRYABLE
 
-    # Type/module only — never the message, or a user ValueError("bad
-    # connection string") would masquerade as infrastructure.
-    qualname = f"{type(exc).__module__}.{type(exc).__name__}".lower()
-    infra_markers = (
-        "xlaruntimeerror", "coordination", "distributed",
-        "deadlineexceeded", "unavailable", "grpc",
-    )
-    if any(m in qualname for m in infra_markers):
+    mod = type(exc).__module__ or ""
+    tname = type(exc).__name__
+    # XLA surfaces both infra failures (lost peer, aborted collective) and
+    # deterministic program errors (OOM, bad shapes) as XlaRuntimeError;
+    # the status-code prefix in the message distinguishes them. A
+    # deterministic failure must fail fast, not burn gang restarts.
+    if tname == "XlaRuntimeError":
+        msg = str(exc).upper()
+        if "RESOURCE_EXHAUSTED" in msg or "INVALID_ARGUMENT" in msg:
+            return EXIT_PERMANENT
+        return EXIT_RETRYABLE
+    # Exact type names / top-level runtime modules only — substring matching
+    # on user module paths (e.g. mylib.distributed_utils) must not match.
+    infra_types = {"DeadlineExceeded", "UnavailableError", "AbortedError",
+                   "InternalError", "JaxRuntimeError"}
+    root_mod = mod.split(".", 1)[0]
+    if tname in infra_types or root_mod in ("jaxlib", "grpc"):
         return EXIT_RETRYABLE
     return EXIT_PERMANENT
 

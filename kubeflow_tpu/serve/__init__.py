@@ -1,0 +1,4 @@
+"""Serving stack: continuous-batching LLM engine, model servers, and the
+InferenceService controller (SURVEY.md §2.3, §7 phase 5 — the KServe analog:
+(U) kserve python/kserve ModelServer + python/huggingfaceserver vLLM runtime,
+rebuilt TPU-native on a JAX decode engine)."""

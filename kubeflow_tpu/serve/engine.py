@@ -1,0 +1,442 @@
+"""Continuous-batching LLM decode engine — the TPU-native counterpart of the
+reference's vLLM-backed HuggingFace runtime ((U) kserve
+python/huggingfaceserver; SURVEY.md §3.2 "engine step loop").
+
+Design, driven by XLA's compilation model rather than CUDA streams:
+
+- **Recompile-free shapes.** Two compiled programs serve all traffic: one
+  decode step at a fixed slot count [B, 1], and one prefill per length
+  bucket. Admission changes data (slot contents), never shapes — XLA traces
+  once, the MXU sees the same tiles forever.
+- **Slot KV cache.** [L, B, Smax, KV, Dh] with per-slot lengths. A slot is
+  the unit of admission (continuous batching: new sequences join between
+  decode steps, finished ones free their slot immediately). Per-slot cache
+  writes are one scatter; decode attention masks by each slot's length.
+  Buffers are donated so the cache updates in place in HBM.
+- **Prefill reuses the training forward** (models/decoder.py
+  decoder_forward) on a [1, bucket] block, then scatters the resulting
+  K/V into the slot — one model definition, two execution shapes.
+- **Scheduler in plain Python** between device steps: admit → prefill →
+  decode → emit. The hot loop holds no Python per-token state beyond the
+  slot table; everything tensor-shaped lives on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.core.serving import BatchingSpec
+from kubeflow_tpu.models import layers as L
+from kubeflow_tpu.models.config import DecoderConfig
+from kubeflow_tpu.models.decoder import Params, decoder_forward, init_decoder_params
+
+
+# -- sampling ------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    max_new_tokens: int = 64
+    temperature: float = 0.0          # 0 = greedy
+    top_k: int = 0                    # 0 = off
+    stop_token: Optional[int] = None  # eos
+
+
+def _sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
+            top_k: int) -> jax.Array:
+    """[B, V] logits -> [B] token ids. Greedy when temperature == 0."""
+    greedy = jnp.argmax(logits, axis=-1)
+    if top_k > 0:
+        vals, idx = jax.lax.top_k(logits, top_k)
+        scaled = vals / jnp.maximum(temperature[:, None], 1e-6)
+        draw = jax.random.categorical(key, scaled, axis=-1)
+        sampled = jnp.take_along_axis(idx, draw[:, None], axis=1)[:, 0]
+    else:
+        scaled = logits / jnp.maximum(temperature[:, None], 1e-6)
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+# -- device-side steps ---------------------------------------------------------
+
+def _decode_attention(q, ck, cv, lengths, cfg: DecoderConfig):
+    """One-token attention over slot caches.
+
+    q [B,1,H,Dh]; ck/cv [B,Smax,KV,Dh]; lengths [B] = position of the token
+    being decoded (its K/V were just written at that index, so attend to
+    kpos <= lengths[b])."""
+    b, smax = ck.shape[0], ck.shape[1]
+    groups = cfg.n_heads // cfg.n_kv_heads
+    qg = q.reshape(b, cfg.n_kv_heads, groups, cfg.head_dim)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, ck,
+                        preferred_element_type=jnp.float32)
+    scores *= cfg.head_dim ** -0.5
+    kpos = jnp.arange(smax, dtype=jnp.int32)
+    mask = kpos[None, :] <= lengths[:, None]            # [B, Smax]
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(ck.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, cv)
+    return out.reshape(b, 1, cfg.n_heads, cfg.head_dim)
+
+
+def _decode_block(bp, x, positions, lengths, cache_k, cache_v, cfg):
+    """One transformer block for a [B,1] decode step against slot caches.
+    Returns (x, new_k_cache, new_v_cache)."""
+    dt = cfg.activation_dtype
+    h = L.rmsnorm(x, bp["ln1"], cfg)
+    q = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wv"].astype(dt))
+    q = L.rope(q, positions, cfg.rope_theta)
+    k = L.rope(k, positions, cfg.rope_theta)
+    bidx = jnp.arange(x.shape[0])
+    ck = cache_k.at[bidx, lengths].set(k[:, 0])   # write this token's K/V
+    cv = cache_v.at[bidx, lengths].set(v[:, 0])
+    attn = _decode_attention(q, ck, cv, lengths, cfg)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
+    h = L.rmsnorm(x, bp["ln2"], cfg)
+    if cfg.is_moe:
+        mlp_out, _ = L.moe_block(bp["mlp"], h, cfg)
+    else:
+        mlp_out = L.mlp_block(bp["mlp"], h, cfg)
+    return x + mlp_out, ck, cv
+
+
+def _decode_step(params: Params, cache: dict, tokens: jax.Array,
+                 lengths: jax.Array, cfg: DecoderConfig):
+    """tokens [B] (last sampled), lengths [B] (their positions).
+    Returns (logits [B,V] fp32, new cache)."""
+    dt = cfg.activation_dtype
+    x = params["embed"].astype(dt)[tokens[:, None]]      # [B,1,D]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden ** 0.5, dt)
+    positions = lengths[:, None]
+
+    def body(x, scan_in):
+        bp, ck, cv = scan_in
+        x, nk, nv = _decode_block(bp, x, positions, lengths, ck, cv, cfg)
+        return x, (nk, nv)
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    x = L.rmsnorm(x, params["final_norm"], cfg)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dt),
+                        preferred_element_type=jnp.float32)[:, 0]
+    if cfg.logits_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+    return logits, {"k": nk, "v": nv}
+
+
+def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
+                  slot: jax.Array, length: jax.Array, cfg: DecoderConfig):
+    """Prefill a [1, S_bucket] prompt into slot ``slot``.
+
+    Runs the training forward with a scratch contiguous cache, scatters the
+    resulting K/V into the slot row, and returns the last-real-token logits
+    [V] (the basis of the first sampled token — TTFT ends when it lands)."""
+    scratch = {
+        "k": jnp.zeros((cfg.n_layers, 1, tokens.shape[1],
+                        cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
+        "v": jnp.zeros((cfg.n_layers, 1, tokens.shape[1],
+                        cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
+        "len": jnp.int32(0),
+    }
+    logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=scratch)
+    bucket = tokens.shape[1]
+    ck = jax.lax.dynamic_update_slice(
+        cache["k"], filled["k"], (0, slot, 0, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cache["v"], filled["v"], (0, slot, 0, 0, 0))
+    last = logits[0, length - 1]
+    return last, {"k": ck, "v": cv}
+
+
+# -- requests ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Request:
+    prompt_tokens: list[int]
+    params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    id: str = ""
+    arrival: float = dataclasses.field(default_factory=time.monotonic)
+    # results
+    output_tokens: list[int] = dataclasses.field(default_factory=list)
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    finish_reason: Optional[str] = None
+    stream: "queue.Queue[Optional[int]]" = dataclasses.field(
+        default_factory=queue.Queue)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival
+
+    def result(self, timeout: Optional[float] = None) -> list[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not finished")
+        return self.output_tokens
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    length: int           # position of the NEXT token to be written
+    last_token: int
+    generated: int = 0
+
+
+# -- the engine ----------------------------------------------------------------
+
+class EngineMetrics:
+    """Serving metrics the reference never surfaces from its own code:
+    req/s, TTFT and TPOT quantiles, tokens/s (SURVEY.md §5 observability)."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self.requests_completed = 0
+        self.tokens_generated = 0
+        self.started = time.monotonic()
+        self._ttft: list[float] = []
+        self._tpot: list[float] = []
+        self._window = window
+
+    def observe(self, req: Request) -> None:
+        with self._lock:
+            self.requests_completed += 1
+            self.tokens_generated += len(req.output_tokens)
+            if req.ttft is not None:
+                self._ttft.append(req.ttft)
+                self._ttft = self._ttft[-self._window:]
+            if (req.finish_time is not None and req.first_token_time is not None
+                    and len(req.output_tokens) > 1):
+                tpot = ((req.finish_time - req.first_token_time)
+                        / (len(req.output_tokens) - 1))
+                self._tpot.append(tpot)
+                self._tpot = self._tpot[-self._window:]
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            elapsed = max(time.monotonic() - self.started, 1e-9)
+            out = {
+                "requests_completed": self.requests_completed,
+                "tokens_generated": self.tokens_generated,
+                "requests_per_sec": self.requests_completed / elapsed,
+                "tokens_per_sec": self.tokens_generated / elapsed,
+            }
+            for name, xs in (("ttft", self._ttft), ("tpot", self._tpot)):
+                if xs:
+                    arr = np.asarray(xs)
+                    out[f"{name}_p50_ms"] = float(np.percentile(arr, 50) * 1e3)
+                    out[f"{name}_p99_ms"] = float(np.percentile(arr, 99) * 1e3)
+            return out
+
+
+class LLMEngine:
+    """Slot-based continuous-batching engine over a decoder LLM."""
+
+    def __init__(self, cfg: DecoderConfig, batching: Optional[BatchingSpec] = None,
+                 *, params: Optional[Params] = None, seed: int = 0):
+        self.cfg = cfg
+        self.batching = batching or BatchingSpec()
+        b = self.batching
+        if b.max_seq_len > cfg.max_seq_len:
+            raise ValueError("batching.max_seq_len exceeds model max_seq_len")
+        self.num_slots = b.max_batch_size
+        self.max_len = b.max_seq_len
+        self.buckets = sorted(set(
+            min(x, self.max_len) for x in b.prefill_buckets)) or [self.max_len]
+
+        key = jax.random.PRNGKey(seed)
+        self.params = params if params is not None else init_decoder_params(key, cfg)
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+        self.cache = {
+            "k": jnp.zeros((cfg.n_layers, self.num_slots, self.max_len,
+                            cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
+            "v": jnp.zeros((cfg.n_layers, self.num_slots, self.max_len,
+                            cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
+        }
+
+        # Compiled programs: donate the cache so it mutates in place in HBM.
+        self._decode = jax.jit(
+            lambda p, c, t, l: _decode_step(p, c, t, l, cfg),
+            donate_argnums=(1,))
+        self._prefill = jax.jit(
+            lambda p, c, t, s, ln: _prefill_step(p, c, t, s, ln, cfg),
+            donate_argnums=(1,))
+        self._sampler = jax.jit(_sample, static_argnums=(3,))
+
+        self.slots: list[Optional[_Slot]] = [None] * self.num_slots
+        self.waiting: "queue.Queue[Request]" = queue.Queue()
+        self.metrics = EngineMetrics()
+        self._id_gen = itertools.count()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+
+    # -- submission ------------------------------------------------------------
+
+    def submit(self, prompt_tokens: list[int],
+               params: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None) -> Request:
+        if not prompt_tokens:
+            raise ValueError("empty prompt")
+        if len(prompt_tokens) >= self.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt_tokens)} >= max_seq_len {self.max_len}")
+        req = Request(prompt_tokens=list(prompt_tokens),
+                      params=params or SamplingParams(),
+                      id=request_id or f"req-{next(self._id_gen)}")
+        self.waiting.put(req)
+        self._wake.set()
+        return req
+
+    # -- scheduler -------------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for bkt in self.buckets:
+            if n <= bkt:
+                return bkt
+        return self.max_len
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _next_key(self) -> jax.Array:
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def _admit(self) -> int:
+        """Prefill waiting requests into free slots. Returns admissions."""
+        n = 0
+        while True:
+            slot_idx = self._free_slot()
+            if slot_idx is None:
+                return n
+            try:
+                req = self.waiting.get_nowait()
+            except queue.Empty:
+                return n
+            plen = len(req.prompt_tokens)
+            bucket = self._bucket_for(plen)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :plen] = req.prompt_tokens
+            last_logits, self.cache = self._prefill(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.int32(slot_idx), jnp.int32(plen))
+            first = self._sampler(
+                last_logits[None, :], self._next_key(),
+                jnp.asarray([req.params.temperature], jnp.float32),
+                req.params.top_k)
+            tok = int(jax.device_get(first)[0])
+            req.first_token_time = time.monotonic()
+            req.output_tokens.append(tok)
+            req.stream.put(tok)
+            self.slots[slot_idx] = _Slot(request=req, length=plen,
+                                         last_token=tok, generated=1)
+            self._finish_if_done(slot_idx)
+            n += 1
+
+    def _finish_if_done(self, idx: int) -> bool:
+        s = self.slots[idx]
+        assert s is not None
+        reason = None
+        if s.request.params.stop_token is not None and \
+                s.last_token == s.request.params.stop_token:
+            reason = "stop"
+        elif s.generated >= s.request.params.max_new_tokens:
+            reason = "length"
+        elif s.length + 1 >= self.max_len:
+            reason = "length"
+        if reason is None:
+            return False
+        req = s.request
+        req.finish_reason = reason
+        req.finish_time = time.monotonic()
+        req.stream.put(None)
+        req.done.set()
+        self.metrics.observe(req)
+        self.slots[idx] = None
+        return True
+
+    def _decode_once(self) -> int:
+        """One decode step for all active slots. Returns tokens emitted."""
+        active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return 0
+        tokens = np.zeros((self.num_slots,), np.int32)
+        lengths = np.zeros((self.num_slots,), np.int32)
+        temps = np.zeros((self.num_slots,), np.float32)
+        for i, s in active:
+            tokens[i] = s.last_token
+            lengths[i] = s.length       # write position of last_token's KV
+            temps[i] = s.request.params.temperature
+        top_k = max((s.request.params.top_k for _, s in active), default=0)
+        logits, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(lengths))
+        sampled = jax.device_get(self._sampler(
+            logits, self._next_key(), jnp.asarray(temps), top_k))
+        emitted = 0
+        for i, s in active:
+            tok = int(sampled[i])
+            s.request.output_tokens.append(tok)
+            s.request.stream.put(tok)
+            s.last_token = tok
+            s.length += 1
+            s.generated += 1
+            emitted += 1
+            self._finish_if_done(i)
+        return emitted
+
+    def step(self) -> int:
+        """One scheduler iteration: admit then decode. Returns work done."""
+        return self._admit() + self._decode_once()
+
+    # -- background loop -------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-engine")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self.step() == 0:
+                # idle: block until a request arrives
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # -- convenience -----------------------------------------------------------
+
+    def generate(self, prompt_tokens: list[int],
+                 params: Optional[SamplingParams] = None,
+                 timeout: float = 120.0) -> list[int]:
+        """Blocking single-shot generation (drives steps if no loop runs)."""
+        req = self.submit(prompt_tokens, params)
+        if self._thread is None:
+            while not req.done.is_set():
+                self.step()
+        return req.result(timeout)

@@ -1,0 +1,43 @@
+"""The ``model_server`` worker entrypoint: what an InferenceService predictor
+replica runs (≈ the kserve-container + storage-initializer pair in one
+process — SURVEY.md §3.2 data path).
+
+Config (injected by the ISVC controller into WorkloadSpec.config):
+    model:     {"preset": str, "overrides": {...}}  decoder architecture
+    storage_uri: str | None                         weights source
+    batching:  BatchingSpec fields                  engine knobs
+    port:      int                                  HTTP port (pre-assigned)
+    service:   str                                  exposed model name
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubeflow_tpu.runtime.entrypoints import WorkerContext, register_entrypoint
+
+
+@register_entrypoint("model_server")
+def model_server(ctx: WorkerContext) -> int:
+    from kubeflow_tpu.core.serving import BatchingSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.serve.engine import LLMEngine
+    from kubeflow_tpu.serve.server import ModelServer
+    from kubeflow_tpu.serve.storage import load_params
+
+    conf = ctx.config
+    model_conf = conf.get("model", {})
+    cfg = preset(model_conf.get("preset", "tiny"),
+                 **model_conf.get("overrides", {}))
+    params = load_params(conf.get("storage_uri"), cfg)
+    batching = BatchingSpec(**conf.get("batching", {}))
+    engine = LLMEngine(cfg, batching, params=params)
+    server = ModelServer(conf.get("service", "model"), engine,
+                         port=int(conf["port"]))
+    server.start()
+    try:
+        while True:          # serve until SIGTERM (exit 143 via worker_main)
+            time.sleep(0.5)
+    finally:
+        server.stop()
+    return 0

@@ -1,0 +1,139 @@
+"""Service router: one stable URL per InferenceService, weighted backend
+selection — the in-process analog of the Istio VirtualService + Knative
+revision traffic split the reference wires per service ((U) kserve
+pkg/controller/v1beta1/inferenceservice/components/predictor.go; SURVEY.md
+§3.2 'istio-ingress → queue-proxy' hop, collapsed to one proxy)."""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class Router:
+    """Weighted HTTP proxy over predictor replicas.
+
+    Backends are registered per traffic group (e.g. generation "3"), each
+    group with a weight percent; requests pick a group by weight, then
+    round-robin inside it."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.Lock()
+        self._groups: dict[str, list[str]] = {}    # group -> base urls
+        self._weights: dict[str, int] = {}         # group -> percent
+        self._rr = itertools.count()
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def set_backends(self, groups: dict[str, list[str]],
+                     weights: Optional[dict[str, int]] = None) -> None:
+        with self._lock:
+            self._groups = {g: list(urls) for g, urls in groups.items() if urls}
+            if weights:
+                self._weights = dict(weights)
+            else:
+                self._weights = {g: 100 // max(len(self._groups), 1)
+                                 for g in self._groups}
+
+    def pick(self) -> Optional[str]:
+        with self._lock:
+            groups = [(g, self._weights.get(g, 0)) for g in self._groups]
+            if not groups:
+                return None
+            total = sum(w for _, w in groups) or len(groups)
+            r = random.uniform(0, total)
+            acc = 0.0
+            chosen = groups[-1][0]
+            for g, w in groups:
+                acc += w if total else 1
+                if r <= acc:
+                    chosen = g
+                    break
+            urls = self._groups[chosen]
+            return urls[next(self._rr) % len(urls)]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="router")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def _make_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args) -> None:
+            pass
+
+        def _proxy(self) -> None:
+            backend = router.pick()
+            if backend is None:
+                data = b'{"error": "no ready backends"}'
+                self.send_response(503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n) if n else None
+            req = urllib.request.Request(
+                backend + self.path, data=body, method=self.command,
+                headers={"Content-Type":
+                         self.headers.get("Content-Type", "application/json")})
+            try:
+                with urllib.request.urlopen(req, timeout=600) as resp:
+                    self.send_response(resp.status)
+                    ctype = resp.headers.get("Content-Type", "application/json")
+                    self.send_header("Content-Type", ctype)
+                    if "event-stream" in ctype:
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        while True:
+                            piece = resp.read(512)
+                            if not piece:
+                                break
+                            self.wfile.write(f"{len(piece):x}\r\n".encode()
+                                             + piece + b"\r\n")
+                            self.wfile.flush()
+                        self.wfile.write(b"0\r\n\r\n")
+                    else:
+                        data = resp.read()
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+            except urllib.error.HTTPError as exc:
+                data = exc.read()
+                self.send_response(exc.code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except OSError as exc:
+                data = f'{{"error": "backend unreachable: {exc}"}}'.encode()
+                self.send_response(502)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        do_GET = _proxy
+        do_POST = _proxy
+
+    return Handler

@@ -1,0 +1,268 @@
+"""Model server: HTTP protocol surface over the LLM engine.
+
+Implements the three protocol families of the reference's model server in one
+stdlib-only server (no fastapi in this image):
+
+- v1 protocol  ((U) kserve kserve/protocol/rest/v1_endpoints.py):
+  POST /v1/models/{name}:predict   {"instances": [...]}
+- v2 open-inference protocol ((U) kserve v2_endpoints.py):
+  GET  /v2/models/{name}           metadata
+  POST /v2/models/{name}/infer     {"inputs": [{name,shape,datatype,data}]}
+- OpenAI-compatible LLM surface ((U) kserve python/huggingfaceserver):
+  POST /v1/completions, /v1/chat/completions (stream=true → SSE)
+
+Plus /healthz (readiness) and /metrics (Prometheus text format).
+Threaded stdlib server: handlers block on the engine's request stream; the
+engine thread does the batching, so concurrency costs one OS thread per
+in-flight request — fine at platform scale, and zero dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from kubeflow_tpu.serve.engine import LLMEngine, Request, SamplingParams
+from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
+
+
+class ModelServer:
+    def __init__(self, name: str, engine: LLMEngine, *,
+                 tokenizer: Optional[Tokenizer] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.name = name
+        self.engine = engine
+        self.tokenizer = tokenizer or get_tokenizer("byte")
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self.engine.start()
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="model-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.engine.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- request plumbing ------------------------------------------------------
+
+    def track(self, delta: int) -> None:
+        with self._in_flight_lock:
+            self._in_flight += delta
+
+    @property
+    def in_flight(self) -> int:
+        with self._in_flight_lock:
+            return self._in_flight
+
+    def sampling_from(self, body: dict[str, Any]) -> SamplingParams:
+        return SamplingParams(
+            max_new_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            stop_token=self.tokenizer.eos_id,
+        )
+
+    def metrics_text(self) -> str:
+        snap = self.engine.metrics.snapshot()
+        lines = [
+            "# TYPE kftpu_serving_requests_total counter",
+            f"kftpu_serving_requests_total {snap['requests_completed']}",
+            "# TYPE kftpu_serving_tokens_total counter",
+            f"kftpu_serving_tokens_total {snap['tokens_generated']}",
+            "# TYPE kftpu_serving_in_flight gauge",
+            f"kftpu_serving_in_flight {self.in_flight}",
+        ]
+        for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                  "requests_per_sec", "tokens_per_sec"):
+            if k in snap:
+                lines.append(f"kftpu_serving_{k} {snap[k]}")
+        return "\n".join(lines) + "\n"
+
+
+def _make_handler(server: ModelServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args) -> None:  # quiet
+            pass
+
+        # -- helpers ----------------------------------------------------------
+
+        def _json(self, code: int, obj: Any) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _text(self, code: int, text: str, ctype="text/plain") -> None:
+            data = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        # -- GET ---------------------------------------------------------------
+
+        def do_GET(self) -> None:
+            if self.path in ("/healthz", "/v2/health/ready", "/v2/health/live"):
+                self._json(200, {"status": "ok", "name": server.name})
+            elif self.path == "/metrics":
+                self._text(200, server.metrics_text())
+            elif self.path == "/v1/models":
+                self._json(200, {"models": [server.name]})
+            elif self.path == f"/v2/models/{server.name}":
+                cfg = server.engine.cfg
+                self._json(200, {
+                    "name": server.name,
+                    "platform": "kubeflow-tpu-llm",
+                    "inputs": [{"name": "text", "datatype": "BYTES",
+                                "shape": [-1]}],
+                    "outputs": [{"name": "text", "datatype": "BYTES",
+                                 "shape": [-1]}],
+                    "config": {"vocab_size": cfg.vocab_size,
+                               "max_seq_len": cfg.max_seq_len},
+                })
+            else:
+                self._json(404, {"error": f"not found: {self.path}"})
+
+        # -- POST --------------------------------------------------------------
+
+        def do_POST(self) -> None:
+            server.track(1)
+            try:
+                body = self._body()
+                if self.path == f"/v1/models/{server.name}:predict":
+                    self._v1_predict(body)
+                elif self.path == f"/v2/models/{server.name}/infer":
+                    self._v2_infer(body)
+                elif self.path == "/v1/completions":
+                    self._completions(body, chat=False)
+                elif self.path == "/v1/chat/completions":
+                    self._completions(body, chat=True)
+                else:
+                    self._json(404, {"error": f"not found: {self.path}"})
+            except ValueError as exc:
+                self._json(400, {"error": str(exc)})
+            except Exception as exc:   # surface, don't hide
+                self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            finally:
+                server.track(-1)
+
+        def _generate_text(self, prompt: str, body: dict) -> tuple[str, Request]:
+            toks = server.tokenizer.encode(prompt)
+            req = server.engine.submit(toks, server.sampling_from(body))
+            out = req.result(timeout=float(body.get("timeout", 300)))
+            text = server.tokenizer.decode(
+                [t for t in out if t != server.tokenizer.eos_id])
+            return text, req
+
+        def _v1_predict(self, body: dict) -> None:
+            instances = body.get("instances")
+            if not isinstance(instances, list):
+                raise ValueError("body must contain 'instances': [...]")
+            preds = [self._generate_text(str(inst), body)[0]
+                     for inst in instances]
+            self._json(200, {"predictions": preds})
+
+        def _v2_infer(self, body: dict) -> None:
+            inputs = body.get("inputs")
+            if not isinstance(inputs, list) or not inputs:
+                raise ValueError("body must contain 'inputs': [...]")
+            texts = []
+            for inp in inputs:
+                for datum in inp.get("data", []):
+                    texts.append(self._generate_text(str(datum), body)[0])
+            self._json(200, {
+                "model_name": server.name,
+                "outputs": [{"name": "text", "datatype": "BYTES",
+                             "shape": [len(texts)], "data": texts}],
+            })
+
+        def _completions(self, body: dict, *, chat: bool) -> None:
+            if chat:
+                msgs = body.get("messages", [])
+                prompt = "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}"
+                                   for m in msgs) + "\nassistant:"
+            else:
+                prompt = body.get("prompt", "")
+                if isinstance(prompt, list):
+                    prompt = prompt[0] if prompt else ""
+            if body.get("stream"):
+                return self._completions_stream(prompt, body, chat=chat)
+            text, req = self._generate_text(prompt, body)
+            usage = {"prompt_tokens": len(req.prompt_tokens),
+                     "completion_tokens": len(req.output_tokens),
+                     "total_tokens": len(req.prompt_tokens) + len(req.output_tokens)}
+            if chat:
+                choice = {"index": 0, "finish_reason": req.finish_reason,
+                          "message": {"role": "assistant", "content": text}}
+                obj = "chat.completion"
+            else:
+                choice = {"index": 0, "finish_reason": req.finish_reason,
+                          "text": text}
+                obj = "text_completion"
+            self._json(200, {
+                "id": req.id, "object": obj, "created": int(time.time()),
+                "model": server.name, "choices": [choice], "usage": usage,
+            })
+
+        def _completions_stream(self, prompt: str, body: dict, *, chat: bool) -> None:
+            toks = server.tokenizer.encode(prompt)
+            req = server.engine.submit(toks, server.sampling_from(body))
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(data: str) -> None:
+                payload = f"data: {data}\n\n".encode()
+                self.wfile.write(f"{len(payload):x}\r\n".encode()
+                                 + payload + b"\r\n")
+                self.wfile.flush()
+
+            while True:
+                tok = req.stream.get(timeout=float(body.get("timeout", 300)))
+                if tok is None:
+                    break
+                if tok == server.tokenizer.eos_id:
+                    continue
+                piece = server.tokenizer.decode([tok])
+                if chat:
+                    delta = {"choices": [{"index": 0,
+                                          "delta": {"content": piece}}]}
+                else:
+                    delta = {"choices": [{"index": 0, "text": piece}]}
+                chunk(json.dumps({"id": req.id, "object": "chunk",
+                                  "model": server.name, **delta}))
+            chunk("[DONE]")
+            self.wfile.write(b"0\r\n\r\n")
+
+    return Handler

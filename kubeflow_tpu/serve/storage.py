@@ -1,0 +1,47 @@
+"""Model storage: resolve a storageUri to model params before serving —
+the storage-initializer analog ((U) kserve python/kserve/kserve/storage
+downloads s3/gcs/pvc/http into /mnt/models; SURVEY.md §2.3#28).
+
+Hermetic environment: only ``file://`` (an orbax checkpoint directory written
+by the trainer) and ``random://`` (fresh init, for load tests) schemes exist;
+cloud schemes raise with a clear message rather than pretending.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+from urllib.parse import urlparse
+
+import jax
+
+from kubeflow_tpu.models.config import DecoderConfig
+from kubeflow_tpu.models.decoder import Params, init_decoder_params
+
+
+def load_params(storage_uri: Optional[str], cfg: DecoderConfig, *,
+                seed: int = 0) -> Params:
+    """Resolve ``storage_uri`` into a decoder param tree.
+
+    file:///path — orbax checkpoint dir (a trainer run's checkpoint_dir);
+    restores the latest step's ``params`` subtree, cast per model config.
+    random:// or None — fresh random init (benchmarks, smoke tests)."""
+    if storage_uri is None or storage_uri.startswith("random://"):
+        return init_decoder_params(jax.random.PRNGKey(seed), cfg)
+    parsed = urlparse(storage_uri)
+    if parsed.scheme == "file":
+        return _load_orbax(parsed.path, cfg)
+    raise ValueError(
+        f"unsupported storageUri scheme {parsed.scheme!r} "
+        "(hermetic build: file:// and random:// only)")
+
+
+def _load_orbax(path: str, cfg: DecoderConfig) -> Params:
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(path) as mgr:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint steps under {path}")
+        state = mgr.restore(step)
+    params = state.get("params", state)
+    return jax.tree.map(jax.numpy.asarray, params)

@@ -1,0 +1,55 @@
+"""Tokenizers for the serving path.
+
+The platform ships a dependency-free byte tokenizer (utf-8 bytes + specials)
+so the full serving stack runs hermetically — the analog of the reference
+runtime's bundled tokenizer download, which needs network ((U) kserve
+python/huggingfaceserver model load path). Real deployments register their
+own via ``register_tokenizer``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """utf-8 bytes shifted by 3: 0=pad, 1=bos, 2=eos. Vocab 259."""
+
+    PAD, BOS, EOS = 0, 1, 2
+    OFFSET = 3
+
+    bos_id = BOS
+    eos_id = EOS
+    vocab_size = 256 + OFFSET
+
+    def encode(self, text: str) -> list[int]:
+        return [self.BOS] + [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: list[int]) -> str:
+        # Ids outside the byte range (specials below, or tokens a larger-
+        # vocab model emitted above 258) have no byte meaning: drop them.
+        data = bytes(i - self.OFFSET for i in ids
+                     if self.OFFSET <= i < self.vocab_size)
+        return data.decode("utf-8", "replace")
+
+
+_registry: dict[str, Callable[[], Tokenizer]] = {"byte": ByteTokenizer}
+
+
+def register_tokenizer(name: str, factory: Callable[[], Tokenizer]) -> None:
+    _registry[name] = factory
+
+
+def get_tokenizer(name: str = "byte") -> Tokenizer:
+    if name not in _registry:
+        raise KeyError(f"unknown tokenizer {name!r}; known: {sorted(_registry)}")
+    return _registry[name]()

@@ -279,6 +279,21 @@ class TestLifecyclePolicies:
         assert cp.allocator.allocation("default/job") is None
 
 
+class TestQueuedResize:
+    def test_shrinking_a_queued_job_lets_it_place(self, cp):
+        # Job a holds 2 of 4 chips; b wants 4 -> queued; shrink b to 2 -> fits.
+        cp.submit(make_job("a", replicas=2))
+        cp.step()
+        cp.submit(make_job("b", replicas=4))
+        cp.step()
+        assert workers_of(cp, "b") == []
+        j = cp.get_job("b")
+        j.spec.replica_specs["worker"].replicas = 2
+        cp.store.update(j)
+        cp.step()
+        assert len(workers_of(cp, "b")) == 2
+
+
 class TestElastic:
     def test_resize_regangs_at_new_size(self, cp):
         j = make_job(replicas=2, elastic_policy=ElasticPolicy(

@@ -1,0 +1,139 @@
+"""ISVC controller semantics, envtest-style: no processes — a fake probe
+plays the replicas' health/metrics endpoints (SURVEY.md §4.2 pattern)."""
+
+import pytest
+
+from kubeflow_tpu.core.jobs import Worker, WorkerPhase
+from kubeflow_tpu.core.object import ObjectMeta
+from kubeflow_tpu.core.serving import (
+    InferenceService, InferenceServiceSpec, ModelSpec, PredictorSpec,
+)
+from kubeflow_tpu.operator.control_plane import ControlPlane, ControlPlaneConfig
+
+
+class FakeProbe:
+    """url -> {"ready", "in_flight"}; tests mutate `ready` and `load`."""
+
+    def __init__(self):
+        self.ready = True
+        self.load = {}          # url -> in_flight
+
+    def __call__(self, url):
+        if not self.ready:
+            return None
+        return {"ready": True, "in_flight": self.load.get(url, 0)}
+
+
+@pytest.fixture()
+def cp(tmp_path):
+    plane = ControlPlane(ControlPlaneConfig(
+        base_dir=str(tmp_path), launch_processes=False,
+        metrics_sync_interval=None))
+    plane.probe = FakeProbe()
+    plane.isvc_reconciler.probe = plane.probe
+    yield plane
+    plane.isvc_reconciler.shutdown()
+
+
+def mkisvc(name="svc", min_replicas=1, max_replicas=1, scale_target=4):
+    return InferenceService(
+        metadata=ObjectMeta(name=name),
+        spec=InferenceServiceSpec(predictor=PredictorSpec(
+            model=ModelSpec(config={"preset": "tiny"}),
+            min_replicas=min_replicas, max_replicas=max_replicas,
+            scale_target=scale_target)))
+
+
+def replicas(cp, name="svc"):
+    ws = cp.store.list(Worker, label_selector={
+        "serving.tpu.kubeflow.dev/service": name})
+    return sorted(ws, key=lambda w: int(
+        w.metadata.labels["serving.tpu.kubeflow.dev/replica"]))
+
+
+def mark_running(cp, ws):
+    for w in ws:
+        w = cp.store.get(Worker, w.metadata.name, w.metadata.namespace)
+        w.status.phase = WorkerPhase.RUNNING
+        cp.store.update_status(w)
+
+
+def get_isvc(cp, name="svc"):
+    return cp.store.get(InferenceService, name)
+
+
+def test_creates_replicas_and_reports_ready(cp):
+    cp.submit(mkisvc())
+    cp.step()
+    ws = replicas(cp)
+    assert len(ws) == 1
+    w = ws[0]
+    assert w.spec.template.entrypoint == "model_server"
+    assert w.spec.template.config["port"] > 0
+    assert w.spec.template.config["model"] == {"preset": "tiny"}
+    isvc = get_isvc(cp)
+    assert isvc.status.ready_replicas == 0     # not Running yet
+    mark_running(cp, ws)
+    cp.step()
+    isvc = get_isvc(cp)
+    assert isvc.status.ready_replicas == 1
+    assert isvc.status.has_condition("Ready")
+    assert isvc.status.url.startswith("http://127.0.0.1:")
+
+
+def test_unready_probe_blocks_ready_condition(cp):
+    cp.submit(mkisvc())
+    cp.step()
+    mark_running(cp, replicas(cp))
+    cp.probe.ready = False
+    cp.step()
+    isvc = get_isvc(cp)
+    assert isvc.status.ready_replicas == 0
+    assert isvc.status.has_condition("Ready", status=False)
+
+
+def test_crashed_replica_is_replaced(cp):
+    cp.submit(mkisvc())
+    cp.step()
+    w = replicas(cp)[0]
+    old_uid = w.metadata.uid
+    w = cp.store.get(Worker, w.metadata.name)
+    w.status.phase = WorkerPhase.FAILED
+    w.status.exit_code = 1
+    cp.store.update_status(w)
+    cp.step()
+    ws = replicas(cp)
+    assert len(ws) == 1
+    assert ws[0].metadata.uid != old_uid
+
+
+def test_scale_up_on_concurrency(cp):
+    # Single reconciles (not cp.step(), which pumps several rounds): the
+    # autoscaler moves one replica per reconcile.
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=1, max_replicas=3, scale_target=2))
+    recon()
+    mark_running(cp, replicas(cp))
+    # Load the single replica beyond target → scale to 2.
+    url = f"http://127.0.0.1:{replicas(cp)[0].spec.template.config['port']}"
+    cp.probe.load[url] = 5
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 2
+    # Load drops; new replica joins; cooldown prevents an instant scale-down.
+    cp.probe.load[url] = 0
+    recon()
+    ws = replicas(cp)
+    assert len(ws) == 2
+    mark_running(cp, ws)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 2
+    assert get_isvc(cp).status.ready_replicas == 2
+
+
+def test_deletion_cleans_replicas(cp):
+    cp.submit(mkisvc())
+    cp.step()
+    assert replicas(cp)
+    cp.store.delete(InferenceService, "svc")
+    cp.step()
+    assert replicas(cp) == []

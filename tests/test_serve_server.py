@@ -1,0 +1,127 @@
+"""Model-server protocol surface tests (≈ kserve's FastAPI TestClient server
+tests, SURVEY.md §4.4 — here against the real threaded server over a port)."""
+
+import json
+import urllib.request
+
+import pytest
+import jax
+
+from kubeflow_tpu.core.serving import BatchingSpec
+from kubeflow_tpu.models.config import preset
+from kubeflow_tpu.models.decoder import init_decoder_params
+from kubeflow_tpu.serve.engine import LLMEngine
+from kubeflow_tpu.serve.server import ModelServer
+from kubeflow_tpu.serve.tokenizer import ByteTokenizer, get_tokenizer
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = preset("tiny", vocab_size=512)  # roomy enough for byte vocab (259)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+    engine = LLMEngine(
+        cfg, BatchingSpec(max_batch_size=4, max_seq_len=96,
+                          prefill_buckets=[32, 64]),
+        params=params)
+    srv = ModelServer("demo", engine, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _post(url: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_health_and_metadata(server):
+    status, body = _get(server.url + "/healthz")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    status, body = _get(server.url + "/v2/models/demo")
+    meta = json.loads(body)
+    assert meta["name"] == "demo"
+    assert meta["inputs"][0]["datatype"] == "BYTES"
+
+
+def test_v1_predict(server):
+    out = _post(server.url + "/v1/models/demo:predict",
+                {"instances": ["ab", "xyz"], "max_tokens": 4})
+    assert len(out["predictions"]) == 2
+    assert all(isinstance(p, str) for p in out["predictions"])
+
+
+def test_v2_infer(server):
+    out = _post(server.url + "/v2/models/demo/infer",
+                {"inputs": [{"name": "text", "shape": [1],
+                             "datatype": "BYTES", "data": ["hello"]}],
+                 "max_tokens": 3})
+    assert out["model_name"] == "demo"
+    assert out["outputs"][0]["shape"] == [1]
+
+
+def test_openai_completions(server):
+    out = _post(server.url + "/v1/completions",
+                {"prompt": "hi", "max_tokens": 5, "model": "demo"})
+    assert out["object"] == "text_completion"
+    assert out["usage"]["completion_tokens"] <= 5
+    assert out["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+def test_openai_chat_completions(server):
+    out = _post(server.url + "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hey"}],
+                 "max_tokens": 4})
+    assert out["object"] == "chat.completion"
+    assert out["choices"][0]["message"]["role"] == "assistant"
+
+
+def test_streaming_sse(server):
+    req = urllib.request.Request(
+        server.url + "/v1/completions",
+        data=json.dumps({"prompt": "s", "max_tokens": 4,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert "text/event-stream" in r.headers["Content-Type"]
+        payload = r.read().decode()
+    events = [ln[6:] for ln in payload.splitlines() if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    assert 1 <= len(events) - 1 <= 4
+    assert all("choices" in json.loads(e) for e in events[:-1])
+
+
+def test_metrics_endpoint(server):
+    _post(server.url + "/v1/models/demo:predict",
+          {"instances": ["m"], "max_tokens": 2})
+    status, text = _get(server.url + "/metrics")
+    assert status == 200
+    assert "kftpu_serving_requests_total" in text
+    assert "kftpu_serving_ttft_p50_ms" in text
+
+
+def test_bad_request_400(server):
+    req = urllib.request.Request(
+        server.url + "/v1/models/demo:predict",
+        data=json.dumps({"wrong": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = get_tokenizer("byte")
+    assert isinstance(tok, ByteTokenizer)
+    ids = tok.encode("héllo ✓")
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "héllo ✓"
