@@ -66,9 +66,18 @@ class ControlPlane:
         from kubeflow_tpu.serve.isvc_controller import ISVCController
 
         self.isvc_reconciler = ISVCController(self.store, recorder=self.recorder)
+        from kubeflow_tpu.tune.experiment_controller import ExperimentController
+        from kubeflow_tpu.tune.trial_controller import TrialController
+
+        self.experiment_reconciler = ExperimentController(
+            self.store, recorder=self.recorder)
+        self.trial_reconciler = TrialController(
+            self.store, base_dir=self.config.base_dir, recorder=self.recorder)
         self.controllers: list[Controller] = [
             Controller(self.store, self.jaxjob_reconciler, name="jaxjob"),
             Controller(self.store, self.isvc_reconciler, name="isvc"),
+            Controller(self.store, self.experiment_reconciler, name="experiment"),
+            Controller(self.store, self.trial_reconciler, name="trial"),
         ]
         self.runtime: Optional[WorkerRuntime] = None
         if self.config.launch_processes:

@@ -87,6 +87,28 @@ def fail(ctx: WorkerContext) -> int:
     return int(ctx.config.get("exit_code", 1))
 
 
+@register_entrypoint("objective_probe")
+def objective_probe(ctx: WorkerContext) -> int:
+    """Synthetic HPO objective: writes a decaying metrics.jsonl series ending
+    at (x-x0)^2 + (y-y0)^2 — lets tune e2e tests optimize a known bowl."""
+    import json
+    import os
+
+    x = float(ctx.config.get("x", 0.0))
+    y = float(ctx.config.get("y", 0.0))
+    x0 = float(ctx.config.get("x0", 0.3))
+    y0 = float(ctx.config.get("y0", -0.2))
+    steps = int(ctx.config.get("steps", 3))
+    final = (x - x0) ** 2 + (y - y0) ** 2
+    if ctx.env.workdir:
+        path = os.path.join(ctx.env.workdir, "metrics.jsonl")
+        with open(path, "w") as f:
+            for s in range(steps):
+                v = final + (steps - 1 - s) * 0.1
+                f.write(json.dumps({"step": s, "objective": v}) + "\n")
+    return 0
+
+
 @register_entrypoint("flaky")
 def flaky(ctx: WorkerContext) -> int:
     """Fails with a retryable code until attempt file reaches a threshold —

@@ -1,0 +1,15 @@
+"""HPO subsystem — the Katib analog (SURVEY.md §2.4, build phase 6).
+
+Experiment/Suggestion/Trial specs live in ``core.tuning``; this package holds
+the suggestion algorithms (numpy-only — hyperopt/skopt are not installed),
+early stopping, the experiment/trial reconcilers that drive trials as JAXJobs,
+and the metrics collectors.
+"""
+
+from kubeflow_tpu.tune.algorithms import get_suggester, Observation
+from kubeflow_tpu.tune.experiment_controller import ExperimentController
+from kubeflow_tpu.tune.trial_controller import TrialController
+
+__all__ = [
+    "get_suggester", "Observation", "ExperimentController", "TrialController",
+]

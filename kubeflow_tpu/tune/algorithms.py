@@ -1,0 +1,459 @@
+"""Suggestion algorithms — numpy-only Katib suggestion-service analogs.
+
+Covers katib's built-in algorithm set (SURVEY.md §2.4#34; (U) katib
+pkg/suggestion/v1beta1/{hyperopt,skopt,optuna,hyperband}): random, grid,
+TPE, GP-EI (Bayesian), CMA-ES, Hyperband. hyperopt/skopt are not installed,
+so the algorithms are implemented directly against the unit-cube geometry in
+``search_space``.
+
+Contract (replaces katib's gRPC ``GetSuggestions``):
+
+    suggester = get_suggester(spec)
+    assignments, state = suggester.suggest(n, history, state)
+
+- **minimization convention**: callers negate for maximize objectives.
+- ``state`` is a JSON-serializable dict kept on ``Suggestion.status.
+  algorithm_state`` — persisting it is what makes ``resumePolicy:
+  FromSuggestion`` work (≈ katib FromVolume).
+- ``history`` matching is by canonical parameter key (the controller has no
+  stable trial ids at suggest time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any, Optional
+
+import numpy as np
+
+from kubeflow_tpu.core.tuning import ExperimentSpec, ParameterSpec
+from kubeflow_tpu.tune import search_space as ss
+
+
+@dataclasses.dataclass
+class Observation:
+    """One trial's outcome as the suggesters see it (lower is better)."""
+
+    parameters: dict[str, Any]
+    value: Optional[float] = None     # None while running
+    failed: bool = False
+    pruned: bool = False
+
+    @property
+    def completed(self) -> bool:
+        return self.failed or self.pruned or self.value is not None
+
+
+def param_key(params: dict[str, Any]) -> str:
+    """Canonical identity of an assignment (floats rounded to survive
+    yaml/json round-trips through trial manifests)."""
+    norm = {k: (round(v, 10) if isinstance(v, float) else v)
+            for k, v in sorted(params.items())}
+    return json.dumps(norm, sort_keys=True)
+
+
+def _rng(state: dict, seed: int) -> np.random.Generator:
+    """Deterministic per-call rng: the draw counter is part of the state, so
+    a resumed suggestion stream continues instead of repeating."""
+    n = state.get("draws", 0)
+    state["draws"] = n + 1
+    return np.random.default_rng(np.random.SeedSequence([seed, n]))
+
+
+class Suggester:
+    name = "base"
+
+    def __init__(self, specs: list[ParameterSpec], settings: dict[str, Any]):
+        self.specs = specs
+        self.settings = settings
+        self.seed = int(settings.get("random_state", settings.get("seed", 0)))
+
+    def suggest(self, n: int, history: list[Observation],
+                state: dict[str, Any]) -> tuple[list[dict[str, Any]], dict[str, Any]]:
+        raise NotImplementedError
+
+    # -- shared helpers --------------------------------------------------------
+
+    def _random(self, n: int, state: dict) -> list[dict[str, Any]]:
+        rng = _rng(state, self.seed)
+        return [ss.sample(self.specs, rng) for _ in range(n)]
+
+    def _xy(self, history: list[Observation]) -> tuple[np.ndarray, np.ndarray]:
+        done = [o for o in history if o.value is not None and not o.failed]
+        if not done:
+            d = len(self.specs)
+            return np.zeros((0, d)), np.zeros((0,))
+        X = np.stack([ss.encode(self.specs, o.parameters) for o in done])
+        y = np.array([o.value for o in done], dtype=np.float64)
+        return X, y
+
+
+class RandomSearch(Suggester):
+    name = "random"
+
+    def suggest(self, n, history, state):
+        state = dict(state)
+        return self._random(n, state), state
+
+
+class GridSearch(Suggester):
+    """Cartesian product in spec order, row-major; exhausts then stops."""
+
+    name = "grid"
+
+    def suggest(self, n, history, state):
+        state = dict(state)
+        points = int(self.settings.get("default_grid_points", 4))
+        axes = [ss.grid_values(s, points) for s in self.specs]
+        total = math.prod(len(a) for a in axes)
+        idx = state.get("index", 0)
+        out = []
+        while idx < total and len(out) < n:
+            rem, assignment = idx, {}
+            for spec, axis in zip(reversed(self.specs), reversed(axes)):
+                rem, i = divmod(rem, len(axis))
+                assignment[spec.name] = axis[i]
+            out.append(assignment)
+            idx += 1
+        state["index"] = idx
+        return out, state
+
+
+class TPE(Suggester):
+    """Tree-structured Parzen Estimator, 1-D Parzen windows per unit-cube dim
+    (the hyperopt algorithm katib fronts; (U) katib pkg/suggestion/v1beta1/
+    hyperopt/base_service.py algorithm_name tpe)."""
+
+    name = "tpe"
+
+    def suggest(self, n, history, state):
+        state = dict(state)
+        min_obs = int(self.settings.get("n_startup_trials", 8))
+        gamma = float(self.settings.get("gamma", 0.25))
+        n_cand = int(self.settings.get("n_ei_candidates", 24))
+        X, y = self._xy(history)
+        out: list[dict[str, Any]] = []
+        for _ in range(n):
+            if len(y) < min_obs:
+                out.extend(self._random(1, state))
+                continue
+            rng = _rng(state, self.seed)
+            n_good = max(1, int(np.ceil(gamma * len(y))))
+            order = np.argsort(y)
+            good, bad = X[order[:n_good]], X[order[n_good:]]
+            cands = self._kde_sample(good, n_cand, rng)
+            score = self._kde_logpdf(good, cands) - self._kde_logpdf(bad, cands)
+            out.append(ss.decode(self.specs, cands[int(np.argmax(score))]))
+        return out, state
+
+    @staticmethod
+    def _bandwidth(pts: np.ndarray) -> np.ndarray:
+        n, d = pts.shape
+        sigma = pts.std(axis=0) * (n ** (-1.0 / (d + 4))) if n > 1 else np.full(d, 0.25)
+        return np.clip(sigma, 0.05, 0.5)
+
+    def _kde_sample(self, pts: np.ndarray, n: int, rng) -> np.ndarray:
+        """Sample from the good-points Parzen mixture, with a uniform-prior
+        component (as hyperopt does) so the search can escape a bad basin."""
+        sigma = self._bandwidth(pts)
+        centers = pts[rng.integers(0, len(pts), size=n)]
+        out = np.clip(centers + rng.normal(size=centers.shape) * sigma, 0.0, 1.0)
+        n_prior = max(1, n // 4)
+        out[:n_prior] = rng.random((n_prior, pts.shape[1]))
+        return out
+
+    def _kde_logpdf(self, pts: np.ndarray, x: np.ndarray) -> np.ndarray:
+        if len(pts) == 0:
+            return np.zeros(len(x))
+        sigma = self._bandwidth(pts)
+        # [n_x, n_pts, d] squared distances, per-dim bandwidth
+        z = (x[:, None, :] - pts[None, :, :]) / sigma
+        log_norm = -0.5 * z.shape[-1] * math.log(2 * math.pi) - np.log(sigma).sum()
+        comp = -0.5 * (z ** 2).sum(-1) + log_norm
+        m = comp.max(axis=1, keepdims=True)
+        kde = m[:, 0] + np.log(np.exp(comp - m).mean(axis=1))
+        # Mix in the uniform prior (density 1 on the unit cube), weight 1/(n+1).
+        n_pts = len(pts)
+        return np.logaddexp(math.log(n_pts / (n_pts + 1)) + kde,
+                            math.log(1.0 / (n_pts + 1)))
+
+
+class GPExpectedImprovement(Suggester):
+    """GP regression (RBF kernel) + expected improvement — the skopt
+    ``bayesianoptimization`` analog ((U) katib pkg/suggestion/v1beta1/skopt)."""
+
+    name = "gp_ei"
+
+    def suggest(self, n, history, state):
+        state = dict(state)
+        min_obs = int(self.settings.get("n_startup_trials", 6))
+        n_cand = int(self.settings.get("n_candidates", 256))
+        X, y = self._xy(history)
+        out: list[dict[str, Any]] = []
+        X_fit, y_fit = X.copy(), y.copy()
+        for _ in range(n):
+            if len(y_fit) < min_obs:
+                out.extend(self._random(1, state))
+                continue
+            rng = _rng(state, self.seed)
+            u = self._propose(X_fit, y_fit, n_cand, rng)
+            out.append(ss.decode(self.specs, u))
+            # Constant liar: pessimistic fantasy so a batch spreads out.
+            X_fit = np.vstack([X_fit, u[None, :]])
+            y_fit = np.append(y_fit, y_fit.max())
+        return out, state
+
+    def _propose(self, X, y, n_cand, rng) -> np.ndarray:
+        mu_y, sd_y = y.mean(), y.std() + 1e-9
+        yn = (y - mu_y) / sd_y
+        ls = float(self.settings.get("length_scale", 0.3))
+        noise = float(self.settings.get("noise", 1e-4))
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) / ls) ** 2
+            return np.exp(-0.5 * d2.sum(-1))
+
+        K = k(X, X) + noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        cands = rng.random((n_cand, X.shape[1]))
+        # Exploit: jittered copies of the incumbent region.
+        best = X[np.argmin(y)]
+        local = np.clip(best + rng.normal(scale=0.05, size=(n_cand // 4, X.shape[1])),
+                        0.0, 1.0)
+        cands = np.vstack([cands, local])
+        Ks = k(cands, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        sd = np.sqrt(var)
+        f_best = yn.min()
+        z = (f_best - mu) / sd
+        Phi = 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        phi = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+        ei = sd * (z * Phi + phi)
+        return cands[int(np.argmax(ei))]
+
+
+class CMAES(Suggester):
+    """(μ/μ_w, λ)-CMA-ES in the unit cube, ask/tell reconstructed from history
+    by canonical param key ((U) katib pkg/suggestion/v1beta1/optuna cmaes)."""
+
+    name = "cmaes"
+
+    def _popsize(self) -> int:
+        d = len(self.specs)
+        return int(self.settings.get("popsize", 4 + int(3 * math.log(max(d, 2)))))
+
+    def suggest(self, n, history, state):
+        state = dict(state)
+        d = len(self.specs)
+        lam = self._popsize()
+        if "mean" not in state:
+            state.update(mean=[0.5] * d, sigma=0.3,
+                         C=np.eye(d).tolist(), p_sigma=[0.0] * d,
+                         p_c=[0.0] * d, gen=0, asked=[])
+        by_key = {param_key(o.parameters): o for o in history}
+        asked: list[str] = list(state["asked"])
+        # Generation complete → update the distribution.
+        if len(asked) >= lam and all(
+                k in by_key and by_key[k].completed for k in asked):
+            self._update(state, asked, by_key, lam)
+            asked = []
+        out: list[dict[str, Any]] = []
+        mean = np.array(state["mean"])
+        C = np.array(state["C"])
+        sigma = float(state["sigma"])
+        # Sample only what the current generation still needs.
+        budget = min(n, max(0, lam - len(asked)))
+        try:
+            A = np.linalg.cholesky(C + 1e-12 * np.eye(d))
+        except np.linalg.LinAlgError:
+            A = np.eye(d)
+        for _ in range(budget):
+            rng = _rng(state, self.seed)
+            u = np.clip(mean + sigma * (A @ rng.normal(size=d)), 0.0, 1.0)
+            params = ss.decode(self.specs, u)
+            out.append(params)
+            asked.append(param_key(params))
+        state["asked"] = asked
+        return out, state
+
+    def _update(self, state: dict, asked: list[str],
+                by_key: dict[str, Observation], lam: int) -> None:
+        d = len(self.specs)
+        evaluated = [(k, by_key[k]) for k in asked]
+        # Failed members rank last even if they logged a partial value
+        # (pruned trials' values are real observations and stay usable).
+        scored = sorted(evaluated, key=lambda kv: (
+            kv[1].value if kv[1].value is not None and not kv[1].failed
+            else float("inf")))
+        mu = lam // 2
+        w = np.log(mu + 0.5) - np.log(np.arange(1, mu + 1))
+        w /= w.sum()
+        mu_eff = 1.0 / (w ** 2).sum()
+        xs = np.stack([ss.encode(self.specs, kv[1].parameters)
+                       for kv in scored[:mu]])
+        mean_old = np.array(state["mean"])
+        sigma = float(state["sigma"])
+        C = np.array(state["C"])
+        mean_new = w @ xs
+        # Standard CMA-ES constants (Hansen's tutorial defaults).
+        c_sigma = (mu_eff + 2) / (d + mu_eff + 5)
+        d_sigma = 1 + 2 * max(0.0, math.sqrt((mu_eff - 1) / (d + 1)) - 1) + c_sigma
+        c_c = (4 + mu_eff / d) / (d + 4 + 2 * mu_eff / d)
+        c_1 = 2 / ((d + 1.3) ** 2 + mu_eff)
+        c_mu = min(1 - c_1, 2 * (mu_eff - 2 + 1 / mu_eff) / ((d + 2) ** 2 + mu_eff))
+        try:
+            C_inv_sqrt = np.linalg.inv(np.linalg.cholesky(C + 1e-12 * np.eye(d))).T
+        except np.linalg.LinAlgError:
+            C_inv_sqrt = np.eye(d)
+        y_w = (mean_new - mean_old) / max(sigma, 1e-12)
+        p_sigma = ((1 - c_sigma) * np.array(state["p_sigma"])
+                   + math.sqrt(c_sigma * (2 - c_sigma) * mu_eff) * (C_inv_sqrt @ y_w))
+        chi_d = math.sqrt(d) * (1 - 1 / (4 * d) + 1 / (21 * d ** 2))
+        sigma_new = sigma * math.exp(
+            (c_sigma / d_sigma) * (np.linalg.norm(p_sigma) / chi_d - 1))
+        p_c = ((1 - c_c) * np.array(state["p_c"])
+               + math.sqrt(c_c * (2 - c_c) * mu_eff) * y_w)
+        ys = (xs - mean_old) / max(sigma, 1e-12)
+        rank_mu = sum(wi * np.outer(yi, yi) for wi, yi in zip(w, ys))
+        C_new = ((1 - c_1 - c_mu) * C + c_1 * np.outer(p_c, p_c) + c_mu * rank_mu)
+        state.update(mean=mean_new.tolist(), sigma=float(np.clip(sigma_new, 1e-4, 1.0)),
+                     C=C_new.tolist(), p_sigma=p_sigma.tolist(), p_c=p_c.tolist(),
+                     gen=state["gen"] + 1)
+
+
+class Hyperband(Suggester):
+    """Successive-halving brackets over a *resource parameter* ((U) katib
+    pkg/suggestion/v1beta1/hyperband). ``resource_parameter`` names one of the
+    experiment's int parameters (e.g. training steps); the suggester assigns
+    it per-rung and promotes the top 1/eta of each completed rung."""
+
+    name = "hyperband"
+
+    def __init__(self, specs, settings):
+        super().__init__(specs, settings)
+        self.resource = settings.get("resource_parameter")
+        if not self.resource or all(s.name != self.resource for s in self.specs):
+            raise ValueError(
+                "hyperband needs settings.resource_parameter naming an "
+                "experiment parameter")
+        self.search_specs = [s for s in self.specs if s.name != self.resource]
+        rspec = next(s for s in self.specs if s.name == self.resource)
+        self.r_max = float(settings.get("max_resource", rspec.feasible_space.max))
+        self.r_min = float(settings.get("min_resource",
+                                        rspec.feasible_space.min or 1))
+        self.eta = float(settings.get("eta", 3))
+        self._rspec = rspec
+
+    def _brackets(self) -> list[list[tuple[int, float]]]:
+        """[(n_configs, resource) per rung] per bracket, aggressive first."""
+        s_max = int(math.log(self.r_max / self.r_min) / math.log(self.eta))
+        out = []
+        for s in range(s_max, -1, -1):
+            n = int(math.ceil((s_max + 1) / (s + 1) * self.eta ** s))
+            rungs = []
+            for i in range(s + 1):
+                n_i = max(1, int(n * self.eta ** (-i)))
+                r_i = max(self.r_min, self.r_max * self.eta ** (i - s))
+                rungs.append((n_i, r_i))
+            out.append(rungs)
+        return out
+
+    def _with_resource(self, params: dict[str, Any], r: float) -> dict[str, Any]:
+        full = dict(params)
+        full[self.resource] = ss.from_unit(self._rspec, ss.to_unit(self._rspec, r))
+        return full
+
+    def suggest(self, n, history, state):
+        state = dict(state)
+        state.setdefault("bracket", 0)
+        state.setdefault("rung", 0)
+        state.setdefault("rung_keys", [])   # keys asked in the current rung
+        state.setdefault("rung_base", [])   # search-space params (no resource)
+        by_key = {param_key(o.parameters): o for o in history}
+        brackets = self._brackets()
+        out: list[dict[str, Any]] = []
+        while len(out) < n and state["bracket"] < len(brackets):
+            rungs = brackets[state["bracket"]]
+            n_i, r_i = rungs[state["rung"]]
+            if len(state["rung_keys"]) < n_i:
+                # Fill the rung: first rung samples fresh; later rungs promote.
+                if state["rung"] == 0:
+                    rng = _rng(state, self.seed)
+                    base = ss.sample(self.search_specs, rng)
+                else:
+                    base = state["promote"].pop(0)
+                full = self._with_resource(base, r_i)
+                state["rung_keys"].append(param_key(full))
+                state["rung_base"].append(base)
+                out.append(full)
+                continue
+            # Rung full: promote when every member finished.
+            done = [by_key.get(k) for k in state["rung_keys"]]
+            if not all(o is not None and o.completed for o in done):
+                break  # wait for results
+            ranked = sorted(
+                zip(state["rung_base"], done),
+                key=lambda bo: (bo[1].value if bo[1].value is not None
+                                else float("inf")))
+            if state["rung"] + 1 < len(rungs):
+                keep = max(1, rungs[state["rung"] + 1][0])
+                state["promote"] = [b for b, _ in ranked[:keep]]
+                state["rung"] += 1
+            else:
+                state["bracket"] += 1
+                state["rung"] = 0
+            state["rung_keys"], state["rung_base"] = [], []
+        return out, state
+
+
+_ALGORITHMS = {
+    cls.name: cls
+    for cls in (RandomSearch, GridSearch, TPE, GPExpectedImprovement,
+                CMAES, Hyperband)
+}
+# Katib-compatible aliases.
+_ALGORITHMS["bayesianoptimization"] = GPExpectedImprovement
+
+
+def get_suggester(spec: ExperimentSpec) -> Suggester:
+    name = spec.algorithm.name
+    try:
+        cls = _ALGORITHMS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: {sorted(_ALGORITHMS)}")
+    return cls(spec.parameters, spec.algorithm.settings)
+
+
+# -- early stopping -------------------------------------------------------------
+
+def median_should_stop(
+    running: list[tuple[int, float]],
+    completed: list[list[tuple[int, float]]],
+    *,
+    min_trials: int = 3,
+    min_steps: int = 1,
+) -> bool:
+    """Median stopping rule ((U) katib pkg/earlystopping/v1beta1/medianstop):
+    stop a running trial whose best objective so far is worse than the median
+    of completed trials' running averages at the same step (minimize
+    convention)."""
+    if len(completed) < min_trials or not running:
+        return False
+    step = running[-1][0]
+    if step < min_steps:
+        return False
+    best_so_far = min(v for _, v in running)
+    averages = []
+    for hist in completed:
+        upto = [v for s, v in hist if s <= step]
+        if upto:
+            averages.append(sum(upto) / len(upto))
+    if len(averages) < min_trials:
+        return False
+    return best_so_far > float(np.median(averages))

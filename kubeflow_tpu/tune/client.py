@@ -1,0 +1,121 @@
+"""One-call HPO — the ``KatibClient.tune()`` analog ((U) katib sdk/python
+kubeflow/katib/api/katib_client.py :: tune).
+
+Builds an Experiment whose trials run a registered entrypoint (or dotted
+``module:function`` path) as single-worker JAXJobs, with the searched
+parameters spliced into the workload config.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from kubeflow_tpu.core.object import ObjectMeta
+from kubeflow_tpu.core.tuning import (
+    AlgorithmSpec, EarlyStoppingSpec, Experiment, ExperimentSpec,
+    FeasibleSpace, ObjectiveSpec, ObjectiveType, ParameterSpec, ParameterType,
+    TrialTemplate,
+)
+
+
+def parameter(name: str, *, min: Optional[float] = None,
+              max: Optional[float] = None, step: Optional[float] = None,
+              values: Optional[list] = None, log_scale: bool = False,
+              type: Optional[str] = None) -> ParameterSpec:
+    """Terse ParameterSpec builder: numeric when min/max given (int if both
+    are ints and no explicit type), categorical when values given."""
+    if values is not None:
+        ptype = ParameterType(type) if type else ParameterType.CATEGORICAL
+        return ParameterSpec(name=name, type=ptype,
+                             feasible_space=FeasibleSpace(list=values))
+    if type is None:
+        is_int = (isinstance(min, int) and isinstance(max, int)
+                  and not isinstance(min, bool))
+        ptype = ParameterType.INT if is_int else ParameterType.DOUBLE
+    else:
+        ptype = ParameterType(type)
+    return ParameterSpec(
+        name=name, type=ptype,
+        feasible_space=FeasibleSpace(min=min, max=max, step=step,
+                                     log_scale=log_scale))
+
+
+def build_experiment(
+    name: str,
+    *,
+    entrypoint: str,
+    parameters: list[ParameterSpec],
+    objective_metric: str,
+    objective_type: str = "minimize",
+    goal: Optional[float] = None,
+    base_config: Optional[dict[str, Any]] = None,
+    algorithm: str = "random",
+    algorithm_settings: Optional[dict[str, Any]] = None,
+    max_trial_count: int = 12,
+    parallel_trial_count: int = 3,
+    max_failed_trial_count: int = 3,
+    early_stopping: bool = False,
+    metric_source: str = "file",
+    tpu_chips: int = 1,
+    namespace: str = "default",
+) -> Experiment:
+    config = dict(base_config or {})
+    for p in parameters:
+        config[p.name] = "${trialParameters.%s}" % p.name
+    manifest = {
+        "apiVersion": "training.tpu.kubeflow.dev/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": "${trialName}", "namespace": namespace},
+        "spec": {
+            "replica_specs": {
+                "worker": {
+                    "replicas": 1,
+                    "template": {"entrypoint": entrypoint, "config": config},
+                    "resources": {"tpu_chips": tpu_chips},
+                }
+            }
+        },
+    }
+    return Experiment(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=ExperimentSpec(
+            parameters=parameters,
+            objective=ObjectiveSpec(type=ObjectiveType(objective_type),
+                                    metric_name=objective_metric, goal=goal),
+            algorithm=AlgorithmSpec(name=algorithm,
+                                    settings=algorithm_settings or {}),
+            parallel_trial_count=parallel_trial_count,
+            max_trial_count=max_trial_count,
+            max_failed_trial_count=max_failed_trial_count,
+            early_stopping=(EarlyStoppingSpec() if early_stopping else None),
+            trial_template=TrialTemplate(manifest=manifest,
+                                         primary_metric_source=metric_source),
+        ))
+
+
+def tune(control_plane, name: str, *, timeout: float = 300.0,
+         stepped: bool = False, **kwargs) -> Experiment:
+    """Submit + wait: returns the finished Experiment (check
+    ``status.current_optimal_trial``). Raises RuntimeError promptly if the
+    experiment fails (instead of burning the whole timeout)."""
+    import time
+
+    exp = build_experiment(name, **kwargs)
+    control_plane.submit(exp)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if stepped:
+            control_plane.step()
+        cur = control_plane.store.try_get(Experiment, name,
+                                          exp.metadata.namespace)
+        if cur is None:
+            raise RuntimeError(f"experiment {name} disappeared while waiting")
+        if cur.status.has_condition("Succeeded"):
+            return cur
+        if cur.status.has_condition("Failed"):
+            cond = cur.status.get_condition("Failed")
+            raise RuntimeError(
+                f"experiment {name} failed: {cond.reason if cond else ''} "
+                f"({cur.status.trials_failed} failed trials)")
+        time.sleep(0.1)
+    raise TimeoutError(f"experiment {name} not finished in {timeout}s")

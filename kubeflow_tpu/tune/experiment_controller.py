@@ -1,0 +1,321 @@
+"""Experiment reconciler — katib experiment+suggestion controllers in one.
+
+Loop (SURVEY.md §3.3): experiment needs N trials → ask the in-process
+suggester (replacing katib's per-algorithm suggestion-service Deployment +
+gRPC GetSuggestions) → create Trial objects from trialTemplate with
+``${trialParameters.x}`` substitution → watch trial conditions → update
+optimal trial → finish on goal / maxTrialCount / maxFailedTrialCount.
+Algorithm state persists on the Suggestion object, making resume work
+(ResumePolicy.FROM_SUGGESTION ≈ katib FromVolume).
+
+(U) katib pkg/controller.v1beta1/experiment experiment_controller.go,
+pkg/controller.v1beta1/suggestion suggestion_controller.go.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from kubeflow_tpu.core.events import EventRecorder
+from kubeflow_tpu.core.object import ObjectMeta
+from kubeflow_tpu.core.store import (
+    AlreadyExistsError, ConflictError, NotFoundError, ObjectStore, WatchEvent,
+)
+from kubeflow_tpu.core.tuning import (
+    Experiment, ObjectiveType, Suggestion, SuggestionSpec, Trial,
+    TrialAssignment, TrialSpec,
+)
+from kubeflow_tpu.operator.controller import ReconcileResult
+from kubeflow_tpu.tune.algorithms import (
+    Observation, get_suggester, median_should_stop,
+)
+from kubeflow_tpu.tune.trial_controller import LABEL_EXPERIMENT
+
+logger = logging.getLogger("kubeflow_tpu.tune")
+
+
+def substitute_parameters(node: Any, params: dict[str, Any],
+                          trial_name: str) -> Any:
+    """Deep-substitute ``${trialParameters.<name>}`` / ``${trialName}`` in a
+    manifest tree. A string that *is* exactly one placeholder becomes the
+    typed value; embedded placeholders stringify (katib trialTemplate
+    contract)."""
+    if isinstance(node, dict):
+        return {k: substitute_parameters(v, params, trial_name)
+                for k, v in node.items()}
+    if isinstance(node, list):
+        return [substitute_parameters(v, params, trial_name) for v in node]
+    if isinstance(node, str):
+        for name, value in params.items():
+            ph = "${trialParameters.%s}" % name
+            if node == ph:
+                return value
+            if ph in node:
+                node = node.replace(ph, str(value))
+        return node.replace("${trialName}", trial_name)
+    return node
+
+
+class ExperimentController:
+    kinds = ["Experiment", "Trial"]
+
+    def __init__(self, store: ObjectStore, *,
+                 recorder: Optional[EventRecorder] = None,
+                 poll_interval: float = 0.5):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.poll_interval = poll_interval
+
+    def key_for(self, ev: WatchEvent) -> Optional[str]:
+        obj = ev.object
+        if obj.kind == "Experiment":
+            return f"{obj.metadata.namespace}/{obj.metadata.name}"
+        if obj.kind == "Trial":
+            return f"{obj.metadata.namespace}/{obj.spec.experiment}"
+        return None
+
+    def reconcile(self, key: str) -> Optional[ReconcileResult]:
+        namespace, name = key.split("/", 1)
+        exp = self.store.try_get(Experiment, name, namespace)
+        if exp is None:
+            self._reap(name, namespace)
+            return None
+        if exp.status.has_condition("Succeeded") or exp.status.has_condition("Failed"):
+            return None
+        if not exp.status.has_condition("Created"):
+            exp.status.set_condition("Created", True, reason="ExperimentCreated")
+            self.recorder.normal(exp, "Created", "experiment accepted")
+
+        trials = self.store.list(
+            Trial, namespace=namespace,
+            label_selector={LABEL_EXPERIMENT: name})
+        trials.sort(key=lambda t: t.metadata.name)
+        self._update_counts(exp, trials)
+        self._update_optimal(exp, trials)
+        self._early_stop(exp, trials)
+
+        done = self._check_completion(exp, trials)
+        if done:
+            self._update_status(exp)
+            return None
+
+        self._spawn_trials(exp, trials)
+        exp.status.set_condition("Running", True, reason="TrialsRunning")
+        self._update_status(exp)
+        return ReconcileResult(requeue_after=self.poll_interval)
+
+    # -- trial bookkeeping -----------------------------------------------------
+
+    @staticmethod
+    def _is_finished(t: Trial) -> bool:
+        return (t.status.has_condition("Succeeded")
+                or t.status.has_condition("Failed"))
+
+    def _update_counts(self, exp: Experiment, trials: list[Trial]) -> None:
+        st = exp.status
+        st.trials = len(trials)
+        st.trials_succeeded = sum(
+            1 for t in trials
+            if t.status.has_condition("Succeeded") and not t.status.pruned)
+        st.trials_pruned = sum(1 for t in trials if t.status.pruned)
+        st.trials_failed = sum(
+            1 for t in trials if t.status.has_condition("Failed"))
+        st.trials_running = sum(1 for t in trials if not self._is_finished(t))
+
+    def _signed(self, exp: Experiment, v: float) -> float:
+        """Objective in minimize convention for the suggesters."""
+        return v if exp.spec.objective.type is ObjectiveType.MINIMIZE else -v
+
+    def _history(self, exp: Experiment, trials: list[Trial]) -> list[Observation]:
+        out = []
+        for t in trials:
+            v = t.status.final_objective
+            out.append(Observation(
+                parameters=t.spec.parameter_assignments,
+                value=None if v is None else self._signed(exp, v),
+                failed=t.status.has_condition("Failed"),
+                pruned=t.status.pruned))
+        return out
+
+    def _update_optimal(self, exp: Experiment, trials: list[Trial]) -> None:
+        best: Optional[Trial] = None
+        for t in trials:
+            # Only succeeded trials compete (katib semantics): a crashed
+            # trial's partial metrics must not win or trip the goal check.
+            if (t.status.final_objective is None
+                    or not t.status.has_condition("Succeeded")):
+                continue
+            if (best is None
+                    or self._signed(exp, t.status.final_objective)
+                    < self._signed(exp, best.status.final_objective)):
+                best = t
+        if best is not None:
+            opt = exp.status.current_optimal_trial
+            opt.trial_name = best.metadata.name
+            opt.parameter_assignments = best.spec.parameter_assignments
+            opt.objective_value = best.status.final_objective
+            opt.observations = {
+                m: pts[-1][1] for m, pts in best.status.observations.items() if pts}
+
+    # -- early stopping --------------------------------------------------------
+
+    def _early_stop(self, exp: Experiment, trials: list[Trial]) -> None:
+        es = exp.spec.early_stopping
+        if es is None or es.name != "medianstop":
+            return
+        metric = exp.spec.objective.metric_name
+        sign = 1.0 if exp.spec.objective.type is ObjectiveType.MINIMIZE else -1.0
+        completed = [
+            [(s, sign * v) for s, v in t.status.observations.get(metric, [])]
+            for t in trials
+            if self._is_finished(t) and t.status.observations.get(metric)]
+        for t in trials:
+            if self._is_finished(t) or t.status.pruned:
+                continue
+            running = [(s, sign * v)
+                       for s, v in t.status.observations.get(metric, [])]
+            if median_should_stop(
+                    running, completed,
+                    min_trials=int(es.settings.get("min_trials_required", 3)),
+                    min_steps=int(es.settings.get("start_step", 1))):
+                # Re-read before writing: update_status is last-writer-wins
+                # and the trial controller may have finalized this trial
+                # since we listed (threaded mode).
+                fresh = self.store.try_get(Trial, t.metadata.name,
+                                           t.metadata.namespace)
+                if fresh is None or self._is_finished(fresh):
+                    continue
+                fresh.status.pruned = True
+                try:
+                    self.store.update_status(fresh)
+                    self.recorder.normal(fresh, "EarlyStopped",
+                                         "median stopping rule")
+                except (NotFoundError, ConflictError):
+                    pass
+
+    # -- completion ------------------------------------------------------------
+
+    def _check_completion(self, exp: Experiment, trials: list[Trial]) -> bool:
+        spec, st = exp.spec, exp.status
+        goal = spec.objective.goal
+        opt = st.current_optimal_trial
+        if goal is not None and opt.objective_value is not None:
+            reached = (opt.objective_value <= goal
+                       if spec.objective.type is ObjectiveType.MINIMIZE
+                       else opt.objective_value >= goal)
+            if reached:
+                return self._finish(exp, True, "GoalReached")
+        if st.trials_failed > spec.max_failed_trial_count:
+            return self._finish(exp, False, "MaxFailedTrialsReached")
+        finished = st.trials_succeeded + st.trials_failed + st.trials_pruned
+        if finished >= spec.max_trial_count:
+            return self._finish(exp, True, "MaxTrialsReached")
+        return False
+
+    def _finish(self, exp: Experiment, succeeded: bool, reason: str) -> bool:
+        exp.status.set_condition("Running", False, reason=reason)
+        exp.status.set_condition("Succeeded" if succeeded else "Failed", True,
+                                 reason=reason)
+        self.recorder.normal(exp, reason,
+                             f"optimal={exp.status.current_optimal_trial.trial_name} "
+                             f"value={exp.status.current_optimal_trial.objective_value}")
+        # Stop stragglers (katib cleans running trials on completion).
+        for t in self.store.list(
+                Trial, namespace=exp.metadata.namespace,
+                label_selector={LABEL_EXPERIMENT: exp.metadata.name}):
+            if not self._is_finished(t):
+                try:
+                    self.store.delete(Trial, t.metadata.name, t.metadata.namespace)
+                except NotFoundError:
+                    pass
+        return True
+
+    # -- suggestion → trial creation -------------------------------------------
+
+    def _suggestion(self, exp: Experiment) -> Suggestion:
+        name = exp.metadata.name
+        s = self.store.try_get(Suggestion, name, exp.metadata.namespace)
+        if s is not None:
+            return s
+        s = Suggestion(
+            metadata=ObjectMeta(name=name, namespace=exp.metadata.namespace,
+                                owner=exp.key,
+                                labels={LABEL_EXPERIMENT: name}),
+            spec=SuggestionSpec(experiment=name))
+        try:
+            return self.store.create(s)
+        except AlreadyExistsError:
+            return self.store.get(Suggestion, name, exp.metadata.namespace)
+
+    def _spawn_trials(self, exp: Experiment, trials: list[Trial]) -> None:
+        spec, st = exp.spec, exp.status
+        finished = st.trials_succeeded + st.trials_failed + st.trials_pruned
+        want = min(spec.parallel_trial_count - st.trials_running,
+                   spec.max_trial_count - finished - st.trials_running)
+        if want <= 0:
+            return
+        sugg = self._suggestion(exp)
+        suggester = get_suggester(spec)
+        assignments, new_state = suggester.suggest(
+            want, self._history(exp, trials), dict(sugg.status.algorithm_state))
+        if not assignments and st.trials_running == 0:
+            # Exhausted (grid done / hyperband waiting on nothing): complete.
+            self._finish(exp, True, "SearchSpaceExhausted")
+            return
+        for params in assignments:
+            index = sugg.spec.requests
+            sugg.spec.requests += 1
+            trial_name = f"{exp.metadata.name}-{index:04d}"
+            sugg.status.assignments.append(
+                TrialAssignment(name=trial_name, parameters=params))
+            manifest = substitute_parameters(
+                exp.spec.trial_template.manifest, params, trial_name)
+            t = Trial(
+                metadata=ObjectMeta(
+                    name=trial_name, namespace=exp.metadata.namespace,
+                    owner=exp.key,
+                    labels={
+                        LABEL_EXPERIMENT: exp.metadata.name,
+                        "tune.tpu.kubeflow.dev/metric-source":
+                            exp.spec.trial_template.primary_metric_source,
+                        **({"tune.tpu.kubeflow.dev/metrics-file":
+                                exp.spec.trial_template.metrics_file}
+                           if exp.spec.trial_template.metrics_file else {}),
+                    }),
+                spec=TrialSpec(
+                    experiment=exp.metadata.name,
+                    parameter_assignments=params,
+                    worker_manifest=manifest,
+                    objective=exp.spec.objective))
+            try:
+                self.store.create(t)
+                self.recorder.normal(exp, "TrialCreated",
+                                     f"{trial_name}: {params}")
+            except AlreadyExistsError:
+                pass
+        sugg.status.algorithm_state = new_state
+        try:
+            self.store.update(sugg, check_version=False)
+        except NotFoundError:
+            pass
+
+    # -- cleanup ---------------------------------------------------------------
+
+    def _reap(self, name: str, namespace: str) -> None:
+        for t in self.store.list(Trial, namespace=namespace,
+                                 label_selector={LABEL_EXPERIMENT: name}):
+            try:
+                self.store.delete(Trial, t.metadata.name, namespace)
+            except NotFoundError:
+                pass
+        try:
+            self.store.delete(Suggestion, name, namespace)
+        except NotFoundError:
+            pass
+
+    def _update_status(self, exp: Experiment) -> None:
+        try:
+            self.store.update_status(exp)
+        except (NotFoundError, ConflictError):
+            pass

@@ -1,0 +1,122 @@
+"""Trial metrics collectors — the katib metrics-collector sidecar analog.
+
+Katib injects a sidecar that parses trial output and pushes observations to
+the db-manager ((U) katib pkg/metricscollector, pkg/webhook/v1beta1/pod
+inject_webhook.go; SURVEY.md §2.4#32). Here collection is a pull: the trial
+controller reads the trial job's worker-0 artifacts on each reconcile.
+
+Sources (TrialTemplate.primary_metric_source):
+- ``file``   — the worker's ``metrics.jsonl`` (the data plane's native metric
+               stream; ≈ katib ``File``/``TensorFlowEvent``).
+- ``stdout`` — ``metric=value`` lines in the worker log (≈ katib ``StdOut``).
+- ``push``   — the JAXJob's own ``status.metrics`` (≈ katib ``Push``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+from kubeflow_tpu.core.jobs import JAXJob
+
+Series = dict[str, list[tuple[int, float]]]
+
+# katib StdOut format: "<name>=<float>" tokens anywhere in a line.
+_STDOUT_RE = re.compile(r"([A-Za-z_][\w./-]*)\s*=\s*([-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)")
+
+
+def _append(series: Series, name: str, step: int, value: float) -> None:
+    pts = series.setdefault(name, [])
+    if not pts or pts[-1][0] != step:
+        pts.append((step, value))
+    else:
+        pts[-1] = (step, value)
+
+
+def collect_file(path: str, metric_names: set[str]) -> Series:
+    """Parse every metrics.jsonl line: {"step": n, "<metric>": v, ...}.
+    Malformed lines (bad JSON, non-numeric step/value) are skipped — user
+    training code writes this file, so garbage must not wedge the trial."""
+    series: Series = {}
+    try:
+        with open(path) as f:
+            for i, line in enumerate(f):
+                try:
+                    rec = json.loads(line)
+                    step = int(rec.get("step", i))
+                    for name in metric_names:
+                        if rec.get(name) is not None:
+                            _append(series, name, step, float(rec[name]))
+                except (ValueError, TypeError):
+                    continue
+    except OSError:
+        pass
+    return series
+
+
+def collect_stdout(log_path: str, metric_names: set[str]) -> Series:
+    """Parse `name=value` tokens from a worker log; step = per-metric line
+    ordinal unless the same line carries a `step=` token."""
+    series: Series = {}
+    counters: dict[str, int] = {}
+    try:
+        with open(log_path, errors="replace") as f:
+            for line in f:
+                found = dict()
+                for m in _STDOUT_RE.finditer(line):
+                    found[m.group(1)] = float(m.group(2))
+                step = int(found["step"]) if "step" in found else None
+                for name, value in found.items():
+                    if name not in metric_names:
+                        continue
+                    s = step if step is not None else counters.get(name, 0)
+                    counters[name] = s + 1
+                    _append(series, name, s, value)
+    except OSError:
+        pass
+    return series
+
+
+def collect_push(job: JAXJob, metric_names: set[str]) -> Series:
+    """Lift the job's own status metrics (one point at the current step)."""
+    m = job.status.metrics
+    series: Series = {}
+    for name in metric_names:
+        v = getattr(m, name, None)
+        if v is not None:
+            _append(series, name, m.step, float(v))
+    return series
+
+
+def collect(
+    source: str,
+    *,
+    job: JAXJob,
+    job_dir: Optional[str],
+    metric_names: set[str],
+    metrics_file: Optional[str] = None,
+) -> Series:
+    if source == "push":
+        return collect_push(job, metric_names)
+    if job_dir is None:
+        return {}
+    if source == "file":
+        # metrics_file: an explicit jsonl path (absolute, or relative to the
+        # job dir); default is worker-0's native metrics stream.
+        if metrics_file:
+            path = (metrics_file if os.path.isabs(metrics_file)
+                    else os.path.join(job_dir, metrics_file))
+        else:
+            path = os.path.join(job_dir, "worker-0", "metrics.jsonl")
+        return collect_file(path, metric_names)
+    if source == "stdout":
+        # WorkerRuntime log layout: {base}/logs/{ns}.{worker-name}.log
+        # (worker_runtime.py _proc_name + procman.py log_path).
+        base = os.path.dirname(os.path.dirname(job_dir))
+        log = os.path.join(
+            base, "logs",
+            f"{job.metadata.namespace}.{job.metadata.name}-worker-0.log")
+        return collect_stdout(log, metric_names)
+    raise ValueError(f"unknown metric source {source!r}")

@@ -1,0 +1,104 @@
+"""Search-space geometry: parameters ↔ the unit cube.
+
+Model-based suggesters (TPE, GP-EI, CMA-ES) all work in [0,1]^d; this module
+owns the mapping so every algorithm shares one notion of scale (linear / log /
+categorical index). The reference spreads the equivalent over each suggestion
+service's own param parsing ((U) katib pkg/suggestion/v1beta1/internal/
+search_space.py :: HyperParameterSearchSpace).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+from kubeflow_tpu.core.tuning import ParameterSpec, ParameterType
+
+
+def _log_bounds(spec: ParameterSpec) -> tuple[float, float]:
+    fs = spec.feasible_space
+    if fs.min is None or fs.min <= 0:
+        raise ValueError(f"{spec.name}: log_scale needs min > 0")
+    return math.log(fs.min), math.log(fs.max)
+
+
+def to_unit(spec: ParameterSpec, value: Any) -> float:
+    """Map a concrete parameter value to [0,1]."""
+    fs = spec.feasible_space
+    if spec.type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+        values = list(fs.list)
+        idx = values.index(value)
+        return (idx + 0.5) / len(values)
+    if fs.log_scale:
+        lo, hi = _log_bounds(spec)
+        x = math.log(float(value))
+    else:
+        lo, hi = float(fs.min), float(fs.max)
+        x = float(value)
+    if hi == lo:
+        return 0.5
+    return min(1.0, max(0.0, (x - lo) / (hi - lo)))
+
+
+def from_unit(spec: ParameterSpec, u: float) -> Any:
+    """Map u ∈ [0,1] back to a concrete, correctly-typed value."""
+    u = min(1.0, max(0.0, float(u)))
+    fs = spec.feasible_space
+    if spec.type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+        values = list(fs.list)
+        idx = min(len(values) - 1, int(u * len(values)))
+        return values[idx]
+    if fs.log_scale:
+        lo, hi = _log_bounds(spec)
+        x = math.exp(lo + u * (hi - lo))
+    else:
+        x = float(fs.min) + u * (float(fs.max) - float(fs.min))
+    if spec.type is ParameterType.INT:
+        return int(min(float(fs.max), max(float(fs.min), round(x))))
+    if fs.step:
+        x = float(fs.min) + round((x - float(fs.min)) / fs.step) * fs.step
+    # exp(log(min)) can land an ulp outside the box — clamp.
+    return min(float(fs.max), max(float(fs.min), x))
+
+
+def encode(specs: list[ParameterSpec], params: dict[str, Any]) -> np.ndarray:
+    return np.array([to_unit(s, params[s.name]) for s in specs])
+
+
+def decode(specs: list[ParameterSpec], u: np.ndarray) -> dict[str, Any]:
+    return {s.name: from_unit(s, float(u[i])) for i, s in enumerate(specs)}
+
+
+def sample(specs: list[ParameterSpec], rng: np.random.Generator) -> dict[str, Any]:
+    """One uniform-in-unit-cube sample (log scale ⇒ log-uniform)."""
+    return decode(specs, rng.random(len(specs)))
+
+
+MAX_GRID_AXIS = 10_000  # an axis larger than this was surely a spec mistake
+
+
+def grid_values(spec: ParameterSpec, default_points: int = 4) -> list[Any]:
+    """The grid axis for one parameter (≈ katib grid suggestion semantics:
+    step-driven for numerics, the full list for categorical/discrete).
+
+    INT/stepped axes larger than MAX_GRID_AXIS fall back to default_points
+    evenly-spaced samples instead of materializing (and running!) an
+    astronomically large grid."""
+    fs = spec.feasible_space
+    if spec.type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+        return list(fs.list)
+    if spec.type is ParameterType.INT:
+        step = int(fs.step or 1)
+        count = (int(fs.max) - int(fs.min)) // step + 1
+        if count <= max(default_points, MAX_GRID_AXIS):
+            return list(range(int(fs.min), int(fs.max) + 1, step))
+    elif fs.step:
+        n = int(round((fs.max - fs.min) / fs.step)) + 1
+        if n <= MAX_GRID_AXIS:
+            return [min(fs.max, fs.min + i * fs.step) for i in range(n)]
+    # No (usable) step: default_points samples, even in (log-)space, deduped
+    # (rounding can collide for narrow int ranges).
+    vals = [from_unit(spec, u) for u in np.linspace(0.0, 1.0, default_points)]
+    return sorted(set(vals), key=vals.index)

@@ -1,0 +1,156 @@
+"""Trial reconciler: Trial → worker JAXJob → observations → final objective.
+
+The katib trial controller creates the worker from trialTemplate and watches
+its conditions ((U) katib pkg/controller.v1beta1/trial trial_controller.go;
+SURVEY.md §3.3). Here the worker is always a JAXJob (the platform's only
+workload kind) and metric collection is pull-based (tune/metrics.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from kubeflow_tpu.core.events import EventRecorder
+from kubeflow_tpu.core.jobs import JAXJob
+from kubeflow_tpu.core.store import (
+    AlreadyExistsError, NotFoundError, ObjectStore, WatchEvent,
+)
+from kubeflow_tpu.core.tuning import ObjectiveType, Trial
+from kubeflow_tpu.operator.controller import ReconcileResult
+from kubeflow_tpu.tune import metrics as metrics_mod
+
+logger = logging.getLogger("kubeflow_tpu.tune")
+
+LABEL_TRIAL = "tune.tpu.kubeflow.dev/trial"
+LABEL_EXPERIMENT = "tune.tpu.kubeflow.dev/experiment"
+
+
+class TrialController:
+    kinds = ["Trial", "JAXJob"]
+
+    def __init__(self, store: ObjectStore, *,
+                 base_dir: Optional[str] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 poll_interval: float = 0.5):
+        self.store = store
+        self.base_dir = base_dir
+        self.recorder = recorder or EventRecorder()
+        self.poll_interval = poll_interval
+
+    def key_for(self, ev: WatchEvent) -> Optional[str]:
+        obj = ev.object
+        if obj.kind == "Trial":
+            return f"{obj.metadata.namespace}/{obj.metadata.name}"
+        if obj.kind == "JAXJob":
+            trial = obj.metadata.labels.get(LABEL_TRIAL)
+            if trial:
+                return f"{obj.metadata.namespace}/{trial}"
+        return None
+
+    def reconcile(self, key: str) -> Optional[ReconcileResult]:
+        namespace, name = key.split("/", 1)
+        trial = self.store.try_get(Trial, name, namespace)
+        if trial is None:
+            # Trial deleted: reap its worker job.
+            try:
+                self.store.delete(JAXJob, self._job_name(name), namespace)
+            except NotFoundError:
+                pass
+            return None
+        if trial.status.has_condition("Succeeded") or trial.status.has_condition("Failed"):
+            return None
+        job = self.store.try_get(JAXJob, self._job_name(name), namespace)
+        if job is None:
+            job = self._create_job(trial)
+            trial.status.set_condition("Running", True, reason="JobCreated")
+            self._update_status(trial)
+            return ReconcileResult(requeue_after=self.poll_interval)
+        self._collect(trial, job)
+        if trial.status.pruned:
+            # Experiment controller marked it pruned: stop the worker, keep
+            # the observations (katib early-stopped trials count as completed).
+            try:
+                self.store.delete(JAXJob, job.metadata.name, namespace)
+            except NotFoundError:
+                pass
+            self._finalize(trial, succeeded=True, reason="EarlyStopped")
+            return None
+        if job.status.has_condition("Succeeded"):
+            self._finalize(trial, succeeded=True, reason="JobSucceeded")
+            return None
+        if job.status.has_condition("Failed"):
+            self._finalize(trial, succeeded=False, reason="JobFailed")
+            return None
+        self._update_status(trial)
+        return ReconcileResult(requeue_after=self.poll_interval)
+
+    # -- helpers ---------------------------------------------------------------
+
+    @staticmethod
+    def _job_name(trial_name: str) -> str:
+        return trial_name
+
+    def _create_job(self, trial: Trial) -> JAXJob:
+        manifest = dict(trial.spec.worker_manifest)
+        job = JAXJob.from_manifest(manifest)
+        job.metadata.name = self._job_name(trial.metadata.name)
+        job.metadata.namespace = trial.metadata.namespace
+        job.metadata.labels.setdefault(LABEL_TRIAL, trial.metadata.name)
+        job.metadata.labels.setdefault(LABEL_EXPERIMENT, trial.spec.experiment)
+        job.metadata.owner = trial.key
+        try:
+            created = self.store.create(job)
+            self.recorder.normal(trial, "CreatedJob",
+                                 f"created worker job {job.metadata.name}")
+            return created
+        except AlreadyExistsError:
+            return self.store.get(JAXJob, job.metadata.name, job.metadata.namespace)
+
+    def _job_dir(self, job: JAXJob) -> Optional[str]:
+        if self.base_dir is None:
+            return None
+        import os
+
+        return os.path.join(self.base_dir, job.metadata.namespace,
+                            job.metadata.name)
+
+    def _collect(self, trial: Trial, job: JAXJob) -> None:
+        obj = trial.spec.objective
+        names = {obj.metric_name, *obj.additional_metric_names}
+        # Source per template; default comes from the experiment's template,
+        # carried on the trial via the worker manifest creation path.
+        source = trial.metadata.labels.get("tune.tpu.kubeflow.dev/metric-source",
+                                           "file")
+        series = metrics_mod.collect(
+            source, job=job, job_dir=self._job_dir(job), metric_names=names,
+            metrics_file=trial.metadata.labels.get(
+                "tune.tpu.kubeflow.dev/metrics-file"))
+        for name, pts in series.items():
+            trial.status.observations[name] = pts
+
+    def _finalize(self, trial: Trial, *, succeeded: bool, reason: str) -> None:
+        obj = trial.spec.objective
+        pts = trial.status.observations.get(obj.metric_name) or []
+        if pts:
+            values = [v for _, v in pts]
+            best = (min(values) if obj.type is ObjectiveType.MINIMIZE
+                    else max(values))
+            trial.status.final_objective = best
+        if succeeded and not pts and not trial.status.pruned:
+            # A "succeeded" trial that never reported the objective is a
+            # failed observation (katib: metrics unavailable → trial failed).
+            succeeded = False
+            reason = "MetricsUnavailable"
+        trial.status.set_condition("Running", False, reason=reason)
+        trial.status.set_condition("Succeeded" if succeeded else "Failed", True,
+                                   reason=reason)
+        self.recorder.normal(trial, reason,
+                             f"objective={trial.status.final_objective}")
+        self._update_status(trial)
+
+    def _update_status(self, trial: Trial) -> None:
+        try:
+            self.store.update_status(trial)
+        except NotFoundError:
+            pass

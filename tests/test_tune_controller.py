@@ -1,0 +1,262 @@
+"""Experiment/Trial controller semantics, envtest-style (no processes):
+tests drive trial-job worker phases by hand, like the reference's katib
+controller tests against envtest (SURVEY.md §4.2, §3.3)."""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.core.jobs import JAXJob, Worker, WorkerPhase
+from kubeflow_tpu.core.tuning import Experiment, Suggestion, Trial
+from kubeflow_tpu.operator.control_plane import ControlPlane, ControlPlaneConfig
+from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+from kubeflow_tpu.tune.client import build_experiment, parameter
+from kubeflow_tpu.tune.experiment_controller import substitute_parameters
+from kubeflow_tpu.tune.trial_controller import LABEL_EXPERIMENT
+
+
+@pytest.fixture()
+def cp(tmp_path):
+    plane = ControlPlane(ControlPlaneConfig(
+        base_dir=str(tmp_path),
+        cluster=Cluster(slices=[SliceTopology(name="s0", generation="v5e",
+                                              dims=(2, 2))]),
+        launch_processes=False,
+        metrics_sync_interval=None,
+    ))
+    yield plane
+
+
+def experiment_of(**kw) -> Experiment:
+    defaults = dict(
+        entrypoint="objective_probe",
+        parameters=[parameter("x", min=-1.0, max=1.0),
+                    parameter("y", min=-1.0, max=1.0)],
+        objective_metric="objective",
+        algorithm="random",
+        algorithm_settings={"random_state": 0},
+        max_trial_count=4,
+        parallel_trial_count=2,
+    )
+    defaults.update(kw)
+    return build_experiment("hpo", **defaults)
+
+
+def quad(params):
+    return (params["x"] - 0.3) ** 2 + (params["y"] + 0.2) ** 2
+
+
+def write_metrics(cp, job_name, series, namespace="default"):
+    """Put a metrics.jsonl where the trial's file collector looks."""
+    workdir = os.path.join(cp.config.base_dir, namespace, job_name, "worker-0")
+    os.makedirs(workdir, exist_ok=True)
+    with open(os.path.join(workdir, "metrics.jsonl"), "w") as f:
+        for step, value in series:
+            f.write(json.dumps({"step": step, "objective": value}) + "\n")
+
+
+def drive_trials(cp, value_fn=quad, *, fail=False, limit=None):
+    """Complete every unfinished trial job: write its metrics, drive workers.
+
+    Returns how many jobs were driven."""
+    n = 0
+    for trial in cp.store.list(Trial):
+        if trial.status.has_condition("Succeeded") or trial.status.has_condition("Failed"):
+            continue
+        job = cp.store.try_get(JAXJob, trial.metadata.name)
+        if job is None:
+            continue
+        workers = cp.store.list(Worker, label_selector={
+            "training.tpu.kubeflow.dev/job-name": job.metadata.name})
+        if not workers:
+            continue
+        if not fail:
+            v = value_fn(trial.spec.parameter_assignments)
+            write_metrics(cp, job.metadata.name,
+                          [(0, v + 0.2), (1, v + 0.1), (2, v)])
+        for w in workers:
+            w = cp.store.get(Worker, w.metadata.name, w.metadata.namespace)
+            w.status.phase = WorkerPhase.FAILED if fail else WorkerPhase.SUCCEEDED
+            w.status.exit_code = 1 if fail else 0
+            cp.store.update_status(w)
+        n += 1
+        if limit and n >= limit:
+            break
+    return n
+
+
+def pump(cp, rounds=30, **drive_kw):
+    """step → drive → step until the experiment finishes or rounds out."""
+    for _ in range(rounds):
+        cp.step()
+        exp = cp.store.try_get(Experiment, "hpo")
+        if exp is None or exp.status.has_condition("Succeeded") \
+                or exp.status.has_condition("Failed"):
+            return exp
+        drive_trials(cp, **drive_kw)
+    return cp.store.try_get(Experiment, "hpo")
+
+
+class TestExperimentLifecycle:
+    def test_random_completes_with_optimal(self, cp):
+        cp.submit(experiment_of())
+        exp = pump(cp)
+        assert exp.status.has_condition("Succeeded")
+        assert exp.status.trials_succeeded == 4
+        opt = exp.status.current_optimal_trial
+        assert opt.trial_name is not None
+        assert opt.objective_value == pytest.approx(
+            quad(opt.parameter_assignments))
+        # optimal really is the min over all trials
+        finals = [t.status.final_objective for t in cp.store.list(Trial)
+                  if t.status.final_objective is not None]
+        assert opt.objective_value == pytest.approx(min(finals))
+
+    def test_parallelism_respected(self, cp):
+        cp.submit(experiment_of(max_trial_count=6, parallel_trial_count=2))
+        cp.step()
+        jobs = cp.store.list(JAXJob)
+        assert len(jobs) == 2  # never more than parallel_trial_count at once
+
+    def test_goal_finishes_early(self, cp):
+        # Any trial beats a goal of 10 → finish after the first wave.
+        cp.submit(experiment_of(goal=10.0, max_trial_count=12))
+        exp = pump(cp)
+        assert exp.status.has_condition("Succeeded")
+        assert exp.status.trials < 12
+        running = [t for t in cp.store.list(Trial)
+                   if not (t.status.has_condition("Succeeded")
+                           or t.status.has_condition("Failed"))]
+        assert running == []  # stragglers reaped on completion
+
+    def test_failures_fail_experiment(self, cp):
+        exp = experiment_of(max_trial_count=4, parallel_trial_count=1,
+                            max_failed_trial_count=0)
+        # Make worker failures terminal (no retries) for determinism.
+        worker = exp.spec.trial_template.manifest["spec"]["replica_specs"]["worker"]
+        worker["restart_policy"] = "Never"
+        cp.submit(exp)
+        exp = pump(cp, fail=True)
+        assert exp.status.has_condition("Failed")
+        assert exp.status.trials_failed >= 1
+
+    def test_suggestion_holds_state_and_assignments(self, cp):
+        cp.submit(experiment_of())
+        pump(cp)
+        sugg = cp.store.get(Suggestion, "hpo")
+        assert sugg.spec.requests == 4
+        assert len(sugg.status.assignments) == 4
+        json.dumps(sugg.status.algorithm_state)
+
+    def test_trials_labeled_and_owned(self, cp):
+        cp.submit(experiment_of())
+        cp.step()
+        trials = cp.store.list(Trial, label_selector={LABEL_EXPERIMENT: "hpo"})
+        assert trials and all(t.metadata.owner == "Experiment/default/hpo"
+                              or "hpo" in t.metadata.owner for t in trials)
+
+
+class TestMaximize:
+    def test_maximize_objective(self, cp):
+        cp.submit(experiment_of(objective_type="maximize"))
+        exp = pump(cp, value_fn=lambda p: -quad(p))
+        assert exp.status.has_condition("Succeeded")
+        finals = [t.status.final_objective for t in cp.store.list(Trial)
+                  if t.status.final_objective is not None]
+        assert exp.status.current_optimal_trial.objective_value == pytest.approx(
+            max(finals))
+
+
+class TestEarlyStopping:
+    def test_medianstop_prunes(self, cp):
+        exp = experiment_of(max_trial_count=6, parallel_trial_count=1,
+                            early_stopping=True)
+        exp.spec.early_stopping.settings = {"min_trials_required": 3}
+        cp.submit(exp)
+        # Complete 3 good trials.
+        for _ in range(20):
+            cp.step()
+            exp_now = cp.store.get(Experiment, "hpo")
+            if exp_now.status.trials_succeeded >= 3:
+                break
+            drive_trials(cp, value_fn=lambda p: 0.1)
+        # Next trial reports terrible metrics but keeps running.
+        cp.step()
+        bad = [t for t in cp.store.list(Trial)
+               if not (t.status.has_condition("Succeeded")
+                       or t.status.has_condition("Failed"))]
+        assert bad
+        write_metrics(cp, bad[0].metadata.name, [(0, 50.0), (1, 50.0)])
+        for _ in range(10):
+            cp.step()
+            t = cp.store.try_get(Trial, bad[0].metadata.name)
+            if t is not None and t.status.has_condition("Succeeded"):
+                break
+        t = cp.store.get(Trial, bad[0].metadata.name)
+        assert t.status.pruned
+        exp_now = cp.store.get(Experiment, "hpo")
+        assert exp_now.status.trials_pruned >= 1
+        # Pruned trial's job was stopped.
+        assert cp.store.try_get(JAXJob, bad[0].metadata.name) is None
+
+
+class TestCollectors:
+    def test_file_collector_skips_garbage(self, tmp_path):
+        from kubeflow_tpu.tune.metrics import collect_file
+
+        p = tmp_path / "metrics.jsonl"
+        p.write_text(
+            '{"step": 0, "objective": 1.5}\n'
+            'not json\n'
+            '{"step": "warmup", "objective": 2.0}\n'
+            '{"step": 1, "objective": "NaN-ish"}\n'
+            '{"step": 2, "objective": 0.5}\n')
+        out = collect_file(str(p), {"objective"})
+        assert out == {"objective": [(0, 1.5), (2, 0.5)]}
+
+    def test_stdout_collector(self, tmp_path):
+        from kubeflow_tpu.tune.metrics import collect_stdout
+
+        p = tmp_path / "w.log"
+        p.write_text(
+            "epoch done loss=0.9 acc=0.1\n"
+            "noise line\n"
+            "step=5 loss=0.4\n")
+        out = collect_stdout(str(p), {"loss"})
+        assert out == {"loss": [(0, 0.9), (5, 0.4)]}
+
+    def test_explicit_metrics_file_relative(self, cp, tmp_path):
+        from kubeflow_tpu.core.jobs import JAXJob, JAXJobSpec, ReplicaSpec, \
+            WorkloadSpec
+        from kubeflow_tpu.core.object import ObjectMeta
+        from kubeflow_tpu.tune.metrics import collect
+
+        job = JAXJob(metadata=ObjectMeta(name="j"), spec=JAXJobSpec(
+            replica_specs={"worker": ReplicaSpec(
+                template=WorkloadSpec(entrypoint="noop"))}))
+        jdir = os.path.join(cp.config.base_dir, "default", "j")
+        os.makedirs(jdir, exist_ok=True)
+        with open(os.path.join(jdir, "my.jsonl"), "w") as f:
+            f.write(json.dumps({"step": 0, "objective": 3.0}) + "\n")
+        out = collect("file", job=job, job_dir=jdir,
+                      metric_names={"objective"}, metrics_file="my.jsonl")
+        assert out == {"objective": [(0, 3.0)]}
+
+
+class TestSubstitution:
+    def test_typed_exact_and_embedded(self):
+        manifest = {
+            "a": "${trialParameters.lr}",
+            "b": "lr=${trialParameters.lr}!",
+            "c": ["${trialParameters.n}", {"d": "${trialName}"}],
+        }
+        out = substitute_parameters(manifest, {"lr": 0.01, "n": 4}, "t-0")
+        assert out["a"] == 0.01          # typed, not stringified
+        assert out["b"] == "lr=0.01!"
+        assert out["c"][0] == 4
+        assert out["c"][1]["d"] == "t-0"
+
+    def test_no_placeholder_untouched(self):
+        src = {"x": 1, "y": "plain"}
+        assert substitute_parameters(src, {"lr": 1}, "t") == src

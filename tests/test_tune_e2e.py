@@ -1,0 +1,47 @@
+"""HPO e2e with real worker processes: a random-search experiment whose
+trials run the ``objective_probe`` entrypoint as real JAXJob workers —
+the katib kind-based e2e analog (SURVEY.md §4.5, §3.3 full stack)."""
+
+import pytest
+
+from kubeflow_tpu.core.tuning import Experiment
+from kubeflow_tpu.operator.control_plane import ControlPlane, ControlPlaneConfig
+from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+from kubeflow_tpu.tune.client import build_experiment, parameter
+
+
+@pytest.fixture()
+def cp(tmp_path):
+    plane = ControlPlane(ControlPlaneConfig(
+        base_dir=str(tmp_path),
+        cluster=Cluster(slices=[SliceTopology(name="s0", generation="cpu",
+                                              dims=(2, 2))]),
+        platform="cpu",
+    ))
+    plane.start()
+    yield plane
+    plane.stop()
+
+
+def test_experiment_end_to_end(cp):
+    exp = build_experiment(
+        "hpo-e2e",
+        entrypoint="objective_probe",
+        parameters=[parameter("x", min=-1.0, max=1.0),
+                    parameter("y", min=-1.0, max=1.0)],
+        objective_metric="objective",
+        algorithm="random",
+        algorithm_settings={"random_state": 0},
+        max_trial_count=3,
+        parallel_trial_count=3,
+        base_config={"steps": 3},
+    )
+    cp.submit(exp)
+    done = cp.wait_for(exp, "Succeeded", timeout=120)
+    assert done.status.trials_succeeded == 3
+    opt = done.status.current_optimal_trial
+    assert opt.trial_name and opt.objective_value is not None
+    # The probe's final objective is exactly the quadratic at the assignment.
+    x, y = opt.parameter_assignments["x"], opt.parameter_assignments["y"]
+    assert opt.objective_value == pytest.approx(
+        (x - 0.3) ** 2 + (y + 0.2) ** 2, abs=1e-6)
