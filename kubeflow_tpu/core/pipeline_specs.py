@@ -39,12 +39,16 @@ class TaskIR(BaseModel):
 
     name: str
     component: str                        # ComponentIR name
-    # input name -> {"constant": v} | {"task_output": "task.output"} | {"param": "p"}
+    # input name -> {"constant": v} | {"task_output": "task.output"}
+    #             | {"param": "p"} | {"loop_item": "<loop_id>"[, "subpath": k]}
     arguments: dict[str, dict[str, Any]] = Field(default_factory=dict)
     depends_on: list[str] = Field(default_factory=list)
     # control flow (≈ dsl.Condition / ParallelFor)
-    condition: Optional[str] = None       # task runs iff expr over params/outputs is truthy
-    iterate_over: Optional[dict[str, Any]] = None  # {"input": name, "items": ... | {"param": p}}
+    # {"all": [{"op": "<", "lhs": <ref>, "rhs": <ref>}, ...]} — AND of
+    # comparisons; refs use the same shapes as arguments.
+    condition: Optional[dict[str, Any]] = None
+    # {"loop_id": id, "items": <ref>} — task instantiated per item at run time
+    iterate_over: Optional[dict[str, Any]] = None
     exit_handler: bool = False
 
 

@@ -73,11 +73,22 @@ class ControlPlane:
             self.store, recorder=self.recorder)
         self.trial_reconciler = TrialController(
             self.store, base_dir=self.config.base_dir, recorder=self.recorder)
+        from kubeflow_tpu.pipelines.controller import (
+            PipelineRunController, ScheduledRunController,
+        )
+
+        self.pipelinerun_reconciler = PipelineRunController(
+            self.store, base_dir=os.path.join(self.config.base_dir, "pipelines"),
+            recorder=self.recorder)
+        self.schedule_reconciler = ScheduledRunController(
+            self.store, recorder=self.recorder)
         self.controllers: list[Controller] = [
             Controller(self.store, self.jaxjob_reconciler, name="jaxjob"),
             Controller(self.store, self.isvc_reconciler, name="isvc"),
             Controller(self.store, self.experiment_reconciler, name="experiment"),
             Controller(self.store, self.trial_reconciler, name="trial"),
+            Controller(self.store, self.pipelinerun_reconciler, name="pipelinerun"),
+            Controller(self.store, self.schedule_reconciler, name="schedule"),
         ]
         self.runtime: Optional[WorkerRuntime] = None
         if self.config.launch_processes:
@@ -126,6 +137,7 @@ class ControlPlane:
         if self.runtime is not None:
             self.runtime.shutdown()
         self.isvc_reconciler.shutdown()
+        self.pipelinerun_reconciler.shutdown()
 
     def step(self) -> int:
         """Deterministic single-threaded pump (test mode)."""

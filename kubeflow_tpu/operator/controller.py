@@ -175,13 +175,15 @@ class Controller:
     # -- test-mode stepping ----------------------------------------------------
 
     def step(self, *, advance_past_delays: bool = False, max_iterations: int = 100,
-             max_delay_advances: int = 3) -> int:
+             max_delay_advances: int = 3, max_advance_delay: float = 2.0) -> int:
         """Pump events and reconcile until quiescent. Returns reconcile count.
 
         With ``advance_past_delays``, sleeps through the nearest pending
         requeue delay (tests use small delays) instead of returning early —
         at most ``max_delay_advances`` times, so a periodic resync requeue
-        cannot make a single step() call spin forever.
+        cannot make a single step() call spin forever. Delays longer than
+        ``max_advance_delay`` (TTL reaps, schedule intervals) are never slept
+        through — a deterministic step must not block for minutes.
         """
         total = 0
         advances = 0
@@ -190,7 +192,7 @@ class Controller:
             keys = self.queue.drain_ready()
             if not keys and advance_past_delays and advances < max_delay_advances:
                 due = self.queue.next_due()
-                if due is not None:
+                if due is not None and due - time.monotonic() <= max_advance_delay:
                     time.sleep(max(0.0, due - time.monotonic()) + 0.001)
                     advances += 1
                     keys = self.queue.drain_ready()

@@ -1,0 +1,13 @@
+"""Pipelines subsystem — the Kubeflow Pipelines analog (SURVEY.md §2.5,
+build phase 7): Python DSL → IR compiler → in-process DAG executor with
+driver/launcher semantics (input resolution, cache-key skip, artifact store)
+over the C++ metadata store (lineage).
+"""
+
+from kubeflow_tpu.pipelines.dsl import component, pipeline
+from kubeflow_tpu.pipelines.compiler import compile_pipeline
+from kubeflow_tpu.pipelines.executor import PipelineExecutor
+from kubeflow_tpu.pipelines.metadata import MetadataStore
+
+__all__ = ["component", "pipeline", "compile_pipeline", "PipelineExecutor",
+           "MetadataStore"]

@@ -1,0 +1,74 @@
+"""Content-addressed artifact store — the MinIO/object-store analog.
+
+KFP stores component outputs in an object store keyed by run/node paths
+((U) kubeflow/pipelines backend launcher artifact upload; SURVEY.md §2.5#44).
+Here artifacts are content-addressed (sha256) on the local filesystem, which
+gives cache reuse integrity for free: equal content = equal uri.
+
+Values are stored as a 1-byte codec tag + payload: JSON for plain data
+(readable, cross-version) and pickle for arbitrary Python objects.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+from typing import Any
+
+SCHEME = "cas://"
+
+
+class ArtifactStore:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.root, digest[:2], digest[2:])
+
+    def put_bytes(self, data: bytes) -> str:
+        digest = hashlib.sha256(data).hexdigest()
+        path = self._path(digest)
+        if not os.path.exists(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            # Atomic publish: same-content races converge on the same digest.
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        return SCHEME + digest
+
+    def get_bytes(self, uri: str) -> bytes:
+        with open(self.path_for(uri), "rb") as f:
+            return f.read()
+
+    def path_for(self, uri: str) -> str:
+        if not uri.startswith(SCHEME):
+            raise ValueError(f"not a cas uri: {uri!r}")
+        return self._path(uri[len(SCHEME):])
+
+    def exists(self, uri: str) -> bool:
+        try:
+            return os.path.exists(self.path_for(uri))
+        except ValueError:
+            return False
+
+    # -- typed values ----------------------------------------------------------
+
+    def put_value(self, value: Any) -> str:
+        try:
+            payload = b"J" + json.dumps(value, sort_keys=True).encode()
+        except (TypeError, ValueError):
+            payload = b"P" + pickle.dumps(value)
+        return self.put_bytes(payload)
+
+    def get_value(self, uri: str) -> Any:
+        data = self.get_bytes(uri)
+        if data[:1] == b"J":
+            return json.loads(data[1:])
+        if data[:1] == b"P":
+            return pickle.loads(data[1:])
+        raise ValueError(f"unknown artifact codec {data[:1]!r}")

@@ -1,0 +1,92 @@
+"""DSL → IR compiler (≈ KFP ``Compiler().compile()`` producing PipelineSpec
+YAML; (U) kubeflow/pipelines sdk/python/kfp/compiler/compiler.py; SURVEY.md
+§2.5#37). The IR is the typed ``PipelineIR`` from core.pipeline_specs —
+deterministic, YAML-dumpable, golden-file testable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import yaml
+
+from kubeflow_tpu.core.pipeline_specs import (
+    ComponentIR, Pipeline, PipelineIR, PipelineSpecModel, TaskIR,
+)
+from kubeflow_tpu.core.object import ObjectMeta
+from kubeflow_tpu.pipelines.dsl import PipelineDef
+
+
+def compile_pipeline(pdef: PipelineDef) -> PipelineIR:
+    """Trace the pipeline function and build the IR, validating the DAG."""
+    trace = pdef.trace()
+    components = {
+        name: ComponentIR(**spec) for name, spec in trace.components.items()}
+    tasks = {name: TaskIR(**spec) for name, spec in trace.tasks.items()}
+    ir = PipelineIR(
+        name=pdef.name,
+        description=pdef.description,
+        parameters=dict(pdef.parameters),
+        components=components,
+        tasks=tasks,
+    )
+    _validate(ir)
+    return ir
+
+
+def _validate(ir: PipelineIR) -> None:
+    for t in ir.tasks.values():
+        if t.component not in ir.components:
+            raise ValueError(f"task {t.name}: unknown component {t.component}")
+        for dep in t.depends_on:
+            if dep not in ir.tasks:
+                raise ValueError(f"task {t.name}: unknown dependency {dep}")
+        for arg, ref in t.arguments.items():
+            if "task_output" in ref:
+                src_task, _, src_out = ref["task_output"].partition(".")
+                if src_task not in ir.tasks:
+                    raise ValueError(
+                        f"task {t.name}.{arg}: unknown source task {src_task}")
+                src_comp = ir.components[ir.tasks[src_task].component]
+                if src_out not in src_comp.outputs:
+                    raise ValueError(
+                        f"task {t.name}.{arg}: {src_task} has no output "
+                        f"{src_out!r} (has {sorted(src_comp.outputs)})")
+            elif "param" in ref and ref["param"] not in ir.parameters:
+                raise ValueError(
+                    f"task {t.name}.{arg}: unknown parameter {ref['param']!r}")
+    topo_order(ir)  # raises on cycles
+
+
+def topo_order(ir: PipelineIR) -> list[str]:
+    """Deterministic topological order (name-sorted within a level)."""
+    remaining = {name: set(t.depends_on) for name, t in ir.tasks.items()}
+    order: list[str] = []
+    while remaining:
+        ready = sorted(n for n, deps in remaining.items() if not deps)
+        if not ready:
+            raise ValueError(f"pipeline {ir.name}: dependency cycle among "
+                             f"{sorted(remaining)}")
+        for n in ready:
+            del remaining[n]
+            order.append(n)
+        for deps in remaining.values():
+            deps.difference_update(ready)
+    return order
+
+
+def to_yaml(ir: PipelineIR) -> str:
+    return yaml.safe_dump(ir.model_dump(exclude_none=True), sort_keys=True)
+
+
+def from_yaml(text: str) -> PipelineIR:
+    return PipelineIR.model_validate(yaml.safe_load(text))
+
+
+def as_pipeline_object(pdef: PipelineDef, *, namespace: str = "default",
+                       name: Optional[str] = None) -> Pipeline:
+    """Wrap compiled IR in the stored Pipeline API object."""
+    ir = compile_pipeline(pdef)
+    return Pipeline(
+        metadata=ObjectMeta(name=name or ir.name, namespace=namespace),
+        spec=PipelineSpecModel(ir=ir))

@@ -1,0 +1,245 @@
+"""PipelineRun + ScheduledRun reconcilers.
+
+The KFP API-server + ScheduledWorkflow-controller + persistence-agent roles
+((U) kubeflow/pipelines backend/src/apiserver, backend/src/crd/controller/
+scheduledworkflow; SURVEY.md §2.5#38-39) collapse onto the platform's
+reconcile engine: a PipelineRun executes the DAG in-process (executor.py)
+and its status is the persistence surface; a ScheduledRun creates
+PipelineRuns on an interval or cron-lite schedule.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import threading
+from typing import Any, Optional
+
+from kubeflow_tpu.core.events import EventRecorder
+from kubeflow_tpu.core.object import ObjectMeta, utcnow
+from kubeflow_tpu.core.pipeline_specs import (
+    Pipeline, PipelineIR, PipelineRun, PipelineRunSpec, RunPhase, ScheduledRun,
+)
+from kubeflow_tpu.core.store import (
+    AlreadyExistsError, NotFoundError, ObjectStore, WatchEvent,
+)
+from kubeflow_tpu.operator.controller import ReconcileResult
+from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+from kubeflow_tpu.pipelines.executor import PipelineExecutor
+from kubeflow_tpu.pipelines.metadata import MetadataStore
+
+logger = logging.getLogger("kubeflow_tpu.pipelines")
+
+LABEL_SCHEDULE = "pipelines.tpu.kubeflow.dev/schedule"
+
+
+class PipelineRunController:
+    kinds = ["PipelineRun"]
+
+    def __init__(self, store: ObjectStore, *, base_dir: str,
+                 recorder: Optional[EventRecorder] = None,
+                 components: Optional[dict] = None,
+                 metadata_backend: str = "auto"):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self.artifacts = ArtifactStore(os.path.join(base_dir, "artifacts"))
+        self.metadata = MetadataStore(os.path.join(base_dir, "metadata.db"),
+                                      backend=metadata_backend)
+        self.components = components or {}
+        # One DAG at a time per controller: executions can be long and the
+        # reconcile engine never runs one key concurrently with itself, but
+        # different runs on the worker thread serialize here too (the
+        # metadata handle is shared).
+        self._exec_lock = threading.Lock()
+
+    def key_for(self, ev: WatchEvent) -> Optional[str]:
+        obj = ev.object
+        if obj.kind == "PipelineRun":
+            return f"{obj.metadata.namespace}/{obj.metadata.name}"
+        return None
+
+    def reconcile(self, key: str) -> Optional[ReconcileResult]:
+        namespace, name = key.split("/", 1)
+        run = self.store.try_get(PipelineRun, name, namespace)
+        if run is None:
+            return None
+        if run.status.phase in (RunPhase.SUCCEEDED, RunPhase.FAILED):
+            return None
+
+        ir = self._resolve_ir(run)
+        if ir is None:
+            run.status.phase = RunPhase.FAILED
+            run.status.set_condition(
+                "Failed", True, reason="PipelineNotFound",
+                message=f"pipeline {run.spec.pipeline!r} not found")
+            self._update_status(run)
+            return None
+
+        run.status.phase = RunPhase.RUNNING
+        run.status.set_condition("Running", True, reason="Executing")
+        self._update_status(run)
+
+        executor = PipelineExecutor(self.artifacts, self.metadata,
+                                    components=self.components)
+        try:
+            with self._exec_lock:
+                result = executor.run(
+                    ir, run.spec.parameters,
+                    run_name=f"{namespace}/{name}",
+                    cache_enabled=run.spec.cache_enabled)
+        except Exception as exc:
+            logger.exception("pipeline run %s failed to execute", key)
+            run = self.store.try_get(PipelineRun, name, namespace) or run
+            run.status.phase = RunPhase.FAILED
+            run.status.set_condition("Running", False, reason="Error")
+            run.status.set_condition("Failed", True, reason="ExecutorError",
+                                     message=str(exc))
+            self._update_status(run)
+            return None
+
+        run = self.store.try_get(PipelineRun, name, namespace) or run
+        run.status.phase = result.phase
+        run.status.tasks = result.tasks
+        run.status.outputs = result.outputs
+        run.status.set_condition("Running", False, reason="Finished")
+        ok = result.phase is RunPhase.SUCCEEDED
+        run.status.set_condition("Succeeded" if ok else "Failed", True,
+                                 reason="Completed" if ok else "TaskFailed")
+        self.recorder.normal(
+            run, "Completed" if ok else "Failed",
+            f"{sum(1 for t in result.tasks.values() if t.cached)} cached, "
+            f"{len(result.tasks)} tasks")
+        self._update_status(run)
+        return None
+
+    def _resolve_ir(self, run: PipelineRun) -> Optional[PipelineIR]:
+        if run.spec.ir is not None:
+            return run.spec.ir
+        p = self.store.try_get(Pipeline, run.spec.pipeline,
+                               run.metadata.namespace)
+        return None if p is None else p.spec.ir
+
+    def _update_status(self, run: PipelineRun) -> None:
+        try:
+            self.store.update_status(run)
+        except NotFoundError:
+            pass
+
+    def shutdown(self) -> None:
+        self.metadata.close()
+
+
+def _cron_field_match(field: str, value: int) -> bool:
+    if field == "*":
+        return True
+    for part in field.split(","):
+        if part.startswith("*/"):
+            if value % int(part[2:]) == 0:
+                return True
+        elif "-" in part:
+            lo, hi = part.split("-", 1)
+            if int(lo) <= value <= int(hi):
+                return True
+        elif part and int(part) == value:
+            return True
+    return False
+
+
+def cron_matches(expr: str, t: datetime.datetime) -> bool:
+    """m h dom mon dow (UTC), supporting * */n a-b and comma lists."""
+    fields = expr.split()
+    if len(fields) != 5:
+        raise ValueError(f"bad cron expr {expr!r}")
+    m, h, dom, mon, dow = fields
+    return (_cron_field_match(m, t.minute)
+            and _cron_field_match(h, t.hour)
+            and _cron_field_match(dom, t.day)
+            and _cron_field_match(mon, t.month)
+            and _cron_field_match(dow, t.weekday()))
+
+
+class ScheduledRunController:
+    kinds = ["ScheduledRun", "PipelineRun"]
+
+    def __init__(self, store: ObjectStore, *,
+                 recorder: Optional[EventRecorder] = None,
+                 now_fn=None):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.now_fn = now_fn or utcnow
+
+    def key_for(self, ev: WatchEvent) -> Optional[str]:
+        obj = ev.object
+        if obj.kind == "ScheduledRun":
+            return f"{obj.metadata.namespace}/{obj.metadata.name}"
+        if obj.kind == "PipelineRun":
+            sched = obj.metadata.labels.get(LABEL_SCHEDULE)
+            if sched:
+                return f"{obj.metadata.namespace}/{sched}"
+        return None
+
+    def reconcile(self, key: str) -> Optional[ReconcileResult]:
+        namespace, name = key.split("/", 1)
+        sr = self.store.try_get(ScheduledRun, name, namespace)
+        if sr is None:
+            return None
+        if not sr.spec.enabled:
+            return None
+        now = self.now_fn()
+        due, next_poll = self._due(sr, now)
+        if due and self._active_runs(sr) < sr.spec.max_concurrency:
+            self._trigger(sr, now)
+        return ReconcileResult(requeue_after=next_poll)
+
+    def _due(self, sr: ScheduledRun, now: datetime.datetime
+             ) -> tuple[bool, float]:
+        last = sr.status.last_triggered
+        if isinstance(last, str):
+            last = datetime.datetime.fromisoformat(last)
+        if sr.spec.interval_seconds is not None:
+            iv = sr.spec.interval_seconds
+            if last is None:
+                return True, iv
+            elapsed = (now - last).total_seconds()
+            if elapsed >= iv:
+                return True, iv
+            return False, max(0.05, iv - elapsed)
+        # cron-lite: fire at most once per matching minute.
+        if cron_matches(sr.spec.cron, now):
+            if last is None or last.replace(second=0, microsecond=0) \
+                    != now.replace(second=0, microsecond=0):
+                return True, 30.0
+        return False, 30.0
+
+    def _active_runs(self, sr: ScheduledRun) -> int:
+        runs = self.store.list(
+            PipelineRun, namespace=sr.metadata.namespace,
+            label_selector={LABEL_SCHEDULE: sr.metadata.name})
+        return sum(1 for r in runs
+                   if r.status.phase in (RunPhase.PENDING, RunPhase.RUNNING))
+
+    def _trigger(self, sr: ScheduledRun, now: datetime.datetime) -> None:
+        idx = sr.status.runs_started
+        run = PipelineRun(
+            metadata=ObjectMeta(
+                name=f"{sr.metadata.name}-{idx:05d}",
+                namespace=sr.metadata.namespace,
+                owner=sr.key,
+                labels={LABEL_SCHEDULE: sr.metadata.name}),
+            spec=PipelineRunSpec(pipeline=sr.spec.pipeline,
+                                 parameters=dict(sr.spec.parameters)))
+        try:
+            self.store.create(run)
+        except AlreadyExistsError:
+            pass
+        sr.status.runs_started = idx + 1
+        sr.status.last_triggered = now.isoformat()
+        sr.status.set_condition("Active", True, reason="Triggered")
+        self.recorder.normal(sr, "Triggered", run.metadata.name)
+        try:
+            self.store.update_status(sr)
+        except NotFoundError:
+            pass

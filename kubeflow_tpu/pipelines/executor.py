@@ -1,0 +1,472 @@
+"""In-process DAG executor — KFP's driver + launcher collapsed into one.
+
+Per node, KFP runs a *driver* pod (resolve inputs from MLMD, compute cache
+key, decide skip-vs-run) and a *launcher* wrapper (download inputs, exec,
+upload outputs, write lineage) ((U) kubeflow/pipelines backend/src/v2/
+{driver,component}; SURVEY.md §2.5#40, §3.4). Here both run in-process per
+task: resolve → cache-check (metadata store) → call the component → store
+outputs (CAS) → record Execution/Artifact/Event lineage.
+
+Control flow: conditions evaluate at readiness; ParallelFor groups expand
+dynamically once their external deps finish (items may be upstream outputs);
+exit-handler tasks run last regardless of failure. Failed/skipped tasks skip
+their dependents, like Argo's DAG semantics under KFP.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from kubeflow_tpu.core.pipeline_specs import (
+    PipelineIR, RunPhase, TaskExecutionStatus, TaskIR,
+)
+from kubeflow_tpu.pipelines import metadata as md
+from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+from kubeflow_tpu.pipelines.dsl import Component
+from kubeflow_tpu.pipelines.metadata import MetadataStore
+
+logger = logging.getLogger("kubeflow_tpu.pipelines")
+
+_OPS: dict[str, Callable[[Any, Any], bool]] = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+@dataclass
+class RunResult:
+    phase: RunPhase
+    tasks: dict[str, TaskExecutionStatus]
+    outputs: dict[str, Any] = field(default_factory=dict)
+    context_id: Optional[int] = None
+
+
+@dataclass
+class _Concrete:
+    """A runnable task instance (loop members become one per item)."""
+
+    name: str
+    ir: TaskIR
+    arguments: dict[str, dict[str, Any]]
+    depends_on: list[str]
+
+
+class PipelineExecutor:
+    def __init__(self, artifacts: ArtifactStore, metadata: MetadataStore, *,
+                 components: Optional[dict[str, Callable]] = None):
+        self.artifacts = artifacts
+        self.metadata = metadata
+        self.components = components or {}
+
+    # -- public ----------------------------------------------------------------
+
+    def run(self, ir: PipelineIR, parameters: Optional[dict[str, Any]] = None,
+            *, run_name: str = "run", cache_enabled: bool = True) -> RunResult:
+        params = dict(ir.parameters)
+        params.update(parameters or {})
+        missing = [k for k, v in params.items() if v is None]
+        if missing:
+            raise ValueError(f"pipeline {ir.name}: parameters {missing} "
+                             "have no default and no value")
+
+        ctx = self.metadata.create_context(
+            "pipeline_run", f"{ir.name}/{run_name}",
+            properties={"pipeline": ir.name,
+                        "parameters": json.dumps(params, sort_keys=True,
+                                                 default=str)})
+
+        state = _RunState(ir, params, cache_enabled and True)
+        # Seed: non-loop tasks are concrete as-is; loop members wait for
+        # group expansion.
+        for name, t in ir.tasks.items():
+            if t.iterate_over is None:
+                state.concrete[name] = _Concrete(
+                    name=name, ir=t, arguments=dict(t.arguments),
+                    depends_on=list(t.depends_on))
+
+        # Main scheduling loop: run ready non-exit tasks; expand ready loops.
+        progress = True
+        while progress:
+            progress = False
+            for loop_id, members in state.loops.items():
+                if loop_id not in state.expanded and self._loop_ready(state, loop_id):
+                    self._expand_loop(state, loop_id, members)
+                    progress = True
+            for c in list(state.concrete.values()):
+                if c.name in state.status or c.ir.exit_handler:
+                    continue
+                verdict = self._readiness(state, c)
+                if verdict == "ready":
+                    self._execute(state, c, ctx)
+                    progress = True
+                elif verdict == "skip":
+                    state.status[c.name] = TaskExecutionStatus(
+                        phase=RunPhase.SUCCEEDED, skipped=True)
+                    progress = True
+
+        # Anything still unscheduled (deps failed/skipped or loop never
+        # expanded) is skipped.
+        for c in state.concrete.values():
+            if c.name not in state.status and not c.ir.exit_handler:
+                state.status[c.name] = TaskExecutionStatus(
+                    phase=RunPhase.SUCCEEDED, skipped=True)
+
+        # Exit handlers always run, after everything else.
+        for c in state.concrete.values():
+            if c.ir.exit_handler and c.name not in state.status:
+                self._execute(state, c, ctx, best_effort_inputs=True)
+
+        failed = any(s.phase is RunPhase.FAILED for s in state.status.values())
+        outputs = self._terminal_outputs(state)
+        return RunResult(
+            phase=RunPhase.FAILED if failed else RunPhase.SUCCEEDED,
+            tasks=state.status, outputs=outputs, context_id=ctx)
+
+    # -- scheduling ------------------------------------------------------------
+
+    def _loop_ready(self, state: "_RunState", loop_id: str) -> bool:
+        """A loop expands when every dependency *outside* the loop is done."""
+        members = set(state.loops[loop_id])
+        for m in state.loops[loop_id]:
+            for dep in state.ir.tasks[m].depends_on:
+                if dep in members:
+                    continue
+                if not state.dep_finished(dep):
+                    return False
+                if not state.dep_succeeded(dep):
+                    return False  # upstream failed/skipped: loop never expands
+        return True
+
+    def _expand_loop(self, state: "_RunState", loop_id: str,
+                     members: list[str]) -> None:
+        first = state.ir.tasks[members[0]]
+        try:
+            items = self._resolve_ref(state, first.iterate_over["items"])
+        except _Unresolvable:
+            state.expanded.add(loop_id)  # upstream skipped: zero items
+            items = []
+        if not isinstance(items, (list, tuple)):
+            raise ValueError(
+                f"ParallelFor {loop_id}: items resolved to "
+                f"{type(items).__name__}, need a list")
+        member_set = set(members)
+        for m in members:
+            t = state.ir.tasks[m]
+            instances = []
+            for i, item in enumerate(items):
+                cname = f"{m}#{i}"
+                args = {}
+                for k, ref in t.arguments.items():
+                    args[k] = self._instance_ref(ref, loop_id, item, i,
+                                                 member_set)
+                deps = [f"{d}#{i}" if d in member_set else d
+                        for d in t.depends_on]
+                cond = t.condition
+                if cond is not None:
+                    cond = json.loads(json.dumps(cond))  # deep copy
+                    for comp in cond["all"]:
+                        for side in ("lhs", "rhs"):
+                            comp[side] = self._instance_ref(
+                                comp[side], loop_id, item, i, member_set)
+                cir = t.model_copy(update={"condition": cond})
+                state.concrete[cname] = _Concrete(
+                    name=cname, ir=cir, arguments=args, depends_on=deps)
+                instances.append(cname)
+            state.instances[m] = instances
+        state.expanded.add(loop_id)
+
+    @staticmethod
+    def _instance_ref(ref: dict[str, Any], loop_id: str, item: Any, i: int,
+                      members: set[str]) -> dict[str, Any]:
+        if ref.get("loop_item") == loop_id:
+            v = item
+            if "subpath" in ref:
+                v = v[ref["subpath"]]
+            return {"constant": v}
+        if "task_output" in ref:
+            src, _, out = ref["task_output"].partition(".")
+            if src in members:
+                return {"task_output": f"{src}#{i}.{out}"}
+        return ref
+
+    def _readiness(self, state: "_RunState", c: _Concrete) -> str:
+        """'ready' | 'wait' | 'skip'."""
+        for dep in c.depends_on:
+            if not state.dep_finished(dep):
+                return "wait"
+        for dep in c.depends_on:
+            if not state.dep_succeeded(dep):
+                return "skip"
+        if c.ir.condition is not None:
+            try:
+                for comp in c.ir.condition["all"]:
+                    lhs = self._resolve_ref(state, comp["lhs"])
+                    rhs = self._resolve_ref(state, comp["rhs"])
+                    if not _OPS[comp["op"]](lhs, rhs):
+                        return "skip"
+            except _Unresolvable:
+                return "skip"
+        return "ready"
+
+    # -- execution -------------------------------------------------------------
+
+    def _execute(self, state: "_RunState", c: _Concrete, ctx: int,
+                 *, best_effort_inputs: bool = False) -> None:
+        comp = state.ir.components[c.ir.component]
+        try:
+            inputs = {}
+            for k, ref in c.arguments.items():
+                try:
+                    inputs[k] = self._resolve_ref(state, ref)
+                except _Unresolvable:
+                    if best_effort_inputs:
+                        inputs[k] = None
+                    else:
+                        raise
+        except _Unresolvable as exc:
+            state.status[c.name] = TaskExecutionStatus(
+                phase=RunPhase.SUCCEEDED, skipped=True, error=str(exc))
+            return
+
+        fn = self._resolve_component(comp.name, comp.entrypoint)
+        defaults = dict(getattr(fn, "defaults", {}))
+        call_args = {**defaults, **inputs}
+
+        cache_key = self._cache_key(comp, call_args)
+        if state.cache_enabled and comp.cache_enabled:
+            hit = self._cache_lookup(cache_key)
+            if hit is not None:
+                exec_id, out_values = hit
+                eid = self.metadata.create_execution(
+                    comp.name, state=md.EXEC_CACHED,
+                    properties={"task": c.name, "cache_key": cache_key,
+                                "cached_from": exec_id})
+                self.metadata.add_association(ctx, eid)
+                self._record_io(state, c, eid, ctx, out_values)
+                state.status[c.name] = TaskExecutionStatus(
+                    phase=RunPhase.SUCCEEDED, cached=True, execution_id=eid,
+                    outputs=self._small(out_values))
+                return
+
+        eid = self.metadata.create_execution(
+            comp.name, state=md.EXEC_RUNNING,
+            properties={"task": c.name, "cache_key": cache_key,
+                        "inputs": json.dumps(call_args, sort_keys=True,
+                                             default=str)[:4096]})
+        self.metadata.add_association(ctx, eid)
+        # Input lineage: upstream artifacts feeding this execution.
+        for k, ref in c.arguments.items():
+            art = state.artifact_for_ref(ref)
+            for aid in art:
+                self.metadata.put_event(eid, aid, md.EVENT_INPUT, k)
+
+        callable_fn = fn.fn if isinstance(fn, Component) else fn
+        try:
+            result = callable_fn(**call_args)
+        except Exception as exc:
+            logger.exception("task %s failed", c.name)
+            self.metadata.update_execution(eid, md.EXEC_FAILED)
+            state.status[c.name] = TaskExecutionStatus(
+                phase=RunPhase.FAILED, execution_id=eid,
+                error=f"{type(exc).__name__}: {exc}")
+            return
+
+        out_values = self._split_outputs(comp.outputs, result)
+        self._record_io(state, c, eid, ctx, out_values)
+        self.metadata.update_execution(eid, md.EXEC_COMPLETE)
+        state.status[c.name] = TaskExecutionStatus(
+            phase=RunPhase.SUCCEEDED, execution_id=eid,
+            outputs=self._small(out_values))
+
+    def _record_io(self, state: "_RunState", c: _Concrete, eid: int, ctx: int,
+                   out_values: dict[str, Any]) -> None:
+        comp = state.ir.components[c.ir.component]
+        for out_name, value in out_values.items():
+            uri = self.artifacts.put_value(value)
+            aid = self.metadata.create_artifact(
+                comp.outputs.get(out_name, "Artifact"), uri=uri,
+                state=md.ART_LIVE, properties={"task": c.name, "output": out_name})
+            self.metadata.put_event(eid, aid, md.EVENT_OUTPUT, out_name)
+            self.metadata.add_attribution(ctx, aid)
+            state.outputs[(c.name, out_name)] = (aid, uri, value)
+
+    # -- resolution ------------------------------------------------------------
+
+    def _resolve_component(self, name: str, entrypoint: str) -> Any:
+        if name in self.components:
+            return self.components[name]
+        module, _, qual = entrypoint.partition(":")
+        try:
+            obj: Any = importlib.import_module(module)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            return obj
+        except (ImportError, AttributeError) as exc:
+            raise RuntimeError(
+                f"component {name}: cannot resolve {entrypoint!r}; register "
+                "it via PipelineExecutor(components={...})") from exc
+
+    def _resolve_ref(self, state: "_RunState", ref: dict[str, Any]) -> Any:
+        if "constant" in ref:
+            return ref["constant"]
+        if "param" in ref:
+            return state.params[ref["param"]]
+        if "task_output" in ref:
+            src, _, out = ref["task_output"].partition(".")
+            if src in state.instances:  # fan-in over loop instances
+                vals = []
+                for inst in state.instances[src]:
+                    st = state.status.get(inst)
+                    if st is None or st.skipped or st.phase is not RunPhase.SUCCEEDED:
+                        continue
+                    vals.append(state.outputs[(inst, out)][2])
+                return vals
+            st = state.status.get(src)
+            if st is None or st.skipped or st.phase is not RunPhase.SUCCEEDED:
+                raise _Unresolvable(f"{src}.{out} unavailable")
+            return state.outputs[(src, out)][2]
+        if "loop_item" in ref:
+            raise _Unresolvable("loop_item outside its loop")
+        raise ValueError(f"bad reference {ref!r}")
+
+    # -- caching ---------------------------------------------------------------
+
+    @staticmethod
+    def _cache_key(comp, call_args: dict[str, Any]) -> str:
+        try:
+            args_json = json.dumps(call_args, sort_keys=True)
+        except (TypeError, ValueError):
+            args_json = repr(sorted(call_args.items(), key=lambda kv: kv[0]))
+        blob = json.dumps({
+            "component": comp.name,
+            "entrypoint": comp.entrypoint,
+            "outputs": sorted(comp.outputs),
+            "args": args_json,
+        }, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def _cache_lookup(self, cache_key: str
+                      ) -> Optional[tuple[int, dict[str, Any]]]:
+        for eid in reversed(self.metadata.find_executions_by_property(
+                "cache_key", cache_key)):
+            info = self.metadata.get_execution(eid)
+            if info is None or info["state"] != md.EXEC_COMPLETE:
+                continue
+            outs: dict[str, Any] = {}
+            ok = True
+            for aid, etype, path in self.metadata.events_by_execution(eid):
+                if etype != md.EVENT_OUTPUT:
+                    continue
+                art = self.metadata.get_artifact(aid)
+                if art is None or not self.artifacts.exists(art["uri"]):
+                    ok = False
+                    break
+                outs[path] = self.artifacts.get_value(art["uri"])
+            if ok:
+                return eid, outs
+        return None
+
+    # -- misc ------------------------------------------------------------------
+
+    @staticmethod
+    def _split_outputs(outputs: dict[str, str], result: Any) -> dict[str, Any]:
+        if list(outputs) == ["output"]:
+            return {"output": result}
+        fields = getattr(result, "_fields", None)
+        if fields is not None:
+            return {f: getattr(result, f) for f in fields if f in outputs}
+        if isinstance(result, dict) and set(result) == set(outputs):
+            return dict(result)
+        raise TypeError(
+            f"component declared outputs {sorted(outputs)} but returned "
+            f"{type(result).__name__}; return the NamedTuple (or a dict with "
+            "exactly those keys)")
+
+    @staticmethod
+    def _small(values: dict[str, Any]) -> dict[str, Any]:
+        """Status-embedded copies of outputs (big/unjsonable → repr stub)."""
+        out = {}
+        for k, v in values.items():
+            try:
+                if len(json.dumps(v)) <= 4096:
+                    out[k] = v
+                else:
+                    out[k] = f"<{type(v).__name__}, large>"
+            except (TypeError, ValueError):
+                out[k] = f"<{type(v).__name__}>"
+        return out
+
+    def _terminal_outputs(self, state: "_RunState") -> dict[str, Any]:
+        consumed: set[str] = set()
+        for c in state.concrete.values():
+            for ref in c.arguments.values():
+                if "task_output" in ref:
+                    consumed.add(ref["task_output"].partition(".")[0])
+        out: dict[str, Any] = {}
+        for (task, out_name), (_aid, _uri, value) in state.outputs.items():
+            base = task.partition("#")[0]
+            if task in consumed or base in consumed:
+                continue
+            out[f"{task}.{out_name}"] = self._small({out_name: value})[out_name]
+        return out
+
+
+class _Unresolvable(Exception):
+    pass
+
+
+class _RunState:
+    def __init__(self, ir: PipelineIR, params: dict[str, Any],
+                 cache_enabled: bool):
+        self.ir = ir
+        self.params = params
+        self.cache_enabled = cache_enabled
+        self.concrete: dict[str, _Concrete] = {}
+        self.status: dict[str, TaskExecutionStatus] = {}
+        # (concrete task, output) -> (artifact_id, uri, value)
+        self.outputs: dict[tuple[str, str], tuple[int, str, Any]] = {}
+        self.instances: dict[str, list[str]] = {}   # loop member -> concrete
+        self.expanded: set[str] = set()
+        self.loops: dict[str, list[str]] = {}
+        for name, t in ir.tasks.items():
+            if t.iterate_over is not None:
+                self.loops.setdefault(t.iterate_over["loop_id"], []).append(name)
+
+    def dep_finished(self, dep: str) -> bool:
+        if dep in self.instances:
+            return all(i in self.status for i in self.instances[dep])
+        if any(dep in members for members in self.loops.values()):
+            if dep not in self.instances:
+                return False  # loop not expanded yet
+        return dep in self.status
+
+    def dep_succeeded(self, dep: str) -> bool:
+        """Loop-member deps succeed if expansion happened (instances may be
+        individually skipped — fan-in just sees fewer values)."""
+        if dep in self.instances:
+            return all(
+                self.status.get(i) is not None
+                and self.status[i].phase is not RunPhase.FAILED
+                for i in self.instances[dep])
+        st = self.status.get(dep)
+        return (st is not None and st.phase is RunPhase.SUCCEEDED
+                and not st.skipped)
+
+    def artifact_for_ref(self, ref: dict[str, Any]) -> list[int]:
+        if "task_output" not in ref:
+            return []
+        src, _, out = ref["task_output"].partition(".")
+        if src in self.instances:
+            return [self.outputs[(i, out)][0] for i in self.instances[src]
+                    if (i, out) in self.outputs]
+        entry = self.outputs.get((src, out))
+        return [entry[0]] if entry else []

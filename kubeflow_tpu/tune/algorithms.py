@@ -307,7 +307,9 @@ class CMAES(Suggester):
         c_1 = 2 / ((d + 1.3) ** 2 + mu_eff)
         c_mu = min(1 - c_1, 2 * (mu_eff - 2 + 1 / mu_eff) / ((d + 2) ** 2 + mu_eff))
         try:
-            C_inv_sqrt = np.linalg.inv(np.linalg.cholesky(C + 1e-12 * np.eye(d))).T
+            # M = L^-1 satisfies M^T M = C^-1 — the whitening transform for
+            # the p_sigma norm (L^-T would whiten under the wrong metric).
+            C_inv_sqrt = np.linalg.inv(np.linalg.cholesky(C + 1e-12 * np.eye(d)))
         except np.linalg.LinAlgError:
             C_inv_sqrt = np.eye(d)
         y_w = (mean_new - mean_old) / max(sigma, 1e-12)

@@ -166,10 +166,13 @@ class ExperimentController:
             return
         metric = exp.spec.objective.metric_name
         sign = 1.0 if exp.spec.objective.type is ObjectiveType.MINIMIZE else -1.0
+        # Baseline on succeeded trials only (katib semantics): a crashed
+        # trial's partial history must not deflate the median.
         completed = [
             [(s, sign * v) for s, v in t.status.observations.get(metric, [])]
             for t in trials
-            if self._is_finished(t) and t.status.observations.get(metric)]
+            if t.status.has_condition("Succeeded")
+            and t.status.observations.get(metric)]
         for t in trials:
             if self._is_finished(t) or t.status.pruned:
                 continue

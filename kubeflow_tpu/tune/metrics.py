@@ -45,6 +45,8 @@ def collect_file(path: str, metric_names: set[str]) -> Series:
             for i, line in enumerate(f):
                 try:
                     rec = json.loads(line)
+                    if not isinstance(rec, dict):
+                        continue
                     step = int(rec.get("step", i))
                     for name in metric_names:
                         if rec.get(name) is not None:

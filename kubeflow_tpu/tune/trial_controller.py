@@ -127,7 +127,17 @@ class TrialController:
             metrics_file=trial.metadata.labels.get(
                 "tune.tpu.kubeflow.dev/metrics-file"))
         for name, pts in series.items():
-            trial.status.observations[name] = pts
+            if source == "push":
+                # Push yields one point per poll — accumulate the series
+                # (file/stdout re-parse the whole history each time instead).
+                existing = trial.status.observations.setdefault(name, [])
+                for step, value in pts:
+                    if not existing or existing[-1][0] < step:
+                        existing.append((step, value))
+                    elif existing[-1][0] == step:
+                        existing[-1] = (step, value)
+            else:
+                trial.status.observations[name] = pts
 
     def _finalize(self, trial: Trial, *, succeeded: bool, reason: str) -> None:
         obj = trial.spec.objective

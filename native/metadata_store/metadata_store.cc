@@ -1,0 +1,469 @@
+// TPU-platform metadata store — the ML-Metadata analog, in C++ on SQLite.
+//
+// The reference stack's one C++ service is ml-metadata (SURVEY.md §2.5#41;
+// (U) google/ml-metadata ml_metadata/metadata_store/metadata_store_server_main
+// .cc): a typed Artifact/Execution/Context store with a lineage (Event) graph
+// backing KFP's driver/cache/lineage. This rebuild keeps the same concepts —
+// types, artifacts, executions, contexts, events, associations/attributions,
+// typed properties — behind a flat C ABI consumed via ctypes (pybind11 is not
+// in the image). In-process by design: the platform is single-host, so a gRPC
+// hop would be pure overhead.
+//
+// Concurrency: one sqlite connection per handle, serialized by a mutex.
+// All multi-statement writes run in IMMEDIATE transactions.
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "sqlite3_api.h"
+
+namespace {
+
+struct Store {
+  sqlite3* db = nullptr;
+  std::mutex mu;
+};
+
+const char* kSchema = R"sql(
+PRAGMA journal_mode=WAL;
+PRAGMA synchronous=NORMAL;
+CREATE TABLE IF NOT EXISTS types(
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  kind INTEGER NOT NULL,            -- 0 artifact, 1 execution, 2 context
+  name TEXT NOT NULL,
+  UNIQUE(kind, name));
+CREATE TABLE IF NOT EXISTS artifacts(
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  type_id INTEGER NOT NULL,
+  uri TEXT NOT NULL DEFAULT '',
+  state INTEGER NOT NULL DEFAULT 0, -- 0 unknown, 1 pending, 2 live, 3 deleted
+  create_ts INTEGER NOT NULL DEFAULT (strftime('%s','now')));
+CREATE TABLE IF NOT EXISTS executions(
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  type_id INTEGER NOT NULL,
+  state INTEGER NOT NULL DEFAULT 0, -- 0 new, 1 running, 2 complete, 3 failed, 4 cached, 5 canceled
+  create_ts INTEGER NOT NULL DEFAULT (strftime('%s','now')));
+CREATE TABLE IF NOT EXISTS contexts(
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  type_id INTEGER NOT NULL,
+  name TEXT NOT NULL,
+  UNIQUE(type_id, name));
+CREATE TABLE IF NOT EXISTS properties(
+  kind INTEGER NOT NULL,            -- owner kind: 0/1/2 as above
+  owner_id INTEGER NOT NULL,
+  key TEXT NOT NULL,
+  tag INTEGER NOT NULL,             -- 0 int, 1 double, 2 string
+  ival INTEGER, dval REAL, sval TEXT,
+  PRIMARY KEY(kind, owner_id, key));
+CREATE INDEX IF NOT EXISTS properties_by_value
+  ON properties(kind, key, sval);
+CREATE TABLE IF NOT EXISTS events(
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  execution_id INTEGER NOT NULL,
+  artifact_id INTEGER NOT NULL,
+  type INTEGER NOT NULL,            -- 0 input, 1 output
+  path TEXT NOT NULL DEFAULT '',
+  ts INTEGER NOT NULL DEFAULT (strftime('%s','now')));
+CREATE INDEX IF NOT EXISTS events_by_execution ON events(execution_id);
+CREATE INDEX IF NOT EXISTS events_by_artifact ON events(artifact_id);
+CREATE TABLE IF NOT EXISTS associations(
+  context_id INTEGER NOT NULL, execution_id INTEGER NOT NULL,
+  PRIMARY KEY(context_id, execution_id));
+CREATE TABLE IF NOT EXISTS attributions(
+  context_id INTEGER NOT NULL, artifact_id INTEGER NOT NULL,
+  PRIMARY KEY(context_id, artifact_id));
+)sql";
+
+// One prepared statement executed to completion; returns last error code.
+class Stmt {
+ public:
+  Stmt(sqlite3* db, const char* sql) {
+    rc_ = sqlite3_prepare_v2(db, sql, -1, &stmt_, nullptr);
+  }
+  ~Stmt() {
+    if (stmt_) sqlite3_finalize(stmt_);
+  }
+  bool ok() const { return rc_ == SQLITE_OK && stmt_ != nullptr; }
+  sqlite3_stmt* get() { return stmt_; }
+  void bind_int(int i, sqlite3_int64 v) { sqlite3_bind_int64(stmt_, i, v); }
+  void bind_double(int i, double v) { sqlite3_bind_double(stmt_, i, v); }
+  void bind_text(int i, const char* v) {
+    if (v) sqlite3_bind_text(stmt_, i, v, -1, SQLITE_TRANSIENT);
+    else sqlite3_bind_null(stmt_, i);
+  }
+  int step() { return sqlite3_step(stmt_); }
+
+ private:
+  sqlite3_stmt* stmt_ = nullptr;
+  int rc_;
+};
+
+bool exec(Store* s, const char* sql) {
+  char* err = nullptr;
+  if (sqlite3_exec(s->db, sql, nullptr, nullptr, &err) != SQLITE_OK) {
+    if (err) sqlite3_free(err);
+    return false;
+  }
+  return true;
+}
+
+int fill_ids(Stmt& q, int64_t* out, int cap) {
+  int n = 0;
+  while (q.step() == SQLITE_ROW) {
+    if (n < cap) out[n] = sqlite3_column_int64(q.get(), 0);
+    ++n;
+  }
+  return n;  // may exceed cap: caller sees truncation
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ms_open(const char* path, char* err, int errcap) {
+  auto* s = new Store();
+  if (sqlite3_open(path, &s->db) != SQLITE_OK) {
+    if (err && errcap > 0)
+      snprintf(err, errcap, "%s", s->db ? sqlite3_errmsg(s->db) : "open failed");
+    if (s->db) sqlite3_close(s->db);
+    delete s;
+    return nullptr;
+  }
+  if (!exec(s, kSchema)) {
+    if (err && errcap > 0) snprintf(err, errcap, "%s", sqlite3_errmsg(s->db));
+    sqlite3_close(s->db);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void ms_close(void* h) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return;
+  sqlite3_close(s->db);
+  delete s;
+}
+
+// -- types ---------------------------------------------------------------------
+
+int64_t ms_put_type(void* h, int kind, const char* name) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  {
+    Stmt ins(s->db, "INSERT OR IGNORE INTO types(kind,name) VALUES(?,?)");
+    if (!ins.ok()) return -1;
+    ins.bind_int(1, kind);
+    ins.bind_text(2, name);
+    if (ins.step() != SQLITE_DONE) return -1;
+  }
+  Stmt q(s->db, "SELECT id FROM types WHERE kind=? AND name=?");
+  if (!q.ok()) return -1;
+  q.bind_int(1, kind);
+  q.bind_text(2, name);
+  return q.step() == SQLITE_ROW ? sqlite3_column_int64(q.get(), 0) : -1;
+}
+
+int64_t ms_get_type(void* h, int kind, const char* name) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db, "SELECT id FROM types WHERE kind=? AND name=?");
+  if (!q.ok()) return -1;
+  q.bind_int(1, kind);
+  q.bind_text(2, name);
+  return q.step() == SQLITE_ROW ? sqlite3_column_int64(q.get(), 0) : -1;
+}
+
+// -- nodes ---------------------------------------------------------------------
+
+int64_t ms_create_artifact(void* h, int64_t type_id, const char* uri, int state) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db, "INSERT INTO artifacts(type_id,uri,state) VALUES(?,?,?)");
+  if (!q.ok()) return -1;
+  q.bind_int(1, type_id);
+  q.bind_text(2, uri ? uri : "");
+  q.bind_int(3, state);
+  if (q.step() != SQLITE_DONE) return -1;
+  return sqlite3_last_insert_rowid(s->db);
+}
+
+int ms_update_artifact(void* h, int64_t id, const char* uri, int state) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db, uri ? "UPDATE artifacts SET uri=?, state=? WHERE id=?"
+                    : "UPDATE artifacts SET state=? WHERE id=?");
+  if (!q.ok()) return -1;
+  int i = 1;
+  if (uri) q.bind_text(i++, uri);
+  q.bind_int(i++, state);
+  q.bind_int(i, id);
+  return q.step() == SQLITE_DONE ? 0 : -1;
+}
+
+int ms_get_artifact(void* h, int64_t id, char* uri, int uricap,
+                    int* state, int64_t* type_id) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db, "SELECT uri,state,type_id FROM artifacts WHERE id=?");
+  if (!q.ok()) return -1;
+  q.bind_int(1, id);
+  if (q.step() != SQLITE_ROW) return -1;
+  if (uri && uricap > 0)
+    snprintf(uri, uricap, "%s", sqlite3_column_text(q.get(), 0));
+  if (state) *state = (int)sqlite3_column_int64(q.get(), 1);
+  if (type_id) *type_id = sqlite3_column_int64(q.get(), 2);
+  return 0;
+}
+
+int64_t ms_create_execution(void* h, int64_t type_id, int state) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db, "INSERT INTO executions(type_id,state) VALUES(?,?)");
+  if (!q.ok()) return -1;
+  q.bind_int(1, type_id);
+  q.bind_int(2, state);
+  if (q.step() != SQLITE_DONE) return -1;
+  return sqlite3_last_insert_rowid(s->db);
+}
+
+int ms_update_execution_state(void* h, int64_t id, int state) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db, "UPDATE executions SET state=? WHERE id=?");
+  if (!q.ok()) return -1;
+  q.bind_int(1, state);
+  q.bind_int(2, id);
+  return q.step() == SQLITE_DONE ? 0 : -1;
+}
+
+int ms_get_execution(void* h, int64_t id, int* state, int64_t* type_id) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db, "SELECT state,type_id FROM executions WHERE id=?");
+  if (!q.ok()) return -1;
+  q.bind_int(1, id);
+  if (q.step() != SQLITE_ROW) return -1;
+  if (state) *state = (int)sqlite3_column_int64(q.get(), 0);
+  if (type_id) *type_id = sqlite3_column_int64(q.get(), 1);
+  return 0;
+}
+
+int64_t ms_create_context(void* h, int64_t type_id, const char* name) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  {
+    Stmt ins(s->db,
+             "INSERT OR IGNORE INTO contexts(type_id,name) VALUES(?,?)");
+    if (!ins.ok()) return -1;
+    ins.bind_int(1, type_id);
+    ins.bind_text(2, name);
+    if (ins.step() != SQLITE_DONE) return -1;
+  }
+  Stmt q(s->db, "SELECT id FROM contexts WHERE type_id=? AND name=?");
+  if (!q.ok()) return -1;
+  q.bind_int(1, type_id);
+  q.bind_text(2, name);
+  return q.step() == SQLITE_ROW ? sqlite3_column_int64(q.get(), 0) : -1;
+}
+
+int ms_list_by_type(void* h, int kind, int64_t type_id, int64_t* out, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  const char* sql =
+      kind == 0 ? "SELECT id FROM artifacts WHERE type_id=? ORDER BY id"
+      : kind == 1 ? "SELECT id FROM executions WHERE type_id=? ORDER BY id"
+                  : "SELECT id FROM contexts WHERE type_id=? ORDER BY id";
+  Stmt q(s->db, sql);
+  if (!q.ok()) return -1;
+  q.bind_int(1, type_id);
+  return fill_ids(q, out, cap);
+}
+
+// -- properties ----------------------------------------------------------------
+
+int ms_put_property(void* h, int kind, int64_t owner, const char* key,
+                    int tag, int64_t ival, double dval, const char* sval) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "INSERT OR REPLACE INTO properties(kind,owner_id,key,tag,ival,dval,sval)"
+         " VALUES(?,?,?,?,?,?,?)");
+  if (!q.ok()) return -1;
+  q.bind_int(1, kind);
+  q.bind_int(2, owner);
+  q.bind_text(3, key);
+  q.bind_int(4, tag);
+  q.bind_int(5, ival);
+  q.bind_double(6, dval);
+  q.bind_text(7, sval);
+  return q.step() == SQLITE_DONE ? 0 : -1;
+}
+
+int ms_get_property(void* h, int kind, int64_t owner, const char* key,
+                    int* tag, int64_t* ival, double* dval,
+                    char* sbuf, int scap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT tag,ival,dval,sval FROM properties"
+         " WHERE kind=? AND owner_id=? AND key=?");
+  if (!q.ok()) return -1;
+  q.bind_int(1, kind);
+  q.bind_int(2, owner);
+  q.bind_text(3, key);
+  if (q.step() != SQLITE_ROW) return -1;
+  if (tag) *tag = (int)sqlite3_column_int64(q.get(), 0);
+  if (ival) *ival = sqlite3_column_int64(q.get(), 1);
+  if (dval) *dval = sqlite3_column_double(q.get(), 2);
+  if (sbuf && scap > 0) {
+    const unsigned char* t = sqlite3_column_text(q.get(), 3);
+    snprintf(sbuf, scap, "%s", t ? (const char*)t : "");
+  }
+  return 0;
+}
+
+int ms_list_property_keys(void* h, int kind, int64_t owner,
+                          char* buf, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT key FROM properties WHERE kind=? AND owner_id=? ORDER BY key");
+  if (!q.ok()) return -1;
+  q.bind_int(1, kind);
+  q.bind_int(2, owner);
+  std::string joined;
+  while (q.step() == SQLITE_ROW) {
+    if (!joined.empty()) joined += '\n';
+    joined += (const char*)sqlite3_column_text(q.get(), 0);
+  }
+  if (buf && cap > 0) snprintf(buf, cap, "%s", joined.c_str());
+  return (int)joined.size();
+}
+
+int ms_find_executions_by_property(void* h, const char* key, const char* sval,
+                                   int64_t* out, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT owner_id FROM properties"
+         " WHERE kind=1 AND key=? AND sval=? ORDER BY owner_id");
+  if (!q.ok()) return -1;
+  q.bind_text(1, key);
+  q.bind_text(2, sval);
+  return fill_ids(q, out, cap);
+}
+
+// -- lineage -------------------------------------------------------------------
+
+int ms_put_event(void* h, int64_t exec, int64_t art, int type,
+                 const char* path) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "INSERT INTO events(execution_id,artifact_id,type,path) VALUES(?,?,?,?)");
+  if (!q.ok()) return -1;
+  q.bind_int(1, exec);
+  q.bind_int(2, art);
+  q.bind_int(3, type);
+  q.bind_text(4, path ? path : "");
+  return q.step() == SQLITE_DONE ? 0 : -1;
+}
+
+// Parallel arrays: artifact ids + event types; paths newline-joined in pathbuf.
+int ms_events_by_execution(void* h, int64_t exec, int64_t* art_ids,
+                           int* types, char* pathbuf, int pathcap, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT artifact_id,type,path FROM events"
+         " WHERE execution_id=? ORDER BY id");
+  if (!q.ok()) return -1;
+  q.bind_int(1, exec);
+  int n = 0;
+  std::string paths;
+  while (q.step() == SQLITE_ROW) {
+    if (n < cap) {
+      art_ids[n] = sqlite3_column_int64(q.get(), 0);
+      types[n] = (int)sqlite3_column_int64(q.get(), 1);
+      if (n) paths += '\n';
+      paths += (const char*)sqlite3_column_text(q.get(), 2);
+    }
+    ++n;
+  }
+  if (pathbuf && pathcap > 0) snprintf(pathbuf, pathcap, "%s", paths.c_str());
+  return n;
+}
+
+int ms_events_by_artifact(void* h, int64_t art, int64_t* exec_ids,
+                          int* types, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT execution_id,type FROM events"
+         " WHERE artifact_id=? ORDER BY id");
+  if (!q.ok()) return -1;
+  q.bind_int(1, art);
+  int n = 0;
+  while (q.step() == SQLITE_ROW) {
+    if (n < cap) {
+      exec_ids[n] = sqlite3_column_int64(q.get(), 0);
+      types[n] = (int)sqlite3_column_int64(q.get(), 1);
+    }
+    ++n;
+  }
+  return n;
+}
+
+// -- contexts ------------------------------------------------------------------
+
+int ms_add_association(void* h, int64_t ctx, int64_t exec) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "INSERT OR IGNORE INTO associations(context_id,execution_id)"
+         " VALUES(?,?)");
+  if (!q.ok()) return -1;
+  q.bind_int(1, ctx);
+  q.bind_int(2, exec);
+  return q.step() == SQLITE_DONE ? 0 : -1;
+}
+
+int ms_add_attribution(void* h, int64_t ctx, int64_t art) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "INSERT OR IGNORE INTO attributions(context_id,artifact_id)"
+         " VALUES(?,?)");
+  if (!q.ok()) return -1;
+  q.bind_int(1, ctx);
+  q.bind_int(2, art);
+  return q.step() == SQLITE_DONE ? 0 : -1;
+}
+
+int ms_list_context_executions(void* h, int64_t ctx, int64_t* out, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT execution_id FROM associations WHERE context_id=?"
+         " ORDER BY execution_id");
+  if (!q.ok()) return -1;
+  q.bind_int(1, ctx);
+  return fill_ids(q, out, cap);
+}
+
+int ms_list_context_artifacts(void* h, int64_t ctx, int64_t* out, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT artifact_id FROM attributions WHERE context_id=?"
+         " ORDER BY artifact_id");
+  if (!q.ok()) return -1;
+  q.bind_int(1, ctx);
+  return fill_ids(q, out, cap);
+}
+
+}  // extern "C"

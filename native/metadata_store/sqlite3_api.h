@@ -1,0 +1,48 @@
+// Minimal declarations for the stable public SQLite3 C ABI (the subset this
+// store uses). The runtime image ships libsqlite3.so.0 but not the dev
+// header; these prototypes follow the documented public API
+// (sqlite.org/c3ref) and link against the system library.
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+
+typedef struct sqlite3 sqlite3;
+typedef struct sqlite3_stmt sqlite3_stmt;
+typedef int64_t sqlite3_int64;
+
+int sqlite3_open(const char* filename, sqlite3** db);
+int sqlite3_close(sqlite3*);
+int sqlite3_exec(sqlite3*, const char* sql,
+                 int (*callback)(void*, int, char**, char**), void*,
+                 char** errmsg);
+void sqlite3_free(void*);
+const char* sqlite3_errmsg(sqlite3*);
+
+int sqlite3_prepare_v2(sqlite3*, const char* sql, int nbyte,
+                       sqlite3_stmt** stmt, const char** tail);
+int sqlite3_step(sqlite3_stmt*);
+int sqlite3_reset(sqlite3_stmt*);
+int sqlite3_finalize(sqlite3_stmt*);
+
+int sqlite3_bind_int64(sqlite3_stmt*, int, sqlite3_int64);
+int sqlite3_bind_double(sqlite3_stmt*, int, double);
+int sqlite3_bind_text(sqlite3_stmt*, int, const char*, int, void (*)(void*));
+int sqlite3_bind_null(sqlite3_stmt*, int);
+
+int sqlite3_column_type(sqlite3_stmt*, int);
+sqlite3_int64 sqlite3_column_int64(sqlite3_stmt*, int);
+double sqlite3_column_double(sqlite3_stmt*, int);
+const unsigned char* sqlite3_column_text(sqlite3_stmt*, int);
+
+sqlite3_int64 sqlite3_last_insert_rowid(sqlite3*);
+
+}  // extern "C"
+
+// Return codes / constants used here (public ABI values).
+#define SQLITE_OK 0
+#define SQLITE_ROW 100
+#define SQLITE_DONE 101
+#define SQLITE_NULL 5
+#define SQLITE_TRANSIENT ((void (*)(void*))(-1))

@@ -1,0 +1,223 @@
+"""DAG-executor semantics: driver/launcher behavior (input resolution,
+cache-skip, lineage), control flow (conditions, loops + fan-in, exit
+handlers), failure propagation (SURVEY.md §2.5#40, §3.4)."""
+
+from typing import NamedTuple
+
+import pytest
+
+from kubeflow_tpu.core.pipeline_specs import RunPhase
+from kubeflow_tpu.pipelines import dsl, metadata as md
+from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+from kubeflow_tpu.pipelines.compiler import compile_pipeline
+from kubeflow_tpu.pipelines.executor import PipelineExecutor
+from kubeflow_tpu.pipelines.metadata import MetadataStore
+
+CALLS: list[str] = []
+
+
+@dsl.component
+def emit(n: int) -> list:
+    CALLS.append("emit")
+    return list(range(n))
+
+
+@dsl.component
+def total(data: list) -> int:
+    CALLS.append("total")
+    return sum(data)
+
+
+@dsl.component
+def double(x: int) -> int:
+    CALLS.append("double")
+    return 2 * x
+
+
+@dsl.component
+def merge(items: list) -> int:
+    CALLS.append("merge")
+    return sum(items)
+
+
+@dsl.component
+def boom(x: int) -> int:
+    raise RuntimeError("kaput")
+
+
+@dsl.component
+def cleanup(tag: str = "t") -> str:
+    CALLS.append("cleanup")
+    return f"cleaned-{tag}"
+
+
+@pytest.fixture()
+def ex(tmp_path):
+    CALLS.clear()
+    return PipelineExecutor(ArtifactStore(str(tmp_path / "cas")),
+                            MetadataStore(str(tmp_path / "md.db")))
+
+
+class TestBasics:
+    def test_linear_flow_and_outputs(self, ex):
+        @dsl.pipeline
+        def p(n: int = 3):
+            t = total(data=emit(n=n))
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.SUCCEEDED
+        assert res.tasks["total"].outputs["output"] == 3
+        assert res.outputs == {"total.output": 3}
+
+    def test_parameter_override_and_missing(self, ex):
+        @dsl.pipeline
+        def p(n: int = 3):
+            emit(n=n)
+
+        res = ex.run(compile_pipeline(p), {"n": 5}, run_name="r")
+        assert res.tasks["emit"].outputs["output"] == [0, 1, 2, 3, 4]
+
+        @dsl.pipeline
+        def q(n: int):
+            emit(n=n)
+
+        with pytest.raises(ValueError, match="no default"):
+            ex.run(compile_pipeline(q), run_name="r2")
+
+    def test_dynamic_loop_from_task_output(self, ex):
+        @dsl.pipeline
+        def p(n: int = 3):
+            data = emit(n=n)           # [0, 1, 2]
+            with dsl.ParallelFor(data.output) as item:
+                d = double(x=item)
+            merge(items=d.output)
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.SUCCEEDED
+        assert res.tasks["merge"].outputs["output"] == 6  # 0+2+4
+        assert {n for n in res.tasks} >= {"double#0", "double#1", "double#2"}
+
+    def test_empty_loop(self, ex):
+        @dsl.pipeline
+        def p():
+            data = emit(n=0)
+            with dsl.ParallelFor(data.output) as item:
+                d = double(x=item)
+            merge(items=d.output)
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.SUCCEEDED
+        assert res.tasks["merge"].outputs["output"] == 0
+
+
+class TestCaching:
+    def test_cache_hit_and_arg_sensitivity(self, ex):
+        @dsl.pipeline
+        def p(n: int = 3):
+            total(data=emit(n=n))
+
+        ir = compile_pipeline(p)
+        ex.run(ir, run_name="r1")
+        assert CALLS == ["emit", "total"]
+        res2 = ex.run(ir, run_name="r2")
+        assert CALLS == ["emit", "total"]       # nothing re-ran
+        assert res2.tasks["emit"].cached and res2.tasks["total"].cached
+        assert res2.tasks["total"].outputs["output"] == 3
+        ex.run(ir, {"n": 4}, run_name="r3")     # different args → re-run
+        assert CALLS == ["emit", "total", "emit", "total"]
+
+    def test_cache_disabled_per_run(self, ex):
+        @dsl.pipeline
+        def p():
+            emit(n=2)
+
+        ir = compile_pipeline(p)
+        ex.run(ir, run_name="r1")
+        ex.run(ir, run_name="r2", cache_enabled=False)
+        assert CALLS == ["emit", "emit"]
+
+    def test_cached_execution_recorded_in_lineage(self, ex):
+        @dsl.pipeline
+        def p():
+            emit(n=2)
+
+        ir = compile_pipeline(p)
+        ex.run(ir, run_name="r1")
+        res = ex.run(ir, run_name="r2")
+        eid = res.tasks["emit"].execution_id
+        info = ex.metadata.get_execution(eid)
+        assert info["state"] == md.EXEC_CACHED
+        assert info["properties"]["cached_from"] > 0
+
+
+class TestFailure:
+    def test_failure_skips_dependents_not_siblings(self, ex):
+        @dsl.pipeline
+        def p():
+            b = boom(x=1)
+            total(data=b.output)     # dependent: skipped
+            emit(n=1)                # independent: runs
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.FAILED
+        assert res.tasks["boom"].phase is RunPhase.FAILED
+        assert "kaput" in res.tasks["boom"].error
+        assert res.tasks["total"].skipped
+        assert res.tasks["emit"].phase is RunPhase.SUCCEEDED
+
+    def test_exit_handler_runs_on_failure(self, ex):
+        @dsl.pipeline
+        def p():
+            c = cleanup()
+            with dsl.ExitHandler(c):
+                boom(x=1)
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.FAILED
+        assert res.tasks["cleanup"].phase is RunPhase.SUCCEEDED
+        assert "cleanup" in CALLS
+
+    def test_failed_execution_recorded(self, ex):
+        @dsl.pipeline
+        def p():
+            boom(x=1)
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        eid = res.tasks["boom"].execution_id
+        assert ex.metadata.get_execution(eid)["state"] == md.EXEC_FAILED
+
+
+class TestLineage:
+    def test_full_provenance_graph(self, ex):
+        @dsl.pipeline
+        def p(n: int = 3):
+            t = total(data=emit(n=n))
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        t_eid = res.tasks["total"].execution_id
+        events = ex.metadata.events_by_execution(t_eid)
+        inputs = [e for e in events if e[1] == md.EVENT_INPUT]
+        outputs = [e for e in events if e[1] == md.EVENT_OUTPUT]
+        assert len(inputs) == 1 and inputs[0][2] == "data"
+        assert len(outputs) == 1 and outputs[0][2] == "output"
+        # the input artifact is emit's output artifact
+        e_eid = res.tasks["emit"].execution_id
+        emit_out = [a for a, t, _ in ex.metadata.events_by_execution(e_eid)
+                    if t == md.EVENT_OUTPUT]
+        assert inputs[0][0] in emit_out
+        lin = ex.metadata.lineage(outputs[0][0])
+        assert set(lin["executions"]) == {e_eid, t_eid}
+        # run context collects all executions
+        assert set(ex.metadata.context_executions(res.context_id)) >= \
+            {e_eid, t_eid}
+
+
+class TestArtifacts:
+    def test_cas_round_trip(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        uri = store.put_value({"a": [1, 2]})
+        assert uri.startswith("cas://")
+        assert store.get_value(uri) == {"a": [1, 2]}
+        assert store.put_value({"a": [1, 2]}) == uri   # content-addressed
+        obj = {1, 2, 3}  # not JSON-able → pickle codec
+        assert store.get_value(store.put_value(obj)) == obj
