@@ -51,6 +51,9 @@ class BatchingSpec(BaseModel):
     max_pages: Optional[int] = None  # default: sized from HBM budget
     chunked_prefill_tokens: int = 512
     prefill_buckets: list[int] = Field(default_factory=lambda: [128, 512, 2048])
+    # "auto": Pallas flash kernel on TPU (forward-only prefill is where it
+    # wins), XLA elsewhere; or force "pallas"/"xla".
+    prefill_attn_impl: str = "auto"
 
 
 class PredictorSpec(BaseModel):

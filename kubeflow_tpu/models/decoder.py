@@ -96,11 +96,12 @@ def decoder_param_specs(cfg: DecoderConfig) -> Params:
 
 
 def _block_forward(block_params, x, positions, cfg: DecoderConfig,
-                   kv_cache=None, attn_impl="xla", mesh=None, rules=DEFAULT_RULES):
+                   kv_cache=None, attn_impl="xla", mesh=None,
+                   rules=DEFAULT_RULES, prefill=False):
     h = L.rmsnorm(x, block_params["ln1"], cfg)
     attn_out, new_cache = L.attention_block(
         block_params["attn"], h, positions, cfg,
-        kv_cache=kv_cache, attn_impl=attn_impl)
+        kv_cache=kv_cache, attn_impl=attn_impl, mesh=mesh, prefill=prefill)
     x = x + attn_out
     h = L.rmsnorm(x, block_params["ln2"], cfg)
     if cfg.is_moe:
@@ -155,14 +156,27 @@ def decoder_forward(
 
     aux_total = jnp.float32(0)
     new_caches = None
+    # Static prefill marker (engine prefill path): cache start is known to be
+    # 0 at trace time, enabling the flash kernel. Must never enter a traced
+    # pytree (remat would trace it into an array).
+    prefill = bool(kv_caches.get("prefill", False)) if kv_caches else False
 
-    if cfg.scan_layers:
+    pp = dict(mesh.shape).get("pipeline", 1) if mesh is not None else 1
+    if pp > 1 and kv_caches is None:
+        # Pipeline parallelism: the layer stack is staged over the
+        # ``pipeline`` mesh axis and microbatches stream through via
+        # ppermute (parallel/pipeline.py). Decode (kv_caches) stays on the
+        # non-pp path — serving shards differently.
+        x = _pipeline_layers(params["layers"], x, positions, cfg, mesh,
+                             attn_impl)
+    elif cfg.scan_layers:
         def scan_body(carry, scan_in):
             x = carry
             block_params, cache = scan_in
             out, new_cache, aux = _block_forward(
                 block_params, x, positions, cfg,
-                kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules)
+                kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules,
+                prefill=prefill)
             return out, (new_cache, aux)
 
         body = _remat(scan_body, cfg.remat_policy)
@@ -190,7 +204,8 @@ def decoder_forward(
         block_fn = _remat(
             lambda bp, x, cache: _block_forward(
                 bp, x, positions, cfg,
-                kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules),
+                kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules,
+                prefill=prefill),
             cfg.remat_policy)
         for i, block_params in enumerate(params["layers"]):
             cache = None
@@ -214,6 +229,48 @@ def decoder_forward(
     if cfg.logits_softcap is not None:
         logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
     return logits, new_caches, aux_total
+
+
+def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
+                     attn_impl: str = "xla"):
+    """Apply the [L, ...] layer stack as pipeline stages (dense only)."""
+    from kubeflow_tpu.parallel.pipeline import pipeline_apply
+
+    if cfg.is_moe:
+        raise NotImplementedError(
+            "pipeline parallel + MoE is not supported yet; use expert "
+            "parallelism for MoE models")
+    if attn_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            "pipeline + sequence parallelism is not composed yet: the "
+            "pipeline shard_map does not map the seq axis; use one or the "
+            "other (pp with attn_impl='xla'/'pallas', or sp without pp)")
+    n_stages = dict(mesh.shape)["pipeline"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"pipeline={n_stages} must divide n_layers={cfg.n_layers}")
+    per = cfg.n_layers // n_stages
+    if not cfg.scan_layers:
+        # List-of-blocks layout: stack to the scan layout first.
+        layer_params = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params)
+    stage_params = jax.tree.map(
+        lambda p: p.reshape(n_stages, per, *p.shape[1:]), layer_params)
+
+    def stage_fn(blocks, xs):
+        def body(h, bp):
+            # No logical-constraint mesh inside shard_map: the activation is
+            # a local shard there and GSPMD annotations don't apply.
+            out, _, _ = _block_forward(bp, h, xs["positions"], cfg,
+                                       attn_impl=attn_impl)
+            return out, None
+
+        h, _ = jax.lax.scan(body, xs["x"], blocks)
+        return {"x": h, "positions": xs["positions"]}
+
+    out = pipeline_apply(stage_fn, stage_params,
+                         {"x": x, "positions": positions},
+                         mesh=mesh, num_microbatches=None)
+    return out["x"]
 
 
 def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int) -> dict:

@@ -85,6 +85,8 @@ def attention_block(
     *,
     kv_cache: Optional[dict] = None,    # {"k","v": [B,Smax,K,Dh]}, + "len": scalar
     attn_impl: str = "xla",
+    mesh=None,
+    prefill: bool = False,              # static: cache start is known to be 0
 ):
     """Returns (out [B,S,D], new_kv_cache|None)."""
     dt = cfg.activation_dtype
@@ -101,12 +103,34 @@ def attention_block(
         ck = jax.lax.dynamic_update_slice_in_dim(kv_cache["k"], k, start, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(kv_cache["v"], v, start, axis=1)
         new_cache = {"k": ck, "v": cv, "len": start + x.shape[1]}
-        # Causal mask with q_offset covers both the cached prefix and
-        # intra-block causality; attn_impl is honored (the pallas kernel
-        # supports q_offset masking too).
-        out = multi_head_attention(
-            q, ck, cv, causal=True, q_offset=start, impl=attn_impl,
-        )
+        if attn_impl == "pallas" and prefill:
+            # Prefill from an empty scratch cache: start is statically 0 and
+            # the cache length equals the block, so the flash kernel applies
+            # directly (its big win is exactly this forward-only pass).
+            out = multi_head_attention(q, ck, cv, causal=True, q_offset=0,
+                                       impl="pallas")
+        else:
+            # Decode with a traced cache offset: the masked XLA path (the
+            # pallas kernel needs a static q_offset).
+            impl = "xla" if attn_impl in ("pallas", "ring", "ulysses") \
+                else attn_impl
+            out = multi_head_attention(
+                q, ck, cv, causal=True, q_offset=start, impl=impl,
+            )
+    elif attn_impl in ("ring", "ulysses"):
+        # Sequence-parallel attention over the mesh 'seq' axis (SURVEY.md
+        # §2.6 SP/CP rows). Degenerates to XLA attention when the mesh has
+        # no seq sharding (keeps tiny/test configs running unchanged).
+        if mesh is None or dict(mesh.shape).get("seq", 1) == 1:
+            out = multi_head_attention(q, k, v, causal=True, impl="xla")
+        else:
+            from kubeflow_tpu.parallel.ring_attention import (
+                ring_attention_sharded, ulysses_attention_sharded,
+            )
+
+            fn = (ring_attention_sharded if attn_impl == "ring"
+                  else ulysses_attention_sharded)
+            out = fn(q, k, v, mesh, causal=True)
     else:
         out = multi_head_attention(q, k, v, causal=True, impl=attn_impl)
     out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))

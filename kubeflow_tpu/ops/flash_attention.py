@@ -1,0 +1,270 @@
+"""Pallas TPU flash attention — blockwise online-softmax kernel.
+
+The data-plane hot op (SURVEY.md §2.6: the reference orchestrates frameworks
+that bring their own fused attention; TPU-natively the kernel is ours).
+Design per the Pallas TPU guide: grid (batch, q_head, q_block, kv_block) with
+the kv dimension innermost so VMEM scratch accumulators (m, l, acc) carry
+across kv steps; causal blocks fully above the diagonal are skipped with
+``pl.when``; logits accumulate on the MXU in float32
+(``preferred_element_type``); GQA maps q-head → kv-head in the BlockSpec
+index maps so each kv block is DMA'd once per group.
+
+Backward runs as a custom VJP that recomputes attention blockwise per kv
+block (flash-style: O(S) memory, no S×S materialization) using the same
+kernel family.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubeflow_tpu.ops.attention import NEG_INF
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_KV = 128
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(sq: int, skv: int, bq: Optional[int], bkv: Optional[int]):
+    bq = min(bq or DEFAULT_BLOCK_Q, sq)
+    bkv = min(bkv or DEFAULT_BLOCK_KV, skv)
+    if sq % bq or skv % bkv:
+        raise ValueError(
+            f"seq lengths ({sq}, {skv}) must divide block sizes ({bq}, {bkv})")
+    return bq, bkv
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+                m_ref, l_ref, acc_ref, *,
+                causal: bool, sm_scale: float, softcap: Optional[float],
+                q_offset: int, block_q: int, block_kv: int,
+                num_kv_blocks: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_pos = q_offset + qi * block_q + \
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+    kv_pos = ki * block_kv + \
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+
+    # Causal skip: the whole kv block is in the future of every q position.
+    block_needed = jnp.logical_or(
+        jnp.logical_not(causal),
+        ki * block_kv <= q_offset + (qi + 1) * block_q - 1)
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bkv, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        if causal:
+            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+
+        m_prev = m_ref[:]                            # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # [bq, bkv]
+        alpha = jnp.exp(m_prev - m_new)              # [bq, 1]
+        l_new = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)          # [bkv, d]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        # Fully-masked rows (decode padding) have l == 0: emit zeros.
+        l = l_ref[:]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, causal, sm_scale, softcap, q_offset,
+               block_q, block_kv, interpret):
+    b, h, sq, d = q.shape
+    _, kh, skv, _ = k.shape
+    n_rep = h // kh
+    bq, bkv = _block_sizes(sq, skv, block_q, block_kv)
+    nq, nkv = sq // bq, skv // bkv
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, sm_scale=sm_scale, softcap=softcap,
+        q_offset=q_offset, block_q=bq, block_kv=bkv, num_kv_blocks=nkv)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max m
+            pltpu.VMEM((bq, 1), jnp.float32),   # running denom l
+            pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
+        ],
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret if interpret is not None else _auto_interpret(),
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, sm_scale, softcap, q_offset, block_q, block_kv,
+           interpret):
+    return _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                      softcap=softcap, q_offset=q_offset, block_q=block_q,
+                      block_kv=block_kv, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, softcap, q_offset, block_q,
+                   block_kv, interpret):
+    o = _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                   softcap=softcap, q_offset=q_offset, block_q=block_q,
+                   block_kv=block_kv, interpret=interpret)
+    return o, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, softcap, q_offset, block_q, block_kv,
+                   interpret, res, do):
+    """Blockwise recompute backward: iterate kv blocks with lax.scan so the
+    S×S score matrix never materializes (memory O(S·block) like flash bwd)."""
+    q, k, v = res
+    b, h, sq, d = q.shape
+    _, kh, skv, _ = k.shape
+    n_rep = h // kh
+    qf = q.astype(jnp.float32)
+    kf = jnp.repeat(k.astype(jnp.float32), n_rep, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float32), n_rep, axis=1)
+    dof = do.astype(jnp.float32)
+    _, bkv = _block_sizes(sq, skv, block_q, block_kv)
+    nkv = skv // bkv
+
+    q_pos = (jnp.arange(sq) + q_offset)[:, None]
+
+    def scores(kb, k0):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb,
+                       preferred_element_type=jnp.float32) * sm_scale
+        capped = s
+        if softcap is not None:
+            capped = jnp.tanh(s / softcap) * softcap
+        if causal:
+            kv_pos = (k0 + jnp.arange(bkv))[None, :]
+            capped = jnp.where((kv_pos <= q_pos)[None, None], capped, NEG_INF)
+        return s, capped
+
+    # Pass 1: global softmax stats (m, l) per q position, blockwise.
+    def stats_step(carry, ki):
+        m, l = carry
+        kb = jax.lax.dynamic_slice_in_dim(kf, ki * bkv, bkv, axis=2)
+        _, s = scores(kb, ki * bkv)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        l_new = l * jnp.exp(m - m_new) + \
+            jnp.sum(jnp.exp(s - m_new[..., None]), axis=-1)
+        return (m_new, l_new), None
+
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (m, l), _ = jax.lax.scan(stats_step, (m0, l0), jnp.arange(nkv))
+    l = jnp.where(l == 0.0, 1.0, l)
+
+    # delta = rowsum(dO * O) — compute O blockwise too.
+    def out_step(acc, ki):
+        kb = jax.lax.dynamic_slice_in_dim(kf, ki * bkv, bkv, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(vf, ki * bkv, bkv, axis=2)
+        _, s = scores(kb, ki * bkv)
+        p = jnp.exp(s - m[..., None]) / l[..., None]
+        return acc + jnp.einsum("bhqk,bhkd->bhqd", p, vb), None
+
+    o, _ = jax.lax.scan(out_step, jnp.zeros_like(qf), jnp.arange(nkv))
+    delta = jnp.sum(dof * o, axis=-1)                    # [b,h,sq]
+
+    # Pass 2: gradients, blockwise over kv.
+    def grad_step(dq_acc, ki):
+        kb = jax.lax.dynamic_slice_in_dim(kf, ki * bkv, bkv, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(vf, ki * bkv, bkv, axis=2)
+        s_raw, s = scores(kb, ki * bkv)
+        p = jnp.exp(s - m[..., None]) / l[..., None]
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb)
+        ds = p * (dp - delta[..., None])
+        if softcap is not None:
+            ds = ds * (1.0 - jnp.tanh(s_raw / softcap) ** 2)
+        ds = ds * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq_acc, (dk_b, dv_b)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        grad_step, jnp.zeros_like(qf), jnp.arange(nkv))
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, skv, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, skv, d)
+    if n_rep > 1:  # fold grads back onto shared kv heads
+        dk = dk.reshape(b, kh, n_rep, skv, d).sum(axis=2)
+        dv = dv.reshape(b, kh, n_rep, skv, d).sum(axis=2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,                     # [B, Sq, H, D]
+    k: jax.Array,                     # [B, Skv, K, D]
+    v: jax.Array,                     # [B, Skv, K, D]
+    *,
+    causal: bool = True,
+    q_offset: jax.Array | int = 0,
+    logits_softcap: Optional[float] = None,
+    sm_scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention with GQA; layout-compatible with ops.attention
+    (returns [B, Sq, H, D]). ``q_offset`` must be a static int here (the
+    prefill path); traced-offset decode goes through the XLA impl, which is
+    the right tool for single-token queries anyway."""
+    if isinstance(q_offset, jax.Array):
+        raise ValueError(
+            "flash_attention needs a static q_offset; use impl='xla' for "
+            "decode with a traced cache offset")
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    # [B,S,H,D] -> [B,H,S,D] (contiguous per-head blocks for the kernel)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash(qt, kt, vt, causal, scale, logits_softcap,
+               int(q_offset), block_q, block_kv, interpret)
+    return jnp.swapaxes(o, 1, 2)

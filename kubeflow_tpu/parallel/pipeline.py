@@ -1,0 +1,149 @@
+"""Pipeline parallelism: microbatch streaming over the ``pipeline`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.6: 'not implemented'
+— user images bring Megatron/DeepSpeed). TPU-natively, stages live on
+ICI-neighbor devices and activations hop stage→stage with `lax.ppermute`
+inside `shard_map` — the collective-pipelining recipe (cf. the public
+scaling-book/praxis pattern), not an NCCL p2p translation.
+
+Schedule: GPipe — m microbatches through n stages in m+n-1 ticks; at tick t
+stage s runs microbatch t-s (bubble ticks are masked compute, fraction
+(n-1)/(m+n-1)). The whole schedule is a `lax.scan`, so it jits once,
+differentiates (ppermute/where/scan all have transposes — reverse-mode
+produces the mirrored backward pipeline), and composes with the data axes in
+the same mesh (``batch_axes`` shards the batch dim of the streamed pytree).
+Stage weights: leading dim sharded over ``pipeline``. Memory: stash
+activations per microbatch (GPipe); ``stage_fn`` is wrapped in
+``jax.checkpoint`` by default to trade recompute for memory (1F1B's win) —
+the schedule itself stays XLA's job.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+StageFn = Callable[[Any, Any], Any]
+
+
+def stack_stage_params(per_stage: list[Any]) -> Any:
+    """[stage0_tree, stage1_tree, ...] → one tree with leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def stage_params_sharding(stacked: Any, mesh: Mesh,
+                          axis_name: str = "pipeline") -> Any:
+    """NamedShardings putting the leading stage dim on the pipeline axis."""
+    return jax.tree.map(
+        lambda x: NamedSharding(
+            mesh, P(axis_name, *([None] * (x.ndim - 1)))), stacked)
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stage_params: Any,                # leaves [n_stages, ...], pipeline-sharded
+    xs: Any,                          # pytree; every leaf [batch, ...]
+    *,
+    mesh: Mesh,
+    num_microbatches: int | None = None,
+    axis_name: str = "pipeline",
+    batch_axes: tuple = ("dcn", "data", "fsdp"),
+    checkpoint_stages: bool = True,
+) -> Any:
+    """Run ``y = stage_{n-1}(... stage_0(xs))`` pipelined over microbatches.
+
+    ``stage_fn(params_one_stage, xs_mb) -> ys_mb`` must preserve the pytree
+    structure and leaf shapes (the transformer-stack contract). Every leaf
+    streams with the microbatch; the batch dim may additionally be sharded
+    over ``batch_axes``. ``num_microbatches=None`` auto-picks the largest
+    m ≤ 2·stages dividing the local batch (bubble ≤ ⅓). Returns the same
+    pytree, [batch, ...] per leaf."""
+    n_stages = mesh.shape[axis_name]
+    leaves = jax.tree.leaves(xs)
+    batch = leaves[0].shape[0]
+    data_shards = 1
+    batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    for a in batch_axes:
+        data_shards *= mesh.shape[a]
+    local_batch = batch // data_shards
+    if num_microbatches is None:
+        num_microbatches = next(
+            (m for m in range(min(2 * n_stages, max(local_batch, 1)), 0, -1)
+             if local_batch % m == 0), 1)
+    if batch % data_shards or local_batch % num_microbatches:
+        raise ValueError(
+            f"batch {batch} must be divisible by data shards {data_shards} × "
+            f"num_microbatches {num_microbatches}")
+    mb = local_batch // num_microbatches
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+
+    def worker(params, xs_local):
+        # params leaves: [1, ...] (this stage's slice); xs leaves [local_b,...]
+        params = jax.tree.map(lambda p: p[0], params)
+        s = jax.lax.axis_index(axis_name)
+        m = num_microbatches
+        xs_mb = jax.tree.map(
+            lambda a: a.reshape(m, mb, *a.shape[1:]), xs_local)
+        send_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            buf, out = carry
+            mb_idx = t - s
+            active = jnp.logical_and(mb_idx >= 0, mb_idx < m)
+            feed = jax.tree.map(lambda a: a[jnp.clip(t, 0, m - 1)], xs_mb)
+            x_in = jax.tree.map(
+                lambda f, b: jnp.where(s == 0, f, b), feed, buf)
+            y = fn(params, x_in)
+            y = jax.tree.map(
+                lambda a: jnp.where(active, a, jnp.zeros_like(a)), y)
+            # Last stage deposits its finished microbatch.
+            write = jnp.logical_and(active, s == n_stages - 1)
+            idx = jnp.clip(mb_idx, 0, m - 1)
+            out = jax.tree.map(
+                lambda o, a: jnp.where(
+                    write, jax.lax.dynamic_update_index_in_dim(o, a, idx, 0),
+                    o),
+                out, y)
+            # Hop to the next stage (stage n-1 sends to nobody; ppermute
+            # without a wrap edge delivers zeros to stage 0, which ignores it)
+            buf_next = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, axis_name, send_perm), y)
+            return (buf_next, out), None
+
+        out0 = jax.tree.map(
+            lambda a: jnp.zeros((m, mb, *a.shape[1:]), a.dtype), xs_local)
+        buf0 = jax.tree.map(
+            lambda a: jnp.zeros((mb, *a.shape[1:]), a.dtype), xs_local)
+        (_, out), _ = jax.lax.scan(
+            tick, (buf0, out0), jnp.arange(m + n_stages - 1))
+        # Replicate the result off the last stage (psum of one-hot owner).
+        def collect(o):
+            owner = (s == n_stages - 1).astype(o.dtype)
+            o = jax.lax.psum(o * owner, axis_name)
+            return o.reshape(local_batch, *o.shape[2:])
+
+        return jax.tree.map(collect, out)
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
+    x_specs = jax.tree.map(
+        lambda a: P(batch_axes or None, *([None] * (a.ndim - 1))), xs)
+    return shard_map(
+        worker, mesh=mesh,
+        in_specs=(param_specs, x_specs),
+        out_specs=x_specs,
+        check_vma=False,
+    )(stage_params, xs)
+
+
+def sequential_apply(stage_fn: StageFn, stage_params: Any, xs: Any) -> Any:
+    """Numerics oracle: same stages, no pipelining."""
+    n = jax.tree.leaves(stage_params)[0].shape[0]
+    for i in range(n):
+        params_i = jax.tree.map(lambda p: p[i], stage_params)
+        xs = stage_fn(params_i, xs)
+    return xs

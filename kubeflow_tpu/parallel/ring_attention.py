@@ -1,0 +1,181 @@
+"""Sequence/context parallelism: ring attention + Ulysses head-swap.
+
+The reference platform has NO long-context support — sequence length is the
+workload's problem (SURVEY.md §5 'Long-context / sequence parallelism:
+absent'). Here it is first-class (§2.6 rows SP/CP/ring/Ulysses):
+
+- **Ring attention** (`ring_attention`): Q/K/V sharded on the sequence dim
+  over the ``seq`` mesh axis; each step computes blockwise attention against
+  the resident KV shard while `lax.ppermute` rotates KV around the ICI ring,
+  accumulating the exact softmax online (m/l/acc rescaling — the blockwise
+  attention recurrence). XLA overlaps the ppermute with the block compute;
+  memory per chip stays O(S/n · S/n) per step instead of O(S²).
+- **Ulysses** (`ulysses_attention`): `lax.all_to_all` swaps the sequence
+  sharding for a head sharding, runs ordinary full attention locally (any
+  impl, incl. the Pallas flash kernel), and swaps back — cheaper at moderate
+  S when heads ≥ ring size.
+
+Both are plain differentiable JAX (scan/ppermute/all_to_all have transposes),
+so the same code path serves training and inference. Call them inside
+``shard_map`` (the model does), or use the ``*_sharded`` wrappers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.ops.attention import NEG_INF, _repeat_kv
+
+
+def _block_attn_step(q, k, v, m, l, acc, *, q_start, kv_start, causal,
+                     sm_scale, softcap):
+    """One online-softmax accumulation step of local Q against one KV shard.
+
+    q: [B,Sq,H,D]; k/v: [B,Skv,H,D]; m/l: [B,H,Sq]; acc: [B,Sq,H,D] (f32).
+    ``q_start``/``kv_start`` are global offsets (traced OK)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    if softcap is not None:
+        s = jnp.tanh(s / softcap) * softcap
+    sq, skv = q.shape[1], k.shape[1]
+    if causal:
+        q_pos = q_start + jnp.arange(sq)[:, None]
+        kv_pos = kv_start + jnp.arange(skv)[None, :]
+        mask = kv_pos <= q_pos                     # [Sq, Skv]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1)                    # [B,H,Sq]
+    m_new = jnp.maximum(m, m_cur)
+    # exp(NEG_INF - NEG_INF) would be 1: zero fully-masked entries explicitly.
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(m - m_new)                     # [B,H,Sq]
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * jnp.transpose(alpha, (0, 2, 1))[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jax.Array,                     # [B, S_local, H, D] (seq shard)
+    k: jax.Array,                     # [B, S_local, K, D]
+    v: jax.Array,                     # [B, S_local, K, D]
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    logits_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over the full (ring-distributed) sequence. Must run
+    inside shard_map with q/k/v sharded on dim 1 over ``axis_name``."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    # GQA expansion happens per-step inside _block_attn_step: the ring
+    # rotates the RAW [B,S,K,D] shards, so ppermute traffic and the scan
+    # carry stay 1/n_rep the size of the expanded heads.
+    n_rep = h // k.shape[2]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+
+    q_start = idx * s_local
+    m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+
+    # Ring schedule: at step t this device holds KV shard (idx - t) mod n and
+    # passes it on to rank+1 afterwards, so every device sees every shard.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        k_cur, v_cur, m, l, acc = carry
+        kv_shard = (idx - t) % n
+        m, l, acc = _block_attn_step(
+            q, _repeat_kv(k_cur, n_rep), _repeat_kv(v_cur, n_rep), m, l, acc,
+            q_start=q_start, kv_start=kv_shard * s_local,
+            causal=causal, sm_scale=scale, softcap=logits_softcap)
+        # Rotate KV for the next step (skipped result after the last one is
+        # harmless; XLA overlaps this transfer with the next block compute).
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    l_t = jnp.transpose(l, (0, 2, 1))[..., None]   # [B,Sq,H,1]
+    out = acc / jnp.where(l_t == 0.0, 1.0, l_t)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,                     # [B, S_local, H, D]
+    k: jax.Array,                     # [B, S_local, K, D]
+    v: jax.Array,                     # [B, S_local, K, D]
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    logits_softcap: Optional[float] = None,
+    impl: str = "xla",
+) -> jax.Array:
+    """All-to-all swap seq-sharding → head-sharding, local full attention,
+    swap back (the DeepSpeed-Ulysses schedule, TPU-natively over ICI)."""
+    from kubeflow_tpu.ops.attention import multi_head_attention
+
+    n = jax.lax.axis_size(axis_name)
+    h, kh = q.shape[2], k.shape[2]
+    if h % n or kh % n:
+        raise ValueError(
+            f"ulysses needs heads divisible by the seq axis: H={h}, K={kh}, "
+            f"axis={n} (use ring attention otherwise)")
+    # [B, S/n, H, D] -> [B, S, H/n, D]
+    qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    kh_ = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                             tiled=True)
+    vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    out = multi_head_attention(qh, kh_, vh, causal=causal,
+                               logits_softcap=logits_softcap, impl=impl)
+    # [B, S, H/n, D] -> [B, S/n, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def _sharded(fn, mesh: Mesh, axis_name: str, batch_axes):
+    spec = P(batch_axes, axis_name, None, None)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)
+
+
+def ring_attention_sharded(
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
+    axis_name: str = "seq", batch_axes=("dcn", "data", "fsdp"),
+    causal: bool = True, sm_scale: Optional[float] = None,
+    logits_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Convenience wrapper: applies shard_map over the mesh (batch sharded on
+    the data axes, sequence on ``axis_name``)."""
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal,
+                           sm_scale=sm_scale, logits_softcap=logits_softcap)
+    return _sharded(fn, mesh, axis_name, batch)(q, k, v)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, *,
+    axis_name: str = "seq", batch_axes=("dcn", "data", "fsdp"),
+    causal: bool = True, sm_scale: Optional[float] = None,
+    logits_softcap: Optional[float] = None, impl: str = "xla",
+) -> jax.Array:
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale,
+                           logits_softcap=logits_softcap, impl=impl)
+    return _sharded(fn, mesh, axis_name, batch)(q, k, v)

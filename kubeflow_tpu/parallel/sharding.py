@@ -50,6 +50,12 @@ DEFAULT_RULES: LogicalRules = (
 )
 
 
+def with_rule(rules: LogicalRules, name: str, axis: Axis) -> LogicalRules:
+    """A copy of ``rules`` with one mapping replaced (e.g. layers→pipeline
+    when pipeline parallelism shards the layer stack across stages)."""
+    return tuple((n, axis if n == name else a) for n, a in rules)
+
+
 def logical_to_mesh_axes(
     logical_axes: Sequence[Optional[str]],
     rules: LogicalRules = DEFAULT_RULES,

@@ -137,7 +137,8 @@ def _decode_step(params: Params, cache: dict, tokens: jax.Array,
 
 
 def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
-                  slot: jax.Array, length: jax.Array, cfg: DecoderConfig):
+                  slot: jax.Array, length: jax.Array, cfg: DecoderConfig,
+                  attn_impl: str = "xla"):
     """Prefill a [1, S_bucket] prompt into slot ``slot``.
 
     Runs the training forward with a scratch contiguous cache, scatters the
@@ -149,8 +150,12 @@ def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
         "v": jnp.zeros((cfg.n_layers, 1, tokens.shape[1],
                         cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
         "len": jnp.int32(0),
+        # Static marker: lets attention_block use the flash kernel (start is
+        # statically 0 on this path).
+        "prefill": True,
     }
-    logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=scratch)
+    logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=scratch,
+                                        attn_impl=attn_impl)
     bucket = tokens.shape[1]
     ck = jax.lax.dynamic_update_slice(
         cache["k"], filled["k"], (0, slot, 0, 0, 0))
@@ -273,9 +278,20 @@ class LLMEngine:
         self._decode = jax.jit(
             lambda p, c, t, l: _decode_step(p, c, t, l, cfg),
             donate_argnums=(1,))
-        self._prefill = jax.jit(
-            lambda p, c, t, s, ln: _prefill_step(p, c, t, s, ln, cfg),
-            donate_argnums=(1,))
+        on_tpu = jax.default_backend() == "tpu"
+
+        def _prefill_fn(p, c, t, s, ln):
+            # Per-bucket impl choice (shape is static per trace): measured on
+            # v5e, the flash kernel overtakes fused XLA attention in the full
+            # model around S≈2k (XLA wins below — matmul-dominated regime).
+            impl = b.prefill_attn_impl
+            if impl == "auto":
+                # Flash kernel needs the bucket to divide its 128 block.
+                impl = ("pallas" if on_tpu and t.shape[1] >= 2048
+                        and t.shape[1] % 128 == 0 else "xla")
+            return _prefill_step(p, c, t, s, ln, cfg, impl)
+
+        self._prefill = jax.jit(_prefill_fn, donate_argnums=(1,))
         self._sampler = jax.jit(_sample, static_argnums=(3,))
 
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots

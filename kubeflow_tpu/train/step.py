@@ -107,6 +107,13 @@ def setup_train(
     init_state: bool = True,
 ) -> TrainTask:
     optimizer = make_optimizer(opt_cfg)
+    if dict(mesh.shape).get("pipeline", 1) > 1:
+        # Pipeline parallelism stages the layer stack: shard the stacked
+        # layer dim over the pipeline axis (parallel/pipeline.py streams
+        # microbatches through it).
+        from kubeflow_tpu.parallel.sharding import with_rule
+
+        rules = with_rule(rules, "layers", "pipeline")
     shardings = _state_shardings(cfg, mesh, rules, optimizer)
     batch_sharding = NamedSharding(
         mesh, logical_to_mesh_axes(("batch", None), rules))
