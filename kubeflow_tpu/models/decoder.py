@@ -252,7 +252,9 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
     per = cfg.n_layers // n_stages
     if not cfg.scan_layers:
         # List-of-blocks layout: stack to the scan layout first.
-        layer_params = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params)
+        from kubeflow_tpu.parallel.pipeline import stack_stage_params
+
+        layer_params = stack_stage_params(layer_params)
     stage_params = jax.tree.map(
         lambda p: p.reshape(n_stages, per, *p.shape[1:]), layer_params)
 
@@ -269,7 +271,10 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
 
     out = pipeline_apply(stage_fn, stage_params,
                          {"x": x, "positions": positions},
-                         mesh=mesh, num_microbatches=None)
+                         mesh=mesh, num_microbatches=None,
+                         # Honor the config's remat knob like the scan path
+                         # (_remat); "none" really means no recompute.
+                         checkpoint_stages=cfg.remat_policy != "none")
     return out["x"]
 
 

@@ -82,6 +82,15 @@ class ControlPlane:
             recorder=self.recorder)
         self.schedule_reconciler = ScheduledRunController(
             self.store, recorder=self.recorder)
+        from kubeflow_tpu.workspace.notebook_controller import NotebookController
+        from kubeflow_tpu.workspace.profile_controller import ProfileController
+
+        self.notebook_reconciler = NotebookController(
+            self.store, base_dir=self.config.base_dir,
+            recorder=self.recorder,
+            launch_processes=self.config.launch_processes)
+        self.profile_reconciler = ProfileController(
+            self.store, recorder=self.recorder)
         self.controllers: list[Controller] = [
             Controller(self.store, self.jaxjob_reconciler, name="jaxjob"),
             Controller(self.store, self.isvc_reconciler, name="isvc"),
@@ -89,6 +98,8 @@ class ControlPlane:
             Controller(self.store, self.trial_reconciler, name="trial"),
             Controller(self.store, self.pipelinerun_reconciler, name="pipelinerun"),
             Controller(self.store, self.schedule_reconciler, name="schedule"),
+            Controller(self.store, self.notebook_reconciler, name="notebook"),
+            Controller(self.store, self.profile_reconciler, name="profile"),
         ]
         self.runtime: Optional[WorkerRuntime] = None
         if self.config.launch_processes:
@@ -138,6 +149,7 @@ class ControlPlane:
             self.runtime.shutdown()
         self.isvc_reconciler.shutdown()
         self.pipelinerun_reconciler.shutdown()
+        self.notebook_reconciler.shutdown()
 
     def step(self) -> int:
         """Deterministic single-threaded pump (test mode)."""

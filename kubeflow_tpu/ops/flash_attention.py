@@ -43,7 +43,7 @@ def _block_sizes(sq: int, skv: int, bq: Optional[int], bkv: Optional[int]):
     return bq, bkv
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_ref, l_ref, acc_ref, *,
                 causal: bool, sm_scale: float, softcap: Optional[float],
                 q_offset: int, block_q: int, block_kv: int,
@@ -99,6 +99,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref,
         l = l_ref[:]
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+        # Log-sum-exp per row: the softmax stats the backward needs (saving
+        # it here is what makes the VJP a single sweep).
+        lse_ref[0, 0] = m_ref[:] + jnp.log(safe)
 
 
 def _flash_fwd(q, k, v, *, causal, sm_scale, softcap, q_offset,
@@ -113,7 +116,7 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, softcap, q_offset,
         _fwd_kernel, causal=causal, sm_scale=sm_scale, softcap=softcap,
         q_offset=q_offset, block_q=bq, block_kv=bkv, num_kv_blocks=nkv)
 
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nkv),
         in_specs=[
@@ -126,112 +129,97 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, softcap, q_offset,
                          lambda bi, hi, qi, ki, n_rep=n_rep:
                          (bi, hi // n_rep, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_specs=(
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            # Trailing singleton keeps the (sublane, lane) tiling legal:
+            # (bq, 1) with last dim == full array dim.
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max m
             pltpu.VMEM((bq, 1), jnp.float32),   # running denom l
             pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
         ],
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ),
         interpret=interpret if interpret is not None else _auto_interpret(),
     )(q, k, v)
+    return o, lse[..., 0]
 
 
 @functools.partial(jax.custom_vjp,
                    nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash(q, k, v, causal, sm_scale, softcap, q_offset, block_q, block_kv,
            interpret):
-    return _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+    o, _ = _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
                       softcap=softcap, q_offset=q_offset, block_q=block_q,
                       block_kv=block_kv, interpret=interpret)
+    return o
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, softcap, q_offset, block_q,
                    block_kv, interpret):
-    o = _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
-                   softcap=softcap, q_offset=q_offset, block_q=block_q,
-                   block_kv=block_kv, interpret=interpret)
-    return o, (q, k, v)
+    o, lse = _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
+                        softcap=softcap, q_offset=q_offset, block_q=block_q,
+                        block_kv=block_kv, interpret=interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, softcap, q_offset, block_q, block_kv,
                    interpret, res, do):
-    """Blockwise recompute backward: iterate kv blocks with lax.scan so the
-    S×S score matrix never materializes (memory O(S·block) like flash bwd)."""
-    q, k, v = res
+    """Flash-style backward: ONE blockwise sweep over KV. The kernel's saved
+    output + log-sum-exp replace the stats/output recompute passes, and the
+    grouped [b, kh, n_rep, s, d] layout keeps K/V at their GQA size (no
+    n_rep-fold expansion)."""
+    q, k, v, o, lse = res
     b, h, sq, d = q.shape
     _, kh, skv, _ = k.shape
     n_rep = h // kh
-    qf = q.astype(jnp.float32)
-    kf = jnp.repeat(k.astype(jnp.float32), n_rep, axis=1)
-    vf = jnp.repeat(v.astype(jnp.float32), n_rep, axis=1)
-    dof = do.astype(jnp.float32)
+    g = n_rep
+    qg = q.astype(jnp.float32).reshape(b, kh, g, sq, d)
+    dog = do.astype(jnp.float32).reshape(b, kh, g, sq, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    lse_g = lse.reshape(b, kh, g, sq)
+    delta_g = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                      axis=-1).reshape(b, kh, g, sq)      # rowsum(dO·O)
     _, bkv = _block_sizes(sq, skv, block_q, block_kv)
     nkv = skv // bkv
-
     q_pos = (jnp.arange(sq) + q_offset)[:, None]
 
-    def scores(kb, k0):
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb,
-                       preferred_element_type=jnp.float32) * sm_scale
-        capped = s
-        if softcap is not None:
-            capped = jnp.tanh(s / softcap) * softcap
-        if causal:
-            kv_pos = (k0 + jnp.arange(bkv))[None, :]
-            capped = jnp.where((kv_pos <= q_pos)[None, None], capped, NEG_INF)
-        return s, capped
-
-    # Pass 1: global softmax stats (m, l) per q position, blockwise.
-    def stats_step(carry, ki):
-        m, l = carry
-        kb = jax.lax.dynamic_slice_in_dim(kf, ki * bkv, bkv, axis=2)
-        _, s = scores(kb, ki * bkv)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        l_new = l * jnp.exp(m - m_new) + \
-            jnp.sum(jnp.exp(s - m_new[..., None]), axis=-1)
-        return (m_new, l_new), None
-
-    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
-    (m, l), _ = jax.lax.scan(stats_step, (m0, l0), jnp.arange(nkv))
-    l = jnp.where(l == 0.0, 1.0, l)
-
-    # delta = rowsum(dO * O) — compute O blockwise too.
-    def out_step(acc, ki):
-        kb = jax.lax.dynamic_slice_in_dim(kf, ki * bkv, bkv, axis=2)
-        vb = jax.lax.dynamic_slice_in_dim(vf, ki * bkv, bkv, axis=2)
-        _, s = scores(kb, ki * bkv)
-        p = jnp.exp(s - m[..., None]) / l[..., None]
-        return acc + jnp.einsum("bhqk,bhkd->bhqd", p, vb), None
-
-    o, _ = jax.lax.scan(out_step, jnp.zeros_like(qf), jnp.arange(nkv))
-    delta = jnp.sum(dof * o, axis=-1)                    # [b,h,sq]
-
-    # Pass 2: gradients, blockwise over kv.
     def grad_step(dq_acc, ki):
         kb = jax.lax.dynamic_slice_in_dim(kf, ki * bkv, bkv, axis=2)
         vb = jax.lax.dynamic_slice_in_dim(vf, ki * bkv, bkv, axis=2)
-        s_raw, s = scores(kb, ki * bkv)
-        p = jnp.exp(s - m[..., None]) / l[..., None]
-        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb)
-        ds = p * (dp - delta[..., None])
+        s_raw = jnp.einsum("bkgqd,bkmd->bkgqm", qg, kb,
+                           preferred_element_type=jnp.float32) * sm_scale
+        s = s_raw
+        if softcap is not None:
+            s = jnp.tanh(s_raw / softcap) * softcap
+        if causal:
+            kv_pos = (ki * bkv + jnp.arange(bkv))[None, :]
+            s = jnp.where((kv_pos <= q_pos)[None, None, None], s, NEG_INF)
+        p = jnp.exp(s - lse_g[..., None])   # exact: kernel-saved normalizer
+        # Fully-masked rows have lse == NEG_INF too: exp(0) would be 1.
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dv_b = jnp.einsum("bkgqm,bkgqd->bkmd", p, dog)
+        dp = jnp.einsum("bkgqd,bkmd->bkgqm", dog, vb)
+        ds = p * (dp - delta_g[..., None])
         if softcap is not None:
             ds = ds * (1.0 - jnp.tanh(s_raw / softcap) ** 2)
         ds = ds * sm_scale
-        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
-        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dq_acc = dq_acc + jnp.einsum("bkgqm,bkmd->bkgqd", ds, kb)
+        dk_b = jnp.einsum("bkgqm,bkgqd->bkmd", ds, qg)
         return dq_acc, (dk_b, dv_b)
 
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        grad_step, jnp.zeros_like(qf), jnp.arange(nkv))
-    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, skv, d)
-    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, skv, d)
-    if n_rep > 1:  # fold grads back onto shared kv heads
-        dk = dk.reshape(b, kh, n_rep, skv, d).sum(axis=2)
-        dv = dv.reshape(b, kh, n_rep, skv, d).sum(axis=2)
+        grad_step, jnp.zeros_like(qg), jnp.arange(nkv))
+    dq = dq.reshape(b, h, sq, d)
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, kh, skv, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, kh, skv, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

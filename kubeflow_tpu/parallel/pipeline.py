@@ -25,7 +25,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 StageFn = Callable[[Any, Any], Any]
 
@@ -33,14 +33,6 @@ StageFn = Callable[[Any, Any], Any]
 def stack_stage_params(per_stage: list[Any]) -> Any:
     """[stage0_tree, stage1_tree, ...] → one tree with leading stage dim."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
-
-
-def stage_params_sharding(stacked: Any, mesh: Mesh,
-                          axis_name: str = "pipeline") -> Any:
-    """NamedShardings putting the leading stage dim on the pipeline axis."""
-    return jax.tree.map(
-        lambda x: NamedSharding(
-            mesh, P(axis_name, *([None] * (x.ndim - 1)))), stacked)
 
 
 def pipeline_apply(

@@ -1,0 +1,9 @@
+"""Platform surface — REST API server, Prometheus metrics, CLI
+(SURVEY.md §2.1 #7 dashboard / L6 gateway analogs, build phase 8): the
+HTTP CRUD gateway over the object store, the observability endpoint, and
+the ``kftpu``-style command line.
+"""
+
+from kubeflow_tpu.platform.api_server import ApiServer
+
+__all__ = ["ApiServer"]

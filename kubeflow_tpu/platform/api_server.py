@@ -1,0 +1,202 @@
+"""REST API gateway over the control plane's object store.
+
+The kube-apiserver-facing L6 surface (SURVEY.md layer map): manifest CRUD,
+status, events, worker logs, Prometheus metrics — what the reference spreads
+over kubectl + per-app REST backends. stdlib ThreadingHTTPServer, matching
+serve/server.py's dependency footprint.
+
+Routes:
+- ``GET  /healthz``
+- ``GET  /metrics``                         Prometheus text
+- ``GET  /apis``                            known kinds
+- ``GET  /apis/{kind}?namespace=``          list manifests
+- ``GET  /apis/{kind}/{ns}/{name}``         one manifest
+- ``POST /apis``                            apply manifest (JSON or YAML body)
+- ``DELETE /apis/{kind}/{ns}/{name}``
+- ``GET  /events?ref={Kind/ns/name}``
+- ``GET  /logs/{ns}/{job}/{replica_index}`` worker log tail
+
+Identity: requests may carry ``X-Kftpu-User``; profile-namespace writes are
+checked against the Profile's owner/contributors (the KFAM authz surface).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+import yaml
+
+from kubeflow_tpu.core.manifest import load_manifest
+from kubeflow_tpu.core.registry import known_kinds
+from kubeflow_tpu.core.store import NotFoundError
+from kubeflow_tpu.core.workspace_specs import Profile
+from kubeflow_tpu.platform.metrics import render_metrics
+
+
+class ApiServer:
+    def __init__(self, control_plane, host: str = "127.0.0.1", port: int = 8134):
+        self.cp = control_plane
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet
+                pass
+
+            def _send(self, code: int, body: Any, content_type="application/json"):
+                data = (body if isinstance(body, bytes)
+                        else json.dumps(body, default=str).encode()
+                        if content_type == "application/json"
+                        else str(body).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except Exception as exc:  # noqa: BLE001 — surface as 500
+                    self._send(500, {"error": str(exc)})
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except Exception as exc:  # noqa: BLE001
+                    self._send(500, {"error": str(exc)})
+
+            def do_DELETE(self):
+                try:
+                    outer._delete(self)
+                except Exception as exc:  # noqa: BLE001
+                    self._send(500, {"error": str(exc)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="api-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- authz (KFAM analog) ---------------------------------------------------
+
+    def _authorized(self, handler, namespace: str) -> bool:
+        user = handler.headers.get("X-Kftpu-User")
+        if user is None:
+            return True   # no identity → single-user mode
+        profile = self.cp.store.try_get(Profile, namespace, "default")
+        if profile is None:
+            return True   # unmanaged namespace
+        return (user == profile.spec.owner
+                or user in profile.spec.contributors)
+
+    # -- handlers --------------------------------------------------------------
+
+    def _get(self, h) -> None:
+        url = urlparse(h.path)
+        parts = [p for p in url.path.split("/") if p]
+        q = parse_qs(url.query)
+        if url.path == "/healthz":
+            return h._send(200, {"ok": True})
+        if url.path == "/metrics":
+            return h._send(200, render_metrics(
+                self.cp.store, self.cp.recorder,
+                getattr(self.cp, "allocator", None)), "text/plain")
+        if url.path == "/apis":
+            return h._send(200, {"kinds": sorted(known_kinds())})
+        if parts[:1] == ["apis"] and len(parts) == 2:
+            cls = self._kind(parts[1])
+            if cls is None:
+                return h._send(404, {"error": f"unknown kind {parts[1]}"})
+            ns = q.get("namespace", [None])[0]
+            objs = self.cp.store.list(cls, namespace=ns)
+            return h._send(200, {"items": [o.to_manifest() for o in objs]})
+        if parts[:1] == ["apis"] and len(parts) == 4:
+            cls = self._kind(parts[1])
+            if cls is None:
+                return h._send(404, {"error": f"unknown kind {parts[1]}"})
+            obj = self.cp.store.try_get(cls, parts[3], parts[2])
+            if obj is None:
+                return h._send(404, {"error": "not found"})
+            return h._send(200, obj.to_manifest())
+        if parts[:1] == ["events"]:
+            ref = q.get("ref", [None])[0]
+            evs = (self.cp.recorder.for_object(ref) if ref
+                   else self.cp.recorder.all())
+            return h._send(200, {"items": [dataclasses.asdict(e) for e in evs]})
+        if parts[:1] == ["logs"] and len(parts) == 4:
+            return self._logs(h, parts[1], parts[2], parts[3])
+        h._send(404, {"error": "no route"})
+
+    def _post(self, h) -> None:
+        if h.path != "/apis":
+            return h._send(404, {"error": "no route"})
+        length = int(h.headers.get("Content-Length", 0))
+        raw = h.rfile.read(length).decode()
+        try:
+            doc = yaml.safe_load(raw)
+            obj = load_manifest(doc)
+        except Exception as exc:  # noqa: BLE001 — bad manifest is a 400
+            return h._send(400, {"error": f"bad manifest: {exc}"})
+        if not self._authorized(h, obj.metadata.namespace):
+            return h._send(403, {"error": "forbidden"})
+        applied = self.cp.apply(obj)
+        h._send(200, applied.to_manifest())
+
+    def _delete(self, h) -> None:
+        parts = [p for p in urlparse(h.path).path.split("/") if p]
+        if parts[:1] != ["apis"] or len(parts) != 4:
+            return h._send(404, {"error": "no route"})
+        cls = self._kind(parts[1])
+        if cls is None:
+            return h._send(404, {"error": f"unknown kind {parts[1]}"})
+        if not self._authorized(h, parts[2]):
+            return h._send(403, {"error": "forbidden"})
+        try:
+            self.cp.store.delete(cls, parts[3], parts[2])
+        except NotFoundError:
+            return h._send(404, {"error": "not found"})
+        h._send(200, {"deleted": f"{parts[1]}/{parts[2]}/{parts[3]}"})
+
+    def _logs(self, h, namespace: str, job: str, index: str) -> None:
+        import os
+
+        log = os.path.join(self.cp.config.base_dir, "logs",
+                           f"{namespace}.{job}-worker-{index}.log")
+        try:
+            with open(log, "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - 65536))
+                data = f.read()
+        except OSError:
+            return h._send(404, {"error": f"no log at {log}"})
+        h._send(200, data, "text/plain")
+
+    @staticmethod
+    def _kind(name: str):
+        kinds = known_kinds()
+        # Accept exact, lowercase, and lowercase-plural forms (kubectl-style).
+        for kind, cls in kinds.items():
+            if name in (kind, kind.lower(), kind.lower() + "s"):
+                return cls
+        return None

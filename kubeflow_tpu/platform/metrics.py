@@ -1,0 +1,82 @@
+"""Prometheus-format platform metrics.
+
+The reference exposes controller-runtime metrics on every controller
+(SURVEY.md §5 observability). Here one endpoint aggregates the platform
+state the reference surfaces — object/phase counts, event totals — plus the
+data-plane numbers it never sees: per-job tokens/sec/chip, step, MFU, and
+gang-allocator chip occupancy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeflow_tpu.core.events import EventRecorder
+from kubeflow_tpu.core.jobs import JAXJob, Worker
+from kubeflow_tpu.core.registry import known_kinds
+from kubeflow_tpu.core.store import ObjectStore
+
+
+def _line(name: str, value, labels: Optional[dict] = None) -> str:
+    if labels:
+        lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{lab}}} {value}"
+    return f"{name} {value}"
+
+
+def render_metrics(store: ObjectStore,
+                   recorder: Optional[EventRecorder] = None,
+                   allocator=None) -> str:
+    out: list[str] = []
+
+    out.append("# TYPE kftpu_objects gauge")
+    for kind, cls in sorted(known_kinds().items()):
+        objs = store.list(cls)
+        phases: dict[str, int] = {}
+        for o in objs:
+            status = getattr(o, "status", None)
+            phase = getattr(status, "phase", None) if status is not None else None
+            phase = getattr(phase, "value", phase) or "unknown"
+            phases[str(phase)] = phases.get(str(phase), 0) + 1
+        for phase, n in sorted(phases.items()):
+            out.append(_line("kftpu_objects", n,
+                             {"kind": kind, "phase": phase}))
+
+    out.append("# TYPE kftpu_job_metric gauge")
+    for job in store.list(JAXJob):
+        m = job.status.metrics
+        labels = {"job": job.metadata.name,
+                  "namespace": job.metadata.namespace}
+        out.append(_line("kftpu_job_step", m.step, labels))
+        for field in ("tokens_per_sec_per_chip", "step_time_ms", "mfu", "loss"):
+            v = getattr(m, field)
+            if v is not None:
+                out.append(_line(f"kftpu_job_{field}", v, labels))
+
+    out.append("# TYPE kftpu_workers gauge")
+    worker_phases: dict[str, int] = {}
+    for w in store.list(Worker):
+        p = getattr(w.status.phase, "value", str(w.status.phase))
+        worker_phases[p] = worker_phases.get(p, 0) + 1
+    for phase, n in sorted(worker_phases.items()):
+        out.append(_line("kftpu_workers", n, {"phase": phase}))
+
+    if allocator is not None:
+        total = sum(s.num_chips for s in allocator._cluster.slices)
+        free = sum(allocator.free_chips(s.name)
+                   for s in allocator._cluster.slices)
+        out.append("# TYPE kftpu_chips gauge")
+        out.append(_line("kftpu_chips_total", total))
+        out.append(_line("kftpu_chips_allocated", total - free))
+
+    if recorder is not None:
+        counts: dict[tuple[str, str], int] = {}
+        for ev in recorder.all():
+            key = (ev.type, ev.reason)
+            counts[key] = counts.get(key, 0) + ev.count
+        out.append("# TYPE kftpu_events_total counter")
+        for (etype, reason), n in sorted(counts.items()):
+            out.append(_line("kftpu_events_total", n,
+                             {"type": etype, "reason": reason}))
+
+    return "\n".join(out) + "\n"

@@ -1,0 +1,11 @@
+"""Workspace subsystem — notebook sessions, profiles, pod defaults
+(SURVEY.md §2.1 #1-4, build phase 8): the notebook-controller /
+profile-controller / admission-webhook analogs, TPU-natively: a Notebook is
+a JAX-ready kernel process with chips attached, a Profile is a namespace +
+quota record, PodDefaults inject env into matching workloads.
+"""
+
+from kubeflow_tpu.workspace.notebook_controller import NotebookController
+from kubeflow_tpu.workspace.profile_controller import ProfileController
+
+__all__ = ["NotebookController", "ProfileController"]

@@ -1,0 +1,195 @@
+"""Notebook reconciler: session process + PodDefault injection + idle culling.
+
+The notebook-controller analog ((U) kubeflow/kubeflow components/
+notebook-controller controllers/notebook_controller.go + culler/culler.go;
+SURVEY.md §2.1#1, §3.5): a Notebook materializes as a JAX-ready kernel
+process (workspace/session_main.py) instead of a StatefulSet; the culler
+watches the session's activity-file mtime instead of polling
+``/api/kernels``; matching PodDefaults inject env at spawn — the
+admission-webhook analog (§2.1#4) applied at the one place processes are
+born.
+
+Culled notebooks restart on demand: set the ``…/wake`` annotation (the
+"open the notebook again" action) or bump the spec.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from kubeflow_tpu.core.events import EventRecorder
+from kubeflow_tpu.core.store import NotFoundError, ObjectStore, WatchEvent
+from kubeflow_tpu.core.workspace_specs import (
+    Notebook, PodDefault, apply_pod_defaults,
+)
+from kubeflow_tpu.operator.controller import ReconcileResult
+
+logger = logging.getLogger("kubeflow_tpu.workspace")
+
+WAKE_ANNOTATION = "workspace.tpu.kubeflow.dev/wake"
+
+
+class NotebookController:
+    kinds = ["Notebook", "PodDefault"]
+
+    def __init__(self, store: ObjectStore, *, base_dir: str,
+                 recorder: Optional[EventRecorder] = None,
+                 launch_processes: bool = True,
+                 poll_interval: float = 2.0):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.base_dir = base_dir
+        self.launch_processes = launch_processes
+        self.poll_interval = poll_interval
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    def key_for(self, ev: WatchEvent) -> Optional[str]:
+        obj = ev.object
+        if obj.kind == "Notebook":
+            return f"{obj.metadata.namespace}/{obj.metadata.name}"
+        return None   # PodDefault changes apply to future spawns only
+
+    # -- paths -----------------------------------------------------------------
+
+    def _dir(self, namespace: str, name: str) -> str:
+        return os.path.join(self.base_dir, "notebooks", namespace, name)
+
+    def socket_path(self, namespace: str, name: str) -> str:
+        return os.path.join(self._dir(namespace, name), "kernel.sock")
+
+    def activity_path(self, namespace: str, name: str) -> str:
+        return os.path.join(self._dir(namespace, name), "last-activity")
+
+    # -- reconcile -------------------------------------------------------------
+
+    def reconcile(self, key: str) -> Optional[ReconcileResult]:
+        namespace, name = key.split("/", 1)
+        nb = self.store.try_get(Notebook, name, namespace)
+        if nb is None:
+            self._teardown(key)
+            return None
+
+        if nb.status.phase == "Culled":
+            if WAKE_ANNOTATION in nb.metadata.annotations:
+                del nb.metadata.annotations[WAKE_ANNOTATION]
+                nb.status.phase = "Pending"
+                try:
+                    self.store.update(nb, check_version=False)
+                except NotFoundError:
+                    return None
+                self.recorder.normal(nb, "Waking", "wake requested")
+            else:
+                return None   # stays culled until woken
+
+        if nb.status.phase in ("Pending", "Failed"):
+            return self._start(key, nb)
+        if nb.status.phase == "Running":
+            return self._check(key, nb)
+        return None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def _start(self, key: str, nb: Notebook) -> Optional[ReconcileResult]:
+        namespace, name = nb.metadata.namespace, nb.metadata.name
+        d = self._dir(namespace, name)
+        os.makedirs(d, exist_ok=True)
+        defaults = self.store.list(PodDefault, namespace=namespace)
+        env = apply_pod_defaults(
+            {**nb.metadata.labels, **nb.spec.pod_default_labels},
+            dict(nb.spec.env), defaults)
+
+        sock = self.socket_path(namespace, name)
+        activity = self.activity_path(namespace, name)
+        # Restart the idle clock NOW: a woken/culled notebook's stale activity
+        # mtime must not re-cull it before the session's first touch.
+        with open(activity, "a"):
+            os.utime(activity, None)
+        if self.launch_processes:
+            # The package may be run from a source tree (not pip-installed):
+            # make it importable in the child regardless of its cwd.
+            pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            pythonpath = os.environ.get("PYTHONPATH", "")
+            full_env = {
+                **os.environ, **env,
+                "KFTPU_NB_SOCKET": sock,
+                "KFTPU_NB_ACTIVITY": activity,
+                "KFTPU_NB_WORKDIR": d,
+                "KFTPU_NB_VOLUMES": ":".join(nb.spec.volumes),
+                "PYTHONPATH": (f"{pkg_root}:{pythonpath}" if pythonpath
+                               else pkg_root),
+            }
+            log = open(os.path.join(d, "session.log"), "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "kubeflow_tpu.workspace.session_main"],
+                env=full_env, stdout=log, stderr=log)
+            self._procs[key] = proc
+            nb.status.pid = proc.pid
+        nb.status.phase = "Running"
+        nb.status.url = f"unix://{sock}"
+        nb.status.last_activity = time.time()
+        nb.status.set_condition("Running", True, reason="SessionStarted")
+        self.recorder.normal(nb, "Started",
+                             f"session at {nb.status.url} env={sorted(env)}")
+        self._update_status(nb)
+        return ReconcileResult(requeue_after=self.poll_interval)
+
+    def _check(self, key: str, nb: Notebook) -> Optional[ReconcileResult]:
+        proc = self._procs.get(key)
+        if self.launch_processes and proc is not None and proc.poll() is not None:
+            nb.status.phase = "Failed"
+            nb.status.set_condition("Running", False, reason="SessionExited",
+                                    message=f"exit code {proc.returncode}")
+            self.recorder.warning(nb, "SessionExited",
+                                  f"exit code {proc.returncode}")
+            self._procs.pop(key, None)
+            self._update_status(nb)
+            # Failed sessions restart on the next reconcile (_start).
+            return ReconcileResult(requeue_after=self.poll_interval)
+
+        idle = self._idle_seconds(nb)
+        nb.status.last_activity = time.time() - idle if idle is not None else None
+        cull_after = nb.spec.idle_cull_seconds
+        if cull_after is not None and idle is not None and idle > cull_after:
+            self._teardown(key)
+            nb.status.phase = "Culled"
+            nb.status.pid = None
+            nb.status.set_condition("Running", False, reason="IdleCulled",
+                                    message=f"idle {idle:.0f}s")
+            self.recorder.normal(nb, "Culled", f"idle {idle:.0f}s")
+            self._update_status(nb)
+            return None
+        self._update_status(nb)
+        return ReconcileResult(requeue_after=self.poll_interval)
+
+    def _idle_seconds(self, nb: Notebook) -> Optional[float]:
+        path = self.activity_path(nb.metadata.namespace, nb.metadata.name)
+        try:
+            return max(0.0, time.time() - os.stat(path).st_mtime)
+        except OSError:
+            return None
+
+    def _teardown(self, key: str) -> None:
+        proc = self._procs.pop(key, None)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def shutdown(self) -> None:
+        for key in list(self._procs):
+            self._teardown(key)
+
+    def _update_status(self, nb: Notebook) -> None:
+        try:
+            self.store.update_status(nb)
+        except NotFoundError:
+            pass

@@ -1,0 +1,160 @@
+"""Profile reconciler: per-user namespace usage + quota enforcement.
+
+The profile-controller + KFAM analog ((U) kubeflow/kubeflow components/
+profile-controller controllers/profile_controller.go, components/
+access-management api/handler.go; SURVEY.md §2.1#2-3). Convention carried
+over: a Profile's name IS its namespace. Quota (ResourceQuota analog) is
+enforced by suspending the newest over-quota JAXJobs — the TPU-native
+equivalent of admission rejection, reversible when capacity frees up.
+Contributor add/remove is an authz record on the spec (the KFAM surface);
+enforcement is by the API server's identity header check.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from kubeflow_tpu.core.events import EventRecorder
+from kubeflow_tpu.core.jobs import JAXJob
+from kubeflow_tpu.core.store import (
+    ConflictError, NotFoundError, ObjectStore, WatchEvent,
+)
+from kubeflow_tpu.core.workspace_specs import Notebook, Profile
+from kubeflow_tpu.operator.controller import ReconcileResult
+
+logger = logging.getLogger("kubeflow_tpu.workspace")
+
+QUOTA_SUSPENDED = "workspace.tpu.kubeflow.dev/quota-suspended"
+
+
+def _job_chips(job: JAXJob) -> int:
+    return sum(rs.replicas * rs.resources.tpu_chips
+               for rs in job.spec.replica_specs.values())
+
+
+def _is_finished(job: JAXJob) -> bool:
+    return (job.status.has_condition("Succeeded")
+            or job.status.has_condition("Failed"))
+
+
+class ProfileController:
+    kinds = ["Profile", "JAXJob", "Notebook"]
+
+    def __init__(self, store: ObjectStore, *,
+                 recorder: Optional[EventRecorder] = None):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+
+    def key_for(self, ev: WatchEvent) -> Optional[str]:
+        obj = ev.object
+        if obj.kind == "Profile":
+            return obj.metadata.name
+        # Jobs/notebooks affect their namespace's profile (name == namespace).
+        return obj.metadata.namespace
+
+    def reconcile(self, key: str) -> Optional[ReconcileResult]:
+        profile = self.store.try_get(Profile, key, "default")
+        if profile is None:
+            return None
+        ns = profile.metadata.name
+        jobs = [j for j in self.store.list(JAXJob, namespace=ns)
+                if not _is_finished(j)]
+        notebooks = [n for n in self.store.list(Notebook, namespace=ns)
+                     if n.status.phase in ("Pending", "Running")]
+
+        quota = profile.spec.quota
+        # Enforcement: keep jobs in creation order; suspend the newest ones
+        # that push usage over quota, resume when room frees.
+        jobs.sort(key=lambda j: (
+            j.metadata.creation_timestamp.timestamp()
+            if j.metadata.creation_timestamp else 0.0,
+            j.metadata.name))
+        chips = 0
+        active_jobs = 0
+        for job in jobs:
+            want_chips = chips + _job_chips(job)
+            want_jobs = active_jobs + 1
+            over = ((quota.max_tpu_chips is not None
+                     and want_chips > quota.max_tpu_chips)
+                    or (quota.max_jobs is not None
+                        and want_jobs > quota.max_jobs))
+            if over:
+                self._suspend(job)
+            else:
+                chips += _job_chips(job)
+                active_jobs += 1
+                self._resume(job)
+
+        if quota.max_notebooks is not None:
+            for nb in notebooks[quota.max_notebooks:]:
+                self.recorder.warning(nb, "QuotaExceeded",
+                                      f"profile {ns} allows "
+                                      f"{quota.max_notebooks} notebooks")
+
+        chips += sum(nb.spec.resources.tpu_chips for nb in notebooks
+                     if nb.status.phase == "Running")
+        profile.status.namespace_ready = True
+        profile.status.chips_in_use = chips
+        profile.status.set_condition("Ready", True, reason="Reconciled")
+        try:
+            self.store.update_status(profile)
+        except (NotFoundError, ConflictError):
+            pass
+        return None
+
+    def _suspend(self, job: JAXJob) -> None:
+        if job.spec.run_policy.suspend:
+            return
+        fresh = self.store.try_get(JAXJob, job.metadata.name,
+                                   job.metadata.namespace)
+        if fresh is None or fresh.spec.run_policy.suspend:
+            return
+        fresh.spec.run_policy.suspend = True
+        fresh.metadata.annotations[QUOTA_SUSPENDED] = "true"
+        try:
+            self.store.update(fresh, check_version=False)
+            self.recorder.warning(fresh, "QuotaExceeded",
+                                  "suspended: profile quota exceeded")
+        except NotFoundError:
+            pass
+
+    def _resume(self, job: JAXJob) -> None:
+        # Only resume jobs WE suspended — a user's own suspend stays.
+        if not job.spec.run_policy.suspend or \
+                job.metadata.annotations.get(QUOTA_SUSPENDED) != "true":
+            return
+        fresh = self.store.try_get(JAXJob, job.metadata.name,
+                                   job.metadata.namespace)
+        if fresh is None or not fresh.spec.run_policy.suspend:
+            return
+        fresh.spec.run_policy.suspend = False
+        fresh.metadata.annotations.pop(QUOTA_SUSPENDED, None)
+        try:
+            self.store.update(fresh, check_version=False)
+            self.recorder.normal(fresh, "QuotaResumed",
+                                 "resumed: quota capacity available")
+        except NotFoundError:
+            pass
+
+
+def add_contributor(store: ObjectStore, profile_name: str, user: str) -> Profile:
+    """KFAM 'Manage Contributors' surface ((U) access-management
+    api/handler.go)."""
+    p = store.get(Profile, profile_name, "default")
+    if user not in p.spec.contributors:
+        p.spec.contributors.append(user)
+        store.update(p, check_version=False)
+    return p
+
+
+def remove_contributor(store: ObjectStore, profile_name: str, user: str) -> Profile:
+    p = store.get(Profile, profile_name, "default")
+    if user in p.spec.contributors:
+        p.spec.contributors.remove(user)
+        store.update(p, check_version=False)
+    return p
+
+
+def can_access(profile: Profile, user: str) -> bool:
+    return user == profile.spec.owner or user in profile.spec.contributors

@@ -1,0 +1,163 @@
+"""Platform surface: REST API server (CRUD, events, metrics, authz) and the
+CLI — the L6/L7 gateway analogs of SURVEY.md's layer map."""
+
+import json
+import urllib.request
+
+import pytest
+import yaml
+
+from kubeflow_tpu.core.jobs import JAXJob
+from kubeflow_tpu.core.object import ObjectMeta
+from kubeflow_tpu.core.workspace_specs import Profile, ProfileSpec
+from kubeflow_tpu.operator.control_plane import ControlPlane, ControlPlaneConfig
+from kubeflow_tpu.platform.api_server import ApiServer
+from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+
+JOB_MANIFEST = {
+    "apiVersion": "training.tpu.kubeflow.dev/v1",
+    "kind": "JAXJob",
+    "metadata": {"name": "api-job", "namespace": "default"},
+    "spec": {"replica_specs": {"worker": {
+        "replicas": 1,
+        "template": {"entrypoint": "noop"},
+        "resources": {"tpu_chips": 1}}}},
+}
+
+
+@pytest.fixture()
+def api(tmp_path):
+    cp = ControlPlane(ControlPlaneConfig(
+        base_dir=str(tmp_path),
+        cluster=Cluster(slices=[SliceTopology(name="s0", generation="v5e",
+                                              dims=(2, 2))]),
+        launch_processes=False,
+        metrics_sync_interval=None,
+    ))
+    server = ApiServer(cp, port=0)   # ephemeral port
+    server.start()
+    yield cp, server
+    server.stop()
+
+
+def call(server, method, path, body=None, user=None):
+    req = urllib.request.Request(server.url + path, data=body, method=method)
+    if user:
+        req.add_header("X-Kftpu-User", user)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            data = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+    return code, (json.loads(data) if "json" in ctype else data.decode())
+
+
+class TestApiServer:
+    def test_crud_round_trip(self, api):
+        cp, server = api
+        code, out = call(server, "POST", "/apis",
+                         json.dumps(JOB_MANIFEST).encode())
+        assert code == 200 and out["metadata"]["name"] == "api-job"
+        cp.step()   # controller picks it up
+        code, out = call(server, "GET", "/apis/jaxjobs?namespace=default")
+        assert code == 200 and len(out["items"]) == 1
+        code, out = call(server, "GET", "/apis/JAXJob/default/api-job")
+        assert code == 200
+        assert out["kind"] == "JAXJob"
+        code, out = call(server, "DELETE", "/apis/jaxjobs/default/api-job")
+        assert code == 200
+        assert cp.store.try_get(JAXJob, "api-job") is None
+
+    def test_yaml_manifest_accepted(self, api):
+        _, server = api
+        code, out = call(server, "POST", "/apis",
+                         yaml.safe_dump(JOB_MANIFEST).encode())
+        assert code == 200
+
+    def test_unknown_kind_and_missing(self, api):
+        _, server = api
+        assert call(server, "GET", "/apis/nonsense")[0] == 404
+        assert call(server, "GET", "/apis/jaxjobs/default/nope")[0] == 404
+        code, out = call(server, "POST", "/apis", b"kind: Bogus\n")
+        assert code == 400
+
+    def test_healthz_kinds_events(self, api):
+        cp, server = api
+        assert call(server, "GET", "/healthz")[1] == {"ok": True}
+        code, out = call(server, "GET", "/apis")
+        assert "JAXJob" in out["kinds"] and "Experiment" in out["kinds"]
+        call(server, "POST", "/apis", json.dumps(JOB_MANIFEST).encode())
+        cp.step()
+        code, out = call(server, "GET", "/events")
+        assert code == 200 and out["items"]
+
+    def test_metrics_endpoint(self, api):
+        cp, server = api
+        call(server, "POST", "/apis", json.dumps(JOB_MANIFEST).encode())
+        cp.step()
+        code, text = call(server, "GET", "/metrics")
+        assert code == 200
+        assert 'kftpu_objects{kind="JAXJob"' in text
+        assert "kftpu_chips_total 4" in text
+
+    def test_kfam_authz(self, api):
+        cp, server = api
+        cp.submit(Profile(metadata=ObjectMeta(name="team-a"),
+                          spec=ProfileSpec(owner="alice",
+                                           contributors=["bob"])))
+        manifest = dict(JOB_MANIFEST,
+                        metadata={"name": "j", "namespace": "team-a"})
+        body = json.dumps(manifest).encode()
+        assert call(server, "POST", "/apis", body, user="eve")[0] == 403
+        assert call(server, "POST", "/apis", body, user="bob")[0] == 200
+        assert call(server, "DELETE", "/apis/jaxjobs/team-a/j",
+                    user="eve")[0] == 403
+        assert call(server, "DELETE", "/apis/jaxjobs/team-a/j",
+                    user="alice")[0] == 200
+
+
+class TestCli:
+    def test_get_describe_metrics(self, api, capsys, tmp_path):
+        cp, server = api
+        from kubeflow_tpu import cli
+
+        mf = tmp_path / "job.yaml"
+        mf.write_text(yaml.safe_dump(JOB_MANIFEST))
+        assert cli.main(["apply", "-f", str(mf),
+                         "--server", server.url]) == 0
+        cp.step()
+        assert cli.main(["get", "jaxjobs", "--server", server.url]) == 0
+        out = capsys.readouterr().out
+        assert "api-job" in out
+        assert cli.main(["describe", "jaxjobs", "api-job",
+                         "--server", server.url]) == 0
+        out = capsys.readouterr().out
+        assert "JAXJob" in out and "Events:" in out
+        assert cli.main(["metrics", "--server", server.url]) == 0
+        assert "kftpu_objects" in capsys.readouterr().out
+        assert cli.main(["delete", "jaxjobs", "api-job",
+                         "--server", server.url]) == 0
+
+    def test_server_unreachable_is_friendly(self):
+        from kubeflow_tpu import cli
+
+        with pytest.raises(SystemExit, match="cannot reach"):
+            cli.main(["get", "jaxjobs", "--server", "http://127.0.0.1:1"])
+
+
+class TestCliRun:
+    def test_one_shot_run(self, tmp_path, capsys):
+        from kubeflow_tpu import cli
+
+        mf = tmp_path / "job.yaml"
+        mf.write_text(yaml.safe_dump({
+            **JOB_MANIFEST,
+            "metadata": {"name": "oneshot", "namespace": "default"},
+        }))
+        rc = cli.main(["run", "-f", str(mf), "--timeout", "60",
+                       "--base-dir", str(tmp_path / "state")])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "Succeeded" in out
