@@ -95,7 +95,10 @@ class TrialTemplate(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
     manifest: dict[str, Any]
-    primary_metric_source: str = "stdout"  # stdout|file|push
+    # file = worker-0's metrics.jsonl (the data plane's native stream, what
+    # every built-in trainer emits); stdout parses `name=value` log lines
+    # (katib StdOut analog); push reads the job's status.metrics.
+    primary_metric_source: str = "file"
     metrics_file: Optional[str] = None
 
 

@@ -51,6 +51,35 @@ class Notebook(ApiObject):
     status: NotebookStatus = Field(default_factory=NotebookStatus)
 
 
+class TensorboardSpec(BaseModel):
+    """Tensorboard-controller analog ((U) kubeflow/kubeflow components/
+    tensorboard-controller): serve a job's log/trace directory. The log dir
+    is typically a JAXJob's working dir (metrics.jsonl + jax.profiler
+    ``trace/`` output, viewable with tensorboard-plugin-profile)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    log_dir: str
+    port: int = 0                  # 0 = pick a free port
+
+
+class TensorboardStatus(ConditionMixin):
+    model_config = ConfigDict(extra="forbid")
+
+    phase: str = "Pending"         # Pending|Running|Failed
+    url: Optional[str] = None
+    pid: Optional[int] = None
+
+
+@register_kind
+class Tensorboard(ApiObject):
+    KIND = "Tensorboard"
+    API_VERSION = "workspace.tpu.kubeflow.dev/v1"
+
+    spec: TensorboardSpec
+    status: TensorboardStatus = Field(default_factory=TensorboardStatus)
+
+
 class QuotaSpec(BaseModel):
     """ResourceQuota analog: caps on what a profile's namespace may consume."""
 

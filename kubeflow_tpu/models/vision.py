@@ -1,0 +1,425 @@
+"""Vision models: ViT encoder + CLIP dual encoder — BASELINE config 4
+("ViT-L / CLIP via pipelines").
+
+TPU-first choices:
+- patchify is a reshape + one big matmul (not a conv): patches land on the
+  MXU as a single [B·N, P²·C]×[P²·C, D] contraction.
+- layers are stacked and traversed with `lax.scan` (depth-independent
+  compile), rematerialized like the decoder.
+- logical-axis sharding reuses parallel/sharding.py rules: batch over the
+  data axes, heads/mlp over ``model``, params' embed dim over ``fsdp``.
+- CLIP's contrastive loss contracts globally sharded feature matrices;
+  GSPMD inserts the all-gather over the data axes (the in-batch negatives
+  collective) — no hand-written collective needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.layers import _init
+from kubeflow_tpu.ops.attention import multi_head_attention
+from kubeflow_tpu.parallel.sharding import (
+    DEFAULT_RULES, LogicalRules, _is_spec_leaf, with_logical_constraint,
+)
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    """Hashable (jit-static) ViT architecture description."""
+
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    hidden: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    mlp_dim: int = 4096
+    num_classes: int = 1000       # classification head; 0 = feature output
+    pool: str = "cls"             # cls | gap
+    norm_eps: float = 1e-6
+    scan_layers: bool = True
+    remat_policy: str = "nothing_saveable"
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + (1 if self.pool == "cls" else 0)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    @property
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def weight_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+
+VIT_PRESETS: dict[str, ViTConfig] = {
+    # Public ViT-L/16 architecture (AN IMAGE IS WORTH 16x16 WORDS table 1).
+    "vit-l16": ViTConfig(hidden=1024, n_layers=24, n_heads=16, mlp_dim=4096),
+    "vit-b16": ViTConfig(hidden=768, n_layers=12, n_heads=12, mlp_dim=3072),
+    "tiny-vit": ViTConfig(image_size=32, patch_size=8, hidden=64, n_layers=2,
+                          n_heads=4, mlp_dim=128, num_classes=10),
+}
+
+
+def vit_preset(name: str, **overrides) -> ViTConfig:
+    return dataclasses.replace(VIT_PRESETS[name], **overrides)
+
+
+# -- layers ----------------------------------------------------------------------
+
+
+def _init_layernorm(cfg, dim: int):
+    return ({"scale": jnp.ones((dim,), cfg.weight_dtype),
+             "bias": jnp.zeros((dim,), cfg.weight_dtype)},
+            {"scale": ("norm",), "bias": ("norm",)})
+
+
+def _layernorm(p, x, eps: float):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _init_encoder_block(key, cfg: ViTConfig):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    d, h, hd = cfg.hidden, cfg.n_heads, cfg.head_dim
+    ln1, ln1_s = _init_layernorm(cfg, d)
+    ln2, ln2_s = _init_layernorm(cfg, d)
+    params = {
+        "ln1": ln1, "ln2": ln2,
+        "wqkv": _init(k1, (d, 3, h, hd), cfg.weight_dtype),
+        "wo": _init(k2, (h, hd, d), cfg.weight_dtype),
+        "w1": _init(k3, (d, cfg.mlp_dim), cfg.weight_dtype),
+        "b1": jnp.zeros((cfg.mlp_dim,), cfg.weight_dtype),
+        "w2": _init(k4, (cfg.mlp_dim, d), cfg.weight_dtype),
+        "b2": jnp.zeros((d,), cfg.weight_dtype),
+    }
+    specs = {
+        "ln1": ln1_s, "ln2": ln2_s,
+        "wqkv": ("embed", None, "heads", "head_dim"),
+        "wo": ("heads", "head_dim", "embed"),
+        "w1": ("embed", "mlp"),
+        "b1": ("mlp",),
+        "w2": ("mlp", "embed"),
+        "b2": ("norm",),
+    }
+    return params, specs
+
+
+def _encoder_block(p, x, cfg: ViTConfig, *, causal: bool = False,
+                   mesh=None, rules=DEFAULT_RULES):
+    dt = cfg.activation_dtype
+    h = _layernorm(p["ln1"], x, cfg.norm_eps)
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(dt))
+    out = multi_head_attention(qkv[0], qkv[1], qkv[2], causal=causal)
+    out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))
+    x = x + out
+    h = _layernorm(p["ln2"], x, cfg.norm_eps)
+    h = jax.nn.gelu(h @ p["w1"].astype(dt) + p["b1"].astype(dt))
+    x = x + (h @ p["w2"].astype(dt) + p["b2"].astype(dt))
+    if mesh is not None:
+        x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"),
+                                    mesh, rules)
+    return x
+
+
+def _remat(fn, policy: str):
+    if policy == "none":
+        return fn
+    if policy == "nothing_saveable":
+        return jax.checkpoint(fn, policy=jax.checkpoint_policies.nothing_saveable)
+    return jax.checkpoint(fn)
+
+
+def _encode(params, x, cfg: ViTConfig, *, causal=False, mesh=None,
+            rules=DEFAULT_RULES):
+    """Shared transformer-encoder trunk (scan over stacked blocks)."""
+    if cfg.scan_layers:
+        def body(carry, bp):
+            return _encoder_block(bp, carry, cfg, causal=causal, mesh=mesh,
+                                  rules=rules), None
+
+        x, _ = jax.lax.scan(_remat(body, cfg.remat_policy), x,
+                            params["layers"])
+    else:
+        for bp in params["layers"]:
+            x = _encoder_block(bp, x, cfg, causal=causal, mesh=mesh,
+                               rules=rules)
+    return _layernorm(params["final_ln"], x, cfg.norm_eps)
+
+
+# -- ViT -------------------------------------------------------------------------
+
+
+def init_vit_params(key: jax.Array, cfg: ViTConfig) -> Params:
+    k_patch, k_pos, k_layers, k_head = jax.random.split(key, 4)
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    if cfg.scan_layers:
+        layers = jax.vmap(lambda k: _init_encoder_block(k, cfg)[0])(layer_keys)
+    else:
+        layers = [_init_encoder_block(k, cfg)[0] for k in layer_keys]
+    final_ln, _ = _init_layernorm(cfg, cfg.hidden)
+    params: Params = {
+        "patch_embed": _init(k_patch, (patch_dim, cfg.hidden),
+                             cfg.weight_dtype),
+        "pos_embed": _init(k_pos, (cfg.seq_len, cfg.hidden),
+                           cfg.weight_dtype, scale=0.02),
+        "layers": layers,
+        "final_ln": final_ln,
+    }
+    if cfg.pool == "cls":
+        params["cls_token"] = jnp.zeros((cfg.hidden,), cfg.weight_dtype)
+    if cfg.num_classes:
+        params["head"] = _init(k_head, (cfg.hidden, cfg.num_classes),
+                               cfg.weight_dtype)
+    return params
+
+
+def vit_param_specs(cfg: ViTConfig) -> Params:
+    captured = {}
+
+    def _shape_only():
+        params, specs = _init_encoder_block(jax.random.PRNGKey(0), cfg)
+        captured["specs"] = specs
+        return params
+
+    jax.eval_shape(_shape_only)
+    block_specs = captured["specs"]
+    if cfg.scan_layers:
+        layer_specs = jax.tree.map(lambda s: ("layers",) + s, block_specs,
+                                   is_leaf=_is_spec_leaf)
+    else:
+        layer_specs = [block_specs] * cfg.n_layers
+    specs: Params = {
+        "patch_embed": (None, "embed"),
+        "pos_embed": (None, None),
+        "layers": layer_specs,
+        "final_ln": {"scale": ("norm",), "bias": ("norm",)},
+    }
+    if cfg.pool == "cls":
+        specs["cls_token"] = ("norm",)
+    if cfg.num_classes:
+        specs["head"] = ("embed", "vocab")
+    return specs
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, C] → [B, N, P²·C] without a conv (one reshape/transpose)."""
+    b, h, w, c = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def vit_forward(params: Params, images: jax.Array, cfg: ViTConfig, *,
+                mesh=None, rules: LogicalRules = DEFAULT_RULES) -> jax.Array:
+    """[B, H, W, C] images → [B, num_classes] logits (or [B, D] features)."""
+    dt = cfg.activation_dtype
+    x = patchify(images.astype(dt), cfg.patch_size)
+    x = x @ params["patch_embed"].astype(dt)
+    if cfg.pool == "cls":
+        cls = jnp.broadcast_to(params["cls_token"].astype(dt),
+                               (x.shape[0], 1, cfg.hidden))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(dt)
+    if mesh is not None:
+        x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"),
+                                    mesh, rules)
+    x = _encode(params, x, cfg, mesh=mesh, rules=rules)
+    feats = x[:, 0] if cfg.pool == "cls" else x.mean(axis=1)
+    if cfg.num_classes:
+        return jnp.einsum("bd,dv->bv", feats, params["head"].astype(dt),
+                          preferred_element_type=jnp.float32)
+    return feats
+
+
+def vit_loss(params: Params, batch: dict, cfg: ViTConfig, *,
+             mesh=None, rules: LogicalRules = DEFAULT_RULES):
+    """Cross-entropy classification. batch: {"images", "labels"}."""
+    logits = vit_forward(params, batch["images"], cfg, mesh=mesh, rules=rules)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    labels = batch["labels"]
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    loss = nll.mean()
+    metrics = {
+        "loss": loss,
+        "accuracy": (logits.argmax(-1) == labels).mean(),
+    }
+    return loss, metrics
+
+
+# -- CLIP ------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    """Dual encoder: ViT image tower + causal text tower + shared proj dim."""
+
+    image: ViTConfig = dataclasses.field(
+        default_factory=lambda: dataclasses.replace(
+            VIT_PRESETS["vit-l16"], num_classes=0))
+    text_vocab: int = 49408
+    text_len: int = 77
+    text_hidden: int = 768
+    text_layers: int = 12
+    text_heads: int = 12
+    text_mlp: int = 3072
+    proj_dim: int = 768
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    @property
+    def text_cfg(self) -> ViTConfig:
+        """The text tower reuses the encoder trunk config-shape."""
+        return ViTConfig(
+            hidden=self.text_hidden, n_layers=self.text_layers,
+            n_heads=self.text_heads, mlp_dim=self.text_mlp,
+            num_classes=0, scan_layers=True, dtype=self.dtype,
+            param_dtype=self.param_dtype)
+
+
+CLIP_PRESETS: dict[str, CLIPConfig] = {
+    "clip-l14": CLIPConfig(),
+    "tiny-clip": CLIPConfig(
+        image=ViTConfig(image_size=32, patch_size=8, hidden=64, n_layers=2,
+                        n_heads=4, mlp_dim=128, num_classes=0),
+        text_vocab=256, text_len=16, text_hidden=64, text_layers=2,
+        text_heads=4, text_mlp=128, proj_dim=32),
+}
+
+
+def clip_preset(name: str, **overrides) -> CLIPConfig:
+    return dataclasses.replace(CLIP_PRESETS[name], **overrides)
+
+
+def init_clip_params(key: jax.Array, cfg: CLIPConfig) -> Params:
+    ki, kt, ke, kpos, kp1, kp2 = jax.random.split(key, 6)
+    tcfg = cfg.text_cfg
+    layer_keys = jax.random.split(kt, tcfg.n_layers)
+    text_layers = jax.vmap(
+        lambda k: _init_encoder_block(k, tcfg)[0])(layer_keys)
+    final_ln, _ = _init_layernorm(tcfg, tcfg.hidden)
+    return {
+        "image": init_vit_params(ki, cfg.image),
+        "text": {
+            "embed": _init(ke, (cfg.text_vocab, tcfg.hidden),
+                           tcfg.weight_dtype, scale=0.02),
+            "pos_embed": _init(kpos, (cfg.text_len, tcfg.hidden),
+                               tcfg.weight_dtype, scale=0.01),
+            "layers": text_layers,
+            "final_ln": final_ln,
+        },
+        "img_proj": _init(kp1, (cfg.image.hidden, cfg.proj_dim),
+                          cfg.image.weight_dtype),
+        "txt_proj": _init(kp2, (tcfg.hidden, cfg.proj_dim),
+                          tcfg.weight_dtype),
+        # CLIP's learned temperature, initialized to 1/0.07 as in the paper.
+        "logit_scale": jnp.asarray(jnp.log(1.0 / 0.07), jnp.float32),
+    }
+
+
+def clip_param_specs(cfg: CLIPConfig) -> Params:
+    tcfg = cfg.text_cfg
+    text_block_specs = jax.tree.map(
+        lambda s: ("layers",) + s,
+        _encoder_block_specs(tcfg), is_leaf=_is_spec_leaf)
+    return {
+        "image": vit_param_specs(cfg.image),
+        "text": {
+            "embed": ("vocab", "embed_table"),
+            "pos_embed": (None, None),
+            "layers": text_block_specs,
+            "final_ln": {"scale": ("norm",), "bias": ("norm",)},
+        },
+        "img_proj": ("embed", None),
+        "txt_proj": ("embed", None),
+        "logit_scale": (),
+    }
+
+
+def _encoder_block_specs(cfg: ViTConfig):
+    captured = {}
+
+    def _shape_only():
+        params, specs = _init_encoder_block(jax.random.PRNGKey(0), cfg)
+        captured["specs"] = specs
+        return params
+
+    jax.eval_shape(_shape_only)
+    return captured["specs"]
+
+
+def clip_encode_image(params: Params, images: jax.Array, cfg: CLIPConfig, *,
+                      mesh=None, rules=DEFAULT_RULES) -> jax.Array:
+    feats = vit_forward(params["image"], images, cfg.image, mesh=mesh,
+                        rules=rules)
+    return feats @ params["img_proj"].astype(feats.dtype)
+
+
+def clip_encode_text(params: Params, tokens: jax.Array, cfg: CLIPConfig, *,
+                     mesh=None, rules=DEFAULT_RULES) -> jax.Array:
+    tcfg = cfg.text_cfg
+    dt = tcfg.activation_dtype
+    p = params["text"]
+    x = p["embed"].astype(dt)[tokens] + p["pos_embed"].astype(dt)
+    if mesh is not None:
+        x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"),
+                                    mesh, rules)
+    x = _encode(p, x, tcfg, causal=True, mesh=mesh, rules=rules)
+    # EOT pooling: the highest token id marks end-of-text (CLIP convention).
+    eot = tokens.argmax(axis=-1)
+    feats = jnp.take_along_axis(x, eot[:, None, None].repeat(x.shape[-1], -1),
+                                axis=1)[:, 0]
+    return feats @ params["txt_proj"].astype(feats.dtype)
+
+
+def clip_loss(params: Params, batch: dict, cfg: CLIPConfig, *,
+              mesh=None, rules=DEFAULT_RULES):
+    """Symmetric InfoNCE over the global batch. batch: {"images","tokens"}.
+
+    Under pjit the feature matrices are batch-sharded; the [B, B] similarity
+    einsum makes GSPMD all-gather the negatives over the data axes — the
+    TPU-native equivalent of torch.distributed all_gather in open_clip."""
+    img = clip_encode_image(params, batch["images"], cfg, mesh=mesh,
+                            rules=rules).astype(jnp.float32)
+    txt = clip_encode_text(params, batch["tokens"], cfg, mesh=mesh,
+                           rules=rules).astype(jnp.float32)
+    img = img / (jnp.linalg.norm(img, axis=-1, keepdims=True) + 1e-8)
+    txt = txt / (jnp.linalg.norm(txt, axis=-1, keepdims=True) + 1e-8)
+    scale = jnp.exp(jnp.clip(params["logit_scale"], -5.0, jnp.log(100.0)))
+    logits = scale * img @ txt.T                      # [B, B]
+    labels = jnp.arange(logits.shape[0])
+    li = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=1),
+                              labels[:, None], axis=1).mean()
+    lt = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=0),
+                              labels[None, :], axis=0).mean()
+    loss = (li + lt) / 2
+    metrics = {
+        "loss": loss,
+        "img_to_txt_acc": (logits.argmax(1) == labels).mean(),
+        "temperature": 1.0 / scale,
+    }
+    return loss, metrics

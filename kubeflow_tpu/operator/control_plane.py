@@ -84,6 +84,9 @@ class ControlPlane:
             self.store, recorder=self.recorder)
         from kubeflow_tpu.workspace.notebook_controller import NotebookController
         from kubeflow_tpu.workspace.profile_controller import ProfileController
+        from kubeflow_tpu.workspace.tensorboard_controller import (
+            TensorboardController,
+        )
 
         self.notebook_reconciler = NotebookController(
             self.store, base_dir=self.config.base_dir,
@@ -91,6 +94,9 @@ class ControlPlane:
             launch_processes=self.config.launch_processes)
         self.profile_reconciler = ProfileController(
             self.store, recorder=self.recorder)
+        self.tensorboard_reconciler = TensorboardController(
+            self.store, recorder=self.recorder,
+            launch_processes=self.config.launch_processes)
         self.controllers: list[Controller] = [
             Controller(self.store, self.jaxjob_reconciler, name="jaxjob"),
             Controller(self.store, self.isvc_reconciler, name="isvc"),
@@ -100,6 +106,7 @@ class ControlPlane:
             Controller(self.store, self.schedule_reconciler, name="schedule"),
             Controller(self.store, self.notebook_reconciler, name="notebook"),
             Controller(self.store, self.profile_reconciler, name="profile"),
+            Controller(self.store, self.tensorboard_reconciler, name="tensorboard"),
         ]
         self.runtime: Optional[WorkerRuntime] = None
         if self.config.launch_processes:
@@ -150,6 +157,7 @@ class ControlPlane:
         self.isvc_reconciler.shutdown()
         self.pipelinerun_reconciler.shutdown()
         self.notebook_reconciler.shutdown()
+        self.tensorboard_reconciler.shutdown()
 
     def step(self) -> int:
         """Deterministic single-threaded pump (test mode)."""

@@ -212,6 +212,11 @@ class ExitHandler(_Group):
         _require_trace("ExitHandler").pop_group(self)
 
 
+#: Live components by entrypoint string — lets the in-process executor run
+#: components whose qualname isn't importable (defined in function scope).
+component_registry: dict[str, "Component"] = {}
+
+
 class Component:
     def __init__(self, fn: Callable, *, name: Optional[str] = None,
                  cache: bool = True, resources: Optional[dict] = None):
@@ -227,6 +232,20 @@ class Component:
             if p.default is not inspect.Parameter.empty}
         self.outputs = _output_spec(sig.return_annotation)
         self.entrypoint = f"{fn.__module__}:{fn.__qualname__}"
+        # Only function-scoped components need the live registry (importable
+        # qualnames resolve via importlib); keeping module-level ones out
+        # bounds growth and avoids most collisions. Same-qualname locals
+        # still collide (last definition wins) — unavoidable with a string
+        # key, so flag it.
+        if "<locals>" in fn.__qualname__:
+            if self.entrypoint in component_registry:
+                import logging
+
+                logging.getLogger("kubeflow_tpu.pipelines").warning(
+                    "component %s redefined; pipelines compiled against the "
+                    "previous definition will run the new body",
+                    self.entrypoint)
+            component_registry[self.entrypoint] = self
 
     def __call__(self, *args, **kwargs):
         trace = _trace.get()

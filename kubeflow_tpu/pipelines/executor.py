@@ -304,6 +304,10 @@ class PipelineExecutor:
     def _resolve_component(self, name: str, entrypoint: str) -> Any:
         if name in self.components:
             return self.components[name]
+        from kubeflow_tpu.pipelines.dsl import component_registry
+
+        if entrypoint in component_registry:  # same-process definition
+            return component_registry[entrypoint]
         module, _, qual = entrypoint.partition(":")
         try:
             obj: Any = importlib.import_module(module)

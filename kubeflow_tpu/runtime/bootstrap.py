@@ -183,6 +183,24 @@ def bootstrap_worker(wenv: Optional[WorkerEnv] = None):
     return wenv, mesh
 
 
+def single_worker_mesh(wenv: Optional["WorkerEnv"], axis: str = "data"):
+    """Mesh for a compute entrypoint on the light-start path.
+
+    Single-worker jobs with no parallelism skip the jax setup in
+    bootstrap_worker (fast start for control-plane probes); an entrypoint
+    that DOES compute calls this to apply the same platform selection and
+    get a 1-axis local mesh."""
+    import jax
+
+    if wenv is not None and wenv.platform == "cpu":
+        # The axon sitecustomize force-sets jax_platforms; the env var alone
+        # cannot override it (same dance as bootstrap_worker).
+        jax.config.update("jax_platforms", "cpu")
+    from kubeflow_tpu.runtime.mesh import build_mesh
+
+    return build_mesh({axis: jax.local_device_count()})
+
+
 def free_port() -> int:
     import socket
 

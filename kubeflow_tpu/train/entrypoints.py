@@ -12,6 +12,60 @@ import os
 from kubeflow_tpu.runtime.entrypoints import WorkerContext, register_entrypoint
 
 
+@register_entrypoint("vision_train")
+def vision_train(ctx: WorkerContext) -> int:
+    """ViT classification / CLIP contrastive training (BASELINE config 4:
+    'ViT-L / CLIP via pipelines'). Config: {"family": "vit"|"clip",
+    "model": preset, "steps", "batch", "optimizer": {...}}."""
+    import jax
+
+    from kubeflow_tpu.models.vision import clip_preset, vit_preset
+    from kubeflow_tpu.train.optim import OptimizerConfig
+    from kubeflow_tpu.train.vision_task import (
+        clip_batch, setup_clip_train, setup_vit_train, vit_batch,
+    )
+
+    cfg = ctx.config
+    family = cfg.get("family", "vit")
+    steps = int(cfg.get("steps", 20))
+    batch = int(cfg.get("batch", 8))
+    opt = OptimizerConfig.from_dict(
+        {"total_steps": steps, "warmup_steps": 0, **cfg.get("optimizer", {})})
+    mesh = ctx.mesh
+    if mesh is None:
+        from kubeflow_tpu.runtime.bootstrap import single_worker_mesh
+
+        mesh = single_worker_mesh(ctx.env, axis="data")
+    overrides = dict(cfg.get("model_overrides", {}))
+    if family == "vit":
+        mcfg = vit_preset(cfg.get("model", "tiny-vit"), **overrides)
+        task = setup_vit_train(mcfg, opt, mesh)
+        batch_fn = lambda step: vit_batch(mcfg, batch, step)  # noqa: E731
+    elif family == "clip":
+        mcfg = clip_preset(cfg.get("model", "tiny-clip"), **overrides)
+        task = setup_clip_train(mcfg, opt, mesh)
+        batch_fn = lambda step: clip_batch(mcfg, batch, step)  # noqa: E731
+    else:
+        raise ValueError(f"unknown vision family {family!r}")
+
+    from kubeflow_tpu.train.metrics import MetricsEmitter
+
+    emitter = MetricsEmitter(
+        jsonl_path=(os.path.join(ctx.env.workdir, "metrics.jsonl")
+                    if ctx.env.workdir and ctx.is_coordinator else None))
+    log_every = int(cfg.get("log_every", 1))
+    state = task.state
+    for step in range(steps):
+        b = jax.device_put(batch_fn(step), task.batch_shardings)
+        state, metrics = task.step_fn(state, b)
+        # Only sync device→host on logging steps (async dispatch otherwise).
+        if ctx.is_coordinator and ((step + 1) % log_every == 0
+                                   or step + 1 == steps):
+            emitter.emit(step, {k: float(v) for k, v in metrics.items()})
+    emitter.close()
+    return 0
+
+
 @register_entrypoint("llm_pretrain")
 def llm_pretrain(ctx: WorkerContext) -> int:
     import jax
@@ -21,9 +75,9 @@ def llm_pretrain(ctx: WorkerContext) -> int:
     cfg = TrainerConfig.from_dict(ctx.config)
     mesh = ctx.mesh
     if mesh is None:
-        from kubeflow_tpu.runtime.mesh import build_mesh
+        from kubeflow_tpu.runtime.bootstrap import single_worker_mesh
 
-        mesh = build_mesh({"fsdp": jax.device_count()})
+        mesh = single_worker_mesh(ctx.env, axis="fsdp")
     metrics_path = None
     if ctx.env.workdir:
         metrics_path = os.path.join(ctx.env.workdir, "metrics.jsonl")

@@ -52,6 +52,7 @@ class MetricsEmitter:
 
     def __init__(self, jsonl_path: Optional[str] = None, stream: Optional[TextIO] = None):
         self.stream = stream or sys.stdout
+        self.jsonl_path = jsonl_path
         self.jsonl = open(jsonl_path, "a") if jsonl_path else None
 
     def emit(self, step: int, metrics: dict) -> None:

@@ -41,6 +41,11 @@ class TrainerConfig:
     seed: int = 0
     attn_impl: str = "xla"
     generation: str = "v5e"                   # hardware gen for MFU math
+    # jax.profiler window (SURVEY.md §5 tracing): trace steps
+    # [profile_start_step, profile_start_step + profile_num_steps) into
+    # <workdir>/trace, viewable with tensorboard-plugin-profile.
+    profile_start_step: Optional[int] = None
+    profile_num_steps: int = 3
 
     @classmethod
     def from_dict(cls, d: dict) -> "TrainerConfig":
@@ -122,7 +127,18 @@ class Trainer:
         start = self.try_resume()
         last_metrics: dict = {}
         last_tick_step = start
+        prof = self.cfg.profile_start_step
+        tracing = False
         for step in range(start, self.cfg.steps):
+            if prof is not None and self.process_id == 0:
+                # `tracing` guards both ends: a resume that lands inside or
+                # past the window must not stop a trace it never started.
+                if step == prof:
+                    jax.profiler.start_trace(self._trace_dir())
+                    tracing = True
+                elif tracing and step >= prof + self.cfg.profile_num_steps:
+                    jax.profiler.stop_trace()
+                    tracing = False
             batch = self.make_global_batch(self.data.batch_at(step))
             self.task.state, metrics = self.task.step_fn(self.task.state, batch)
             if (step + 1) % self.cfg.log_every == 0 or step + 1 == self.cfg.steps:
@@ -136,6 +152,8 @@ class Trainer:
                 self.save(step + 1)
             if on_step is not None:
                 on_step(step + 1, last_metrics)
+        if tracing:
+            jax.profiler.stop_trace()   # window ran past the last step
         if self.ckpt is not None:
             if self.ckpt.latest_step() != self.cfg.steps:
                 self.save(self.cfg.steps, force=True)
@@ -143,3 +161,10 @@ class Trainer:
             self.ckpt.close()
         self.emitter.close()
         return last_metrics
+
+    def _trace_dir(self) -> str:
+        import os
+
+        base = (os.path.dirname(self.emitter.jsonl_path)
+                if getattr(self.emitter, "jsonl_path", None) else ".")
+        return os.path.join(base, "trace")

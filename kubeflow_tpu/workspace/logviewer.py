@@ -1,0 +1,96 @@
+"""Minimal log/metrics viewer: ``python -m kubeflow_tpu.workspace.logviewer``.
+
+Fallback server for the Tensorboard analog when the tensorboard package is
+unusable (UI parity is a non-goal beyond status surfaces — SURVEY.md §2.1).
+Serves a job workdir over HTTP:
+
+- ``GET /``                      file listing (JSON)
+- ``GET /scalars``               metrics.jsonl parsed into per-metric series
+- ``GET /files/<relpath>``       raw file bytes (trace dumps, logs)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote, urlparse
+
+
+def make_handler(logdir: str):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, code, body, ctype="application/json"):
+            data = body if isinstance(body, bytes) else json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            path = urlparse(self.path).path
+            if path == "/":
+                files = []
+                for root, _, names in os.walk(logdir):
+                    for n in names:
+                        full = os.path.join(root, n)
+                        files.append({
+                            "path": os.path.relpath(full, logdir),
+                            "bytes": os.path.getsize(full)})
+                return self._send(200, {"logdir": logdir, "files": files})
+            if path == "/scalars":
+                series: dict[str, list] = {}
+                try:
+                    with open(os.path.join(logdir, "metrics.jsonl")) as f:
+                        for i, line in enumerate(f):
+                            try:
+                                rec = json.loads(line)
+                            except ValueError:
+                                continue
+                            if not isinstance(rec, dict):
+                                continue
+                            step = rec.get("step", i)
+                            for k, v in rec.items():
+                                if k != "step" and isinstance(v, (int, float)):
+                                    series.setdefault(k, []).append([step, v])
+                except OSError:
+                    pass
+                return self._send(200, {"scalars": series})
+            if path.startswith("/files/"):
+                rel = unquote(path[len("/files/"):])
+                full = os.path.realpath(os.path.join(logdir, rel))
+                if not full.startswith(os.path.realpath(logdir) + os.sep):
+                    return self._send(403, {"error": "outside logdir"})
+                try:
+                    with open(full, "rb") as f:
+                        return self._send(200, f.read(),
+                                          "application/octet-stream")
+                except OSError:
+                    return self._send(404, {"error": "not found"})
+            self._send(404, {"error": "no route"})
+
+    return Handler
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--logdir", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args()
+    srv = ThreadingHTTPServer((args.host, args.port),
+                              make_handler(args.logdir))
+    try:
+        srv.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

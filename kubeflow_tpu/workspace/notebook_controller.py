@@ -125,10 +125,11 @@ class NotebookController:
                 "PYTHONPATH": (f"{pkg_root}:{pythonpath}" if pythonpath
                                else pkg_root),
             }
-            log = open(os.path.join(d, "session.log"), "ab")
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "kubeflow_tpu.workspace.session_main"],
-                env=full_env, stdout=log, stderr=log)
+            with open(os.path.join(d, "session.log"), "ab") as log:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "kubeflow_tpu.workspace.session_main"],
+                    env=full_env, stdout=log, stderr=log)
             self._procs[key] = proc
             nb.status.pid = proc.pid
         nb.status.phase = "Running"

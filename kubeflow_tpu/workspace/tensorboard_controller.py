@@ -1,0 +1,138 @@
+"""Tensorboard reconciler: serve a log/trace directory.
+
+((U) kubeflow/kubeflow components/tensorboard-controller
+controllers/tensorboard_controller.go; SURVEY.md §2.1#5.) Spawns
+``python -m tensorboard.main --logdir ...`` against a job's working dir —
+where the trainer writes metrics.jsonl and the jax.profiler ``trace/``
+window (tensorboard-plugin-profile reads the latter). The process is
+reaped with the object.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+from typing import Optional
+
+from kubeflow_tpu.core.events import EventRecorder
+from kubeflow_tpu.core.store import NotFoundError, ObjectStore, WatchEvent
+from kubeflow_tpu.core.workspace_specs import Tensorboard
+from kubeflow_tpu.operator.controller import ReconcileResult
+
+logger = logging.getLogger("kubeflow_tpu.workspace")
+
+
+def _tensorboard_available() -> bool:
+    try:
+        import tensorboard  # noqa: F401
+        # tensorboard.main needs pkg_resources (setuptools); probe both so a
+        # broken install falls back to the built-in viewer cleanly.
+        import pkg_resources  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TensorboardController:
+    kinds = ["Tensorboard"]
+
+    def __init__(self, store: ObjectStore, *,
+                 recorder: Optional[EventRecorder] = None,
+                 launch_processes: bool = True,
+                 poll_interval: float = 5.0):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.launch_processes = launch_processes
+        self.poll_interval = poll_interval
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    def key_for(self, ev: WatchEvent) -> Optional[str]:
+        obj = ev.object
+        if obj.kind == "Tensorboard":
+            return f"{obj.metadata.namespace}/{obj.metadata.name}"
+        return None
+
+    def reconcile(self, key: str) -> Optional[ReconcileResult]:
+        tb = self.store.try_get(Tensorboard, key.split("/", 1)[1],
+                                key.split("/", 1)[0])
+        if tb is None:
+            self._teardown(key)
+            return None
+        if tb.status.phase == "Running":
+            proc = self._procs.get(key)
+            if self.launch_processes and proc is not None \
+                    and proc.poll() is not None:
+                tb.status.phase = "Failed"
+                tb.status.set_condition("Running", False, reason="Exited",
+                                        message=f"exit {proc.returncode}")
+                self._procs.pop(key, None)
+                self._update(tb)
+            return ReconcileResult(requeue_after=self.poll_interval)
+        if tb.status.phase == "Failed":
+            return None
+        # Pending → start
+        if not os.path.isdir(tb.spec.log_dir):
+            tb.status.set_condition("Running", False, reason="LogDirMissing",
+                                    message=tb.spec.log_dir)
+            self._update(tb)
+            return ReconcileResult(requeue_after=self.poll_interval)
+        port = tb.spec.port or _free_port()
+        if self.launch_processes:
+            if _tensorboard_available():
+                module, reason = "tensorboard.main", "Started"
+            else:
+                # Built-in viewer fallback: scalar series + trace files over
+                # HTTP — the status surface survives a broken tb install.
+                module, reason = "kubeflow_tpu.workspace.logviewer", \
+                    "StartedBuiltinViewer"
+            pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            env = {**os.environ,
+                   "PYTHONPATH": pkg_root + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")}
+            with open(os.path.join(tb.spec.log_dir, "tensorboard.log"),
+                      "ab") as log:   # child keeps its own duplicated fd
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", module,
+                     "--logdir", tb.spec.log_dir,
+                     "--port", str(port), "--host", "127.0.0.1"],
+                    stdout=log, stderr=log, env=env)
+            self._procs[key] = proc
+            tb.status.pid = proc.pid
+            self.recorder.normal(tb, reason, module)
+        tb.status.phase = "Running"
+        tb.status.url = f"http://127.0.0.1:{port}"
+        tb.status.set_condition("Running", True, reason="Started")
+        self.recorder.normal(tb, "Started", tb.status.url)
+        self._update(tb)
+        return ReconcileResult(requeue_after=self.poll_interval)
+
+    def _teardown(self, key: str) -> None:
+        proc = self._procs.pop(key, None)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def shutdown(self) -> None:
+        for key in list(self._procs):
+            self._teardown(key)
+
+    def _update(self, tb: Tensorboard) -> None:
+        try:
+            self.store.update_status(tb)
+        except NotFoundError:
+            pass

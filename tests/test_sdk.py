@@ -1,0 +1,98 @@
+"""SDK client surface (TrainingClient/KatibClient/kfp.Client analogs) +
+Tensorboard controller — the remaining L6 parity items."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.core.object import ObjectMeta
+from kubeflow_tpu.core.workspace_specs import (
+    Tensorboard, TensorboardSpec,
+)
+from kubeflow_tpu.sdk import Client
+
+
+@pytest.fixture()
+def client(tmp_path):
+    c = Client.local(base_dir=str(tmp_path), num_chips=4)
+    yield c
+    c.shutdown()
+
+
+class TestTrainingClient:
+    def test_create_wait_logs_delete(self, client):
+        client.create_job("probe", entrypoint="objective_probe",
+                          config={"x": 0.1, "y": 0.2, "steps": 2}, workers=2)
+        job = client.wait_for_job_conditions("probe", timeout=60)
+        assert job.status.has_condition("Succeeded")
+        assert client.get_job_logs("probe") != ""
+        client.delete_job("probe")
+        assert client.get_job("probe") is None
+
+    def test_failed_job_raises(self, client):
+        client.create_job("boom", entrypoint="fail",
+                          config={"exit_code": 3}, backoff_limit=0)
+        with pytest.raises(RuntimeError, match="failed"):
+            client.wait_for_job_conditions("boom", timeout=60)
+
+    def test_train_high_level(self, client):
+        job = client.train("mini", model="tiny",
+                           model_overrides={"max_seq_len": 64},
+                           steps=3, checkpoint=False,
+                           optimizer={"warmup_steps": 0},
+                           data={"global_batch": 4, "seq_len": 64},
+                           wait=True, timeout=180)
+        assert job.status.has_condition("Succeeded")
+        assert job.status.metrics.loss is not None
+
+
+class TestPipelineClient:
+    def test_upload_and_run(self, client):
+        from kubeflow_tpu.pipelines import dsl
+
+        @dsl.component
+        def double_it(x: int) -> int:
+            return 2 * x
+
+        @dsl.pipeline(name="sdk-pipe")
+        def p(x: int = 21):
+            double_it(x=x)
+
+        client.upload_pipeline(p)
+        run = client.create_run("sdk-pipe", run_name="r1", wait=True,
+                                timeout=60)
+        assert run.status.tasks["double_it"].outputs["output"] == 42
+
+
+class TestTensorboard:
+    def test_serves_logdir(self, client, tmp_path):
+        logdir = tmp_path / "logs"
+        logdir.mkdir()
+        (logdir / "metrics.jsonl").write_text('{"step":1,"loss":1.0}\n')
+        client.apply(Tensorboard(
+            metadata=ObjectMeta(name="tb"),
+            spec=TensorboardSpec(log_dir=str(logdir))))
+        deadline = time.time() + 30
+        tb = None
+        while time.time() < deadline:
+            tb = client.cp.store.try_get(Tensorboard, "tb")
+            if tb is not None and tb.status.phase in ("Running", "Failed"):
+                break
+            time.sleep(0.2)
+        assert tb is not None and tb.status.phase == "Running", \
+            (tb.status.phase, tb.status.conditions)
+        assert tb.status.url.startswith("http://127.0.0.1:")
+        assert tb.status.pid is not None
+
+    def test_missing_logdir_reported(self, client):
+        client.apply(Tensorboard(
+            metadata=ObjectMeta(name="tb2"),
+            spec=TensorboardSpec(log_dir="/nonexistent/dir")))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            tb = client.cp.store.try_get(Tensorboard, "tb2")
+            if tb is not None and tb.status.get_condition("Running"):
+                break
+            time.sleep(0.2)
+        cond = tb.status.get_condition("Running")
+        assert cond is not None and cond.reason == "LogDirMissing"
