@@ -1,0 +1,109 @@
+"""Serving benchmark: continuous-batching req/s + TTFT/TPOT percentiles.
+
+BASELINE config 2 evidence ("KServe req/s + p50 TTFT, v5e"): drives the
+LLMEngine with a closed-loop client pool and prints one JSON line. The
+driver's headline bench stays bench.py (training); run this by hand:
+
+    python bench_serve.py [--requests 64] [--concurrency 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+
+def run_bench(requests: int, concurrency: int, prompt_len: int,
+              max_new: int) -> dict:
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.core.serving import BatchingSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.serve.engine import LLMEngine, SamplingParams
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = preset(
+            "llama3-8b",
+            n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
+            mlp_dim=8192, vocab_size=32000, max_seq_len=2048)
+        model_tag = "llama3-0.6b"
+    else:
+        cfg = preset("tiny")
+        model_tag = "tiny"
+        prompt_len = min(prompt_len, 64)
+
+    engine = LLMEngine(cfg, BatchingSpec(
+        max_batch_size=min(16, concurrency), max_seq_len=cfg.max_seq_len,
+        prefill_buckets=[prompt_len]))
+    engine.start()
+
+    rng = np.random.default_rng(0)
+    params = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+    results = []
+    lock = threading.Lock()
+
+    def client(n_requests: int):
+        for _ in range(n_requests):
+            prompt = rng.integers(1, cfg.vocab_size,
+                                  size=prompt_len).tolist()
+            t0 = time.perf_counter()
+            req = engine.submit(prompt, params)
+            first = None
+            tokens = 0
+            while True:
+                tok = req.stream.get()
+                if tok is None:
+                    break
+                tokens += 1
+                if first is None:
+                    first = time.perf_counter() - t0
+            with lock:
+                results.append((first, time.perf_counter() - t0, tokens))
+
+    concurrency = max(1, min(concurrency, requests))
+    # Distribute the remainder so exactly `requests` requests run.
+    base, extra = divmod(requests, concurrency)
+    counts = [base + (1 if i < extra else 0) for i in range(concurrency)]
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in counts if c > 0]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    engine.stop()
+
+    ttfts = sorted(r[0] for r in results if r[0] is not None)
+    totals = [r[1] for r in results]
+    tokens = sum(r[2] for r in results)
+    p = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))]  # noqa: E731
+    return {
+        "metric": f"serve_req_per_sec[{model_tag},prompt{prompt_len},"
+                  f"gen{max_new},c{concurrency}]",
+        "value": round(len(results) / wall, 2),
+        "unit": "req/s",
+        "vs_baseline": 1.0,
+        "detail": {
+            "p50_ttft_ms": round(p(ttfts, 0.5) * 1e3, 1),
+            "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 1),
+            "mean_total_ms": round(sum(totals) / len(totals) * 1e3, 1),
+            "decode_tokens_per_sec": round(tokens / wall, 1),
+            "requests": len(results),
+        },
+    }
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--prompt-len", type=int, default=512)
+    ap.add_argument("--max-new", type=int, default=64)
+    args = ap.parse_args()
+    print(json.dumps(run_bench(args.requests, args.concurrency,
+                               args.prompt_len, args.max_new)))

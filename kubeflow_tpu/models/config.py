@@ -38,6 +38,9 @@ class DecoderConfig:
     # compile-time policy
     scan_layers: bool = True
     remat_policy: str = "nothing_saveable"   # none | nothing_saveable | full
+    # Sequence-chunked cross-entropy: never materialize [B,S,V] logits
+    # (0 = off). Big win at large vocab; numerics identical.
+    loss_chunk_size: int = 0
     dtype: str = "bfloat16"        # activation/compute dtype
     param_dtype: str = "float32"
 
@@ -89,11 +92,13 @@ PRESETS: dict[str, DecoderConfig] = {
     "llama3-8b": DecoderConfig(
         vocab_size=128256, hidden=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         head_dim=128, mlp_dim=14336, max_seq_len=8192, rope_theta=500000.0,
+        loss_chunk_size=512,
     ),
     # Llama-3-70B-class (for sharding dry-runs only)
     "llama3-70b": DecoderConfig(
         vocab_size=128256, hidden=8192, n_layers=80, n_heads=64, n_kv_heads=8,
         head_dim=128, mlp_dim=28672, max_seq_len=8192, rope_theta=500000.0,
+        loss_chunk_size=512,
     ),
     # Gemma-2B (public card: 18L, 2048h, 8 heads / 1 kv, head_dim 256, gelu,
     # 256k vocab, tied embeddings, embedding scale, (1+w) norms)
@@ -101,7 +106,7 @@ PRESETS: dict[str, DecoderConfig] = {
         vocab_size=256128, hidden=2048, n_layers=18, n_heads=8, n_kv_heads=1,
         head_dim=256, mlp_dim=16384, max_seq_len=8192, rope_theta=10000.0,
         hidden_act="gelu", tie_embeddings=True, norm_plus_one=True,
-        embed_scale=True,
+        embed_scale=True, loss_chunk_size=512,
     ),
     # Mixtral-8x7B (public card: 32L, 4096h, 32/8 heads, 14336 mlp, 8 experts top-2)
     "mixtral-8x7b": DecoderConfig(

@@ -137,8 +137,11 @@ def decoder_forward(
     attn_impl: str = "xla",
     mesh=None,
     rules: LogicalRules = DEFAULT_RULES,
+    skip_head: bool = False,
 ):
-    """Returns (logits [B,S,V] float32, new_kv_caches|None, aux_loss)."""
+    """Returns (logits [B,S,V] float32, new_kv_caches|None, aux_loss).
+    With ``skip_head``, returns the final-norm hidden states [B,S,D] instead
+    of logits (the chunked-CE loss applies the head blockwise)."""
     if positions is None:
         # Decode with a cache: absolute positions continue from the cache
         # length (RoPE angles and the causal mask must agree on the offset).
@@ -223,6 +226,8 @@ def decoder_forward(
                           "len": kv_caches["len"] + tokens.shape[1]}
 
     x = L.rmsnorm(x, params["final_norm"], cfg)
+    if skip_head:
+        return x, new_caches, aux_total
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dt),
                         preferred_element_type=jnp.float32)
@@ -278,6 +283,37 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
     return out["x"]
 
 
+def _chunked_ce(hidden: jax.Array, head: jax.Array, targets: jax.Array,
+                cfg: DecoderConfig):
+    """Blockwise softmax-CE: scan over sequence chunks so only
+    [B, chunk, V] logits are live at once. Under remat the backward
+    recomputes per chunk (same O(S·V) flops, O(chunk·V) memory).
+    Returns (nll [B,S] f32, correct [B,S] f32)."""
+    b, s, d = hidden.shape
+    chunk = min(cfg.loss_chunk_size, s)
+    if s % chunk:
+        chunk = s  # odd tails: fall back to one chunk
+    n = s // chunk
+    h = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)      # [n,B,c,D]
+    t = targets.reshape(b, n, chunk).swapaxes(0, 1)        # [n,B,c]
+
+    @jax.checkpoint
+    def body(_, ht):
+        hc, tc = ht
+        logits = jnp.einsum("bcd,dv->bcv", hc, head,
+                            preferred_element_type=jnp.float32)
+        if cfg.logits_softcap is not None:
+            logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        correct = (logits.argmax(-1) == tc).astype(jnp.float32)
+        return None, (logz - picked, correct)
+
+    _, (nll, correct) = jax.lax.scan(body, None, (h, t))
+    return (nll.swapaxes(0, 1).reshape(b, s),
+            correct.swapaxes(0, 1).reshape(b, s))
+
+
 def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int) -> dict:
     """Contiguous decode cache, stacked over layers."""
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
@@ -299,12 +335,26 @@ def decoder_loss(
     rules: LogicalRules = DEFAULT_RULES,
     aux_loss_weight: float = 0.01,
 ):
-    """Next-token cross-entropy in fp32. Returns (loss, metrics)."""
+    """Next-token cross-entropy in fp32. Returns (loss, metrics).
+
+    When ``cfg.loss_chunk_size`` is set, the [B,S,V] logits tensor is never
+    materialized: hidden states stream through the head + softmax in
+    sequence chunks (HBM traffic drops by O(S·V) — the usual LLM-training
+    memory hog at large vocab)."""
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, _, aux = decoder_forward(
-        params, inputs, cfg, attn_impl=attn_impl, mesh=mesh, rules=rules)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if cfg.loss_chunk_size:
+        hidden, _, aux = decoder_forward(
+            params, inputs, cfg, attn_impl=attn_impl, mesh=mesh, rules=rules,
+            skip_head=True)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        nll, correct = _chunked_ce(hidden, head.astype(hidden.dtype), targets,
+                                   cfg)
+    else:
+        logits, _, aux = decoder_forward(
+            params, inputs, cfg, attn_impl=attn_impl, mesh=mesh, rules=rules)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        correct = (logits.argmax(-1) == targets).astype(jnp.float32)
     if loss_mask is None:
         loss_mask = jnp.ones_like(nll)
     denom = jnp.maximum(loss_mask.sum(), 1.0)
@@ -314,6 +364,6 @@ def decoder_loss(
         "ce_loss": ce,
         "aux_loss": aux,
         "tokens": denom,
-        "accuracy": ((logits.argmax(-1) == targets) * loss_mask).sum() / denom,
+        "accuracy": (correct * loss_mask).sum() / denom,
     }
     return loss, metrics

@@ -46,6 +46,9 @@ class TrainerConfig:
     # <workdir>/trace, viewable with tensorboard-plugin-profile.
     profile_start_step: Optional[int] = None
     profile_num_steps: int = 3
+    # Debug mode (SURVEY.md §5 race-detection analogs): trap NaNs at the op
+    # that produced them instead of surfacing as a corrupted loss later.
+    debug_nans: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "TrainerConfig":
@@ -62,6 +65,8 @@ class Trainer:
         self.process_id = process_id
         self.num_processes = num_processes
 
+        if cfg.debug_nans:
+            jax.config.update("jax_debug_nans", True)
         self.model_cfg: DecoderConfig = preset(cfg.model, **cfg.model_overrides)
         opt_cfg = OptimizerConfig.from_dict(
             {"total_steps": cfg.steps, **cfg.optimizer})

@@ -413,10 +413,102 @@ class Hyperband(Suggester):
         return out, state
 
 
+class PBT(Suggester):
+    """Population-based training, hyperparameter-evolution form ((U) katib
+    pkg/suggestion/v1beta1/pbt). A population trains per generation; the
+    bottom truncation quantile exploits (copies a top member's params) and
+    explores (perturbs continuous dims by a random factor, occasionally
+    resampling). Weight inheritance is the trial template's job (trials can
+    resume a checkpoint path parameter); the suggester evolves the params."""
+
+    name = "pbt"
+
+    #: synthetic assignment key distinguishing generations: a survivor's next
+    #: segment keeps its hyperparams but must be a NEW trial (katib PBT
+    #: resumes the checkpoint; the tag keeps observation keys unique).
+    GEN_KEY = "_pbt_generation"
+
+    def suggest(self, n, history, state):
+        state = dict(state)
+        pop = int(self.settings.get("population_size", 8))
+        trunc = float(self.settings.get("truncation", 0.25))
+        resample_p = float(self.settings.get("resample_prob", 0.25))
+        factors = list(self.settings.get("perturb_factors", [0.8, 1.25]))
+        state.setdefault("gen", 0)
+        state.setdefault("asked", [])
+        by_key = {param_key(o.parameters): o for o in history}
+        asked: list[str] = list(state["asked"])
+        out: list[dict[str, Any]] = []
+
+        # Generation finished → evolve the next one.
+        if len(asked) >= pop and all(
+                k in by_key and by_key[k].completed for k in asked):
+            rng = _rng(state, self.seed)
+            scored = sorted(
+                (by_key[k] for k in asked),
+                key=lambda o: o.value if o.value is not None and not o.failed
+                else float("inf"))
+            k_cut = max(1, int(len(scored) * trunc))
+            top, bottom = scored[:k_cut], scored[-k_cut:]
+            survivors = scored[:-k_cut] if k_cut < len(scored) else scored
+            # Survivors continue with their params; losers exploit+explore.
+            nxt = [dict(o.parameters) for o in survivors]
+            for _ in bottom:
+                parent = top[int(rng.integers(0, len(top)))]
+                nxt.append(self._explore(dict(parent.parameters), rng,
+                                         resample_p, factors))
+            state["gen"] += 1
+            state["next_population"] = nxt
+            asked = []
+
+        pending = state.pop("next_population", None)
+        while len(out) < n and len(asked) < pop:
+            if pending:
+                params = pending.pop(0)
+            else:
+                rng = _rng(state, self.seed)
+                params = ss.sample(self.specs, rng)
+            params[self.GEN_KEY] = state["gen"]
+            # Intra-generation duplicates (possible in small discrete spaces)
+            # get a bounded nudge; an irreducible duplicate is accepted —
+            # termination over uniqueness.
+            for _ in range(16):
+                if param_key(params) not in by_key \
+                        and param_key(params) not in asked:
+                    break
+                rng = _rng(state, self.seed)
+                params = self._explore(params, rng, resample_p, factors)
+                params[self.GEN_KEY] = state["gen"]
+            out.append(params)
+            asked.append(param_key(params))
+        if pending:
+            state["next_population"] = pending
+        state["asked"] = asked
+        return out, state
+
+    def _explore(self, params: dict[str, Any], rng, resample_p: float,
+                 factors: list[float]) -> dict[str, Any]:
+        """Perturb known parameter dims (the GEN_KEY tag passes through)."""
+        from kubeflow_tpu.core.tuning import ParameterType
+
+        out = dict(params)
+        for spec in self.specs:
+            if rng.random() < resample_p:
+                out[spec.name] = ss.sample([spec], rng)[spec.name]
+                continue
+            if spec.type in (ParameterType.DOUBLE, ParameterType.INT):
+                f = factors[int(rng.integers(0, len(factors)))]
+                out[spec.name] = ss.from_unit(
+                    spec, ss.to_unit(spec, out[spec.name] * f)
+                    if not spec.feasible_space.log_scale
+                    else ss.to_unit(spec, max(out[spec.name] * f, 1e-30)))
+        return out
+
+
 _ALGORITHMS = {
     cls.name: cls
     for cls in (RandomSearch, GridSearch, TPE, GPExpectedImprovement,
-                CMAES, Hyperband)
+                CMAES, Hyperband, PBT)
 }
 # Katib-compatible aliases.
 _ALGORITHMS["bayesianoptimization"] = GPExpectedImprovement

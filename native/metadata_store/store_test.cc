@@ -1,0 +1,161 @@
+// Native smoke/sanitizer test for the metadata store (SURVEY.md §4
+// 'rebuild translation': TSan/ASan builds for the C++ metadata store —
+// the race/sanitizer coverage the reference gets from `go test -race`).
+//
+// Build & run via the Makefile: `make test-asan` / `make test-tsan`.
+// Exercises the full C ABI incl. concurrent writers; exits nonzero on any
+// mismatch, and the sanitizers abort on memory/thread errors.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* ms_open(const char* path, char* err, int errcap);
+void ms_close(void* h);
+int64_t ms_put_type(void* h, int kind, const char* name);
+int64_t ms_get_type(void* h, int kind, const char* name);
+int64_t ms_create_artifact(void* h, int64_t type_id, const char* uri, int state);
+int ms_update_artifact(void* h, int64_t id, const char* uri, int state);
+int ms_get_artifact(void* h, int64_t id, char* uri, int uricap, int* state,
+                    int64_t* type_id);
+int64_t ms_create_execution(void* h, int64_t type_id, int state);
+int ms_update_execution_state(void* h, int64_t id, int state);
+int ms_get_execution(void* h, int64_t id, int* state, int64_t* type_id);
+int64_t ms_create_context(void* h, int64_t type_id, const char* name);
+int ms_list_by_type(void* h, int kind, int64_t type_id, int64_t* out, int cap);
+int ms_put_property(void* h, int kind, int64_t owner, const char* key, int tag,
+                    int64_t ival, double dval, const char* sval);
+int ms_get_property(void* h, int kind, int64_t owner, const char* key,
+                    int* tag, int64_t* ival, double* dval, char* sbuf,
+                    int scap);
+int ms_find_executions_by_property(void* h, const char* key, const char* sval,
+                                   int64_t* out, int cap);
+int ms_put_event(void* h, int64_t exec, int64_t art, int type,
+                 const char* path);
+int ms_events_by_execution(void* h, int64_t exec, int64_t* art_ids, int* types,
+                           char* pathbuf, int pathcap, int cap);
+int ms_events_by_artifact(void* h, int64_t art, int64_t* exec_ids, int* types,
+                          int cap);
+int ms_add_association(void* h, int64_t ctx, int64_t exec);
+int ms_add_attribution(void* h, int64_t ctx, int64_t art);
+int ms_list_context_executions(void* h, int64_t ctx, int64_t* out, int cap);
+}
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,   \
+                   __LINE__, #cond);                                  \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char** argv) {
+  std::string path = argc > 1 ? argv[1] : "/tmp/ms_native_test.db";
+  std::remove(path.c_str());
+  char err[256] = {0};
+  void* h = ms_open(path.c_str(), err, sizeof(err));
+  if (!h) {
+    std::fprintf(stderr, "open failed: %s\n", err);
+    return 1;
+  }
+
+  // Types dedupe per kind.
+  int64_t t_ds = ms_put_type(h, 0, "Dataset");
+  CHECK(t_ds > 0);
+  CHECK(ms_put_type(h, 0, "Dataset") == t_ds);
+  CHECK(ms_get_type(h, 0, "Dataset") == t_ds);
+  int64_t t_exec = ms_put_type(h, 1, "train");
+  CHECK(t_exec != t_ds || t_exec > 0);
+
+  // Artifact round trip + properties of every tag.
+  int64_t a = ms_create_artifact(h, t_ds, "cas://abc", 1);
+  CHECK(a > 0);
+  CHECK(ms_put_property(h, 0, a, "rows", 0, 42, 0, "") == 0);
+  CHECK(ms_put_property(h, 0, a, "split", 1, 0, 0.25, "") == 0);
+  CHECK(ms_put_property(h, 0, a, "name", 2, 0, 0, "train-set") == 0);
+  char uri[256];
+  int state = -1;
+  int64_t tid = -1;
+  CHECK(ms_get_artifact(h, a, uri, sizeof(uri), &state, &tid) == 0);
+  CHECK(std::strcmp(uri, "cas://abc") == 0 && state == 1 && tid == t_ds);
+  int tag;
+  int64_t iv;
+  double dv;
+  char sv[128];
+  CHECK(ms_get_property(h, 0, a, "rows", &tag, &iv, &dv, sv, sizeof(sv)) == 0);
+  CHECK(tag == 0 && iv == 42);
+  CHECK(ms_get_property(h, 0, a, "nope", &tag, &iv, &dv, sv, sizeof(sv)) != 0);
+  CHECK(ms_update_artifact(h, a, "cas://def", 2) == 0);
+  CHECK(ms_get_artifact(h, a, uri, sizeof(uri), &state, nullptr) == 0);
+  CHECK(std::strcmp(uri, "cas://def") == 0 && state == 2);
+
+  // Execution lifecycle + cache-key lookup.
+  int64_t e = ms_create_execution(h, t_exec, 1);
+  CHECK(e > 0);
+  CHECK(ms_put_property(h, 1, e, "cache_key", 2, 0, 0, "k123") == 0);
+  CHECK(ms_update_execution_state(h, e, 2) == 0);
+  int es;
+  CHECK(ms_get_execution(h, e, &es, nullptr) == 0 && es == 2);
+  int64_t hits[4];
+  CHECK(ms_find_executions_by_property(h, "cache_key", "k123", hits, 4) == 1);
+  CHECK(hits[0] == e);
+
+  // Lineage events + context membership.
+  int64_t model = ms_create_artifact(h, t_ds, "cas://model", 2);
+  CHECK(ms_put_event(h, e, a, 0, "data") == 0);
+  CHECK(ms_put_event(h, e, model, 1, "model") == 0);
+  int64_t arts[8];
+  int types[8];
+  char paths[512];
+  int n = ms_events_by_execution(h, e, arts, types, paths, sizeof(paths), 8);
+  CHECK(n == 2 && arts[0] == a && types[0] == 0 && arts[1] == model &&
+        types[1] == 1);
+  CHECK(std::strcmp(paths, "data\nmodel") == 0);
+  int64_t execs[8];
+  CHECK(ms_events_by_artifact(h, model, execs, types, 8) == 1);
+  CHECK(execs[0] == e && types[0] == 1);
+  int64_t t_ctx = ms_put_type(h, 2, "run");
+  int64_t ctx = ms_create_context(h, t_ctx, "r1");
+  CHECK(ctx > 0);
+  CHECK(ms_create_context(h, t_ctx, "r1") == ctx);  // get-or-create
+  CHECK(ms_add_association(h, ctx, e) == 0);
+  CHECK(ms_add_association(h, ctx, e) == 0);        // idempotent
+  CHECK(ms_add_attribution(h, ctx, model) == 0);
+  int64_t members[4];
+  CHECK(ms_list_context_executions(h, ctx, members, 4) == 1);
+
+  // Truncation contract: more rows than cap reports the true count.
+  for (int i = 0; i < 20; i++) ms_create_artifact(h, t_ds, "cas://bulk", 0);
+  int64_t small[4];
+  CHECK(ms_list_by_type(h, 0, t_ds, small, 4) > 4);
+
+  // Concurrent writers (the TSan target of this test).
+  std::vector<std::thread> workers;
+  for (int w = 0; w < 4; w++) {
+    workers.emplace_back([h, t_ds, w] {
+      for (int i = 0; i < 50; i++) {
+        char u[64];
+        std::snprintf(u, sizeof(u), "cas://w%d/%d", w, i);
+        int64_t id = ms_create_artifact(h, t_ds, u, 1);
+        ms_put_property(h, 0, id, "i", 0, i, 0, "");
+        char buf[64];
+        int st;
+        ms_get_artifact(h, id, buf, sizeof(buf), &st, nullptr);
+      }
+    });
+  }
+  for (auto& t : workers) t.join();
+  int64_t big[512];
+  int total = ms_list_by_type(h, 0, t_ds, big, 512);
+  CHECK(total == 1 + 1 + 20 + 200);  // a + model + bulk + concurrent
+
+  ms_close(h);
+  std::remove(path.c_str());
+  std::printf("metadata store native test OK (%d artifacts)\n", total);
+  return 0;
+}

@@ -142,3 +142,47 @@ def test_moe_sharded_matches_unsharded_expert_parallel():
         sharded_params, jax.device_put(toks, batch_sh))
     # bf16 all-to-all/psum reduction order differs under EP; ~1e-3 abs noise
     np.testing.assert_allclose(float(ref_loss), float(loss), rtol=5e-4)
+
+
+def test_chunked_ce_matches_full():
+    """loss_chunk_size must be numerics-identical (loss, accuracy, grads) to
+    the full-logits path — it's a memory optimization, not an approximation."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.models.decoder import decoder_loss, init_decoder_params
+
+    for name in ("tiny", "tiny-gemma"):       # gemma: softcap + tied head
+        cfg = preset(name, dtype="float32")
+        chunked = dataclasses.replace(cfg, loss_chunk_size=32)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 129), 0,
+                                  cfg.vocab_size)
+        l0, m0 = decoder_loss(params, toks, cfg)
+        l1, m1 = decoder_loss(params, toks, chunked)
+        assert abs(float(l0) - float(l1)) < 1e-5
+        assert float(m0["accuracy"]) == float(m1["accuracy"])
+        g0 = jax.grad(lambda p: decoder_loss(p, toks, cfg)[0])(params)
+        g1 = jax.grad(lambda p: decoder_loss(p, toks, chunked)[0])(params)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            assert float(jnp.abs(a - b).max()) < 1e-5
+
+
+def test_chunked_ce_odd_tail_falls_back():
+    import dataclasses
+
+    import jax
+
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.models.decoder import decoder_loss, init_decoder_params
+
+    cfg = preset("tiny", dtype="float32")
+    chunked = dataclasses.replace(cfg, loss_chunk_size=50)  # 128 % 50 != 0
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 129), 0, 256)
+    l0, _ = decoder_loss(params, toks, cfg)
+    l1, _ = decoder_loss(params, toks, chunked)
+    assert abs(float(l0) - float(l1)) < 1e-5

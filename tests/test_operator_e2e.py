@@ -107,10 +107,10 @@ def test_train_gang_kill_resume_e2e(cp, tmp_path):
     j.spec.run_policy.checkpoint.enabled = True
     j.spec.run_policy.checkpoint.interval_steps = 5
     job = cp.submit(j)
-    cp.wait_for(job, "Running", timeout=120)
+    cp.wait_for(job, "Running", timeout=240)
     inj = FaultInjector(cp)
-    inj.kill_worker_at_step("default/train", index=0, step=6, timeout=180)
-    done = cp.wait_for(job, "Succeeded", timeout=300)
+    inj.kill_worker_at_step("default/train", index=0, step=6, timeout=300)
+    done = cp.wait_for(job, "Succeeded", timeout=420)
     assert done.status.restart_count >= 1, "kill did not trigger a restart"
     assert done.status.metrics.step == 40
     assert done.status.metrics.tokens_per_sec_per_chip is not None

@@ -144,3 +144,22 @@ def test_large_id_list(tmp_path):
                        backend=BACKENDS[-1]) as store:
         ids = [store.create_artifact("Bulk") for _ in range(300)]
         assert store.artifacts_of_type("Bulk") == ids
+
+
+class TestNativeSanitizers:
+    """Run the C++ store test under ASan/TSan — the reference's `go test
+    -race` analog for the one native component (SURVEY.md §4.7, §5)."""
+
+    @pytest.mark.parametrize("target", ["test-asan", "test-tsan"])
+    def test_sanitized_build_passes(self, target, tmp_path):
+        import shutil
+        import subprocess
+
+        if shutil.which("g++") is None:
+            pytest.skip("no C++ toolchain")
+        src = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "native", "metadata_store")
+        res = subprocess.run(["make", target], cwd=src, capture_output=True,
+                             text=True, timeout=300)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "native test OK" in res.stdout + res.stderr

@@ -208,6 +208,37 @@ class TestHyperband:
             get_suggester(make_spec(QUAD, algorithm="hyperband"))
 
 
+class TestPBT:
+    def test_population_evolves_toward_optimum(self):
+        spec = make_spec(QUAD, algorithm="pbt",
+                         settings={"population_size": 8, "random_state": 0})
+        sugg = get_suggester(spec)
+        history, state = [], {}
+        gen_best = []
+        for _ in range(8):   # generations
+            asked, state = sugg.suggest(8, history, state)
+            state = json.loads(json.dumps(state))
+            vals = []
+            for p in asked:
+                assert -1.0 <= p["x"] <= 1.0 and -1.0 <= p["y"] <= 1.0
+                v = quad_value(p)
+                vals.append(v)
+                history.append(Observation(parameters=p, value=v))
+            if vals:
+                gen_best.append(min(vals))
+        # Later generations should beat the first (exploit+explore works).
+        assert min(gen_best[3:]) < gen_best[0]
+
+    def test_waits_for_generation(self):
+        spec = make_spec(QUAD, algorithm="pbt",
+                         settings={"population_size": 4})
+        sugg = get_suggester(spec)
+        asked, state = sugg.suggest(10, [], {})
+        assert len(asked) == 4   # never more than the population in flight
+        more, state = sugg.suggest(4, [], state)
+        assert more == []        # generation incomplete → wait
+
+
 class TestMedianStop:
     def test_prunes_bad_trial(self):
         completed = [[(s, 1.0 - 0.1 * s) for s in range(5)] for _ in range(3)]

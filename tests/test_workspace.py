@@ -74,7 +74,10 @@ class TestNotebookSession:
             metadata=ObjectMeta(name="nb", labels={"team": "ml"}),
             spec=NotebookSpec(env={"OWN_VAR": "1",
                                    "KFTPU_NB_PREIMPORT": "0"},
-                              idle_cull_seconds=5.0)))
+                              # generous: under full-suite load the spawn
+                              # itself can take seconds; the cull-wait below
+                              # tolerates up to 60s
+                              idle_cull_seconds=10.0)))
         nb = self.wait_phase(cp, "nb", "Running")
         assert nb.status.url.startswith("unix://")
         sock = nb.status.url[len("unix://"):]
@@ -94,7 +97,7 @@ class TestNotebookSession:
         assert exec_code(sock, "print('alive')")["ok"]
 
         # Idle culling: stop talking to it for > idle_cull_seconds.
-        nb = self.wait_phase(cp, "nb", "Culled", timeout=30)
+        nb = self.wait_phase(cp, "nb", "Culled", timeout=60)
         assert nb.status.pid is None
 
         # Wake: the "open notebook" action.
