@@ -1,0 +1,193 @@
+"""Multi-model repository with LRU placement — ModelMesh-lite.
+
+((U) kserve pkg/agent + modelmesh-serving; SURVEY.md §2.3#29.) The reference
+pairs a per-pod model *agent* (pull/evict) with ModelMesh's high-density LRU
+placement of models across serving pods. TPU-natively the scarce resource is
+one chip's HBM: the repository keeps registered models' engines loaded up to
+a budget (count and/or estimated bytes) and evicts least-recently-used
+engines — their slot KV caches and weights free HBM — reloading on demand.
+
+Serves the v2 repository API through the model server:
+``GET /v2/repository/index``, ``POST /v2/repository/models/{m}/load|unload``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+from kubeflow_tpu.models.config import DecoderConfig
+from kubeflow_tpu.serve.engine import LLMEngine
+from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
+
+logger = logging.getLogger("kubeflow_tpu.serve")
+
+
+def estimate_model_bytes(cfg: DecoderConfig) -> int:
+    """Weights (param dtype) + a slot KV cache worth of activations."""
+    param_bytes = cfg.num_params() * cfg.weight_dtype.itemsize
+    return int(param_bytes * 1.2)   # +20% engine/cache headroom
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    name: str
+    cfg: DecoderConfig
+    make_engine: Callable[[], LLMEngine]
+    tokenizer: Tokenizer
+    bytes: int
+    engine: Optional[LLMEngine] = None   # None = registered but not loaded
+    refs: int = 0                        # in-flight requests holding a lease
+
+    @property
+    def state(self) -> str:
+        return "READY" if self.engine is not None else "UNLOADED"
+
+
+class ModelRepository:
+    """Thread-safe LRU of loaded engines under a capacity budget.
+
+    Loads are serialized (`_load_lock`): engine construction takes seconds
+    and double-building on a racing first request would bust the HBM budget.
+    In-flight requests hold a *lease* on their entry; eviction skips leased
+    engines (temporarily exceeding the budget beats killing live requests)."""
+
+    def __init__(self, *, max_loaded: int = 2,
+                 max_bytes: Optional[int] = None):
+        self.max_loaded = max_loaded
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._load_lock = threading.Lock()
+        self._entries: "OrderedDict[str, ModelEntry]" = OrderedDict()
+
+    # -- registration ----------------------------------------------------------
+
+    def register(self, name: str, cfg: DecoderConfig, *,
+                 make_engine: Optional[Callable[[], LLMEngine]] = None,
+                 tokenizer: Optional[Tokenizer] = None,
+                 batching=None) -> ModelEntry:
+        if make_engine is None:
+            def make_engine(cfg=cfg, batching=batching):
+                return LLMEngine(cfg, batching)
+
+        entry = ModelEntry(
+            name=name, cfg=cfg, make_engine=make_engine,
+            tokenizer=tokenizer or get_tokenizer("byte"),
+            bytes=estimate_model_bytes(cfg))
+        with self._lock:
+            self._entries[name] = entry
+        return entry
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def index(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [{"name": e.name, "state": e.state,
+                     "bytes": e.bytes} for e in self._entries.values()]
+
+    def peek(self, name: str) -> Optional[ModelEntry]:
+        """Entry without loading or touching LRU recency (metadata/metrics)."""
+        with self._lock:
+            return self._entries.get(name)
+
+    # -- load/unload/eviction --------------------------------------------------
+
+    def _loaded_locked(self) -> list[ModelEntry]:
+        return [e for e in self._entries.values() if e.engine is not None]
+
+    def _evict_for_locked(self, incoming: ModelEntry
+                          ) -> list[tuple[ModelEntry, LLMEngine]]:
+        """LRU-evict (OrderedDict order = recency, oldest first) until the
+        incoming model fits, skipping leased entries. Detaches victim
+        engines under the lock; returns them to stop outside it."""
+        victims: list[tuple[ModelEntry, LLMEngine]] = []
+
+        def over() -> bool:
+            loaded = [e for e in self._loaded_locked()]
+            if len(loaded) + 1 > self.max_loaded:
+                return True
+            if self.max_bytes is not None:
+                used = sum(e.bytes for e in loaded)
+                return used + incoming.bytes > self.max_bytes
+            return False
+
+        for e in list(self._entries.values()):      # oldest-touched first
+            if not over():
+                break
+            if e.engine is not None and e.name != incoming.name \
+                    and e.refs == 0:
+                engine, e.engine = e.engine, None
+                victims.append((e, engine))
+        return victims
+
+    def load(self, name: str) -> LLMEngine:
+        """Load (or touch) a registered model; may evict idle LRU engines."""
+        with self._lock:
+            if name not in self._entries:
+                raise KeyError(f"model {name!r} is not registered")
+            entry = self._entries[name]
+            self._entries.move_to_end(name)         # touch (most recent)
+            if entry.engine is not None:
+                return entry.engine
+        # Serialize builds: a racing first request must not double-build.
+        with self._load_lock:
+            with self._lock:
+                if entry.engine is not None:        # loaded while we waited
+                    return entry.engine
+                victims = self._evict_for_locked(entry)
+            for v, engine in victims:
+                logger.info("evicting model %s (LRU)", v.name)
+                engine.stop()
+            engine = entry.make_engine()
+            engine.start()
+            with self._lock:
+                entry.engine = engine
+        logger.info("loaded model %s (%.1f MB est.)", name,
+                    entry.bytes / 1e6)
+        return engine
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"model {name!r} is not registered")
+            engine, entry.engine = entry.engine, None
+        if engine is not None:
+            engine.stop()
+
+    def acquire(self, name: str) -> ModelEntry:
+        """Lease an entry for one request: loads on demand and pins the
+        engine against eviction until release()."""
+        self.load(name)
+        with self._lock:
+            entry = self._entries[name]
+            if entry.engine is None:
+                # unloaded between load and lease (explicit unload): retry
+                pass
+            else:
+                entry.refs += 1
+                return entry
+        return self.acquire(name)
+
+    def release(self, entry: ModelEntry) -> None:
+        with self._lock:
+            entry.refs = max(0, entry.refs - 1)
+
+    def get(self, name: str) -> ModelEntry:
+        """Entry for serving: loads on demand (the model-agent pull path).
+        Prefer acquire()/release() for request-scoped use."""
+        self.load(name)
+        with self._lock:
+            return self._entries[name]
+
+    def shutdown(self) -> None:
+        for name in self.names():
+            try:
+                self.unload(name)
+            except KeyError:
+                pass

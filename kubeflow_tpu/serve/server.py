@@ -1,4 +1,5 @@
-"""Model server: HTTP protocol surface over the LLM engine.
+"""Model server: HTTP protocol surface over one LLM engine or a multi-model
+repository.
 
 Implements the three protocol families of the reference's model server in one
 stdlib-only server (no fastapi in this image):
@@ -9,7 +10,13 @@ stdlib-only server (no fastapi in this image):
   GET  /v2/models/{name}           metadata
   POST /v2/models/{name}/infer     {"inputs": [{name,shape,datatype,data}]}
 - OpenAI-compatible LLM surface ((U) kserve python/huggingfaceserver):
-  POST /v1/completions, /v1/chat/completions (stream=true → SSE)
+  POST /v1/completions, /v1/chat/completions (stream=true → SSE; the
+  "model" body field routes in multi-model mode)
+
+Multi-model mode (≈ model agent + ModelMesh — SURVEY.md §2.3#29): construct
+with a ``ModelRepository`` and the server adds the v2 repository API
+(``GET /v2/repository/index``, ``POST /v2/repository/models/{m}/load|
+unload``) and per-request routing with LRU load-on-demand.
 
 Plus /healthz (readiness) and /metrics (Prometheus text format).
 Threaded stdlib server: handlers block on the engine's request stream; the
@@ -20,6 +27,7 @@ in-flight request — fine at platform scale, and zero dependencies.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -28,13 +36,22 @@ from typing import Any, Optional
 from kubeflow_tpu.serve.engine import LLMEngine, Request, SamplingParams
 from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
 
+_V1_PREDICT = re.compile(r"^/v1/models/([^/:]+):predict$")
+_V2_MODEL = re.compile(r"^/v2/models/([^/]+)$")
+_V2_INFER = re.compile(r"^/v2/models/([^/]+)/infer$")
+_REPO_ACTION = re.compile(r"^/v2/repository/models/([^/]+)/(load|unload)$")
+
 
 class ModelServer:
-    def __init__(self, name: str, engine: LLMEngine, *,
+    def __init__(self, name: str, engine: Optional[LLMEngine] = None, *,
+                 repository=None,
                  tokenizer: Optional[Tokenizer] = None,
                  host: str = "127.0.0.1", port: int = 0):
-        self.name = name
-        self.engine = engine
+        if (engine is None) == (repository is None):
+            raise ValueError("pass exactly one of engine= or repository=")
+        self.name = name                  # default model name
+        self.engine = engine              # single-model mode only
+        self.repository = repository
         self.tokenizer = tokenizer or get_tokenizer("byte")
         self._in_flight = 0
         self._in_flight_lock = threading.Lock()
@@ -47,7 +64,8 @@ class ModelServer:
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> None:
-        self.engine.start()
+        if self.engine is not None:
+            self.engine.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="model-server")
         self._thread.start()
@@ -57,11 +75,57 @@ class ModelServer:
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
-        self.engine.stop()
+        if self.engine is not None:
+            self.engine.stop()
+        if self.repository is not None:
+            self.repository.shutdown()
 
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    # -- model resolution ------------------------------------------------------
+
+    def model_names(self) -> list[str]:
+        if self.repository is None:
+            return [self.name]
+        return self.repository.names()
+
+    def lease(self, name: Optional[str]):
+        """Context manager: (engine, tokenizer, resolved_name) pinned for the
+        request's duration (repository mode leases against LRU eviction).
+
+        Single-model servers ignore a foreign "model" field — OpenAI SDK
+        clients always send one, and the pre-multi-model server served them."""
+        import contextlib
+
+        if self.repository is None:
+            @contextlib.contextmanager
+            def single():
+                yield self.engine, self.tokenizer, self.name
+
+            return single()
+
+        @contextlib.contextmanager
+        def leased():
+            entry = self.repository.acquire(name or self.name)
+            try:
+                yield entry.engine, entry.tokenizer, entry.name
+            finally:
+                self.repository.release(entry)
+
+        return leased()
+
+    def model_config(self, name: str):
+        """Model metadata without forcing a load."""
+        if self.repository is None:
+            if name != self.name:
+                raise KeyError(name)
+            return self.engine.cfg
+        entry = self.repository.peek(name)
+        if entry is None:
+            raise KeyError(name)
+        return entry.cfg
 
     # -- request plumbing ------------------------------------------------------
 
@@ -74,28 +138,44 @@ class ModelServer:
         with self._in_flight_lock:
             return self._in_flight
 
-    def sampling_from(self, body: dict[str, Any]) -> SamplingParams:
+    @staticmethod
+    def sampling_from(body: dict[str, Any],
+                      tokenizer: Tokenizer) -> SamplingParams:
         return SamplingParams(
             max_new_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
-            stop_token=self.tokenizer.eos_id,
+            stop_token=tokenizer.eos_id,
         )
 
     def metrics_text(self) -> str:
-        snap = self.engine.metrics.snapshot()
         lines = [
             "# TYPE kftpu_serving_requests_total counter",
-            f"kftpu_serving_requests_total {snap['requests_completed']}",
             "# TYPE kftpu_serving_tokens_total counter",
-            f"kftpu_serving_tokens_total {snap['tokens_generated']}",
             "# TYPE kftpu_serving_in_flight gauge",
             f"kftpu_serving_in_flight {self.in_flight}",
         ]
-        for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
-                  "requests_per_sec", "tokens_per_sec"):
-            if k in snap:
-                lines.append(f"kftpu_serving_{k} {snap[k]}")
+        engines: list[tuple[str, LLMEngine]] = []
+        if self.engine is not None:
+            engines.append((self.name, self.engine))
+        elif self.repository is not None:
+            # peek only: a metrics scrape must not touch LRU recency or
+            # load anything.
+            for item in self.repository.index():
+                entry = self.repository.peek(item["name"])
+                if entry is not None and entry.engine is not None:
+                    engines.append((entry.name, entry.engine))
+        for name, engine in engines:
+            snap = engine.metrics.snapshot()
+            lab = f'{{model="{name}"}}'
+            lines.append(f"kftpu_serving_requests_total{lab} "
+                         f"{snap['requests_completed']}")
+            lines.append(f"kftpu_serving_tokens_total{lab} "
+                         f"{snap['tokens_generated']}")
+            for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                      "requests_per_sec", "tokens_per_sec"):
+                if k in snap:
+                    lines.append(f"kftpu_serving_{k}{lab} {snap[k]}")
         return "\n".join(lines) + "\n"
 
 
@@ -133,14 +213,28 @@ def _make_handler(server: ModelServer):
         def do_GET(self) -> None:
             if self.path in ("/healthz", "/v2/health/ready", "/v2/health/live"):
                 self._json(200, {"status": "ok", "name": server.name})
-            elif self.path == "/metrics":
+                return
+            if self.path == "/metrics":
                 self._text(200, server.metrics_text())
-            elif self.path == "/v1/models":
-                self._json(200, {"models": [server.name]})
-            elif self.path == f"/v2/models/{server.name}":
-                cfg = server.engine.cfg
+                return
+            if self.path == "/v1/models":
+                self._json(200, {"models": server.model_names()})
+                return
+            if self.path == "/v2/repository/index":
+                if server.repository is None:
+                    self._json(200, {"models": [
+                        {"name": server.name, "state": "READY"}]})
+                else:
+                    self._json(200, {"models": server.repository.index()})
+                return
+            m = _V2_MODEL.match(self.path)
+            if m:
+                try:
+                    cfg = server.model_config(m.group(1))
+                except KeyError:
+                    return self._json(404, {"error": f"no model {m.group(1)}"})
                 self._json(200, {
-                    "name": server.name,
+                    "name": m.group(1),
                     "platform": "kubeflow-tpu-llm",
                     "inputs": [{"name": "text", "datatype": "BYTES",
                                 "shape": [-1]}],
@@ -149,25 +243,34 @@ def _make_handler(server: ModelServer):
                     "config": {"vocab_size": cfg.vocab_size,
                                "max_seq_len": cfg.max_seq_len},
                 })
-            else:
-                self._json(404, {"error": f"not found: {self.path}"})
+                return
+            self._json(404, {"error": f"not found: {self.path}"})
 
         # -- POST --------------------------------------------------------------
 
         def do_POST(self) -> None:
             server.track(1)
             try:
+                # Always drain the body first: HTTP/1.1 keep-alive breaks if
+                # unread bytes remain on the connection.
                 body = self._body()
-                if self.path == f"/v1/models/{server.name}:predict":
-                    self._v1_predict(body)
-                elif self.path == f"/v2/models/{server.name}/infer":
-                    self._v2_infer(body)
-                elif self.path == "/v1/completions":
-                    self._completions(body, chat=False)
-                elif self.path == "/v1/chat/completions":
-                    self._completions(body, chat=True)
-                else:
-                    self._json(404, {"error": f"not found: {self.path}"})
+                repo = _REPO_ACTION.match(self.path)
+                if repo:
+                    return self._repository_action(repo.group(1),
+                                                   repo.group(2))
+                m = _V1_PREDICT.match(self.path)
+                if m:
+                    return self._v1_predict(body, m.group(1))
+                m = _V2_INFER.match(self.path)
+                if m:
+                    return self._v2_infer(body, m.group(1))
+                if self.path == "/v1/completions":
+                    return self._completions(body, chat=False)
+                if self.path == "/v1/chat/completions":
+                    return self._completions(body, chat=True)
+                self._json(404, {"error": f"not found: {self.path}"})
+            except KeyError as exc:
+                self._json(404, {"error": str(exc)})
             except ValueError as exc:
                 self._json(400, {"error": str(exc)})
             except Exception as exc:   # surface, don't hide
@@ -175,37 +278,52 @@ def _make_handler(server: ModelServer):
             finally:
                 server.track(-1)
 
-        def _generate_text(self, prompt: str, body: dict) -> tuple[str, Request]:
-            toks = server.tokenizer.encode(prompt)
-            req = server.engine.submit(toks, server.sampling_from(body))
-            out = req.result(timeout=float(body.get("timeout", 300)))
-            text = server.tokenizer.decode(
-                [t for t in out if t != server.tokenizer.eos_id])
-            return text, req
+        def _repository_action(self, name: str, action: str) -> None:
+            if server.repository is None:
+                return self._json(400, {"error": "single-model server"})
+            if action == "load":
+                server.repository.load(name)
+            else:
+                server.repository.unload(name)
+            self._json(200, {"name": name, "state": "READY"
+                             if action == "load" else "UNLOADED"})
 
-        def _v1_predict(self, body: dict) -> None:
+        def _generate_text(self, prompt: str, body: dict,
+                           model: Optional[str]) -> tuple[str, Request]:
+            with server.lease(model) as (engine, tokenizer, _):
+                toks = tokenizer.encode(prompt)
+                req = engine.submit(toks,
+                                    server.sampling_from(body, tokenizer))
+                out = req.result(timeout=float(body.get("timeout", 300)))
+                text = tokenizer.decode(
+                    [t for t in out if t != tokenizer.eos_id])
+                return text, req
+
+        def _v1_predict(self, body: dict, model: str) -> None:
             instances = body.get("instances")
             if not isinstance(instances, list):
                 raise ValueError("body must contain 'instances': [...]")
-            preds = [self._generate_text(str(inst), body)[0]
+            preds = [self._generate_text(str(inst), body, model)[0]
                      for inst in instances]
             self._json(200, {"predictions": preds})
 
-        def _v2_infer(self, body: dict) -> None:
+        def _v2_infer(self, body: dict, model: str) -> None:
             inputs = body.get("inputs")
             if not isinstance(inputs, list) or not inputs:
                 raise ValueError("body must contain 'inputs': [...]")
             texts = []
             for inp in inputs:
                 for datum in inp.get("data", []):
-                    texts.append(self._generate_text(str(datum), body)[0])
+                    texts.append(self._generate_text(str(datum), body,
+                                                     model)[0])
             self._json(200, {
-                "model_name": server.name,
+                "model_name": model,
                 "outputs": [{"name": "text", "datatype": "BYTES",
                              "shape": [len(texts)], "data": texts}],
             })
 
         def _completions(self, body: dict, *, chat: bool) -> None:
+            model = body.get("model")
             if chat:
                 msgs = body.get("messages", [])
                 prompt = "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}"
@@ -215,8 +333,9 @@ def _make_handler(server: ModelServer):
                 if isinstance(prompt, list):
                     prompt = prompt[0] if prompt else ""
             if body.get("stream"):
-                return self._completions_stream(prompt, body, chat=chat)
-            text, req = self._generate_text(prompt, body)
+                return self._completions_stream(prompt, body, chat=chat,
+                                                model=model)
+            text, req = self._generate_text(prompt, body, model)
             usage = {"prompt_tokens": len(req.prompt_tokens),
                      "completion_tokens": len(req.output_tokens),
                      "total_tokens": len(req.prompt_tokens) + len(req.output_tokens)}
@@ -230,39 +349,45 @@ def _make_handler(server: ModelServer):
                 obj = "text_completion"
             self._json(200, {
                 "id": req.id, "object": obj, "created": int(time.time()),
-                "model": server.name, "choices": [choice], "usage": usage,
+                "model": model or server.name, "choices": [choice],
+                "usage": usage,
             })
 
-        def _completions_stream(self, prompt: str, body: dict, *, chat: bool) -> None:
-            toks = server.tokenizer.encode(prompt)
-            req = server.engine.submit(toks, server.sampling_from(body))
-            self.send_response(200)
-            self.send_header("Content-Type", "text/event-stream")
-            self.send_header("Cache-Control", "no-cache")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
+        def _completions_stream(self, prompt: str, body: dict, *, chat: bool,
+                                model: Optional[str]) -> None:
+            with server.lease(model) as (engine, tokenizer, _):
+                toks = tokenizer.encode(prompt)
+                req = engine.submit(toks,
+                                    server.sampling_from(body, tokenizer))
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
 
-            def chunk(data: str) -> None:
-                payload = f"data: {data}\n\n".encode()
-                self.wfile.write(f"{len(payload):x}\r\n".encode()
-                                 + payload + b"\r\n")
-                self.wfile.flush()
+                def chunk(data: str) -> None:
+                    payload = f"data: {data}\n\n".encode()
+                    self.wfile.write(f"{len(payload):x}\r\n".encode()
+                                     + payload + b"\r\n")
+                    self.wfile.flush()
 
-            while True:
-                tok = req.stream.get(timeout=float(body.get("timeout", 300)))
-                if tok is None:
-                    break
-                if tok == server.tokenizer.eos_id:
-                    continue
-                piece = server.tokenizer.decode([tok])
-                if chat:
-                    delta = {"choices": [{"index": 0,
-                                          "delta": {"content": piece}}]}
-                else:
-                    delta = {"choices": [{"index": 0, "text": piece}]}
-                chunk(json.dumps({"id": req.id, "object": "chunk",
-                                  "model": server.name, **delta}))
-            chunk("[DONE]")
-            self.wfile.write(b"0\r\n\r\n")
+                while True:
+                    tok = req.stream.get(
+                        timeout=float(body.get("timeout", 300)))
+                    if tok is None:
+                        break
+                    if tok == tokenizer.eos_id:
+                        continue
+                    piece = tokenizer.decode([tok])
+                    if chat:
+                        delta = {"choices": [{"index": 0,
+                                              "delta": {"content": piece}}]}
+                    else:
+                        delta = {"choices": [{"index": 0, "text": piece}]}
+                    chunk(json.dumps({"id": req.id, "object": "chunk",
+                                      "model": model or server.name,
+                                      **delta}))
+                chunk("[DONE]")
+                self.wfile.write(b"0\r\n\r\n")
 
     return Handler

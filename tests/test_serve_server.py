@@ -125,3 +125,93 @@ def test_byte_tokenizer_roundtrip():
     ids = tok.encode("héllo ✓")
     assert ids[0] == tok.bos_id
     assert tok.decode(ids) == "héllo ✓"
+
+
+def http(server, method: str, path: str, body: dict | None = None):
+    """(status, parsed-json-or-text) without raising on 4xx/5xx."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(server.url + path, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            raw, code, ctype = r.read(), r.status, r.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+    return code, (json.loads(raw) if "json" in ctype else raw.decode())
+
+
+class TestMultiModel:
+    """ModelMesh-lite: repository-backed server with LRU load-on-demand and
+    the v2 repository API (SURVEY.md §2.3#29)."""
+
+    @pytest.fixture()
+    def repo_server(self):
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.serve.repository import ModelRepository
+
+        repo = ModelRepository(max_loaded=1)   # force evictions
+        repo.register("alpha", preset("tiny"), batching=BatchingSpec(
+            max_batch_size=2, max_seq_len=64, prefill_buckets=[16]))
+        repo.register("beta", preset("tiny-gemma"), batching=BatchingSpec(
+            max_batch_size=2, max_seq_len=64, prefill_buckets=[16]))
+        srv = ModelServer("alpha", repository=repo, port=0)
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def test_index_and_lazy_load(self, repo_server):
+        code, out = http(repo_server, "GET", "/v2/repository/index")
+        assert code == 200
+        states = {m["name"]: m["state"] for m in out["models"]}
+        assert states == {"alpha": "UNLOADED", "beta": "UNLOADED"}
+        # Serving a request loads on demand.
+        code, out = http(repo_server, "POST", "/v1/models/alpha:predict",
+                         {"instances": ["hi"], "max_tokens": 4})
+        assert code == 200 and len(out["predictions"]) == 1
+        states = {m["name"]: m["state"]
+                  for m in http(repo_server, "GET",
+                                "/v2/repository/index")[1]["models"]}
+        assert states["alpha"] == "READY"
+
+    def test_lru_eviction_on_second_model(self, repo_server):
+        http(repo_server, "POST", "/v1/models/alpha:predict",
+             {"instances": ["hi"], "max_tokens": 4})
+        # Serving beta evicts alpha (max_loaded=1)...
+        code, out = http(repo_server, "POST", "/v1/models/beta:predict",
+                         {"instances": ["yo"], "max_tokens": 4})
+        assert code == 200
+        states = {m["name"]: m["state"]
+                  for m in http(repo_server, "GET",
+                                "/v2/repository/index")[1]["models"]}
+        assert states == {"alpha": "UNLOADED", "beta": "READY"}
+        # ...and alpha reloads transparently on the next request.
+        code, _ = http(repo_server, "POST", "/v1/models/alpha:predict",
+                       {"instances": ["back"], "max_tokens": 4})
+        assert code == 200
+
+    def test_explicit_load_unload(self, repo_server):
+        code, out = http(repo_server, "POST",
+                         "/v2/repository/models/beta/load", {})
+        assert code == 200 and out["state"] == "READY"
+        code, out = http(repo_server, "POST",
+                         "/v2/repository/models/beta/unload", {})
+        assert code == 200 and out["state"] == "UNLOADED"
+        assert http(repo_server, "POST",
+                    "/v2/repository/models/nope/load", {})[0] == 404
+
+    def test_openai_model_field_routes(self, repo_server):
+        code, out = http(repo_server, "POST", "/v1/completions",
+                         {"model": "beta", "prompt": "hello",
+                          "max_tokens": 4})
+        assert code == 200 and out["model"] == "beta"
+
+    def test_unknown_model_404(self, repo_server):
+        code, out = http(repo_server, "POST", "/v1/models/ghost:predict",
+                         {"instances": ["x"]})
+        assert code == 404
+
+    def test_metrics_labeled_per_model(self, repo_server):
+        http(repo_server, "POST", "/v1/models/alpha:predict",
+             {"instances": ["hi"], "max_tokens": 4})
+        code, text = http(repo_server, "GET", "/metrics")
+        assert 'kftpu_serving_requests_total{model="alpha"}' in text
