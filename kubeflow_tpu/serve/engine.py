@@ -136,6 +136,28 @@ def _decode_step(params: Params, cache: dict, tokens: jax.Array,
     return logits, {"k": nk, "v": nv}
 
 
+def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
+                        slot: jax.Array, start: jax.Array,
+                        cfg: DecoderConfig):
+    """Prefill ONE chunk of a prompt into slot ``slot`` at position ``start``.
+
+    Chunked prefill (SURVEY.md §5 long-context serving): long prompts are
+    split into fixed-size chunks so decode steps for running streams
+    interleave between chunks — bounding their TPOT spike. The slot's cache
+    row accumulates KV across chunks (the cache path already supports an
+    arbitrary traced start); positions beyond the written region are causal-
+    masked until decode overwrites them. Returns ([C, V] logits, cache)."""
+    ck = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1)
+    cv = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1)
+    caches = {"k": ck, "v": cv, "len": start}
+    logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=caches)
+    nk = jax.lax.dynamic_update_slice_in_dim(cache["k"], filled["k"], slot,
+                                             axis=1)
+    nv = jax.lax.dynamic_update_slice_in_dim(cache["v"], filled["v"], slot,
+                                             axis=1)
+    return logits[0], {"k": nk, "v": nv}
+
+
 def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
                   slot: jax.Array, length: jax.Array, cfg: DecoderConfig,
                   attn_impl: str = "xla"):
@@ -292,6 +314,14 @@ class LLMEngine:
             return _prefill_step(p, c, t, s, ln, cfg, impl)
 
         self._prefill = jax.jit(_prefill_fn, donate_argnums=(1,))
+        # Chunked prefill for prompts longer than the chunk size: one chunk
+        # per scheduler step, decode interleaving between chunks.
+        self.chunk_size = max(0, int(b.chunked_prefill_tokens))
+        self._prefill_chunk = jax.jit(
+            lambda p, c, t, s, st: _chunk_prefill_step(p, c, t, s, st, cfg),
+            donate_argnums=(1,))
+        # (request, slot, next_position) of the in-flight chunked prefill.
+        self._chunking: Optional[tuple[Request, int, int]] = None
         self._sampler = jax.jit(_sample, static_argnums=(3,))
 
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots
@@ -328,8 +358,9 @@ class LLMEngine:
         return self.max_len
 
     def _free_slot(self) -> Optional[int]:
+        reserved = self._chunking[1] if self._chunking is not None else None
         for i, s in enumerate(self.slots):
-            if s is None:
+            if s is None and i != reserved:
                 return i
         return None
 
@@ -337,10 +368,49 @@ class LLMEngine:
         self._rng, k = jax.random.split(self._rng)
         return k
 
+    def _start_first_token(self, req: Request, slot_idx: int, plen: int,
+                           last_logits: jax.Array) -> None:
+        first = self._sampler(
+            last_logits[None, :], self._next_key(),
+            jnp.asarray([req.params.temperature], jnp.float32),
+            req.params.top_k)
+        tok = int(jax.device_get(first)[0])
+        req.first_token_time = time.monotonic()
+        req.output_tokens.append(tok)
+        req.stream.put(tok)
+        self.slots[slot_idx] = _Slot(request=req, length=plen,
+                                     last_token=tok, generated=1)
+        self._finish_if_done(slot_idx)
+
+    def _advance_chunked(self) -> int:
+        """Run ONE chunk of the in-flight chunked prefill (decode steps run
+        between calls — that's the whole point). Returns work done."""
+        if self._chunking is None:
+            return 0
+        req, slot_idx, pos = self._chunking
+        C = self.chunk_size
+        plen = len(req.prompt_tokens)
+        chunk = np.zeros((1, C), np.int32)
+        real = min(C, plen - pos)
+        chunk[0, :real] = req.prompt_tokens[pos:pos + real]
+        logits, self.cache = self._prefill_chunk(
+            self.params, self.cache, jnp.asarray(chunk),
+            jnp.int32(slot_idx), jnp.int32(pos))
+        pos += real
+        if pos >= plen:
+            self._chunking = None
+            # Logits index of the prompt's true last token within this chunk.
+            self._start_first_token(req, slot_idx, plen, logits[real - 1])
+        else:
+            self._chunking = (req, slot_idx, pos)
+        return 1
+
     def _admit(self) -> int:
         """Prefill waiting requests into free slots. Returns admissions."""
-        n = 0
+        n = self._advance_chunked()
         while True:
+            if self._chunking is not None:
+                return n   # one long prefill at a time; chunks interleave
             slot_idx = self._free_slot()
             if slot_idx is None:
                 return n
@@ -349,23 +419,23 @@ class LLMEngine:
             except queue.Empty:
                 return n
             plen = len(req.prompt_tokens)
+            C = self.chunk_size
+            if C and plen > C and -(-plen // C) * C <= self.max_len:
+                # Long prompt: chunked path — _free_slot holds this slot
+                # while chunks stream across scheduler steps. Guard: every
+                # C-wide window must fit inside max_len, else the final
+                # chunk's dynamic_update_slice would clamp and overwrite
+                # earlier KV (fall through to one-shot prefill instead).
+                self._chunking = (req, slot_idx, 0)
+                n += self._advance_chunked()
+                continue
             bucket = self._bucket_for(plen)
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :plen] = req.prompt_tokens
             last_logits, self.cache = self._prefill(
                 self.params, self.cache, jnp.asarray(toks),
                 jnp.int32(slot_idx), jnp.int32(plen))
-            first = self._sampler(
-                last_logits[None, :], self._next_key(),
-                jnp.asarray([req.params.temperature], jnp.float32),
-                req.params.top_k)
-            tok = int(jax.device_get(first)[0])
-            req.first_token_time = time.monotonic()
-            req.output_tokens.append(tok)
-            req.stream.put(tok)
-            self.slots[slot_idx] = _Slot(request=req, length=plen,
-                                         last_token=tok, generated=1)
-            self._finish_if_done(slot_idx)
+            self._start_first_token(req, slot_idx, plen, last_logits)
             n += 1
 
     def _finish_if_done(self, idx: int) -> bool:

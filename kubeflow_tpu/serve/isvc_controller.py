@@ -211,6 +211,8 @@ class ISVCController:
             "batching": pred.batching.model_dump(),
             "port": port,
         }
+        if isvc.spec.transformer is not None:
+            config["transformer"] = isvc.spec.transformer.model_dump()
         w = Worker(
             metadata=ObjectMeta(
                 name=f"{isvc.metadata.name}-predictor-{index}",

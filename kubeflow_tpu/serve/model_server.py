@@ -13,8 +13,34 @@ Config (injected by the ISVC controller into WorkloadSpec.config):
 from __future__ import annotations
 
 import time
+from typing import Callable
 
 from kubeflow_tpu.runtime.entrypoints import WorkerContext, register_entrypoint
+
+#: Named transformer handlers (the "registered name" form of
+#: TransformerSpec.handler; the alternative is "module:function").
+transformer_registry: dict[str, Callable] = {}
+
+
+def register_transformer(name: str):
+    def deco(fn: Callable) -> Callable:
+        transformer_registry[name] = fn
+        return fn
+    return deco
+
+
+def resolve_transformer(handler: str) -> Callable:
+    if handler in transformer_registry:
+        return transformer_registry[handler]
+    module, sep, attr = handler.partition(":")
+    if not sep:
+        raise KeyError(
+            f"transformer {handler!r} is not registered and is not a "
+            f"'module:function' path; registered: "
+            f"{sorted(transformer_registry)}")
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)
 
 
 @register_entrypoint("model_server")
@@ -32,7 +58,18 @@ def model_server(ctx: WorkerContext) -> int:
     params = load_params(conf.get("storage_uri"), cfg)
     batching = BatchingSpec(**conf.get("batching", {}))
     engine = LLMEngine(cfg, batching, params=params)
+    transformer = None
+    t_conf = conf.get("transformer")
+    if t_conf:
+        # kserve-transformer analog: fn(text, phase, **config).
+        import functools
+
+        fn = resolve_transformer(t_conf["handler"])
+        if t_conf.get("config"):
+            fn = functools.partial(fn, **t_conf["config"])
+        transformer = fn
     server = ModelServer(conf.get("service", "model"), engine,
+                         transformer=transformer,
                          port=int(conf["port"]))
     server.start()
     try:

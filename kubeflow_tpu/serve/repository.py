@@ -26,10 +26,16 @@ from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
 logger = logging.getLogger("kubeflow_tpu.serve")
 
 
-def estimate_model_bytes(cfg: DecoderConfig) -> int:
-    """Weights (param dtype) + a slot KV cache worth of activations."""
+def estimate_model_bytes(cfg: DecoderConfig, batching=None) -> int:
+    """Weights (param dtype) + the engine's slot KV cache (often dominant
+    for small models at long max_seq_len)."""
     param_bytes = cfg.num_params() * cfg.weight_dtype.itemsize
-    return int(param_bytes * 1.2)   # +20% engine/cache headroom
+    kv_bytes = 0
+    if batching is not None:
+        kv_bytes = (2 * cfg.n_layers * batching.max_batch_size
+                    * batching.max_seq_len * cfg.n_kv_heads * cfg.head_dim
+                    * cfg.activation_dtype.itemsize)
+    return int(param_bytes * 1.1) + kv_bytes
 
 
 @dataclasses.dataclass
@@ -41,6 +47,8 @@ class ModelEntry:
     bytes: int
     engine: Optional[LLMEngine] = None   # None = registered but not loaded
     refs: int = 0                        # in-flight requests holding a lease
+    #: engines detached by unload while leased: stopped when refs hit 0
+    draining: list = dataclasses.field(default_factory=list)
 
     @property
     def state(self) -> str:
@@ -76,7 +84,7 @@ class ModelRepository:
         entry = ModelEntry(
             name=name, cfg=cfg, make_engine=make_engine,
             tokenizer=tokenizer or get_tokenizer("byte"),
-            bytes=estimate_model_bytes(cfg))
+            bytes=estimate_model_bytes(cfg, batching))
         with self._lock:
             self._entries[name] = entry
         return entry
@@ -152,11 +160,17 @@ class ModelRepository:
         return engine
 
     def unload(self, name: str) -> None:
+        """Detach the model. Leased in-flight requests keep their engine
+        alive (it drains and stops when the last lease releases) — unload
+        must not kill live requests any more than LRU eviction does."""
         with self._lock:
             entry = self._entries.get(name)
             if entry is None:
                 raise KeyError(f"model {name!r} is not registered")
             engine, entry.engine = entry.engine, None
+            if engine is not None and entry.refs > 0:
+                entry.draining.append(engine)
+                engine = None
         if engine is not None:
             engine.stop()
 
@@ -175,8 +189,13 @@ class ModelRepository:
         return self.acquire(name)
 
     def release(self, entry: ModelEntry) -> None:
+        drained: list = []
         with self._lock:
             entry.refs = max(0, entry.refs - 1)
+            if entry.refs == 0 and entry.draining:
+                drained, entry.draining = entry.draining, []
+        for engine in drained:
+            engine.stop()
 
     def get(self, name: str) -> ModelEntry:
         """Entry for serving: loads on demand (the model-agent pull path).

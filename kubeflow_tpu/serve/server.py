@@ -46,6 +46,7 @@ class ModelServer:
     def __init__(self, name: str, engine: Optional[LLMEngine] = None, *,
                  repository=None,
                  tokenizer: Optional[Tokenizer] = None,
+                 transformer=None,
                  host: str = "127.0.0.1", port: int = 0):
         if (engine is None) == (repository is None):
             raise ValueError("pass exactly one of engine= or repository=")
@@ -53,6 +54,9 @@ class ModelServer:
         self.engine = engine              # single-model mode only
         self.repository = repository
         self.tokenizer = tokenizer or get_tokenizer("byte")
+        # Pre/post-processing hop (≈ kserve transformer — SURVEY.md §2.3):
+        # transformer(text, phase) with phase in {"pre", "post"}.
+        self.transformer = transformer
         self._in_flight = 0
         self._in_flight_lock = threading.Lock()
         handler = _make_handler(self)
@@ -91,15 +95,20 @@ class ModelServer:
             return [self.name]
         return self.repository.names()
 
-    def lease(self, name: Optional[str]):
+    def lease(self, name: Optional[str], *, strict: bool = False):
         """Context manager: (engine, tokenizer, resolved_name) pinned for the
         request's duration (repository mode leases against LRU eviction).
 
-        Single-model servers ignore a foreign "model" field — OpenAI SDK
-        clients always send one, and the pre-multi-model server served them."""
+        ``strict`` (path-addressed endpoints): a single-model server 404s a
+        foreign name. Non-strict (OpenAI body "model" field): a foreign name
+        is ignored — OpenAI SDK clients always send one, and the
+        pre-multi-model server served them."""
         import contextlib
 
         if self.repository is None:
+            if strict and name not in (None, self.name):
+                raise KeyError(f"unknown model {name!r} (serving {self.name})")
+
             @contextlib.contextmanager
             def single():
                 yield self.engine, self.tokenizer, self.name
@@ -289,21 +298,27 @@ def _make_handler(server: ModelServer):
                              if action == "load" else "UNLOADED"})
 
         def _generate_text(self, prompt: str, body: dict,
-                           model: Optional[str]) -> tuple[str, Request]:
-            with server.lease(model) as (engine, tokenizer, _):
+                           model: Optional[str],
+                           strict: bool = False) -> tuple[str, Request]:
+            if server.transformer is not None:
+                prompt = server.transformer(prompt, "pre")
+            with server.lease(model, strict=strict) as (engine, tokenizer, _):
                 toks = tokenizer.encode(prompt)
                 req = engine.submit(toks,
                                     server.sampling_from(body, tokenizer))
                 out = req.result(timeout=float(body.get("timeout", 300)))
                 text = tokenizer.decode(
                     [t for t in out if t != tokenizer.eos_id])
-                return text, req
+            if server.transformer is not None:
+                text = server.transformer(text, "post")
+            return text, req
 
         def _v1_predict(self, body: dict, model: str) -> None:
             instances = body.get("instances")
             if not isinstance(instances, list):
                 raise ValueError("body must contain 'instances': [...]")
-            preds = [self._generate_text(str(inst), body, model)[0]
+            preds = [self._generate_text(str(inst), body, model,
+                                         strict=True)[0]
                      for inst in instances]
             self._json(200, {"predictions": preds})
 
@@ -315,7 +330,7 @@ def _make_handler(server: ModelServer):
             for inp in inputs:
                 for datum in inp.get("data", []):
                     texts.append(self._generate_text(str(datum), body,
-                                                     model)[0])
+                                                     model, strict=True)[0])
             self._json(200, {
                 "model_name": model,
                 "outputs": [{"name": "text", "datatype": "BYTES",
@@ -355,6 +370,11 @@ def _make_handler(server: ModelServer):
 
         def _completions_stream(self, prompt: str, body: dict, *, chat: bool,
                                 model: Optional[str]) -> None:
+            # The pre-hook applies to the prompt like the non-streaming path;
+            # the post-hook cannot (output streams piecewise) — a documented
+            # transformer limitation, matching kserve's non-streaming scope.
+            if server.transformer is not None:
+                prompt = server.transformer(prompt, "pre")
             with server.lease(model) as (engine, tokenizer, _):
                 toks = tokenizer.encode(prompt)
                 req = engine.submit(toks,

@@ -215,3 +215,27 @@ class TestMultiModel:
              {"instances": ["hi"], "max_tokens": 4})
         code, text = http(repo_server, "GET", "/metrics")
         assert 'kftpu_serving_requests_total{model="alpha"}' in text
+
+
+def upcase_transformer(text: str, phase: str) -> str:
+    """Test transformer: tags the prompt (pre) and uppercases output (post)."""
+    return f"[pre]{text}" if phase == "pre" else text.upper()
+
+
+class TestTransformer:
+    def test_pre_and_post_hooks(self):
+        cfg = preset("tiny", vocab_size=512)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        engine = LLMEngine(cfg, BatchingSpec(max_batch_size=2, max_seq_len=64,
+                                             prefill_buckets=[16]),
+                           params=params)
+        srv = ModelServer("t", engine, transformer=upcase_transformer, port=0)
+        srv.start()
+        try:
+            code, out = http(srv, "POST", "/v1/models/t:predict",
+                             {"instances": ["ab"], "max_tokens": 3})
+            assert code == 200
+            pred = out["predictions"][0]
+            assert pred == pred.upper()     # post hook ran
+        finally:
+            srv.stop()
