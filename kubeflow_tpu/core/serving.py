@@ -51,6 +51,10 @@ class BatchingSpec(BaseModel):
     max_pages: Optional[int] = None  # default: sized from HBM budget
     chunked_prefill_tokens: int = 512
     prefill_buckets: list[int] = Field(default_factory=lambda: [128, 512, 2048])
+    # Decode steps per device dispatch: sampling runs on-device and up to
+    # this many tokens emit per host round-trip (amortizes dispatch latency;
+    # early-exits when all slots finish). 1 = one step per dispatch.
+    decode_steps: int = 8
     # "auto": Pallas flash kernel on TPU (forward-only prefill is where it
     # wins), XLA elsewhere; or force "pallas"/"xla".
     prefill_attn_impl: str = "auto"

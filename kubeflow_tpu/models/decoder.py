@@ -124,6 +124,13 @@ def _remat(fn, policy: str):
             fn, policy=jax.checkpoint_policies.nothing_saveable)
     if policy == "dots_saveable":
         return jax.checkpoint(fn, policy=jax.checkpoint_policies.dots_saveable)
+    if policy == "dots_no_batch":
+        # The classic transformer policy: save every weight matmul (QKV/out
+        # projections, MLP) but recompute the attention einsums — their dots
+        # carry batch dims, so the O(S²) score/prob tensors are never stashed.
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     raise ValueError(f"unknown remat policy {policy!r}")
 
 

@@ -47,22 +47,58 @@ class SamplingParams:
     max_new_tokens: int = 64
     temperature: float = 0.0          # 0 = greedy
     top_k: int = 0                    # 0 = off
+    top_p: float = 1.0                # >= 1 = off (nucleus sampling)
     stop_token: Optional[int] = None  # eos
 
 
-def _sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
-            top_k: int) -> jax.Array:
-    """[B, V] logits -> [B] token ids. Greedy when temperature == 0."""
+def _mode_for(params_list) -> str:
+    """Static sampling mode for a dispatch (cheapest program that is exact
+    for every slot in it)."""
+    if all(p.temperature <= 0.0 for p in params_list):
+        return "greedy"
+    if all(p.top_k <= 0 and p.top_p >= 1.0 for p in params_list):
+        return "plain"
+    return "full"
+
+
+def _sample_batch(logits: jax.Array, key: jax.Array, temps: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array,
+                  mode: str = "full") -> jax.Array:
+    """[B, V] logits -> [B] token ids with PER-SLOT sampling params.
+
+    ``temps``/``top_k``/``top_p`` are traced [B] arrays, so one compiled
+    program serves every mix of greedy / top-k / nucleus requests sharing a
+    decode batch (a slot asking top_k=0 full-categorical must never inherit a
+    neighbor's truncation). One descending sort per step provides both the
+    k-th-value threshold (any k, no static cap) and the nucleus cumsum.
+
+    ``mode`` is a static fast-path hint the host computes per dispatch:
+    "greedy" (every slot temperature=0) skips sampling entirely; "plain"
+    (no slot requests truncation) skips the sort pipeline and draws from the
+    scaled logits directly; "full" runs top-k/top-p filtering."""
+    v = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1)
-    if top_k > 0:
-        vals, idx = jax.lax.top_k(logits, top_k)
-        scaled = vals / jnp.maximum(temperature[:, None], 1e-6)
-        draw = jax.random.categorical(key, scaled, axis=-1)
-        sampled = jnp.take_along_axis(idx, draw[:, None], axis=1)[:, 0]
-    else:
-        scaled = logits / jnp.maximum(temperature[:, None], 1e-6)
+    if mode == "greedy":
+        return greedy
+    if mode == "plain":
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
         sampled = jax.random.categorical(key, scaled, axis=-1)
-    return jnp.where(temperature > 0, sampled, greedy)
+        return jnp.where(temps > 0, sampled, greedy)
+    order = jnp.argsort(-logits, axis=-1)                       # [B,V] desc
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, v), 1)
+    keep_k = jnp.where((top_k > 0)[:, None], col < top_k[:, None], True)
+    scaled = jnp.where(keep_k, sorted_logits, -1e30) \
+        / jnp.maximum(temps, 1e-6)[:, None]
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs                    # exclusive
+    # Exclusive cumsum keeps the first token whenever top_p > 0; the col==0
+    # clause guards degenerate top_p <= 0 from an all-masked row.
+    keep_p = (cum < top_p[:, None]) | (col == 0)
+    final = jnp.where(keep_p, scaled, -1e30)
+    draw = jax.random.categorical(key, final, axis=-1)          # [B]
+    sampled = jnp.take_along_axis(order, draw[:, None], axis=-1)[:, 0]
+    return jnp.where(temps > 0, sampled, greedy)
 
 
 # -- device-side steps ---------------------------------------------------------
@@ -87,7 +123,7 @@ def _decode_attention(q, ck, cv, lengths, cfg: DecoderConfig):
     return out.reshape(b, 1, cfg.n_heads, cfg.head_dim)
 
 
-def _decode_block(bp, x, positions, lengths, cache_k, cache_v, cfg):
+def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg):
     """One transformer block for a [B,1] decode step against slot caches.
     Returns (x, new_k_cache, new_v_cache)."""
     dt = cfg.activation_dtype
@@ -98,8 +134,13 @@ def _decode_block(bp, x, positions, lengths, cache_k, cache_v, cfg):
     q = L.rope(q, positions, cfg.rope_theta)
     k = L.rope(k, positions, cfg.rope_theta)
     bidx = jnp.arange(x.shape[0])
-    ck = cache_k.at[bidx, lengths].set(k[:, 0])   # write this token's K/V
-    cv = cache_v.at[bidx, lengths].set(v[:, 0])
+    # Dead rows (free slots, finished slots, and the slot a chunked prefill
+    # is filling) must not touch the cache: aim their write out of bounds
+    # and drop it — a slot mid-chunking has real KV at position 0 that a
+    # lengths=0 placeholder write would silently corrupt.
+    widx = jnp.where(live, lengths, jnp.int32(cache_k.shape[1]))
+    ck = cache_k.at[bidx, widx].set(k[:, 0], mode="drop")
+    cv = cache_v.at[bidx, widx].set(v[:, 0], mode="drop")
     attn = _decode_attention(q, ck, cv, lengths, cfg)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
     h = L.rmsnorm(x, bp["ln2"], cfg)
@@ -111,9 +152,9 @@ def _decode_block(bp, x, positions, lengths, cache_k, cache_v, cfg):
 
 
 def _decode_step(params: Params, cache: dict, tokens: jax.Array,
-                 lengths: jax.Array, cfg: DecoderConfig):
-    """tokens [B] (last sampled), lengths [B] (their positions).
-    Returns (logits [B,V] fp32, new cache)."""
+                 lengths: jax.Array, live: jax.Array, cfg: DecoderConfig):
+    """tokens [B] (last sampled), lengths [B] (their positions), live [B]
+    (rows whose KV write is real). Returns (logits [B,V] fp32, new cache)."""
     dt = cfg.activation_dtype
     x = params["embed"].astype(dt)[tokens[:, None]]      # [B,1,D]
     if cfg.embed_scale:
@@ -122,7 +163,8 @@ def _decode_step(params: Params, cache: dict, tokens: jax.Array,
 
     def body(x, scan_in):
         bp, ck, cv = scan_in
-        x, nk, nv = _decode_block(bp, x, positions, lengths, ck, cv, cfg)
+        x, nk, nv = _decode_block(bp, x, positions, lengths, live, ck, cv,
+                                  cfg)
         return x, (nk, nv)
 
     x, (nk, nv) = jax.lax.scan(body, x, (params["layers"],
@@ -134,6 +176,57 @@ def _decode_step(params: Params, cache: dict, tokens: jax.Array,
     if cfg.logits_softcap is not None:
         logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
     return logits, {"k": nk, "v": nv}
+
+
+def _decode_multi(params: Params, cache: dict, tokens: jax.Array,
+                  lengths: jax.Array, live: jax.Array, temps: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array, stop_tokens: jax.Array,
+                  budgets: jax.Array, key: jax.Array, cfg: DecoderConfig,
+                  num_steps: int, sample_mode: str = "full"):
+    """Up to ``num_steps`` decode+sample steps in ONE device dispatch.
+
+    The single-step loop pays one host round-trip per token — on a tunneled
+    chip that round-trip (~16 ms) dwarfs the model forward. Sampling runs
+    on-device inside a ``while_loop`` that exits as soon as every slot is
+    finished (stop token, token budget, or cache-length cap).
+
+    Dead rows (free slots, finished slots, a slot mid-chunked-prefill) still
+    flow through the batch so shapes never change, but their KV writes are
+    aimed out of bounds and DROPPED in _decode_block — a replayed write is
+    NOT safe (it would corrupt KV a chunked prefill already wrote). Their
+    sampled tokens are discarded via the ``live`` mask. Emitted tokens
+    surface as ``out`` [B, num_steps] with -1 in never-emitted cells.
+
+    Returns (out, cache, lengths, live, budgets)."""
+    b = tokens.shape[0]
+    max_len = cache["k"].shape[2]
+    out0 = jnp.full((b, num_steps), -1, jnp.int32)
+
+    def cond(carry):
+        i, _, _, _, live, _, _, _ = carry
+        return (i < num_steps) & jnp.any(live)
+
+    def body(carry):
+        i, cache, tokens, lengths, live, budgets, key, out = carry
+        logits, cache = _decode_step(params, cache, tokens, lengths, live,
+                                     cfg)
+        key, sub = jax.random.split(key)
+        sampled = _sample_batch(logits, sub, temps, top_k, top_p,
+                                mode=sample_mode)
+        tokens = jnp.where(live, sampled, tokens)
+        out = out.at[:, i].set(jnp.where(live, sampled, -1))
+        lengths = jnp.where(live, lengths + 1, lengths)
+        budgets = jnp.where(live, budgets - 1, budgets)
+        # Same finish rules the host scheduler applies (they must agree, or a
+        # slot would stall or over-generate between dispatches).
+        live = live & (sampled != stop_tokens) & (budgets > 0) \
+            & (lengths + 1 < max_len)
+        return i + 1, cache, tokens, lengths, live, budgets, key, out
+
+    _, cache, _, lengths, live, budgets, _, out = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), cache, tokens, lengths, live, budgets, key, out0))
+    return out, cache, lengths, live, budgets
 
 
 def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
@@ -297,9 +390,6 @@ class LLMEngine:
         }
 
         # Compiled programs: donate the cache so it mutates in place in HBM.
-        self._decode = jax.jit(
-            lambda p, c, t, l: _decode_step(p, c, t, l, cfg),
-            donate_argnums=(1,))
         on_tpu = jax.default_backend() == "tpu"
 
         def _prefill_fn(p, c, t, s, ln):
@@ -322,7 +412,17 @@ class LLMEngine:
             donate_argnums=(1,))
         # (request, slot, next_position) of the in-flight chunked prefill.
         self._chunking: Optional[tuple[Request, int, int]] = None
-        self._sampler = jax.jit(_sample, static_argnums=(3,))
+        self._sampler = jax.jit(_sample_batch, static_argnums=(5,))
+        # K decode steps per dispatch amortizes host round-trip latency
+        # (sampling happens on-device; the while_loop exits early when every
+        # slot finishes). num_steps and sample_mode are static — a handful
+        # of traces (K/1 × greedy/plain/full) cover all traffic.
+        self.decode_steps = max(1, int(b.decode_steps))
+        self._decode_n = jax.jit(
+            lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m:
+            _decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k, cfg, n,
+                          sample_mode=m),
+            static_argnums=(11, 12), donate_argnums=(1,))
 
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots
         self.waiting: "queue.Queue[Request]" = queue.Queue()
@@ -373,7 +473,9 @@ class LLMEngine:
         first = self._sampler(
             last_logits[None, :], self._next_key(),
             jnp.asarray([req.params.temperature], jnp.float32),
-            req.params.top_k)
+            jnp.asarray([req.params.top_k], jnp.int32),
+            jnp.asarray([req.params.top_p], jnp.float32),
+            _mode_for([req.params]))
         tok = int(jax.device_get(first)[0])
         req.first_token_time = time.monotonic()
         req.output_tokens.append(tok)
@@ -461,31 +563,52 @@ class LLMEngine:
         return True
 
     def _decode_once(self) -> int:
-        """One decode step for all active slots. Returns tokens emitted."""
+        """Up to ``decode_steps`` decode steps for all active slots in one
+        dispatch (one step while a chunked prefill interleaves, so running
+        streams still tick between chunks). Returns tokens emitted."""
         active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return 0
-        tokens = np.zeros((self.num_slots,), np.int32)
-        lengths = np.zeros((self.num_slots,), np.int32)
-        temps = np.zeros((self.num_slots,), np.float32)
+        nb = self.num_slots
+        tokens = np.zeros((nb,), np.int32)
+        lengths = np.zeros((nb,), np.int32)
+        live = np.zeros((nb,), bool)
+        temps = np.zeros((nb,), np.float32)
+        top_k = np.zeros((nb,), np.int32)
+        top_p = np.ones((nb,), np.float32)
+        stops = np.full((nb,), -1, np.int32)
+        budgets = np.zeros((nb,), np.int32)
         for i, s in active:
+            p = s.request.params
             tokens[i] = s.last_token
             lengths[i] = s.length       # write position of last_token's KV
-            temps[i] = s.request.params.temperature
-        top_k = max((s.request.params.top_k for _, s in active), default=0)
-        logits, self.cache = self._decode(
-            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(lengths))
-        sampled = jax.device_get(self._sampler(
-            logits, self._next_key(), jnp.asarray(temps), top_k))
+            budget = max(p.max_new_tokens - s.generated, 0)
+            live[i] = budget > 0
+            temps[i] = p.temperature
+            top_k[i] = p.top_k
+            top_p[i] = p.top_p
+            stops[i] = -1 if p.stop_token is None else p.stop_token
+            budgets[i] = budget
+        k_steps = 1 if self._chunking is not None else self.decode_steps
+        mode = _mode_for([s.request.params for _, s in active])
+        out, self.cache, _, _, _ = self._decode_n(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(lengths), jnp.asarray(live), jnp.asarray(temps),
+            jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(stops),
+            jnp.asarray(budgets), self._next_key(), k_steps, mode)
+        out = np.asarray(jax.device_get(out))
         emitted = 0
         for i, s in active:
-            tok = int(sampled[i])
-            s.request.output_tokens.append(tok)
-            s.request.stream.put(tok)
-            s.last_token = tok
-            s.length += 1
-            s.generated += 1
-            emitted += 1
+            for t in out[i]:
+                if t < 0:
+                    break               # -1 = emitted nothing further
+                tok = int(t)
+                s.request.output_tokens.append(tok)
+                s.request.stream.put(tok)
+                s.last_token = tok
+                s.length += 1
+                s.generated += 1
+                emitted += 1
             self._finish_if_done(i)
         return emitted
 

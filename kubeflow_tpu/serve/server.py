@@ -154,6 +154,7 @@ class ModelServer:
             max_new_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
             stop_token=tokenizer.eos_id,
         )
 

@@ -1,0 +1,121 @@
+"""On-chip MFU sweep: time the full train step across remat/attn/batch grids.
+
+Each config runs in a subprocess (the axon compile helper can 500 on big
+programs; isolation keeps one failure from killing the sweep). Prints one
+JSON line per config.
+
+Usage:
+    python scripts/mfu_sweep.py               # run the default grid
+    python scripts/mfu_sweep.py --one nothing_saveable xla 4   # single config
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRID = [
+    # (remat_policy, attn_impl, per_chip_batch)
+    ("nothing_saveable", "xla", 4),      # round-1 baseline
+    ("dots_no_batch", "xla", 4),
+    ("dots_no_batch", "pallas", 4),
+    ("nothing_saveable", "pallas", 4),
+    ("none", "pallas", 4),
+    ("none", "xla", 4),
+    ("dots_no_batch", "xla", 8),
+    ("none", "pallas", 8),
+    ("dots_no_batch", "pallas", 8),
+]
+
+
+def run_one(remat: str, attn: str, batch: int, steps: int = 8, warmup: int = 2):
+    import jax
+
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.runtime.mesh import build_mesh
+    from kubeflow_tpu.runtime.topology import detect_local_cluster
+    from kubeflow_tpu.train.data import DataConfig, make_data_source
+    from kubeflow_tpu.train.optim import OptimizerConfig
+    from kubeflow_tpu.train.step import setup_train
+
+    devices = jax.devices()
+    n = len(devices)
+    cfg = preset(
+        "llama3-8b",
+        n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
+        mlp_dim=8192, vocab_size=32000, max_seq_len=2048,
+        remat_policy=remat,
+    )
+    mesh = build_mesh({"fsdp": n}, devices)
+    data_cfg = DataConfig(vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
+                          global_batch=batch * n)
+    source = make_data_source(data_cfg)
+    task = setup_train(cfg, OptimizerConfig(total_steps=warmup + steps), mesh,
+                       attn_impl=attn)
+
+    def step(i, state):
+        b = jax.device_put(source.batch_at(i), task.batch_sharding)
+        state, metrics = task.step_fn(state, b)
+        return state, float(metrics["loss"])  # host fetch = the only fence
+
+    state = task.state
+    t_c0 = time.perf_counter()
+    for i in range(warmup):
+        state, loss = step(i, state)
+    compile_s = time.perf_counter() - t_c0
+
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + steps):
+        state, loss = step(i, state)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = data_cfg.global_batch * data_cfg.seq_len
+    tps_chip = tokens_per_step * steps / dt / n
+    gen = detect_local_cluster().slices[0].gen
+    mfu = (cfg.flops_per_token() * tps_chip) / (gen.bf16_tflops * 1e12)
+    return {
+        "remat": remat, "attn": attn, "batch": batch,
+        "tok_s_chip": round(tps_chip, 1),
+        "step_ms": round(dt / steps * 1e3, 2),
+        "mfu": round(mfu, 4),
+        "loss": round(loss, 4),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def main():
+    if len(sys.argv) >= 5 and sys.argv[1] == "--one":
+        remat, attn, batch = sys.argv[2], sys.argv[3], int(sys.argv[4])
+        steps = int(sys.argv[5]) if len(sys.argv) > 5 else 8
+        print(json.dumps(run_one(remat, attn, batch, steps=steps)))
+        return
+
+    for remat, attn, batch in GRID:
+        cmd = [sys.executable, __file__, "--one", remat, attn, str(batch)]
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"remat": remat, "attn": attn, "batch": batch,
+                              "failed": True, "err": "timeout 900s"}),
+                  flush=True)
+            continue
+        wall = round(time.perf_counter() - t0, 1)
+        if proc.returncode == 0 and proc.stdout.strip():
+            line = proc.stdout.strip().splitlines()[-1]
+            print(line, flush=True)
+        else:
+            err = (proc.stderr or "")[-400:].replace("\n", " | ")
+            print(json.dumps({"remat": remat, "attn": attn, "batch": batch,
+                              "failed": True, "wall_s": wall, "err": err}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,99 @@ def test_sampling_respects_temperature(engine):
     assert all(0 <= t < engine.cfg.vocab_size for o in outs for t in o)
 
 
+class TestMultiStepDecode:
+    """K decode steps per dispatch must be invisible to outputs: greedy
+    streams match the single-step engine exactly, stop/budget rules fire
+    mid-dispatch, and per-slot sampling params are honored."""
+
+    def make_engine(self, cfg, params, decode_steps):
+        return LLMEngine(cfg, BatchingSpec(
+            max_batch_size=4, max_seq_len=96, prefill_buckets=[16, 32, 64],
+            decode_steps=decode_steps), params=params)
+
+    def test_matches_single_step_greedy(self, cfg, params):
+        prompts = [[5, 17, 3], [7] * 12, [1, 2]]
+        outs = []
+        for k in (1, 4):
+            eng = self.make_engine(cfg, params, k)
+            reqs = [eng.submit(p, SamplingParams(max_new_tokens=n))
+                    for p, n in zip(prompts, (11, 6, 3))]
+            while not all(r.done.is_set() for r in reqs):
+                eng.step()
+            outs.append([list(r.output_tokens) for r in reqs])
+        assert outs[0] == outs[1]
+
+    def test_stop_token_mid_dispatch(self, cfg, params):
+        eng = self.make_engine(cfg, params, 8)
+        probe = eng.generate([3, 1, 4], SamplingParams(max_new_tokens=8))
+        stop = probe[3]                    # fires mid-way through a dispatch
+        req = eng.submit([3, 1, 4], SamplingParams(max_new_tokens=50,
+                                                   stop_token=stop))
+        while not req.done.is_set():
+            eng.step()
+        assert req.finish_reason == "stop"
+        assert req.output_tokens == probe[:4]
+
+    def test_budget_honored_mid_dispatch(self, cfg, params):
+        eng = self.make_engine(cfg, params, 8)
+        req = eng.submit([9, 9, 2], SamplingParams(max_new_tokens=5))
+        while not req.done.is_set():
+            eng.step()
+        assert len(req.output_tokens) == 5
+        assert req.finish_reason == "length"
+
+
+class TestPerSlotSampling:
+    """Each slot's temperature/top_k/top_p apply to that slot alone."""
+
+    def test_top_k_not_shared_across_slots(self, cfg, params):
+        """A top_k=1 slot decoding next to a top_k=0 (full categorical) slot
+        must still sample greedily — round-1 took max(top_k) over the batch,
+        silently truncating every slot alike."""
+        from kubeflow_tpu.serve.engine import _sample_batch
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(2, 64)) * 3, jnp.float32)
+        argmaxes = np.asarray(jax.device_get(jnp.argmax(logits, axis=-1)))
+        temps = jnp.asarray([1.0, 1.0], jnp.float32)
+        top_k = jnp.asarray([1, 0], jnp.int32)
+        top_p = jnp.asarray([1.0, 1.0], jnp.float32)
+        row0, row1 = set(), set()
+        for i in range(64):
+            got = np.asarray(jax.device_get(_sample_batch(
+                logits, jax.random.PRNGKey(i), temps, top_k, top_p)))
+            row0.add(int(got[0]))
+            row1.add(int(got[1]))
+        assert row0 == {int(argmaxes[0])}   # top_k=1 == greedy, every draw
+        assert len(row1) > 4                # full categorical explores
+
+    def test_top_p_nucleus(self):
+        from kubeflow_tpu.serve.engine import _sample_batch
+
+        # Probabilities ~ [0.5, 0.3, 0.2]: top_p=0.6 keeps {0, 1} only
+        # (exclusive cumsum: 0.0, 0.5 < 0.6, 0.8 ≥ 0.6).
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.2]], jnp.float32))
+        seen = set()
+        for i in range(100):
+            got = _sample_batch(logits, jax.random.PRNGKey(i),
+                                jnp.asarray([1.0]), jnp.asarray([0]),
+                                jnp.asarray([0.6]))
+            seen.add(int(jax.device_get(got)[0]))
+        assert seen == {0, 1}
+
+    def test_temperature_zero_is_greedy_per_slot(self):
+        from kubeflow_tpu.serve.engine import _sample_batch
+
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.normal(size=(2, 32)), jnp.float32)
+        got = _sample_batch(logits, jax.random.PRNGKey(0),
+                            jnp.asarray([0.0, 0.0]), jnp.asarray([0, 0]),
+                            jnp.asarray([1.0, 1.0]))
+        assert np.array_equal(np.asarray(jax.device_get(got)),
+                              np.asarray(jax.device_get(
+                                  jnp.argmax(logits, axis=-1))))
+
+
 class TestChunkedPrefill:
     """Chunked prefill: long prompts stream through fixed chunks with decode
     interleaving, producing the same output as one-shot prefill."""
@@ -169,6 +262,36 @@ class TestChunkedPrefill:
                 break
         assert long_req.done.is_set() and short.done.is_set()
         assert len(long_req.output_tokens) == 4
+
+    def test_interleaved_decode_does_not_corrupt_chunked_kv(self):
+        """Decode dispatches running while a chunked prefill holds its slot
+        must leave that slot's already-written KV untouched: the chunked
+        request's greedy output must equal the solo one-shot output.
+        (Regression: placeholder rows once wrote KV at position 0.)"""
+        long_prompt = list(range(7, 56))     # prompt[0] != 0 matters here
+        want = None
+        eng = self.make_engine(0)            # one-shot oracle, no traffic
+        solo = eng.submit(long_prompt,
+                          SamplingParams(max_new_tokens=6, temperature=0.0))
+        for _ in range(200):
+            eng.step()
+            if solo.done.is_set():
+                break
+        want = list(solo.output_tokens)
+
+        eng = self.make_engine(16)
+        short = eng.submit([9, 8, 7],
+                           SamplingParams(max_new_tokens=60, temperature=0.0))
+        eng.step()                           # short admitted and decoding
+        long_req = eng.submit(long_prompt,
+                              SamplingParams(max_new_tokens=6,
+                                             temperature=0.0))
+        for _ in range(300):
+            eng.step()                       # decode interleaves every chunk
+            if long_req.done.is_set():
+                break
+        assert long_req.done.is_set()
+        assert list(long_req.output_tokens) == want
 
     def test_slot_reserved_during_chunking(self):
         eng = self.make_engine(16)           # 2 slots
