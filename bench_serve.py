@@ -38,7 +38,8 @@ def run_bench(requests: int, concurrency: int, prompt_len: int,
 
     engine = LLMEngine(cfg, BatchingSpec(
         max_batch_size=min(16, concurrency), max_seq_len=cfg.max_seq_len,
-        prefill_buckets=[prompt_len]))
+        prefill_buckets=[prompt_len],
+        weights_dtype="bfloat16" if on_tpu else None))
     engine.start()
 
     rng = np.random.default_rng(0)

@@ -54,7 +54,11 @@ class BatchingSpec(BaseModel):
     # Decode steps per device dispatch: sampling runs on-device and up to
     # this many tokens emit per host round-trip (amortizes dispatch latency;
     # early-exits when all slots finish). 1 = one step per dispatch.
-    decode_steps: int = 8
+    decode_steps: int = 16
+    # Cast model weights once at engine load (e.g. "bfloat16" — halves the
+    # per-step HBM param read, the decode bottleneck; standard for serving).
+    # None keeps the checkpoint dtype.
+    weights_dtype: Optional[str] = None
     # "auto": Pallas flash kernel on TPU (forward-only prefill is where it
     # wins), XLA elsewhere; or force "pallas"/"xla".
     prefill_attn_impl: str = "auto"

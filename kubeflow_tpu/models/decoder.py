@@ -124,6 +124,14 @@ def _remat(fn, policy: str):
             fn, policy=jax.checkpoint_policies.nothing_saveable)
     if policy == "dots_saveable":
         return jax.checkpoint(fn, policy=jax.checkpoint_policies.dots_saveable)
+    if policy == "block_outs":
+        # Save post-rope Q/K/V + attention/MLP block outputs (named in
+        # models/layers.py) — ~1/4 of dots_no_batch's footprint; backward
+        # recomputes only norms, the S×S attention einsums, and the MLP
+        # interior.
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.save_only_these_names(
+                "q_rope", "k_rope", "v_proj", "attn_out", "mlp_out"))
     if policy == "dots_no_batch":
         # The classic transformer policy: save every weight matmul (QKV/out
         # projections, MLP) but recompute the attention einsums — their dots

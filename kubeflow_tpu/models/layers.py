@@ -14,6 +14,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from jax.ad_checkpoint import checkpoint_name
+
 from kubeflow_tpu.models.config import DecoderConfig
 from kubeflow_tpu.ops.attention import multi_head_attention
 
@@ -93,8 +95,12 @@ def attention_block(
     q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(dt))
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    # Names feed the "block_outs" remat policy: saving post-rope Q/K/V plus
+    # the block outputs skips reprojecting + re-rotating in the backward
+    # while staying far under dots_no_batch's save footprint.
+    q = checkpoint_name(rope(q, positions, cfg.rope_theta), "q_rope")
+    k = checkpoint_name(rope(k, positions, cfg.rope_theta), "k_rope")
+    v = checkpoint_name(v, "v_proj")
 
     new_cache = None
     if kv_cache is not None:
@@ -134,7 +140,7 @@ def attention_block(
     else:
         out = multi_head_attention(q, k, v, causal=True, impl=attn_impl)
     out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))
-    return out, new_cache
+    return checkpoint_name(out, "attn_out"), new_cache
 
 
 # -- MLP -----------------------------------------------------------------------
@@ -163,7 +169,8 @@ def mlp_block(p: dict, x: jax.Array, cfg: DecoderConfig) -> jax.Array:
     dt = cfg.activation_dtype
     gate = _act(jnp.einsum("bsd,dm->bsm", x, p["gate"].astype(dt)), cfg.hidden_act)
     up = jnp.einsum("bsd,dm->bsm", x, p["up"].astype(dt))
-    return jnp.einsum("bsm,md->bsd", gate * up, p["down"].astype(dt))
+    out = jnp.einsum("bsm,md->bsd", gate * up, p["down"].astype(dt))
+    return checkpoint_name(out, "mlp_out")
 
 
 # -- MoE -----------------------------------------------------------------------

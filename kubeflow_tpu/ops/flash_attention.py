@@ -151,10 +151,217 @@ def _flash_fwd(q, k, v, *, causal, sm_scale, softcap, q_offset,
     return o, lse[..., 0]
 
 
+def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *,
+                     causal: bool, sm_scale: float, softcap: Optional[float],
+                     q_offset: int, block_q: int, block_kv: int,
+                     num_q_blocks: int, num_groups: int):
+    """dK/dV: grid (batch, kv_head, kv_block, group, q_block) — the q sweep
+    is innermost so the [bkv, d] accumulators carry across every query block
+    (and every GQA group head) that attends to this kv block."""
+    ki = pl.program_id(2)
+    gi = pl.program_id(3)
+    qi = pl.program_id(4)
+
+    @pl.when((gi == 0) & (qi == 0))
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Causal skip: no query in this block sits at-or-after the kv block.
+    block_needed = jnp.logical_or(
+        jnp.logical_not(causal),
+        q_offset + (qi + 1) * block_q - 1 >= ki * block_kv)
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bkv, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                          # [bq, 1]
+        delta = delta_ref[0, 0]                      # [bq, 1]
+        s_raw = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.tanh(s_raw / softcap) * softcap if softcap is not None else s_raw
+        if causal:
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                         # exact: saved normalizer
+        # Fully-masked rows have lse == NEG_INF: exp(0) would be 1.
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bkv, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bq, bkv]
+        ds = p * (dp - delta)
+        if softcap is not None:
+            ds = ds * (1.0 - jnp.tanh(s_raw / softcap) ** 2)
+        ds = ds * sm_scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bkv, d]
+
+    @pl.when((gi == num_groups - 1) & (qi == num_q_blocks - 1))
+    def _flush():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                   dq_ref, dq_acc, *,
+                   causal: bool, sm_scale: float, softcap: Optional[float],
+                   q_offset: int, block_q: int, block_kv: int,
+                   num_kv_blocks: int):
+    """dQ: grid (batch, q_head, q_block, kv_block) — kv innermost so the
+    [bq, d] accumulator carries across the kv sweep, mirroring the forward."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    block_needed = jnp.logical_or(
+        jnp.logical_not(causal),
+        ki * block_kv <= q_offset + (qi + 1) * block_q - 1)
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s_raw = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.tanh(s_raw / softcap) * softcap if softcap is not None else s_raw
+        if causal:
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        if softcap is not None:
+            ds = ds * (1.0 - jnp.tanh(s_raw / softcap) ** 2)
+        ds = ds * sm_scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bq, d]
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _flush():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, sm_scale, softcap,
+                      q_offset, block_q, block_kv, interpret):
+    """Pallas flash backward: recompute attention blockwise from the saved
+    LSE (never materializing S×S), accumulating dK/dV per kv block and dQ
+    per q block in VMEM. K/V gradients stay at their GQA size."""
+    b, h, sq, d = q.shape
+    _, kh, skv, _ = k.shape
+    n_rep = h // kh
+    bq, bkv = _block_sizes(sq, skv, block_q, block_kv)
+    nq, nkv = sq // bq, skv // bkv
+    interp = interpret if interpret is not None else _auto_interpret()
+
+    # Rowsum(dO · O): the softmax-backward correction term, cheap in XLA.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)           # [B,H,Sq,1]
+    lse4 = lse[..., None]                             # [B,H,Sq,1]
+
+    dkdv = functools.partial(
+        _bwd_dkdv_kernel, causal=causal, sm_scale=sm_scale, softcap=softcap,
+        q_offset=q_offset, block_q=bq, block_kv=bkv,
+        num_q_blocks=nq, num_groups=n_rep)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(b, kh, nkv, n_rep, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, khi, ki, gi, qi, n_rep=n_rep:
+                         (bi, khi * n_rep + gi, qi, 0)),   # q
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, khi, ki, gi, qi, n_rep=n_rep:
+                         (bi, khi * n_rep + gi, qi, 0)),   # do
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, khi, ki, gi, qi, n_rep=n_rep:
+                         (bi, khi * n_rep + gi, qi, 0)),   # lse
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, khi, ki, gi, qi, n_rep=n_rep:
+                         (bi, khi * n_rep + gi, qi, 0)),   # delta
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, khi, ki, gi, qi: (bi, khi, ki, 0)),  # k
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, khi, ki, gi, qi: (bi, khi, ki, 0)),  # v
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, khi, ki, gi, qi: (bi, khi, ki, 0)),
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, khi, ki, gi, qi: (bi, khi, ki, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bkv, d), jnp.float32),
+            pltpu.VMEM((bkv, d), jnp.float32),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((b, kh, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, kh, skv, d), v.dtype),
+        ),
+        interpret=interp,
+    )(q, do, lse4, delta, k, v)
+
+    dqk = functools.partial(
+        _bwd_dq_kernel, causal=causal, sm_scale=sm_scale, softcap=softcap,
+        q_offset=q_offset, block_q=bq, block_kv=bkv, num_kv_blocks=nkv)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(b, h, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),        # q
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),        # do
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),        # lse
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),        # delta
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),                      # k
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),                      # v
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interp,
+    )(q, do, lse4, delta, k, v)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, causal, sm_scale, softcap, q_offset, block_q, block_kv,
-           interpret):
+           interpret, bwd_impl):
     o, _ = _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
                       softcap=softcap, q_offset=q_offset, block_q=block_q,
                       block_kv=block_kv, interpret=interpret)
@@ -162,7 +369,7 @@ def _flash(q, k, v, causal, sm_scale, softcap, q_offset, block_q, block_kv,
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, softcap, q_offset, block_q,
-                   block_kv, interpret):
+                   block_kv, interpret, bwd_impl):
     o, lse = _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale,
                         softcap=softcap, q_offset=q_offset, block_q=block_q,
                         block_kv=block_kv, interpret=interpret)
@@ -170,12 +377,17 @@ def _flash_vjp_fwd(q, k, v, causal, sm_scale, softcap, q_offset, block_q,
 
 
 def _flash_vjp_bwd(causal, sm_scale, softcap, q_offset, block_q, block_kv,
-                   interpret, res, do):
-    """Flash-style backward: ONE blockwise sweep over KV. The kernel's saved
-    output + log-sum-exp replace the stats/output recompute passes, and the
-    grouped [b, kh, n_rep, s, d] layout keeps K/V at their GQA size (no
-    n_rep-fold expansion)."""
+                   interpret, bwd_impl, res, do):
+    """Backward dispatch: ``bwd_impl="pallas"`` runs the blockwise Pallas
+    kernels (dK/dV + dQ, no S×S materialization — the training hot path);
+    ``"xla"`` keeps the einsum/scan sweep as oracle and fallback."""
     q, k, v, o, lse = res
+    if bwd_impl == "pallas":
+        dq, dk, dv = _flash_bwd_pallas(
+            q, k, v, o, lse, do, causal=causal, sm_scale=sm_scale,
+            softcap=softcap, q_offset=q_offset, block_q=block_q,
+            block_kv=block_kv, interpret=interpret)
+        return dq, dk, dv
     b, h, sq, d = q.shape
     _, kh, skv, _ = k.shape
     n_rep = h // kh
@@ -238,11 +450,13 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
+    bwd_impl: str = "pallas",
 ) -> jax.Array:
     """Flash attention with GQA; layout-compatible with ops.attention
     (returns [B, Sq, H, D]). ``q_offset`` must be a static int here (the
     prefill path); traced-offset decode goes through the XLA impl, which is
-    the right tool for single-token queries anyway."""
+    the right tool for single-token queries anyway. ``bwd_impl`` picks the
+    gradient path: "pallas" blockwise kernels (default), "xla" oracle."""
     if isinstance(q_offset, jax.Array):
         raise ValueError(
             "flash_attention needs a static q_offset; use impl='xla' for "
@@ -254,5 +468,5 @@ def flash_attention(
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     o = _flash(qt, kt, vt, causal, scale, logits_softcap,
-               int(q_offset), block_q, block_kv, interpret)
+               int(q_offset), block_q, block_kv, interpret, bwd_impl)
     return jnp.swapaxes(o, 1, 2)

@@ -6,16 +6,29 @@ ICI-neighbor devices and activations hop stage→stage with `lax.ppermute`
 inside `shard_map` — the collective-pipelining recipe (cf. the public
 scaling-book/praxis pattern), not an NCCL p2p translation.
 
-Schedule: GPipe — m microbatches through n stages in m+n-1 ticks; at tick t
-stage s runs microbatch t-s (bubble ticks are masked compute, fraction
-(n-1)/(m+n-1)). The whole schedule is a `lax.scan`, so it jits once,
-differentiates (ppermute/where/scan all have transposes — reverse-mode
-produces the mirrored backward pipeline), and composes with the data axes in
-the same mesh (``batch_axes`` shards the batch dim of the streamed pytree).
-Stage weights: leading dim sharded over ``pipeline``. Memory: stash
-activations per microbatch (GPipe); ``stage_fn`` is wrapped in
-``jax.checkpoint`` by default to trade recompute for memory (1F1B's win) —
-the schedule itself stays XLA's job.
+Two schedules:
+
+- **GPipe** — m microbatches through n stages in m+n-1 ticks; at tick t
+  stage s runs microbatch t-s (bubble ticks are masked compute, fraction
+  (n-1)/(m+n-1)). The whole schedule is a `lax.scan`, so it jits once and
+  differentiates (reverse-mode produces the mirrored backward pipeline).
+  Autodiff stashes one boundary activation per microbatch per stage, so m
+  is capped at 2·stages — bubble floor ≈ ⅓.
+- **1F1B** (``schedule="1f1b"``) — the forward is the same streaming scan,
+  but the backward is a hand-written interleaved schedule (custom_vjp): per
+  super-tick each stage runs one forward (recompute) and one backward of an
+  *earlier* microbatch, with activations hopping forward and cotangents
+  hopping backward in the same tick. Live stage-inputs are bounded by a
+  ring buffer of depth 2n-1 — **independent of m** — so microbatch count
+  (and thus bubble fraction (n-1)/(m+n-1)) is no longer memory-capped.
+  FLOPs: 3 forwards + 1 backward per microbatch per stage (the fwd lane
+  regenerates ring inputs and the vjp's primal re-runs the stage), ~25%
+  over checkpointed GPipe's 2 fwd + 1 bwd — the price of the
+  m-independent ring.
+
+Both compose with the data axes in the same mesh (``batch_axes`` shards the
+batch dim of the streamed pytree). Stage weights: leading dim sharded over
+``pipeline``.
 """
 
 from __future__ import annotations
@@ -45,15 +58,19 @@ def pipeline_apply(
     axis_name: str = "pipeline",
     batch_axes: tuple = ("dcn", "data", "fsdp"),
     checkpoint_stages: bool = True,
+    schedule: str = "gpipe",
 ) -> Any:
     """Run ``y = stage_{n-1}(... stage_0(xs))`` pipelined over microbatches.
 
     ``stage_fn(params_one_stage, xs_mb) -> ys_mb`` must preserve the pytree
     structure and leaf shapes (the transformer-stack contract). Every leaf
     streams with the microbatch; the batch dim may additionally be sharded
-    over ``batch_axes``. ``num_microbatches=None`` auto-picks the largest
-    m ≤ 2·stages dividing the local batch (bubble ≤ ⅓). Returns the same
-    pytree, [batch, ...] per leaf."""
+    over ``batch_axes``. With ``schedule="gpipe"``, ``num_microbatches=None``
+    auto-picks the largest m ≤ 2·stages dividing the local batch (autodiff
+    stashes per-microbatch activations — bubble ≤ ⅓); with ``"1f1b"`` the
+    stash is a fixed 2n-1 ring so auto-m rises to ≤ 4·stages and any m is
+    legal (every leaf must then be inexact — stream ints via closure).
+    Returns the same pytree, [batch, ...] per leaf."""
     n_stages = mesh.shape[axis_name]
     leaves = jax.tree.leaves(xs)
     batch = leaves[0].shape[0]
@@ -63,13 +80,21 @@ def pipeline_apply(
         data_shards *= mesh.shape[a]
     local_batch = batch // data_shards
     if num_microbatches is None:
+        m_cap = (4 if schedule == "1f1b" else 2) * n_stages
         num_microbatches = next(
-            (m for m in range(min(2 * n_stages, max(local_batch, 1)), 0, -1)
+            (m for m in range(min(m_cap, max(local_batch, 1)), 0, -1)
              if local_batch % m == 0), 1)
     if batch % data_shards or local_batch % num_microbatches:
         raise ValueError(
             f"batch {batch} must be divisible by data shards {data_shards} × "
             f"num_microbatches {num_microbatches}")
+    if schedule == "1f1b":
+        return _pipeline_1f1b(
+            stage_fn, stage_params, xs, mesh=mesh,
+            num_microbatches=num_microbatches, axis_name=axis_name,
+            batch_axes=batch_axes, local_batch=local_batch)
+    if schedule != "gpipe":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     mb = local_batch // num_microbatches
     fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
 
@@ -130,6 +155,189 @@ def pipeline_apply(
         out_specs=x_specs,
         check_vma=False,
     )(stage_params, xs)
+
+
+def _pipeline_1f1b(stage_fn, stage_params, xs, *, mesh, num_microbatches,
+                   axis_name, batch_axes, local_batch):
+    """1F1B: GPipe-style streaming forward + a hand-scheduled interleaved
+    backward under ``jax.custom_vjp``.
+
+    Backward super-tick t at stage s (n stages, m microbatches):
+      - forward-recompute lane: microbatch ``fi = t - s`` (the GPipe wave);
+      - backward lane: microbatch ``bi = t - (2n - 2 - s)`` — the last stage
+        backprops a microbatch in the same tick its recompute lands, earlier
+        stages 2·(n-1-s) ticks later, exactly the 1F1B pattern.
+    Both lanes run every tick (masked when out of range): activations hop
+    s→s+1 and cotangents hop s+1→s in the same tick, so no device ever
+    waits on a branch. A stage holds at most 2n-1 microbatch inputs
+    (fi - bi = 2(n-1-s)), so the ring buffer — not m — bounds memory. Cost:
+    3 forwards + 1 backward per microbatch per stage (the fwd lane refills
+    the ring AND the vjp's primal re-runs the stage) — one extra forward
+    over checkpointed GPipe, the price of the m-independent ring."""
+    n = mesh.shape[axis_name]
+    m = num_microbatches
+    mb = local_batch // m
+    ring_depth = 2 * n - 1
+    send_perm = [(i, i + 1) for i in range(n - 1)]
+    recv_perm = [(i + 1, i) for i in range(n - 1)]
+
+    for leaf in jax.tree.leaves(xs):
+        if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            raise TypeError(
+                "1f1b pipeline streams cotangents; every xs leaf must be "
+                f"inexact (got {leaf.dtype}) — close over integer inputs "
+                "in stage_fn instead")
+
+    def fwd_worker(params, xs_local):
+        params1 = jax.tree.map(lambda p: p[0], params)
+        s = jax.lax.axis_index(axis_name)
+        xs_mb = jax.tree.map(
+            lambda a: a.reshape(m, mb, *a.shape[1:]), xs_local)
+
+        def tick(carry, t):
+            buf, out = carry
+            fi = t - s
+            active = jnp.logical_and(fi >= 0, fi < m)
+            feed = jax.tree.map(lambda a: a[jnp.clip(fi, 0, m - 1)], xs_mb)
+            x_in = jax.tree.map(
+                lambda f, b: jnp.where(s == 0, f, b), feed, buf)
+            y = stage_fn(params1, x_in)
+            y = jax.tree.map(
+                lambda a: jnp.where(active, a, jnp.zeros_like(a)), y)
+            write = jnp.logical_and(active, s == n - 1)
+            idx = jnp.clip(fi, 0, m - 1)
+            out = jax.tree.map(
+                lambda o, a: jnp.where(
+                    write, jax.lax.dynamic_update_index_in_dim(o, a, idx, 0),
+                    o),
+                out, y)
+            buf_next = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, axis_name, send_perm), y)
+            return (buf_next, out), None
+
+        out0 = jax.tree.map(
+            lambda a: jnp.zeros((m, mb, *a.shape[1:]), a.dtype), xs_local)
+        buf0 = jax.tree.map(
+            lambda a: jnp.zeros((mb, *a.shape[1:]), a.dtype), xs_local)
+        (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(m + n - 1))
+
+        def collect(o):
+            owner = (s == n - 1).astype(o.dtype)
+            o = jax.lax.psum(o * owner, axis_name)
+            return o.reshape(local_batch, *o.shape[2:])
+
+        return jax.tree.map(collect, out)
+
+    def bwd_worker(params, xs_local, gys_local):
+        params1 = jax.tree.map(lambda p: p[0], params)
+        s = jax.lax.axis_index(axis_name)
+        xs_mb = jax.tree.map(
+            lambda a: a.reshape(m, mb, *a.shape[1:]), xs_local)
+        gys_mb = jax.tree.map(
+            lambda a: a.reshape(m, mb, *a.shape[1:]), gys_local)
+
+        def tick(carry, t):
+            ring, fbuf, gbuf, dparams, dxs = carry
+            # -- forward-recompute lane: microbatch fi enters this stage
+            fi = t - s
+            f_active = jnp.logical_and(fi >= 0, fi < m)
+            feed = jax.tree.map(lambda a: a[jnp.clip(fi, 0, m - 1)], xs_mb)
+            x_in = jax.tree.map(
+                lambda f, b: jnp.where(s == 0, f, b), feed, fbuf)
+            fslot = jnp.clip(fi, 0, m - 1) % ring_depth
+            ring = jax.tree.map(
+                lambda r, x: jnp.where(
+                    f_active,
+                    jax.lax.dynamic_update_index_in_dim(r, x, fslot, 0), r),
+                ring, x_in)
+            y = stage_fn(params1, x_in)
+            # -- backward lane: microbatch bi leaves this stage
+            bi = t - (2 * n - 2 - s)
+            b_active = jnp.logical_and(bi >= 0, bi < m)
+            bslot = jnp.clip(bi, 0, m - 1) % ring_depth
+            x_saved = jax.tree.map(lambda r: r[bslot], ring)
+            g_in = jax.tree.map(
+                lambda g, b: jnp.where(s == n - 1,
+                                       g[jnp.clip(bi, 0, m - 1)], b),
+                gys_mb, gbuf)
+            _, vjp_fn = jax.vjp(stage_fn, params1, x_saved)
+            dp, dx = vjp_fn(g_in)
+            dparams = jax.tree.map(
+                lambda acc, d: acc + jnp.where(b_active, d,
+                                               jnp.zeros_like(d)),
+                dparams, dp)
+            deposit = jnp.logical_and(b_active, s == 0)
+            dxs = jax.tree.map(
+                lambda o, d: jnp.where(
+                    deposit,
+                    jax.lax.dynamic_update_index_in_dim(
+                        o, d, jnp.clip(bi, 0, m - 1), 0),
+                    o),
+                dxs, dx)
+            # -- hops: activations forward, cotangents backward, every tick
+            fbuf = jax.tree.map(
+                lambda a: jax.lax.ppermute(
+                    jnp.where(f_active, a, jnp.zeros_like(a)),
+                    axis_name, send_perm), y)
+            gbuf = jax.tree.map(
+                lambda a: jax.lax.ppermute(
+                    jnp.where(b_active, a, jnp.zeros_like(a)),
+                    axis_name, recv_perm), dx)
+            return (ring, fbuf, gbuf, dparams, dxs), None
+
+        ring0 = jax.tree.map(
+            lambda a: jnp.zeros((ring_depth, mb, *a.shape[1:]), a.dtype),
+            xs_local)
+        fbuf0 = jax.tree.map(
+            lambda a: jnp.zeros((mb, *a.shape[1:]), a.dtype), xs_local)
+        gbuf0 = jax.tree.map(jnp.zeros_like, fbuf0)
+        dparams0 = jax.tree.map(jnp.zeros_like, params1)
+        dxs0 = jax.tree.map(
+            lambda a: jnp.zeros((m, mb, *a.shape[1:]), a.dtype), xs_local)
+        (_, _, _, dparams, dxs), _ = jax.lax.scan(
+            tick, (ring0, fbuf0, gbuf0, dparams0, dxs0),
+            jnp.arange(m + 2 * n - 2))
+
+        def collect(o):
+            owner = (s == 0).astype(o.dtype)
+            o = jax.lax.psum(o * owner, axis_name)
+            return o.reshape(local_batch, *o.shape[2:])
+
+        if batch_axes:
+            # Shared stage weights under data parallelism: every data shard
+            # contributes gradient; out_specs claims replication over the
+            # batch axes, so the sum must happen here (autodiff would have
+            # inserted this psum as the transpose of the implicit broadcast).
+            dparams = jax.lax.psum(dparams, batch_axes)
+        return (jax.tree.map(lambda d: d[None], dparams),
+                jax.tree.map(collect, dxs))
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
+    x_specs = jax.tree.map(
+        lambda a: P(batch_axes or None, *([None] * (a.ndim - 1))), xs)
+
+    fwd_sm = shard_map(fwd_worker, mesh=mesh,
+                       in_specs=(param_specs, x_specs),
+                       out_specs=x_specs, check_vma=False)
+    bwd_sm = shard_map(bwd_worker, mesh=mesh,
+                       in_specs=(param_specs, x_specs, x_specs),
+                       out_specs=(param_specs, x_specs), check_vma=False)
+
+    @jax.custom_vjp
+    def apply(params, xs):
+        return fwd_sm(params, xs)
+
+    def apply_fwd(params, xs):
+        return fwd_sm(params, xs), (params, xs)
+
+    def apply_bwd(res, gys):
+        params, xs_in = res
+        dparams, dxs = bwd_sm(params, xs_in, gys)
+        return dparams, dxs
+
+    apply.defvjp(apply_fwd, apply_bwd)
+    return apply(stage_params, xs)
 
 
 def sequential_apply(stage_fn: StageFn, stage_params: Any, xs: Any) -> Any:

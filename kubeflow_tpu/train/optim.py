@@ -22,6 +22,9 @@ class OptimizerConfig:
     eps: float = 1e-8
     weight_decay: float = 0.1
     clip_norm: Optional[float] = 1.0
+    # First-moment dtype: "bfloat16" halves mu's HBM (the standard
+    # memory/precision trade — nu stays fp32, its dynamic range matters).
+    mu_dtype: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "OptimizerConfig":
@@ -41,9 +44,11 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     sched = make_schedule(cfg)
     if cfg.name == "adamw":
         opt = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-                          weight_decay=cfg.weight_decay)
+                          weight_decay=cfg.weight_decay,
+                          mu_dtype=cfg.mu_dtype)
     elif cfg.name == "adam":
-        opt = optax.adam(sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+        opt = optax.adam(sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                         mu_dtype=cfg.mu_dtype)
     elif cfg.name == "sgd":
         opt = optax.sgd(sched, momentum=0.9)
     else:

@@ -42,6 +42,11 @@ class TrainTask:
     state_shardings: Any
     batch_sharding: NamedSharding
     step_fn: Callable[[Any, jax.Array], tuple[Any, dict]]
+    # K steps per device dispatch: scan over stacked [K, ...] batches,
+    # returning the last step's metrics. Host round-trip cost (which can
+    # dwarf a step on a tunneled chip) amortizes across K.
+    multi_step_fn: Callable[[Any, jax.Array], tuple[Any, dict]] = None
+    multi_batch_sharding: NamedSharding = None
 
     @property
     def params(self):
@@ -149,8 +154,22 @@ def setup_train(
         donate_argnums=(0,),
     )
 
+    def multi_step_impl(state, batches):   # batches [K, B, S+1]
+        state, ms = jax.lax.scan(step_impl, state, batches)
+        return state, jax.tree.map(lambda x: x[-1], ms)
+
+    multi_batch_sharding = NamedSharding(
+        mesh, PartitionSpec(None, *batch_sharding.spec))
+    multi_step_fn = jax.jit(
+        multi_step_impl,
+        in_shardings=(shardings, multi_batch_sharding),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,),
+    )
+
     return TrainTask(
         cfg=cfg, mesh=mesh, optimizer=optimizer, state=state,
         state_shardings=shardings, batch_sharding=batch_sharding,
-        step_fn=step_fn,
+        step_fn=step_fn, multi_step_fn=multi_step_fn,
+        multi_batch_sharding=multi_batch_sharding,
     )

@@ -1,12 +1,13 @@
-"""On-chip MFU sweep: time the full train step across remat/attn/batch grids.
+"""On-chip MFU sweep: time the full train step across remat / attention /
+batch / steps-per-dispatch / Adam-mu-dtype grids.
 
 Each config runs in a subprocess (the axon compile helper can 500 on big
 programs; isolation keeps one failure from killing the sweep). Prints one
 JSON line per config.
 
 Usage:
-    python scripts/mfu_sweep.py               # run the default grid
-    python scripts/mfu_sweep.py --one nothing_saveable xla 4   # single config
+    python scripts/mfu_sweep.py                                  # grid
+    python scripts/mfu_sweep.py --one <remat> <attn> <batch> [k] [mu]
 """
 
 from __future__ import annotations
@@ -20,21 +21,21 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 GRID = [
-    # (remat_policy, attn_impl, per_chip_batch)
-    ("nothing_saveable", "xla", 4),      # round-1 baseline
-    ("dots_no_batch", "xla", 4),
-    ("dots_no_batch", "pallas", 4),
-    ("nothing_saveable", "pallas", 4),
-    ("none", "pallas", 4),
-    ("none", "xla", 4),
-    ("dots_no_batch", "xla", 8),
-    ("none", "pallas", 8),
-    ("dots_no_batch", "pallas", 8),
+    # (remat_policy, attn_impl, per_chip_batch, k_dispatch, mu_dtype)
+    ("nothing_saveable", "xla", 4, 1, "none"),      # round-1 baseline
+    ("nothing_saveable", "xla", 4, 16, "none"),     # dispatch amortization
+    ("block_outs", "xla", 4, 16, "none"),           # round-2 headline
+    ("block_outs", "xla", 4, 16, "bfloat16"),
+    ("block_outs", "pallas", 4, 16, "bfloat16"),
+    ("dots_no_batch", "xla", 4, 16, "bfloat16"),
+    ("none", "pallas", 4, 16, "bfloat16"),
 ]
 
 
-def run_one(remat: str, attn: str, batch: int, steps: int = 8, warmup: int = 2):
+def run_one(remat: str, attn: str, batch: int, kd: int = 1,
+            mu: str = "none", steps: int = 16, warmup_disp: int = 2):
     import jax
+    import numpy as np
 
     from kubeflow_tpu.models.config import preset
     from kubeflow_tpu.runtime.mesh import build_mesh
@@ -55,33 +56,37 @@ def run_one(remat: str, attn: str, batch: int, steps: int = 8, warmup: int = 2):
     data_cfg = DataConfig(vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
                           global_batch=batch * n)
     source = make_data_source(data_cfg)
-    task = setup_train(cfg, OptimizerConfig(total_steps=warmup + steps), mesh,
-                       attn_impl=attn)
+    opt_cfg = OptimizerConfig(total_steps=10_000,
+                              mu_dtype=None if mu == "none" else mu)
+    task = setup_train(cfg, opt_cfg, mesh, attn_impl=attn)
 
-    def step(i, state):
-        b = jax.device_put(source.batch_at(i), task.batch_sharding)
-        state, metrics = task.step_fn(state, b)
-        return state, float(metrics["loss"])  # host fetch = the only fence
+    def dispatch(i0, state):
+        b = np.stack([source.batch_at(i0 + j) for j in range(kd)])
+        b = jax.device_put(b, task.multi_batch_sharding)
+        state, metrics = task.multi_step_fn(state, b)
+        # Host fetch of the loss = the only reliable fence on the tunnel.
+        return state, float(metrics["loss"])
 
     state = task.state
     t_c0 = time.perf_counter()
-    for i in range(warmup):
-        state, loss = step(i, state)
+    for w in range(warmup_disp):
+        state, loss = dispatch(w * kd, state)
     compile_s = time.perf_counter() - t_c0
 
+    n_disp = max(steps // kd, 1)
     t0 = time.perf_counter()
-    for i in range(warmup, warmup + steps):
-        state, loss = step(i, state)
+    for di in range(n_disp):
+        state, loss = dispatch((warmup_disp + di) * kd, state)
     dt = time.perf_counter() - t0
 
-    tokens_per_step = data_cfg.global_batch * data_cfg.seq_len
-    tps_chip = tokens_per_step * steps / dt / n
+    tokens = data_cfg.global_batch * data_cfg.seq_len * kd * n_disp
+    tps_chip = tokens / dt / n
     gen = detect_local_cluster().slices[0].gen
     mfu = (cfg.flops_per_token() * tps_chip) / (gen.bf16_tflops * 1e12)
     return {
-        "remat": remat, "attn": attn, "batch": batch,
+        "remat": remat, "attn": attn, "batch": batch, "k": kd, "mu": mu,
         "tok_s_chip": round(tps_chip, 1),
-        "step_ms": round(dt / steps * 1e3, 2),
+        "step_ms": round(dt / (kd * n_disp) * 1e3, 2),
         "mfu": round(mfu, 4),
         "loss": round(loss, 4),
         "compile_s": round(compile_s, 1),
@@ -91,30 +96,31 @@ def run_one(remat: str, attn: str, batch: int, steps: int = 8, warmup: int = 2):
 def main():
     if len(sys.argv) >= 5 and sys.argv[1] == "--one":
         remat, attn, batch = sys.argv[2], sys.argv[3], int(sys.argv[4])
-        steps = int(sys.argv[5]) if len(sys.argv) > 5 else 8
-        print(json.dumps(run_one(remat, attn, batch, steps=steps)))
+        kd = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+        mu = sys.argv[6] if len(sys.argv) > 6 else "none"
+        print(json.dumps(run_one(remat, attn, batch, kd, mu)))
         return
 
-    for remat, attn, batch in GRID:
-        cmd = [sys.executable, __file__, "--one", remat, attn, str(batch)]
+    for remat, attn, batch, kd, mu in GRID:
+        cmd = [sys.executable, __file__, "--one", remat, attn, str(batch),
+               str(kd), mu]
         t0 = time.perf_counter()
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=900)
         except subprocess.TimeoutExpired:
             print(json.dumps({"remat": remat, "attn": attn, "batch": batch,
-                              "failed": True, "err": "timeout 900s"}),
+                              "k": kd, "failed": True, "err": "timeout 900s"}),
                   flush=True)
             continue
         wall = round(time.perf_counter() - t0, 1)
         if proc.returncode == 0 and proc.stdout.strip():
-            line = proc.stdout.strip().splitlines()[-1]
-            print(line, flush=True)
+            print(proc.stdout.strip().splitlines()[-1], flush=True)
         else:
-            err = (proc.stderr or "")[-400:].replace("\n", " | ")
+            err = (proc.stderr or "")[-300:].replace("\n", " | ")
             print(json.dumps({"remat": remat, "attn": attn, "batch": batch,
-                              "failed": True, "wall_s": wall, "err": err}),
-                  flush=True)
+                              "k": kd, "mu": mu, "failed": True,
+                              "wall_s": wall, "err": err}), flush=True)
 
 
 if __name__ == "__main__":
