@@ -38,6 +38,9 @@ class DecoderConfig:
     # compile-time policy
     scan_layers: bool = True
     remat_policy: str = "nothing_saveable"   # none | nothing_saveable | full
+    # Pipeline-parallel microbatch schedule (only read when the mesh has
+    # pipeline>1): "gpipe" | "1f1b" (parallel/pipeline.py).
+    pipeline_schedule: str = "gpipe"
     # Sequence-chunked cross-entropy: never materialize [B,S,V] logits
     # (0 = off). Big win at large vocab; numerics identical.
     loss_chunk_size: int = 0

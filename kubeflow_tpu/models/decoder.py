@@ -60,13 +60,10 @@ def init_decoder_params(key: jax.Array, cfg: DecoderConfig) -> Params:
     return params
 
 
-def decoder_param_specs(cfg: DecoderConfig) -> Params:
-    """Logical-axis spec tree mirroring init_decoder_params' structure.
-
-    The stacked layer axis prepends the "layers" logical axis to every
-    per-layer leaf when scanning."""
-    # Trace under eval_shape so no params materialize (llama3-70b's block is
-    # ~GBs); the static spec tree is captured on the side during the trace.
+def _block_specs(cfg: DecoderConfig):
+    """Logical-axis spec tree for one decoder block (no params materialize:
+    llama3-70b's block is ~GBs — trace under eval_shape, capture the static
+    spec tree on the side)."""
     captured = {}
 
     def _shape_only():
@@ -75,7 +72,15 @@ def decoder_param_specs(cfg: DecoderConfig) -> Params:
         return params
 
     jax.eval_shape(_shape_only)
-    block_specs = captured["specs"]
+    return captured["specs"]
+
+
+def decoder_param_specs(cfg: DecoderConfig) -> Params:
+    """Logical-axis spec tree mirroring init_decoder_params' structure.
+
+    The stacked layer axis prepends the "layers" logical axis to every
+    per-layer leaf when scanning."""
+    block_specs = _block_specs(cfg)
 
     if cfg.scan_layers:
         def stack_spec(s):
@@ -97,7 +102,8 @@ def decoder_param_specs(cfg: DecoderConfig) -> Params:
 
 def _block_forward(block_params, x, positions, cfg: DecoderConfig,
                    kv_cache=None, attn_impl="xla", mesh=None,
-                   rules=DEFAULT_RULES, prefill=False):
+                   rules=DEFAULT_RULES, prefill=False,
+                   expert_axis=None, seq_axis=None):
     h = L.rmsnorm(x, block_params["ln1"], cfg)
     attn_out, new_cache = L.attention_block(
         block_params["attn"], h, positions, cfg,
@@ -105,7 +111,8 @@ def _block_forward(block_params, x, positions, cfg: DecoderConfig,
     x = x + attn_out
     h = L.rmsnorm(x, block_params["ln2"], cfg)
     if cfg.is_moe:
-        mlp_out, aux = L.moe_block(block_params["mlp"], h, cfg)
+        mlp_out, aux = L.moe_block(block_params["mlp"], h, cfg,
+                                   expert_axis=expert_axis, seq_axis=seq_axis)
     else:
         mlp_out, aux = L.mlp_block(block_params["mlp"], h, cfg), jnp.float32(0)
     x = x + mlp_out
@@ -157,6 +164,7 @@ def decoder_forward(
     """Returns (logits [B,S,V] float32, new_kv_caches|None, aux_loss).
     With ``skip_head``, returns the final-norm hidden states [B,S,D] instead
     of logits (the chunked-CE loss applies the head blockwise)."""
+    custom_positions = positions is not None
     if positions is None:
         # Decode with a cache: absolute positions continue from the cache
         # length (RoPE angles and the causal mask must agree on the offset).
@@ -166,7 +174,14 @@ def decoder_forward(
             tokens.shape)
 
     dt = cfg.activation_dtype
-    x = params["embed"].astype(dt)[tokens]
+    table = params["embed"]
+    if mesh is not None:
+        # The table stores fsdp-sharded on the hidden dim (ZeRO-3); gather
+        # that dim explicitly before the token gather (sharding.py rationale
+        # at the embed_table rule) — vocab stays model-sharded, the gather
+        # of a vocab-sharded operand GSPMD handles natively.
+        table = with_logical_constraint(table, ("vocab", None), mesh, rules)
+    x = table.astype(dt)[tokens]
     if mesh is not None:
         x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"), mesh, rules)
     if cfg.embed_scale:
@@ -181,12 +196,17 @@ def decoder_forward(
 
     pp = dict(mesh.shape).get("pipeline", 1) if mesh is not None else 1
     if pp > 1 and kv_caches is None:
+        if custom_positions:
+            raise NotImplementedError(
+                "pipeline parallelism computes contiguous positions inside "
+                "the stage (1F1B streams inexact leaves only); custom "
+                "positions are not supported under pp>1")
         # Pipeline parallelism: the layer stack is staged over the
         # ``pipeline`` mesh axis and microbatches stream through via
         # ppermute (parallel/pipeline.py). Decode (kv_caches) stays on the
         # non-pp path — serving shards differently.
-        x = _pipeline_layers(params["layers"], x, positions, cfg, mesh,
-                             attn_impl)
+        x, aux_total = _pipeline_layers(params["layers"], x, positions, cfg,
+                                        mesh, attn_impl)
     elif cfg.scan_layers:
         def scan_body(carry, scan_in):
             x = carry
@@ -253,19 +273,29 @@ def decoder_forward(
 
 def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
                      attn_impl: str = "xla"):
-    """Apply the [L, ...] layer stack as pipeline stages (dense only)."""
-    from kubeflow_tpu.parallel.pipeline import pipeline_apply
+    """Apply the [L, ...] layer stack as pipeline stages.
 
-    if cfg.is_moe:
-        raise NotImplementedError(
-            "pipeline parallel + MoE is not supported yet; use expert "
-            "parallelism for MoE models")
-    if attn_impl in ("ring", "ulysses"):
-        raise NotImplementedError(
-            "pipeline + sequence parallelism is not composed yet: the "
-            "pipeline shard_map does not map the seq axis; use one or the "
-            "other (pp with attn_impl='xla'/'pallas', or sp without pp)")
-    n_stages = dict(mesh.shape)["pipeline"]
+    Compositions (the SURVEY.md §2.6 beyond-reference axis):
+    - **PP×EP (MoE)**: expert weights keep their ``expert`` sharding inside
+      the stage shard_map; each device runs its local experts and psums the
+      combined output over the axis (layers.moe_block ``expert_axis``). The
+      microbatch-local aux losses stream with the batch and average — the
+      standard pipelined-MoE semantics (full-batch fractions aren't visible
+      to a microbatch).
+    - **PP×SP (ring/Ulysses)**: the streamed activation is additionally
+      sharded on the sequence dim over ``seq``; attention runs the
+      collective form over that axis inside the stage.
+    Positions are computed inside the stage from the seq-shard offset
+    (contiguous training positions only — the decode/kv path never takes
+    this branch), which keeps every streamed leaf inexact so the 1F1B
+    schedule (``cfg.pipeline_schedule``) is legal."""
+    from kubeflow_tpu.parallel.pipeline import pipeline_apply
+    from jax.sharding import PartitionSpec as P
+
+    axis_sizes = dict(mesh.shape)
+    n_stages = axis_sizes["pipeline"]
+    sp = attn_impl in ("ring", "ulysses") and axis_sizes.get("seq", 1) > 1
+    ep = cfg.is_moe and axis_sizes.get("expert", 1) > 1
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"pipeline={n_stages} must divide n_layers={cfg.n_layers}")
@@ -278,24 +308,61 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
     stage_params = jax.tree.map(
         lambda p: p.reshape(n_stages, per, *p.shape[1:]), layer_params)
 
-    def stage_fn(blocks, xs):
+    # Per-leaf partition specs: stage dim over pipeline; the expert dim keeps
+    # its sharding for local-EP compute; everything else replicated within
+    # the stage (TP inside a stage would need psums the stage doesn't do).
+    def leaf_spec(spec):
+        rest = tuple("expert" if (ep and name == "expert") else None
+                     for name in spec)
+        return P("pipeline", None, *rest)
+
+    param_specs = jax.tree.map(leaf_spec, _block_specs(cfg),
+                               is_leaf=_is_spec_leaf)
+    batch_axes = tuple(a for a in ("dcn", "data", "fsdp")
+                       if a in mesh.axis_names)
+    xs = {"x": x}
+    x_specs = {"x": P(batch_axes or None, "seq" if sp else None,
+                      *([None] * (x.ndim - 2)))}
+    if cfg.is_moe:
+        xs["aux"] = jnp.zeros((x.shape[0], 1), jnp.float32)
+        x_specs["aux"] = P(batch_axes or None, None)
+
+    impl = {"ring": "ring_local", "ulysses": "ulysses_local"}.get(
+        attn_impl, attn_impl)
+
+    def stage_fn(blocks, xs_mb):
+        h = xs_mb["x"]
+        s_local = h.shape[1]
+        offset = jax.lax.axis_index("seq") * s_local if sp else 0
+        pos = jnp.broadcast_to(
+            jnp.arange(s_local, dtype=jnp.int32)[None, :] + offset,
+            (h.shape[0], s_local))
+
         def body(h, bp):
             # No logical-constraint mesh inside shard_map: the activation is
             # a local shard there and GSPMD annotations don't apply.
-            out, _, _ = _block_forward(bp, h, xs["positions"], cfg,
-                                       attn_impl=attn_impl)
-            return out, None
+            out, _, aux = _block_forward(
+                bp, h, pos, cfg, attn_impl=impl,
+                expert_axis="expert" if ep else None,
+                seq_axis="seq" if sp else None)
+            return out, aux
 
-        h, _ = jax.lax.scan(body, xs["x"], blocks)
-        return {"x": h, "positions": xs["positions"]}
+        h, auxs = jax.lax.scan(body, h, blocks)
+        out = {"x": h}
+        if cfg.is_moe:
+            out["aux"] = xs_mb["aux"] + jnp.sum(auxs)
+        return out
 
-    out = pipeline_apply(stage_fn, stage_params,
-                         {"x": x, "positions": positions},
+    out = pipeline_apply(stage_fn, stage_params, xs,
                          mesh=mesh, num_microbatches=None,
+                         batch_axes=batch_axes,
+                         x_specs=x_specs, param_specs=param_specs,
+                         schedule=cfg.pipeline_schedule,
                          # Honor the config's remat knob like the scan path
                          # (_remat); "none" really means no recompute.
                          checkpoint_stages=cfg.remat_policy != "none")
-    return out["x"]
+    aux = jnp.mean(out["aux"]) if cfg.is_moe else jnp.float32(0)
+    return out["x"], aux
 
 
 def _chunked_ce(hidden: jax.Array, head: jax.Array, targets: jax.Array,

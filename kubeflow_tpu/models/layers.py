@@ -137,6 +137,16 @@ def attention_block(
             fn = (ring_attention_sharded if attn_impl == "ring"
                   else ulysses_attention_sharded)
             out = fn(q, k, v, mesh, causal=True)
+    elif attn_impl in ("ring_local", "ulysses_local"):
+        # Already inside shard_map with Q/K/V sharded on dim 1 over 'seq'
+        # (the pipeline×SP composition): call the collective form directly.
+        from kubeflow_tpu.parallel.ring_attention import (
+            ring_attention, ulysses_attention,
+        )
+
+        fn = (ring_attention if attn_impl == "ring_local"
+              else ulysses_attention)
+        out = fn(q, k, v, causal=True)
     else:
         out = multi_head_attention(q, k, v, causal=True, impl=attn_impl)
     out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))
@@ -193,13 +203,23 @@ def init_moe(key, cfg: DecoderConfig):
     return params, specs
 
 
-def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig):
+def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig,
+              expert_axis: Optional[str] = None,
+              seq_axis: Optional[str] = None):
     """Top-k MoE (Mixtral semantics: softmax over the selected k logits).
 
     Einsum-dense formulation: every expert computes every token and a one-hot
     combine weights the results. FLOP-inefficient (E/k overcompute) but fully
-    static-shaped and correct — the oracle for the ragged all-to-all expert-
-    parallel dispatch (parallel/expert.py) which replaces it on real runs.
+    static-shaped — under GSPMD the ``expert`` sharding of the weight specs
+    turns the expert einsums into expert-parallel partials XLA combines.
+
+    With ``expert_axis`` (inside shard_map — the pipeline×EP composition),
+    ``p["gate"]/["up"]/["down"]`` hold this device's expert slice: the block
+    computes local experts only, slices the combine weights at the shard
+    offset, and psums the combined output over the axis. The router is
+    replicated, so top-k runs on full logits. ``seq_axis`` (sequence-sharded
+    activations, PP×SP): the load-balancing fractions pmean over the axis so
+    the aux loss sees full-sequence statistics.
 
     Returns (out, aux_loss)."""
     dt = cfg.activation_dtype
@@ -210,15 +230,25 @@ def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig):
     onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)          # [B,S,k,E]
     combine = jnp.einsum("bske,bsk->bse", onehot, topk_w)            # [B,S,E]
 
+    if expert_axis is not None:
+        e_local = p["gate"].shape[0]
+        offset = jax.lax.axis_index(expert_axis) * e_local
+        combine = jax.lax.dynamic_slice_in_dim(combine, offset, e_local,
+                                               axis=-1)
     gate = _act(jnp.einsum("bsd,edm->ebsm", x, p["gate"].astype(dt)), cfg.hidden_act)
     up = jnp.einsum("bsd,edm->ebsm", x, p["up"].astype(dt))
     expert_out = jnp.einsum("ebsm,emd->ebsd", gate * up, p["down"].astype(dt))
     out = jnp.einsum("ebsd,bse->bsd", expert_out, combine.astype(dt))
+    if expert_axis is not None:
+        out = jax.lax.psum(out, expert_axis)
 
     # Load-balancing aux loss (Switch-style): E * sum(frac_tokens * frac_router_prob)
     probs = jax.nn.softmax(router_logits, axis=-1)                   # [B,S,E]
     frac_tokens = jnp.mean(onehot.sum(axis=2), axis=(0, 1))          # [E]
     frac_probs = jnp.mean(probs, axis=(0, 1))                        # [E]
+    if seq_axis is not None:
+        frac_tokens = jax.lax.pmean(frac_tokens, seq_axis)
+        frac_probs = jax.lax.pmean(frac_probs, seq_axis)
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return out, aux
 

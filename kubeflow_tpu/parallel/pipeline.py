@@ -29,6 +29,18 @@ Two schedules:
 Both compose with the data axes in the same mesh (``batch_axes`` shards the
 batch dim of the streamed pytree). Stage weights: leading dim sharded over
 ``pipeline``.
+
+Composition beyond data axes goes through ``x_specs`` / ``param_specs``:
+callers may shard additional dims of the streamed pytree (e.g. the sequence
+dim over ``seq`` for PP×SP ring attention) or of the stage params (e.g. the
+expert dim over ``expert`` for PP×EP MoE), and run the matching collectives
+inside ``stage_fn`` — every mesh axis is a named collective axis inside the
+worker. The GPipe schedule differentiates through shard_map (psums for
+replicated operands are inserted by the transpose); the hand-written 1F1B
+backward derives its gradient-sync psums from the specs: parameter grads
+psum over every axis the streamed pytree is sharded on but the param is not,
+and input cotangents psum over every axis the params are sharded on (minus
+the pipeline axis itself) but the stream is not.
 """
 
 from __future__ import annotations
@@ -59,6 +71,8 @@ def pipeline_apply(
     batch_axes: tuple = ("dcn", "data", "fsdp"),
     checkpoint_stages: bool = True,
     schedule: str = "gpipe",
+    x_specs: Any = None,              # pytree of PartitionSpec matching xs
+    param_specs: Any = None,          # pytree of PartitionSpec, dim0=pipeline
 ) -> Any:
     """Run ``y = stage_{n-1}(... stage_0(xs))`` pipelined over microbatches.
 
@@ -88,11 +102,17 @@ def pipeline_apply(
         raise ValueError(
             f"batch {batch} must be divisible by data shards {data_shards} × "
             f"num_microbatches {num_microbatches}")
+    if param_specs is None:
+        param_specs = jax.tree.map(
+            lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
+    if x_specs is None:
+        x_specs = jax.tree.map(
+            lambda a: P(batch_axes or None, *([None] * (a.ndim - 1))), xs)
     if schedule == "1f1b":
         return _pipeline_1f1b(
             stage_fn, stage_params, xs, mesh=mesh,
             num_microbatches=num_microbatches, axis_name=axis_name,
-            batch_axes=batch_axes, local_batch=local_batch)
+            local_batch=local_batch, x_specs=x_specs, param_specs=param_specs)
     if schedule != "gpipe":
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     mb = local_batch // num_microbatches
@@ -145,10 +165,6 @@ def pipeline_apply(
 
         return jax.tree.map(collect, out)
 
-    param_specs = jax.tree.map(
-        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
-    x_specs = jax.tree.map(
-        lambda a: P(batch_axes or None, *([None] * (a.ndim - 1))), xs)
     return shard_map(
         worker, mesh=mesh,
         in_specs=(param_specs, x_specs),
@@ -157,8 +173,18 @@ def pipeline_apply(
     )(stage_params, xs)
 
 
+def _spec_axes(spec) -> set:
+    """Mesh axes a PartitionSpec shards over."""
+    axes: set = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        axes.update((entry,) if isinstance(entry, str) else entry)
+    return axes
+
+
 def _pipeline_1f1b(stage_fn, stage_params, xs, *, mesh, num_microbatches,
-                   axis_name, batch_axes, local_batch):
+                   axis_name, local_batch, x_specs, param_specs):
     """1F1B: GPipe-style streaming forward + a hand-scheduled interleaved
     backward under ``jax.custom_vjp``.
 
@@ -173,13 +199,39 @@ def _pipeline_1f1b(stage_fn, stage_params, xs, *, mesh, num_microbatches,
     (fi - bi = 2(n-1-s)), so the ring buffer — not m — bounds memory. Cost:
     3 forwards + 1 backward per microbatch per stage (the fwd lane refills
     the ring AND the vjp's primal re-runs the stage) — one extra forward
-    over checkpointed GPipe, the price of the m-independent ring."""
+    over checkpointed GPipe, the price of the m-independent ring.
+
+    Gradient sync, derived from the specs (the hand-written vjp must do what
+    shard_map's transpose would have):
+      - ``x_axes`` (stream sharded, params replicated — data/seq axes):
+        parameter grads psum over them after the scan.
+      - ``vjp_axes`` (params sharded, stream replicated — e.g. ``expert``):
+        stage_fn psums its partial outputs over these in the forward, and
+        ``jax.vjp`` *inside* the worker transposes that psum to a psum, so
+        every cotangent below such a site is inflated by the axis size while
+        carrying only the local branch's mixing. The exact fix (inductively:
+        psum of local cotangents = axis_size × true cotangent at every
+        level): pmean local vjp outputs over these axes — for param leaves
+        *sharded* on such an axis, divide by the axis size instead (pmean
+        would average different experts' grads)."""
     n = mesh.shape[axis_name]
     m = num_microbatches
     mb = local_batch // m
     ring_depth = 2 * n - 1
     send_perm = [(i, i + 1) for i in range(n - 1)]
     recv_perm = [(i + 1, i) for i in range(n - 1)]
+
+    is_spec = lambda s: isinstance(s, P)
+    x_axes: set = set()
+    for spec in jax.tree.leaves(x_specs, is_leaf=is_spec):
+        x_axes |= _spec_axes(spec)
+    p_axes: set = set()
+    for spec in jax.tree.leaves(param_specs, is_leaf=is_spec):
+        p_axes |= _spec_axes(spec)
+    # Axes whose collectives jax.vjp mis-transposes inside the worker (see
+    # docstring): params sharded there, the stream not.
+    vjp_axes = tuple(a for a in mesh.axis_names
+                     if a in p_axes and a != axis_name and a not in x_axes)
 
     for leaf in jax.tree.leaves(xs):
         if not jnp.issubdtype(leaf.dtype, jnp.inexact):
@@ -262,6 +314,11 @@ def _pipeline_1f1b(stage_fn, stage_params, xs, *, mesh, num_microbatches,
                 gys_mb, gbuf)
             _, vjp_fn = jax.vjp(stage_fn, params1, x_saved)
             dp, dx = vjp_fn(g_in)
+            if vjp_axes:
+                # Restore the exact (replicated) input cotangent before it
+                # hops to the previous stage or deposits (docstring: sync).
+                dx = jax.tree.map(
+                    lambda d: jax.lax.pmean(d, vjp_axes), dx)
             dparams = jax.tree.map(
                 lambda acc, d: acc + jnp.where(b_active, d,
                                                jnp.zeros_like(d)),
@@ -303,19 +360,29 @@ def _pipeline_1f1b(stage_fn, stage_params, xs, *, mesh, num_microbatches,
             o = jax.lax.psum(o * owner, axis_name)
             return o.reshape(local_batch, *o.shape[2:])
 
-        if batch_axes:
-            # Shared stage weights under data parallelism: every data shard
-            # contributes gradient; out_specs claims replication over the
-            # batch axes, so the sum must happen here (autodiff would have
-            # inserted this psum as the transpose of the implicit broadcast).
-            dparams = jax.lax.psum(dparams, batch_axes)
+        def sync_param_grad(d, spec):
+            leaf_axes = _spec_axes(spec)
+            pmean_axes, scale = [], 1.0
+            for a in vjp_axes:
+                if a in leaf_axes:
+                    scale /= mesh.shape[a]   # sharded leaf: undo inflation
+                else:
+                    pmean_axes.append(a)     # replicated leaf: exact pmean
+            if pmean_axes:
+                d = jax.lax.pmean(d, tuple(pmean_axes))
+            if scale != 1.0:
+                d = d * jnp.asarray(scale, d.dtype)
+            # Stream-sharded axes the leaf is replicated over (data/seq):
+            # every shard contributes gradient; out_specs claims replication,
+            # so the sum happens here (autodiff would have inserted it as
+            # the transpose of the implicit broadcast).
+            psum_axes = tuple(a for a in mesh.axis_names
+                              if a in x_axes and a not in leaf_axes)
+            return jax.lax.psum(d, psum_axes) if psum_axes else d
+
+        dparams = jax.tree.map(sync_param_grad, dparams, param_specs)
         return (jax.tree.map(lambda d: d[None], dparams),
                 jax.tree.map(collect, dxs))
-
-    param_specs = jax.tree.map(
-        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
-    x_specs = jax.tree.map(
-        lambda a: P(batch_axes or None, *([None] * (a.ndim - 1))), xs)
 
     fwd_sm = shard_map(fwd_worker, mesh=mesh,
                        in_specs=(param_specs, x_specs),

@@ -36,12 +36,16 @@ DEFAULT_RULES: LogicalRules = (
     ("head_dim", None),
     ("mlp", "model"),
     ("vocab", "model"),
-    # The embedding table's hidden dim stays unsharded: sharding it over fsdp
-    # makes the token-gather output spec reuse fsdp (already consumed by the
-    # batch dim), which GSPMD propagation rejects. Vocab-parallel (Megatron
-    # style) is the TP-correct layout; FSDP-sharding the table is a TODO that
-    # needs a manual all-gather before the gather op.
-    ("embed_table", None),
+    # The embedding table stores vocab-parallel (Megatron) over ``model`` AND
+    # ZeRO-3-sharded over ``fsdp`` on the hidden dim. The token gather can't
+    # consume an fsdp-sharded operand (its output spec would reuse fsdp,
+    # already consumed by the batch dim — GSPMD rejects the reuse), so the
+    # forward all-gathers the hidden dim explicitly first
+    # (decoder_forward's with_logical_constraint(("vocab", None))); the
+    # transpose reduce-scatters the table grad back. Storage per chip drops
+    # by the fsdp degree — the difference between replicating GBs of a
+    # 128k-vocab table and not.
+    ("embed_table", "fsdp"),
     ("expert", "expert"),
     ("expert_mlp", "model"),
     ("layers", None),
