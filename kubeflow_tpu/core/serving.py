@@ -47,8 +47,15 @@ class BatchingSpec(BaseModel):
 
     max_batch_size: int = 8          # decode batch slots
     max_seq_len: int = 2048
+    # Paged KV cache (vLLM analog): HBM budget decoupled from
+    # slots × max_seq_len; shared-prefix requests reuse pages.
+    paged: bool = False
     page_size: int = 128             # KV cache page (tokens)
-    max_pages: Optional[int] = None  # default: sized from HBM budget
+    max_pages: Optional[int] = None  # default: slots × max_seq_len / page
+    enable_prefix_caching: bool = True
+    # Long prompts split into chunks with decode interleaving; this many may
+    # chunk concurrently (no head-of-line blocking between long prompts).
+    max_concurrent_prefills: int = 2
     chunked_prefill_tokens: int = 512
     prefill_buckets: list[int] = Field(default_factory=lambda: [128, 512, 2048])
     # Decode steps per device dispatch: sampling runs on-device and up to
@@ -109,7 +116,9 @@ class InferenceServiceStatus(ConditionMixin):
 
     url: Optional[str] = None
     ready_replicas: int = 0
-    desired_replicas: int = 0
+    # None = the autoscaler hasn't decided yet (first reconcile seeds it);
+    # 0 is a real state — scaled to zero (min_replicas=0, idle).
+    desired_replicas: Optional[int] = None
     traffic: dict[str, int] = Field(default_factory=dict)  # generation -> percent
     latest_ready_generation: Optional[int] = None
 

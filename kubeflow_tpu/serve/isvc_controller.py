@@ -11,16 +11,22 @@ predictor.go — SURVEY.md §2.3#25), TPU-native shape:
   to ready replicas, so rollouts and crashes never 502 through the URL.
 - Autoscaling = concurrency against ``scale_target`` (the KPA analog),
   scraped from each replica's /metrics; scale-up is eager, scale-down waits
-  out a cooldown. min_replicas=0 gives scale-to-zero with cold-start on
-  traffic arriving at the router? No — scale-to-zero needs the router to
-  queue; v1 clamps at >=1 and records the gap honestly.
+  out a cooldown. **min_replicas=0 scales to zero**: the router parks
+  requests (activator analog), the parked-request gauge is the 0→1
+  activation signal, and an idle service drops its last replica after the
+  cooldown — the Knative serverless path ((U) kserve serverless mode via
+  Knative PodAutoscaler + activator).
+- Canary = generation-based traffic split ((U) kserve canaryTrafficPercent
+  on the predictor): a spec update with ``canary_traffic_percent=p`` keeps
+  the previous generation's replicas serving ``100-p``% while the new
+  generation takes ``p``%; clearing the percent (or setting 100) promotes —
+  old-generation replicas are torn down once the new generation is ready.
 - Crash recovery: failed replicas are replaced (fresh Worker object), not
   gang-restarted — serving replicas are independent, unlike SPMD training.
 """
 
 from __future__ import annotations
 
-import json
 import time
 import urllib.request
 from typing import Callable, Optional
@@ -40,9 +46,11 @@ from kubeflow_tpu.serve.router import Router
 
 LABEL_ISVC = "serving.tpu.kubeflow.dev/service"
 LABEL_REPLICA = "serving.tpu.kubeflow.dev/replica"
+LABEL_GEN = "serving.tpu.kubeflow.dev/generation"
 
 _RESYNC = 1.0           # readiness/autoscale poll period (seconds)
 _SCALE_DOWN_COOLDOWN = 10.0
+_SCALE_TO_ZERO_COOLDOWN = 10.0
 
 
 def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
@@ -100,54 +108,107 @@ class ISVCController:
             return None
 
         pred = isvc.spec.predictor
-        desired = isvc.status.desired_replicas or max(pred.min_replicas, 1)
-        desired = max(max(pred.min_replicas, 1), min(desired, pred.max_replicas))
-
-        # Replace crashed/finished replicas; a model server never "succeeds".
-        workers = self._workers(key)
-        for w in workers:
-            if w.status.phase in (WorkerPhase.FAILED, WorkerPhase.SUCCEEDED):
-                self.recorder.warning(
-                    isvc, "ReplicaCrashed",
-                    f"{w.metadata.name}: exit={w.status.exit_code}; replacing")
-                self._delete_worker(w)
-        workers = [w for w in self._workers(key)]
-        by_index = {int(w.metadata.labels[LABEL_REPLICA]): w for w in workers}
-
-        # Converge replica count: create missing, trim highest-index extras.
-        for i in range(desired):
-            if i not in by_index:
-                by_index[i] = self._create_replica(isvc, i)
-        for i in sorted(by_index):
-            if i >= desired:
-                self._delete_worker(by_index.pop(i))
-
-        # Readiness probing → router backends.
-        ready_urls = []
-        in_flight = 0
-        for i, w in sorted(by_index.items()):
-            if w.status.phase != WorkerPhase.RUNNING:
-                continue
-            url = f"http://127.0.0.1:{w.spec.template.config['port']}"
-            got = self.probe(url)
-            if got is not None:
-                ready_urls.append(url)
-                in_flight += got.get("in_flight", 0)
-
         router = self._routers.get(key)
         if router is None:
             router = Router()
             router.start()
             self._routers[key] = router
-        router.set_backends({"latest": ready_urls}, {"latest": 100})
 
+        # Desired count: autoscaler-owned once seeded; 0 is a real state.
+        desired = isvc.status.desired_replicas
+        if desired is None:
+            desired = max(pred.min_replicas, 1)
+        desired = max(pred.min_replicas, min(desired, pred.max_replicas))
+        pending = router.pending
+        if desired == 0 and pending > 0:
+            # Activation: a request is parked at the router — 0→1 cold start.
+            desired = 1
+            self._last_scale[key] = time.monotonic()
+            self.recorder.normal(
+                isvc, "ColdStart",
+                f"{pending} request(s) queued at the router: 0 -> 1")
+
+        # Replace crashed/finished replicas; a model server never "succeeds".
+        for w in self._workers(key):
+            if w.status.phase in (WorkerPhase.FAILED, WorkerPhase.SUCCEEDED):
+                self.recorder.warning(
+                    isvc, "ReplicaCrashed",
+                    f"{w.metadata.name}: exit={w.status.exit_code}; replacing")
+                self._delete_worker(w)
+
+        gen = isvc.metadata.generation
+        by: dict[tuple[int, int], Worker] = {}
+        for w in self._workers(key):
+            g = int(w.metadata.labels.get(LABEL_GEN, gen))
+            i = int(w.metadata.labels[LABEL_REPLICA])
+            by[(g, i)] = w
+        prev_gens = sorted({g for g, _ in by if g != gen})
+        canary_p = pred.canary_traffic_percent
+        # desired == 0 suspends the canary: a scaled-to-zero service keeps
+        # NO generation running (otherwise the previous generation's
+        # replicas would be unreachable by the cleanup below and idle on).
+        canary_active = (canary_p is not None and canary_p < 100
+                         and bool(prev_gens) and desired > 0)
+        if canary_active:
+            # The previous generation keeps serving at full strength; the
+            # canary generation gets a traffic-proportional slice (>=1).
+            n_latest = min(max(1, round(desired * canary_p / 100)), desired)
+        else:
+            n_latest = desired
+
+        # Converge the latest generation: create missing, trim extras.
+        for i in range(n_latest):
+            if (gen, i) not in by:
+                by[(gen, i)] = self._create_replica(isvc, i, gen)
+        for (g, i) in sorted(by):
+            if g == gen and i >= n_latest:
+                self._delete_worker(by.pop((g, i)))
+
+        # Readiness probing, per generation.
+        ready_by_gen: dict[int, list[str]] = {}
+        in_flight = 0
+        for (g, i), w in sorted(by.items()):
+            if w.status.phase != WorkerPhase.RUNNING:
+                continue
+            url = f"http://127.0.0.1:{w.spec.template.config['port']}"
+            got = self.probe(url)
+            if got is not None:
+                ready_by_gen.setdefault(g, []).append(url)
+                in_flight += got.get("in_flight", 0)
+
+        latest_ready = ready_by_gen.get(gen, [])
+        if not canary_active:
+            # Rolling update: drop old generations once the new one is ready
+            # (or immediately when scaling to zero — nothing to hand over to).
+            if latest_ready or n_latest == 0:
+                for (g, i) in sorted(by):
+                    if g != gen:
+                        self._delete_worker(by.pop((g, i)))
+                        ready_by_gen.pop(g, None)
+
+        # Router backends + traffic split.
+        if canary_active:
+            prev_urls = [u for g in prev_gens
+                         for u in ready_by_gen.get(g, [])]
+            router.set_backends(
+                {"latest": latest_ready, "previous": prev_urls},
+                {"latest": canary_p, "previous": 100 - canary_p})
+            traffic = {"latest": canary_p, "previous": 100 - canary_p}
+        else:
+            # Rolling update: until the new generation is ready, the old one
+            # keeps taking traffic (no outage window).
+            urls = latest_ready or [
+                u for us in ready_by_gen.values() for u in us]
+            router.set_backends({"latest": urls}, {"latest": 100})
+            traffic = {"latest": 100}
+
+        ready_urls = [u for urls in ready_by_gen.values() for u in urls]
         isvc.status.url = router.url
         isvc.status.desired_replicas = desired
         isvc.status.ready_replicas = len(ready_urls)
-        isvc.status.traffic = {"latest": 100}
-        isvc.status.latest_ready_generation = (
-            isvc.metadata.generation if ready_urls else
-            isvc.status.latest_ready_generation)
+        isvc.status.traffic = traffic
+        if latest_ready:
+            isvc.status.latest_ready_generation = gen
         if ready_urls:
             if not isvc.status.has_condition("Ready"):
                 self.recorder.normal(isvc, "Ready",
@@ -155,23 +216,29 @@ class ISVCController:
                                      f"at {router.url}")
             isvc.status.set_condition("PredictorReady")
             isvc.status.set_condition("Ready")
+        elif desired == 0:
+            isvc.status.set_condition("Ready", status=False,
+                                      reason="ScaledToZero")
         else:
             isvc.status.set_condition("Ready", status=False,
                                       reason="NoReadyReplicas")
 
-        self._autoscale(isvc, key, in_flight)
+        self._autoscale(isvc, key, in_flight, pending)
         self._update_status(isvc)
         return ReconcileResult(requeue_after=_RESYNC)
 
     # -- autoscaler (KPA analog) -----------------------------------------------
 
-    def _autoscale(self, isvc: InferenceService, key: str, in_flight: int) -> None:
+    def _autoscale(self, isvc: InferenceService, key: str, in_flight: int,
+                   pending: int) -> None:
         pred = isvc.spec.predictor
         ready = isvc.status.ready_replicas
-        if ready == 0 or pred.min_replicas >= pred.max_replicas:
-            return
-        per_replica = in_flight / ready
         desired = isvc.status.desired_replicas
+        if ready == 0:
+            return
+        if pred.min_replicas >= pred.max_replicas and pred.min_replicas > 0:
+            return   # fixed-size service; min=0,max=1 still autoscales 0↔1
+        per_replica = in_flight / ready
         now = time.monotonic()
         self._last_scale.setdefault(key, now)  # first sight starts the clock
         if per_replica > pred.scale_target and desired < pred.max_replicas:
@@ -182,14 +249,21 @@ class ISVCController:
                 f"concurrency {per_replica:.1f} > target {pred.scale_target}: "
                 f"{desired} -> {desired + 1}")
         elif (per_replica < pred.scale_target / 2
-              and desired > max(pred.min_replicas, 1)):
+              and desired > pred.min_replicas):
             # Scale-down only after a quiet period since ANY scale event —
-            # a fresh scale-up must get time to absorb load first.
-            if now - self._last_scale[key] >= _SCALE_DOWN_COOLDOWN:
+            # a fresh scale-up must get time to absorb load first. Dropping
+            # the LAST replica (scale-to-zero) additionally requires a fully
+            # idle service: nothing in flight, nothing parked at the router.
+            to_zero = desired == 1
+            if to_zero and (in_flight > 0 or pending > 0):
+                return
+            cooldown = (_SCALE_TO_ZERO_COOLDOWN if to_zero
+                        else _SCALE_DOWN_COOLDOWN)
+            if now - self._last_scale[key] >= cooldown:
                 isvc.status.desired_replicas = desired - 1
                 self._last_scale[key] = now
                 self.recorder.normal(
-                    isvc, "ScaledDown",
+                    isvc, "ScaledToZero" if to_zero else "ScaledDown",
                     f"concurrency {per_replica:.1f} < half target: "
                     f"{desired} -> {desired - 1}")
 
@@ -200,7 +274,8 @@ class ISVCController:
         return self.store.list(Worker, namespace=namespace,
                                label_selector={LABEL_ISVC: name})
 
-    def _create_replica(self, isvc: InferenceService, index: int) -> Worker:
+    def _create_replica(self, isvc: InferenceService, index: int,
+                        generation: int) -> Worker:
         pred = isvc.spec.predictor
         model = pred.model
         port = free_port()
@@ -215,10 +290,11 @@ class ISVCController:
             config["transformer"] = isvc.spec.transformer.model_dump()
         w = Worker(
             metadata=ObjectMeta(
-                name=f"{isvc.metadata.name}-predictor-{index}",
+                name=f"{isvc.metadata.name}-predictor-g{generation}-{index}",
                 namespace=isvc.metadata.namespace,
                 labels={LABEL_ISVC: isvc.metadata.name,
-                        LABEL_REPLICA: str(index)},
+                        LABEL_REPLICA: str(index),
+                        LABEL_GEN: str(generation)},
                 owner=isvc.key,
             ),
             spec=WorkerSpec(

@@ -1,14 +1,23 @@
 """Service router: one stable URL per InferenceService, weighted backend
-selection — the in-process analog of the Istio VirtualService + Knative
-revision traffic split the reference wires per service ((U) kserve
+selection, and activator-style request queueing — the in-process analog of
+the Istio VirtualService + Knative revision traffic split AND the Knative
+activator the reference wires per service ((U) kserve
 pkg/controller/v1beta1/inferenceservice/components/predictor.go; SURVEY.md
-§3.2 'istio-ingress → queue-proxy' hop, collapsed to one proxy)."""
+§3.2 'istio-ingress → (serverless: activator→KPA scale 0→1) → queue-proxy'
+hop, collapsed to one proxy).
+
+Scale-to-zero: with no ready backends a request does NOT 503 — it parks on a
+condition variable and the ``pending`` gauge rises; the ISVC controller
+reads that gauge as the activation signal, spawns a replica, and the next
+``set_backends`` wakes every parked request (0→1 cold start). 503 only after
+``queue_timeout``."""
 
 from __future__ import annotations
 
 import itertools
 import random
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -17,15 +26,20 @@ from typing import Optional
 class Router:
     """Weighted HTTP proxy over predictor replicas.
 
-    Backends are registered per traffic group (e.g. generation "3"), each
-    group with a weight percent; requests pick a group by weight, then
-    round-robin inside it."""
+    Backends are registered per traffic group (e.g. "latest"/"previous"
+    during a canary rollout), each group with a weight percent; requests
+    pick a group by weight, then round-robin inside it."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 queue_timeout: float = 120.0):
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._groups: dict[str, list[str]] = {}    # group -> base urls
         self._weights: dict[str, int] = {}         # group -> percent
         self._rr = itertools.count()
+        self._pending = 0
+        self._closed = False
+        self.queue_timeout = queue_timeout
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
@@ -33,30 +47,65 @@ class Router:
 
     def set_backends(self, groups: dict[str, list[str]],
                      weights: Optional[dict[str, int]] = None) -> None:
-        with self._lock:
+        with self._cond:
             self._groups = {g: list(urls) for g, urls in groups.items() if urls}
             if weights:
                 self._weights = dict(weights)
             else:
                 self._weights = {g: 100 // max(len(self._groups), 1)
                                  for g in self._groups}
+            if self._groups:
+                self._cond.notify_all()   # wake cold-start queued requests
+
+    @property
+    def pending(self) -> int:
+        """Requests parked waiting for a backend (the activation signal)."""
+        with self._lock:
+            return self._pending
+
+    def _pick_locked(self) -> Optional[str]:
+        groups = [(g, self._weights.get(g, 0)) for g in self._groups]
+        if not groups:
+            return None
+        total = sum(w for _, w in groups) or len(groups)
+        r = random.uniform(0, total)
+        acc = 0.0
+        chosen = groups[-1][0]
+        for g, w in groups:
+            acc += w if total else 1
+            if r <= acc:
+                chosen = g
+                break
+        urls = self._groups[chosen]
+        return urls[next(self._rr) % len(urls)]
 
     def pick(self) -> Optional[str]:
         with self._lock:
-            groups = [(g, self._weights.get(g, 0)) for g in self._groups]
-            if not groups:
-                return None
-            total = sum(w for _, w in groups) or len(groups)
-            r = random.uniform(0, total)
-            acc = 0.0
-            chosen = groups[-1][0]
-            for g, w in groups:
-                acc += w if total else 1
-                if r <= acc:
-                    chosen = g
-                    break
-            urls = self._groups[chosen]
-            return urls[next(self._rr) % len(urls)]
+            return self._pick_locked()
+
+    def pick_or_wait(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Pick a backend, queueing until one registers (scale-from-zero
+        path). Returns None only after ``timeout`` (default: the router's
+        queue_timeout) with still no backend."""
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self.queue_timeout)
+        with self._cond:
+            backend = self._pick_locked()
+            if backend is not None:
+                return backend
+            self._pending += 1
+            try:
+                while not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cond.wait(remaining)
+                    backend = self._pick_locked()
+                    if backend is not None:
+                        return backend
+                return None   # router torn down: fail fast, don't hold 120s
+            finally:
+                self._pending -= 1
 
     @property
     def url(self) -> str:
@@ -68,6 +117,9 @@ class Router:
         self._thread.start()
 
     def stop(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()   # release every parked request
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
@@ -82,9 +134,9 @@ def _make_handler(router: Router):
             pass
 
         def _proxy(self) -> None:
-            backend = router.pick()
+            backend = router.pick_or_wait()
             if backend is None:
-                data = b'{"error": "no ready backends"}'
+                data = b'{"error": "no ready backends (queue timeout)"}'
                 self.send_response(503)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))

@@ -137,3 +137,184 @@ def test_deletion_cleans_replicas(cp):
     cp.store.delete(InferenceService, "svc")
     cp.step()
     assert replicas(cp) == []
+
+
+# -- scale-to-zero + activation (Knative serverless analog) -------------------
+
+def _backdate(cp, key="default/svc", by=999.0):
+    import time as _t
+    cp.isvc_reconciler._last_scale[key] = _t.monotonic() - by
+
+
+def test_scales_to_zero_when_idle(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=0, max_replicas=2))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    assert get_isvc(cp).status.ready_replicas == 1
+    _backdate(cp)          # idle past the cooldown
+    recon()
+    isvc = get_isvc(cp)
+    assert isvc.status.desired_replicas == 0
+    recon()
+    assert replicas(cp) == []
+    isvc = get_isvc(cp)
+    assert isvc.status.has_condition("Ready", status=False)
+    assert isvc.status.url    # the routed URL survives at zero
+
+
+def test_busy_service_never_drops_last_replica(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=0, max_replicas=2))
+    recon()
+    mark_running(cp, replicas(cp))
+    url = f"http://127.0.0.1:{replicas(cp)[0].spec.template.config['port']}"
+    cp.probe.load[url] = 1   # in flight < target/2 but nonzero
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 1
+
+
+def test_cold_start_on_queued_request(cp):
+    import threading
+
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=0, max_replicas=1))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    _backdate(cp)
+    recon()
+    recon()
+    assert replicas(cp) == []
+
+    # A request arrives at the router: it must park, not 503.
+    router = cp.isvc_reconciler._routers["default/svc"]
+    got = {}
+
+    def ask():
+        got["backend"] = router.pick_or_wait(timeout=30.0)
+
+    t = threading.Thread(target=ask)
+    t.start()
+    deadline = __import__("time").monotonic() + 5.0
+    while router.pending == 0:
+        assert __import__("time").monotonic() < deadline
+    recon()                   # sees pending>0 → ColdStart 0→1
+    ws = replicas(cp)
+    assert len(ws) == 1
+    assert get_isvc(cp).status.desired_replicas == 1
+    mark_running(cp, ws)
+    recon()                   # replica ready → set_backends wakes the queue
+    t.join(timeout=10.0)
+    assert got["backend"] is not None
+    events = [e.reason for e in cp.recorder.for_object(get_isvc(cp))]
+    assert "ColdStart" in events
+
+
+# -- canary rollout (generation traffic split) --------------------------------
+
+def test_canary_split_and_promotion(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=2, max_replicas=2))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    isvc = get_isvc(cp)
+    assert isvc.status.ready_replicas == 2
+    gen1 = isvc.metadata.generation
+
+    # Rollout: new model config at 50% canary.
+    isvc.spec.predictor.model.config = {"preset": "tiny-gemma"}
+    isvc.spec.predictor.canary_traffic_percent = 50
+    cp.store.update(isvc)
+    recon()
+    ws = replicas(cp)
+    gens = sorted({int(w.metadata.labels[
+        "serving.tpu.kubeflow.dev/generation"]) for w in ws})
+    assert len(gens) == 2 and gens[0] == gen1
+    # Previous generation keeps its 2; canary gets round(2*0.5)=1.
+    assert len(ws) == 3
+    mark_running(cp, ws)
+    recon()
+    isvc = get_isvc(cp)
+    assert isvc.status.traffic == {"latest": 50, "previous": 50}
+    router = cp.isvc_reconciler._routers["default/svc"]
+    assert len(router._groups.get("latest", [])) == 1
+    assert len(router._groups.get("previous", [])) == 2
+
+    # Promote: clear the canary percent → new generation takes 100%, old
+    # replicas torn down once the promoted generation is ready.
+    isvc.spec.predictor.canary_traffic_percent = None
+    cp.store.update(isvc)
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    recon()
+    ws = replicas(cp)
+    gens = {int(w.metadata.labels["serving.tpu.kubeflow.dev/generation"])
+            for w in ws}
+    assert len(gens) == 1 and gens != {gen1}
+    assert len(ws) == 2
+    assert get_isvc(cp).status.traffic == {"latest": 100}
+
+
+def test_canary_not_ready_keeps_previous_serving(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc())
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    isvc = get_isvc(cp)
+    isvc.spec.predictor.canary_traffic_percent = 30
+    isvc.spec.predictor.model.config = {"preset": "tiny-gemma"}
+    cp.store.update(isvc)
+    recon()   # canary replica created but not Running
+    router = cp.isvc_reconciler._routers["default/svc"]
+    # Traffic still flows: previous group holds the only ready replica.
+    assert router._groups.get("previous")
+    assert router.pick() is not None
+
+
+def test_scale_to_zero_suspends_canary_generations(cp):
+    """A scaled-to-zero service must not keep previous-generation canary
+    replicas running (regression: old generations leaked at zero)."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=0, max_replicas=2))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    isvc = get_isvc(cp)
+    isvc.spec.predictor.canary_traffic_percent = 50
+    isvc.spec.predictor.model.config = {"preset": "tiny-gemma"}
+    cp.store.update(isvc)
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    assert len(replicas(cp)) == 2            # previous + canary
+    _backdate(cp)
+    recon()                                  # autoscaler -> 0
+    recon()                                  # converge: everything gone
+    recon()
+    assert replicas(cp) == []
+
+
+def test_router_stop_releases_parked_requests(cp):
+    import threading
+    import time as _t
+    from kubeflow_tpu.serve.router import Router
+
+    r = Router()
+    r.start()
+    got = {}
+    t = threading.Thread(
+        target=lambda: got.update(x=r.pick_or_wait(timeout=60.0), done=True))
+    t.start()
+    while r.pending == 0:
+        _t.sleep(0.01)
+    start = _t.monotonic()
+    r.stop()
+    t.join(timeout=5.0)
+    assert got.get("done") and got["x"] is None
+    assert _t.monotonic() - start < 5.0      # fail fast, not queue_timeout

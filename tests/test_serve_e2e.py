@@ -79,3 +79,44 @@ def test_isvc_serves_through_router_and_recovers(cp):
     assert recovered, "replica was not replaced after crash"
     out = _post(url + "/v1/completions", {"prompt": "yo", "max_tokens": 2})
     assert out["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+@pytest.mark.slow
+def test_scale_to_zero_cold_start_e2e(cp):
+    """The serverless path end to end ((U) kserve Knative mode): a
+    min_replicas=0 service serves, idles to zero, then a request parks at
+    the router, the controller cold-starts a replica, and the request is
+    answered — no 503 anywhere."""
+    isvc = cp.submit(InferenceService(
+        metadata=ObjectMeta(name="szero"),
+        spec=InferenceServiceSpec(predictor=PredictorSpec(
+            model=ModelSpec(model_name="szero",
+                            config={"preset": "tiny",
+                                    "overrides": {"vocab_size": 512}}),
+            min_replicas=0, max_replicas=1,
+            batching=BatchingSpec(max_batch_size=2, max_seq_len=64,
+                                  prefill_buckets=[32])))))
+    ready = cp.wait_for(isvc, "Ready", timeout=180)
+    url = ready.status.url
+    out = _post(url + "/v1/completions", {"prompt": "hi", "max_tokens": 2})
+    assert out["usage"]["completion_tokens"] >= 1
+
+    # Idle past the cooldown → the controller drops the last replica.
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        cur = cp.store.get(InferenceService, "szero")
+        ws = cp.store.list(Worker, label_selector={
+            "serving.tpu.kubeflow.dev/service": "szero"})
+        if cur.status.desired_replicas == 0 and not ws:
+            break
+        time.sleep(1.0)
+    else:
+        raise AssertionError("service never scaled to zero while idle")
+
+    # A request against the zero-scaled URL: parks at the router, replica
+    # cold-starts (spawn + model init + compile), request answers.
+    out = _post(url + "/v1/completions", {"prompt": "again", "max_tokens": 2},
+                timeout=240)
+    assert out["usage"]["completion_tokens"] >= 1
+    cur = cp.store.get(InferenceService, "szero")
+    assert cur.status.ready_replicas >= 1
