@@ -288,6 +288,9 @@ class Request:
     params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     id: str = ""
     arrival: float = dataclasses.field(default_factory=time.monotonic)
+    # Recompute-preemption bookkeeping (paged engine): output tokens already
+    # folded back into prompt_tokens when the slot was preempted.
+    resumed_from: int = 0
     # results
     output_tokens: list[int] = dataclasses.field(default_factory=list)
     first_token_time: Optional[float] = None
@@ -315,6 +318,17 @@ class _Slot:
     length: int           # position of the NEXT token to be written
     last_token: int
     generated: int = 0
+    admit_seq: int = 0    # admission order (preemption picks the youngest)
+
+
+@dataclasses.dataclass
+class _Chunking:
+    """An in-flight chunked prefill (several may run concurrently — no
+    head-of-line blocking between long prompts)."""
+    request: Request
+    slot: int
+    pos: int              # next prompt position to prefill
+    stalls: int = 0       # consecutive page-starved attempts (paged mode)
 
 
 # -- the engine ----------------------------------------------------------------
@@ -390,12 +404,46 @@ class LLMEngine:
                 else x, self.params)
         self._rng = jax.random.PRNGKey(seed + 1)
 
-        self.cache = {
-            "k": jnp.zeros((cfg.n_layers, self.num_slots, self.max_len,
-                            cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
-            "v": jnp.zeros((cfg.n_layers, self.num_slots, self.max_len,
-                            cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
-        }
+        self.paged = bool(b.paged)
+        self.page_size = int(b.page_size)
+        self._allocator = None
+        if self.paged:
+            from kubeflow_tpu.serve.paged import PageAllocator
+
+            pg = self.page_size
+            if pg <= 0 or self.max_len % pg:
+                raise ValueError("page_size must divide max_seq_len")
+            chunk = max(0, int(b.chunked_prefill_tokens)) or pg
+            if chunk % pg:
+                raise ValueError(
+                    "chunked_prefill_tokens must be a multiple of page_size "
+                    "in paged mode (chunk boundaries are page boundaries)")
+            self._mpp = self.max_len // pg
+            self._num_pages = int(b.max_pages or self.num_slots * self._mpp)
+            if self._num_pages * pg < self.max_len:
+                raise ValueError(
+                    "page pool smaller than one max-length sequence")
+            self._allocator = PageAllocator(
+                self._num_pages, pg,
+                enable_prefix_caching=b.enable_prefix_caching)
+            self._table = np.full((self.num_slots, self._mpp), -1, np.int32)
+            self._slot_pages: list[list[int]] = [
+                [] for _ in range(self.num_slots)]
+            self.cache = {
+                "k": jnp.zeros((cfg.n_layers, self._num_pages, pg,
+                                cfg.n_kv_heads, cfg.head_dim),
+                               cfg.activation_dtype),
+                "v": jnp.zeros((cfg.n_layers, self._num_pages, pg,
+                                cfg.n_kv_heads, cfg.head_dim),
+                               cfg.activation_dtype),
+            }
+        else:
+            self.cache = {
+                "k": jnp.zeros((cfg.n_layers, self.num_slots, self.max_len,
+                                cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
+                "v": jnp.zeros((cfg.n_layers, self.num_slots, self.max_len,
+                                cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
+            }
 
         # Compiled programs: donate the cache so it mutates in place in HBM.
         on_tpu = jax.default_backend() == "tpu"
@@ -413,13 +461,36 @@ class LLMEngine:
 
         self._prefill = jax.jit(_prefill_fn, donate_argnums=(1,))
         # Chunked prefill for prompts longer than the chunk size: one chunk
-        # per scheduler step, decode interleaving between chunks.
+        # per scheduler step per in-flight prompt, decode interleaving
+        # between chunks. In paged mode EVERY admission takes this path
+        # (chunks write exactly the pages they fill — no bucket slack), so
+        # chunking can't be off: 0 falls back to one page per chunk.
         self.chunk_size = max(0, int(b.chunked_prefill_tokens))
+        if self.paged and (self.chunk_size <= 0
+                           or self.chunk_size % self.page_size):
+            self.chunk_size = self.page_size
         self._prefill_chunk = jax.jit(
             lambda p, c, t, s, st: _chunk_prefill_step(p, c, t, s, st, cfg),
             donate_argnums=(1,))
-        # (request, slot, next_position) of the in-flight chunked prefill.
-        self._chunking: Optional[tuple[Request, int, int]] = None
+        self._chunkings: list[_Chunking] = []
+        self.max_concurrent_prefills = max(1, int(b.max_concurrent_prefills))
+        if self.paged:
+            from kubeflow_tpu.serve.paged import (
+                paged_chunk_prefill, paged_decode_multi,
+            )
+
+            self._paged_chunk = jax.jit(
+                lambda p, c, t, tr, st, cp: paged_chunk_prefill(
+                    p, c, t, tr, st, cp, cfg),
+                donate_argnums=(1,))
+            self._paged_decode_n = jax.jit(
+                lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m:
+                paged_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k,
+                                   cfg, n, sample_mode=m),
+                static_argnums=(11, 12), donate_argnums=(1,))
+        self._preempted: list[Request] = []
+        self._backlog: list[Request] = []   # scheduler-side admission queue
+        self._admit_seq = itertools.count()
         self._sampler = jax.jit(_sample_batch, static_argnums=(5,))
         # K decode steps per dispatch amortizes host round-trip latency
         # (sampling happens on-device; the while_loop exits early when every
@@ -466,9 +537,9 @@ class LLMEngine:
         return self.max_len
 
     def _free_slot(self) -> Optional[int]:
-        reserved = self._chunking[1] if self._chunking is not None else None
+        reserved = {ch.slot for ch in self._chunkings}
         for i, s in enumerate(self.slots):
-            if s is None and i != reserved:
+            if s is None and i not in reserved:
                 return i
         return None
 
@@ -485,59 +556,142 @@ class LLMEngine:
             jnp.asarray([req.params.top_p], jnp.float32),
             _mode_for([req.params]))
         tok = int(jax.device_get(first)[0])
-        req.first_token_time = time.monotonic()
+        if req.first_token_time is None:
+            req.first_token_time = time.monotonic()
         req.output_tokens.append(tok)
         req.stream.put(tok)
+        # generated counts ALL emitted tokens — on re-admission after a
+        # recompute preemption the budget picks up where it left off.
         self.slots[slot_idx] = _Slot(request=req, length=plen,
-                                     last_token=tok, generated=1)
+                                     last_token=tok,
+                                     generated=len(req.output_tokens),
+                                     admit_seq=next(self._admit_seq))
         self._finish_if_done(slot_idx)
 
-    def _advance_chunked(self) -> int:
-        """Run ONE chunk of the in-flight chunked prefill (decode steps run
-        between calls — that's the whole point). Returns work done."""
-        if self._chunking is None:
-            return 0
-        req, slot_idx, pos = self._chunking
+    def _advance_one(self, ch: "_Chunking") -> int:
+        """Run ONE chunk of one in-flight chunked prefill. Returns work done
+        (0 when page-pool pressure defers the chunk to a later step)."""
+        req, slot_idx = ch.request, ch.slot
         C = self.chunk_size
         plen = len(req.prompt_tokens)
+        real = min(C, plen - ch.pos)
         chunk = np.zeros((1, C), np.int32)
-        real = min(C, plen - pos)
-        chunk[0, :real] = req.prompt_tokens[pos:pos + real]
-        logits, self.cache = self._prefill_chunk(
-            self.params, self.cache, jnp.asarray(chunk),
-            jnp.int32(slot_idx), jnp.int32(pos))
-        pos += real
-        if pos >= plen:
-            self._chunking = None
-            # Logits index of the prompt's true last token within this chunk.
-            self._start_first_token(req, slot_idx, plen, logits[real - 1])
+        chunk[0, :real] = req.prompt_tokens[ch.pos:ch.pos + real]
+        if self.paged:
+            if not self._ensure_pages(slot_idx, ch.pos + real):
+                # Pool pressure. A stalled chunking holds pages the decode
+                # preemption path can't see (its slot is None), so two
+                # growing prefills could deadlock each other: after a few
+                # starved attempts, abort this one — release its pages and
+                # requeue through the preempted lane, whose admission gate
+                # waits for room for the ENTIRE remaining run.
+                ch.stalls += 1
+                if ch.stalls >= 3:
+                    self._chunkings.remove(ch)
+                    self._release_slot_pages(slot_idx)
+                    self._preempted.append(req)
+                return 0    # otherwise retry next scheduler step
+            ch.stalls = 0
+            pg = self.page_size
+            ids = np.full((C // pg,), self._num_pages, np.int32)   # OOB pad
+            first = ch.pos // pg
+            last = (ch.pos + real - 1) // pg
+            ids[:last - first + 1] = self._table[slot_idx, first:last + 1]
+            logits, self.cache = self._paged_chunk(
+                self.params, self.cache, jnp.asarray(chunk),
+                jnp.asarray(self._table[slot_idx]), jnp.int32(ch.pos),
+                jnp.asarray(ids))
         else:
-            self._chunking = (req, slot_idx, pos)
+            logits, self.cache = self._prefill_chunk(
+                self.params, self.cache, jnp.asarray(chunk),
+                jnp.int32(slot_idx), jnp.int32(ch.pos))
+        ch.pos += real
+        if ch.pos >= plen:
+            self._chunkings.remove(ch)
+            if self.paged and self._allocator is not None:
+                # Hash the FULL prompt pages for cross-request reuse
+                # (decode writes never touch them — they start at plen).
+                self._allocator.register_prefix(
+                    req.prompt_tokens,
+                    self._slot_pages[slot_idx][:plen // self.page_size])
+            # Logits index of the prompt's true last token in this chunk.
+            self._start_first_token(req, slot_idx, plen, logits[real - 1])
         return 1
+
+    def _advance_chunked(self) -> int:
+        """One chunk of EVERY in-flight chunked prefill (decode steps run
+        between calls — that's the whole point). Returns work done."""
+        return sum(self._advance_one(ch) for ch in list(self._chunkings))
+
+    def _pages_for(self, tokens: int) -> int:
+        return -(-min(tokens, self.max_len) // self.page_size)
+
+    def _next_admissible(self) -> Optional[Request]:
+        """Next request the scheduler may start. Paged admission control
+        (livelock prevention under pool pressure): a preempted request
+        resumes FIRST and only once the pool can hold its entire remaining
+        run — and while one waits, nothing else is admitted (backpressure);
+        fresh requests need room for their prompt plus one growth page."""
+        while True:
+            try:
+                self._backlog.append(self.waiting.get_nowait())
+            except queue.Empty:
+                break
+        if not self.paged:
+            return self._backlog.pop(0) if self._backlog else None
+        if self._preempted:
+            req = self._preempted[0]
+            remaining = max(req.params.max_new_tokens
+                            - len(req.output_tokens), 0)
+            if self._allocator.available() < self._pages_for(
+                    len(req.prompt_tokens) + remaining):
+                return None
+            return self._preempted.pop(0)
+        if not self._backlog:
+            return None
+        req = self._backlog[0]
+        if self._allocator.available() < self._pages_for(
+                len(req.prompt_tokens)) + 1:
+            return None
+        return self._backlog.pop(0)
 
     def _admit(self) -> int:
         """Prefill waiting requests into free slots. Returns admissions."""
         n = self._advance_chunked()
         while True:
-            if self._chunking is not None:
-                return n   # one long prefill at a time; chunks interleave
+            if len(self._chunkings) >= self.max_concurrent_prefills \
+                    and self.paged:
+                return n
             slot_idx = self._free_slot()
             if slot_idx is None:
                 return n
-            try:
-                req = self.waiting.get_nowait()
-            except queue.Empty:
+            req = self._next_admissible()
+            if req is None:
                 return n
             plen = len(req.prompt_tokens)
             C = self.chunk_size
-            if C and plen > C and -(-plen // C) * C <= self.max_len:
+            if self.paged:
+                # Paged admission is always chunked; the prefix cache trims
+                # the work to the uncached tail.
+                hit = self._allocator.match_prefix(req.prompt_tokens)
+                self._release_slot_pages(slot_idx)
+                self._slot_pages[slot_idx] = list(hit)
+                self._table[slot_idx, :] = -1
+                self._table[slot_idx, :len(hit)] = hit
+                ch = _Chunking(req, slot_idx, len(hit) * self.page_size)
+                self._chunkings.append(ch)
+                n += self._advance_one(ch)
+                continue
+            if C and plen > C and -(-plen // C) * C <= self.max_len \
+                    and len(self._chunkings) < self.max_concurrent_prefills:
                 # Long prompt: chunked path — _free_slot holds this slot
                 # while chunks stream across scheduler steps. Guard: every
                 # C-wide window must fit inside max_len, else the final
                 # chunk's dynamic_update_slice would clamp and overwrite
                 # earlier KV (fall through to one-shot prefill instead).
-                self._chunking = (req, slot_idx, 0)
-                n += self._advance_chunked()
+                ch = _Chunking(req, slot_idx, 0)
+                self._chunkings.append(ch)
+                n += self._advance_one(ch)
                 continue
             bucket = self._bucket_for(plen)
             toks = np.zeros((1, bucket), np.int32)
@@ -547,6 +701,52 @@ class LLMEngine:
                 jnp.int32(slot_idx), jnp.int32(plen))
             self._start_first_token(req, slot_idx, plen, last_logits)
             n += 1
+
+    # -- paged bookkeeping -----------------------------------------------------
+
+    def _ensure_pages(self, slot_idx: int, upto: int) -> bool:
+        """Grow ``slot_idx``'s page list to cover positions [0, upto)."""
+        from kubeflow_tpu.serve.paged import PagePoolExhausted
+
+        need = min(-(-upto // self.page_size), self._mpp)
+        have = len(self._slot_pages[slot_idx])
+        if need <= have:
+            return True
+        try:
+            new = self._allocator.alloc(need - have)
+        except PagePoolExhausted:
+            return False
+        self._table[slot_idx, have:need] = new
+        self._slot_pages[slot_idx].extend(new)
+        return True
+
+    def _release_slot_pages(self, idx: int) -> None:
+        if self._allocator is not None and self._slot_pages[idx]:
+            self._allocator.free(self._slot_pages[idx])
+            self._slot_pages[idx] = []
+            self._table[idx, :] = -1
+
+    def _preempt_slot(self, idx: int) -> None:
+        """Recompute preemption (vLLM analog): release the slot's pages and
+        requeue its request with prompt+generated-so-far; re-admission
+        recomputes (prefix cache permitting) and generation resumes."""
+        s = self.slots[idx]
+        req = s.request
+        req.prompt_tokens = list(req.prompt_tokens) \
+            + req.output_tokens[req.resumed_from:]
+        req.resumed_from = len(req.output_tokens)
+        self._release_slot_pages(idx)
+        self.slots[idx] = None
+        self._preempted.append(req)
+
+    def _preempt_youngest(self, keep: int) -> bool:
+        candidates = [(s.admit_seq, i) for i, s in enumerate(self.slots)
+                      if s is not None and i != keep]
+        if not candidates:
+            return False
+        _, idx = max(candidates)
+        self._preempt_slot(idx)
+        return True
 
     def _finish_if_done(self, idx: int) -> bool:
         s = self.slots[idx]
@@ -567,6 +767,8 @@ class LLMEngine:
         req.stream.put(None)
         req.done.set()
         self.metrics.observe(req)
+        if self.paged:
+            self._release_slot_pages(idx)
         self.slots[idx] = None
         return True
 
@@ -577,6 +779,30 @@ class LLMEngine:
         active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return 0
+        k_steps = 1 if self._chunkings else self.decode_steps
+        if self.paged:
+            # Pre-allocate pages covering every live slot's next k_steps
+            # write positions (mid-dispatch page crossings must land on
+            # mapped pages); under pool pressure, preempt youngest-first.
+            for i, s in list(active):
+                if self.slots[i] is not s:
+                    continue    # preempted by an earlier slot's allocation
+                upto = min(s.length + k_steps, self.max_len)
+                while not self._ensure_pages(i, upto):
+                    if self._preempt_youngest(keep=i):
+                        continue
+                    # Sole survivor: shrink the dispatch to one step; init
+                    # guarantees one max-length sequence always fits, but
+                    # guard the next write position anyway.
+                    k_steps = 1
+                    if not self._ensure_pages(i, min(s.length + 1,
+                                                     self.max_len)):
+                        self._preempt_slot(i)
+                    break
+            active = [(i, s) for i, s in enumerate(self.slots)
+                      if s is not None]
+            if not active:
+                return 0
         nb = self.num_slots
         tokens = np.zeros((nb,), np.int32)
         lengths = np.zeros((nb,), np.int32)
@@ -597,13 +823,21 @@ class LLMEngine:
             top_p[i] = p.top_p
             stops[i] = -1 if p.stop_token is None else p.stop_token
             budgets[i] = budget
-        k_steps = 1 if self._chunking is not None else self.decode_steps
         mode = _mode_for([s.request.params for _, s in active])
-        out, self.cache, _, _, _ = self._decode_n(
-            self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(lengths), jnp.asarray(live), jnp.asarray(temps),
-            jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(stops),
-            jnp.asarray(budgets), self._next_key(), k_steps, mode)
+        if self.paged:
+            cache_in = {**self.cache, "table": jnp.asarray(self._table)}
+            out, cache_out, _, _, _ = self._paged_decode_n(
+                self.params, cache_in, jnp.asarray(tokens),
+                jnp.asarray(lengths), jnp.asarray(live), jnp.asarray(temps),
+                jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(stops),
+                jnp.asarray(budgets), self._next_key(), k_steps, mode)
+            self.cache = {"k": cache_out["k"], "v": cache_out["v"]}
+        else:
+            out, self.cache, _, _, _ = self._decode_n(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(lengths), jnp.asarray(live), jnp.asarray(temps),
+                jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(stops),
+                jnp.asarray(budgets), self._next_key(), k_steps, mode)
         out = np.asarray(jax.device_get(out))
         emitted = 0
         for i, s in active:

@@ -1,0 +1,336 @@
+"""Paged KV cache: page pool + page tables + prefix caching — the TPU-native
+analog of vLLM's PagedAttention memory manager ((U) kserve
+python/huggingfaceserver vLLM backend; SURVEY.md §2.3#27 'continuous
+batching, paged KV').
+
+Why paging matters on v5e: the contiguous slot cache reserves
+``slots × max_seq_len`` HBM whether or not requests use it; high-density
+serving wants HBM proportional to *actual* tokens resident. Here KV lives in
+a fixed pool of pages ``[L, P, page, KV, Dh]``; each slot owns an ordered
+page list (its page table), and:
+
+- **Allocation** is a host-side free list with O(1) alloc/free between
+  device steps — the device never sees allocation, only page-id arrays.
+- **Prefix caching**: pages holding FULL prompt prefixes are content-hashed
+  (chained: page i's key folds page i-1's key), refcounted, and reused
+  across requests — a shared system-prompt costs its KV once. Freed pages
+  linger in the hash map (ref=0, LRU) until the pool needs them.
+- **Preemption = recompute**: if the pool can't cover a running slot's next
+  tokens even after evicting cached pages, the youngest slot releases its
+  pages and its request requeues with prompt+generated so far (vLLM's
+  recompute preemption).
+
+Device side, the paged variants mirror the contiguous ones (engine.py): the
+page table rides into the dispatch as a ``[B, max_pages_per_slot]`` int32
+array; reads gather pages back into the ``[B, S, KV, Dh]`` layout XLA
+already tiles well, writes scatter ``(page, offset)`` with out-of-bounds
+drops for dead rows. Exactness: same einsums over the same values — the
+paged engine is bit-compatible with the contiguous one (tests pin this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models import layers as L
+from kubeflow_tpu.models.config import DecoderConfig
+from kubeflow_tpu.models.decoder import Params
+
+
+# -- host-side page allocator --------------------------------------------------
+
+class PagePoolExhausted(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class _CachedPage:
+    page: int
+    key: tuple
+
+
+class PageAllocator:
+    """Free-list page allocator with chained-hash prefix caching.
+
+    Pages are ints in [0, num_pages). A page is in exactly one of:
+    - allocated (ref > 0): owned by one or more slots;
+    - cached (ref == 0, still hash-mapped): reusable prefix content, evicted
+      LRU when the free list runs dry;
+    - free: on the free list.
+    """
+
+    def __init__(self, num_pages: int, page_size: int,
+                 enable_prefix_caching: bool = True):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.prefix_caching = enable_prefix_caching
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        self._ref = np.zeros((num_pages,), np.int32)
+        # content key -> page id (for reuse); page id -> key (for eviction)
+        self._by_key: dict[tuple, int] = {}
+        self._key_of: dict[int, tuple] = {}
+        # ref==0 pages that still hold cached content, LRU order
+        self._reclaimable: "OrderedDict[int, None]" = OrderedDict()
+        self.stats = {"prefix_hits": 0, "prefix_queries": 0, "evictions": 0}
+
+    # -- raw pages ---------------------------------------------------------
+
+    def available(self) -> int:
+        return len(self._free) + len(self._reclaimable)
+
+    def alloc(self, n: int) -> list[int]:
+        """n fresh pages (ref=1 each). Evicts cached pages LRU if needed."""
+        if self.available() < n:
+            raise PagePoolExhausted(f"need {n}, have {self.available()}")
+        out = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.pop()
+            else:
+                p, _ = self._reclaimable.popitem(last=False)   # LRU evict
+                key = self._key_of.pop(p, None)
+                if key is not None:
+                    self._by_key.pop(key, None)
+                self.stats["evictions"] += 1
+            self._ref[p] = 1
+            out.append(p)
+        return out
+
+    def incref(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if self._ref[p] == 0:
+                self._reclaimable.pop(p, None)
+            self._ref[p] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference; ref-0 pages become reclaimable (cached) if
+        hashed, else go straight to the free list."""
+        for p in pages:
+            self._ref[p] -= 1
+            assert self._ref[p] >= 0, f"double free of page {p}"
+            if self._ref[p] == 0:
+                if p in self._key_of:
+                    self._reclaimable[p] = None    # keep content, LRU
+                else:
+                    self._free.append(p)
+
+    # -- prefix caching ----------------------------------------------------
+
+    @staticmethod
+    def chain_keys(tokens: Sequence[int], page_size: int) -> list[tuple]:
+        """Chained content keys for every FULL page of ``tokens``."""
+        keys, parent = [], ()
+        for i in range(len(tokens) // page_size):
+            parent = (hash((parent, tuple(tokens[i * page_size:(i + 1) * page_size]))),)
+            keys.append(parent)
+        return keys
+
+    def match_prefix(self, tokens: Sequence[int]) -> list[int]:
+        """Longest run of cached pages for ``tokens``' full-page prefix
+        (capped so at least one prompt token remains to prefill — the first
+        sampled token needs real last-token logits). Bumps refs on the hit
+        pages; caller owns them."""
+        if not self.prefix_caching:
+            return []
+        self.stats["prefix_queries"] += 1
+        max_reuse = (len(tokens) - 1) // self.page_size
+        hit: list[int] = []
+        for key in self.chain_keys(tokens, self.page_size)[:max_reuse]:
+            page = self._by_key.get(key)
+            if page is None:
+                break
+            hit.append(page)
+        if hit:
+            self.incref(hit)
+            self.stats["prefix_hits"] += 1
+        return hit
+
+    def register_prefix(self, tokens: Sequence[int],
+                        pages: Sequence[int]) -> None:
+        """Hash ``pages`` as holding ``tokens``' full-page prefixes (called
+        after the KV is actually written)."""
+        if not self.prefix_caching:
+            return
+        for key, page in zip(self.chain_keys(tokens, self.page_size), pages):
+            old = self._by_key.get(key)
+            if old is not None and old != page:
+                continue     # first writer wins; duplicates just aren't hashed
+            self._by_key[key] = page
+            self._key_of[page] = key
+
+
+# -- device-side paged steps ---------------------------------------------------
+#
+# Cache pytree: {"k": [L,P,pg,KV,Dh], "v": same, "table": [B, mpp] int32}
+# where mpp = max_seq_len // page. Table entries are page ids; -1 = unmapped
+# (reads are length-masked, writes aimed out of bounds and dropped).
+
+
+def paged_gather(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """[P,pg,K,D] pool + [B,mpp] table -> [B, mpp*pg, K, D] per-slot view."""
+    b, mpp = table.shape
+    pages = pool[jnp.clip(table, 0, pool.shape[0] - 1)]   # [B,mpp,pg,K,D]
+    return pages.reshape(b, mpp * pool.shape[1], *pool.shape[2:])
+
+
+def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,
+                        table, cfg: DecoderConfig):
+    """One transformer block for a [B,1] decode step against the page pool.
+    Mirrors engine._decode_block; only the KV residency differs."""
+    from kubeflow_tpu.serve.engine import _decode_attention
+
+    dt = cfg.activation_dtype
+    pg = pool_k.shape[1]
+    h = L.rmsnorm(x, bp["ln1"], cfg)
+    q = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wv"].astype(dt))
+    q = L.rope(q, positions, cfg.rope_theta)
+    k = L.rope(k, positions, cfg.rope_theta)
+    # Write position -> (page, offset); dead rows (and unmapped pages) aim
+    # out of bounds and DROP.
+    bidx = jnp.arange(x.shape[0])
+    page_slot = lengths // pg
+    page_id = table[bidx, jnp.clip(page_slot, 0, table.shape[1] - 1)]
+    ok = live & (page_id >= 0)
+    pidx = jnp.where(ok, page_id, pool_k.shape[0])
+    off = lengths % pg
+    nk = pool_k.at[pidx, off].set(k[:, 0], mode="drop")
+    nv = pool_v.at[pidx, off].set(v[:, 0], mode="drop")
+    ck = paged_gather(nk, table)
+    cv = paged_gather(nv, table)
+    attn = _decode_attention(q, ck, cv, lengths, cfg)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
+    h = L.rmsnorm(x, bp["ln2"], cfg)
+    if cfg.is_moe:
+        mlp_out, _ = L.moe_block(bp["mlp"], h, cfg)
+    else:
+        mlp_out = L.mlp_block(bp["mlp"], h, cfg)
+    return x + mlp_out, nk, nv
+
+
+def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,
+                       lengths: jax.Array, live: jax.Array,
+                       cfg: DecoderConfig):
+    """One [B,1] decode step over the page pool (≈ engine._decode_step)."""
+    dt = cfg.activation_dtype
+    x = params["embed"].astype(dt)[tokens[:, None]]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden ** 0.5, dt)
+    positions = lengths[:, None]
+    table = cache["table"]
+
+    def body(x, scan_in):
+        bp, pk, pv = scan_in
+        x, nk, nv = _paged_decode_block(bp, x, positions, lengths, live,
+                                        pk, pv, table, cfg)
+        return x, (nk, nv)
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    x = L.rmsnorm(x, params["final_norm"], cfg)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dt),
+                        preferred_element_type=jnp.float32)[:, 0]
+    if cfg.logits_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+    return logits, {"k": nk, "v": nv, "table": table}
+
+
+def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,
+                       lengths: jax.Array, live: jax.Array, temps: jax.Array,
+                       top_k: jax.Array, top_p: jax.Array,
+                       stop_tokens: jax.Array, budgets: jax.Array,
+                       key: jax.Array, cfg: DecoderConfig, num_steps: int,
+                       sample_mode: str = "full"):
+    """Up to ``num_steps`` decode+sample steps in ONE dispatch over the page
+    pool (≈ engine._decode_multi; the host pre-allocates pages covering
+    ``lengths + num_steps`` so mid-dispatch page-boundary crossings always
+    land on mapped pages)."""
+    from kubeflow_tpu.serve.engine import _sample_batch
+
+    b = tokens.shape[0]
+    mpp = cache["table"].shape[1]
+    pg = cache["k"].shape[2]
+    max_len = mpp * pg
+    out0 = jnp.full((b, num_steps), -1, jnp.int32)
+
+    def cond(carry):
+        i, _, _, _, live, _, _, _ = carry
+        return (i < num_steps) & jnp.any(live)
+
+    def body(carry):
+        i, cache, tokens, lengths, live, budgets, key, out = carry
+        logits, cache = _paged_decode_step(params, cache, tokens, lengths,
+                                           live, cfg)
+        key, sub = jax.random.split(key)
+        sampled = _sample_batch(logits, sub, temps, top_k, top_p,
+                                mode=sample_mode)
+        tokens = jnp.where(live, sampled, tokens)
+        out = out.at[:, i].set(jnp.where(live, sampled, -1))
+        lengths = jnp.where(live, lengths + 1, lengths)
+        budgets = jnp.where(live, budgets - 1, budgets)
+        live = live & (sampled != stop_tokens) & (budgets > 0) \
+            & (lengths + 1 < max_len)
+        return i + 1, cache, tokens, lengths, live, budgets, key, out
+
+    _, cache, _, lengths, live, budgets, _, out = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), cache, tokens, lengths, live, budgets, key, out0))
+    return out, cache, lengths, live, budgets
+
+
+def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,
+                        table_row: jax.Array, start: jax.Array,
+                        chunk_pages: jax.Array, cfg: DecoderConfig,
+                        attn_impl: str = "xla"):
+    """Prefill ONE chunk (``tokens`` [1,C], positions [start, start+C)) of a
+    slot whose pages are ``table_row`` [mpp]; write the chunk's K/V into
+    ``chunk_pages`` [C//pg] (OOB-padded ids → dropped writes for the pages a
+    short tail doesn't reach).
+
+    The chunk attends to the slot's earlier KV by gathering the page table
+    into the contiguous layout decoder_forward's cache path expects, then
+    scatters only the chunk's pages back — pool traffic stays O(resident
+    KV), not O(pool). Returns ([C,V] logits, cache)."""
+    from kubeflow_tpu.models.decoder import decoder_forward
+
+    pg = cache["k"].shape[2]
+    c = tokens.shape[1]
+    npages = c // pg
+    # Gather the slot's cache row: [L,1,mpp*pg,K,D]. Pad the row by one
+    # chunk of scratch positions so the final chunk's C-wide
+    # dynamic_update_slice window can never clamp at max_len and overwrite
+    # earlier KV (prefix-cache hits start chunks at page — not chunk —
+    # alignment, so start + C may exceed max_len). The scratch tail is
+    # causal-masked (kv position > any query position) and never scattered
+    # back to pages.
+    row_k = jax.vmap(lambda pool: paged_gather(pool, table_row[None]))(
+        cache["k"])
+    row_v = jax.vmap(lambda pool: paged_gather(pool, table_row[None]))(
+        cache["v"])
+    pad = [(0, 0), (0, 0), (0, c), (0, 0), (0, 0)]
+    caches = {"k": jnp.pad(row_k, pad), "v": jnp.pad(row_v, pad),
+              "len": start}
+    logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=caches,
+                                        attn_impl=attn_impl)
+    # Scatter the chunk's pages back into the pool: the chunk occupies
+    # positions [start, start+C) = page slots start//pg .. +npages.
+    written_k = jax.lax.dynamic_slice_in_dim(filled["k"], start, c, axis=2)
+    written_v = jax.lax.dynamic_slice_in_dim(filled["v"], start, c, axis=2)
+    # [L,1,C,K,D] -> [L, npages, pg, K, D]
+    written_k = written_k.reshape(cfg.n_layers, npages, pg,
+                                  *written_k.shape[3:])
+    written_v = written_v.reshape(cfg.n_layers, npages, pg,
+                                  *written_v.shape[3:])
+    pidx = jnp.where((chunk_pages >= 0) & (chunk_pages < cache["k"].shape[1]),
+                     chunk_pages, cache["k"].shape[1])
+    nk = cache["k"].at[:, pidx].set(written_k, mode="drop")
+    nv = cache["v"].at[:, pidx].set(written_v, mode="drop")
+    return logits[0], {"k": nk, "v": nv}
