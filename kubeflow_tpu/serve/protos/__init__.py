@@ -1,0 +1,1 @@
+"""Generated OIP protobuf messages (oip_pb2 via `protoc --python_out`)."""

@@ -47,7 +47,8 @@ class ModelServer:
                  repository=None,
                  tokenizer: Optional[Tokenizer] = None,
                  transformer=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 grpc_port: Optional[int] = None):
         if (engine is None) == (repository is None):
             raise ValueError("pass exactly one of engine= or repository=")
         self.name = name                  # default model name
@@ -64,6 +65,13 @@ class ModelServer:
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        # v2 protocol over gRPC as well as REST (grpc_port=0 → ephemeral).
+        self.grpc_server = None
+        if grpc_port is not None:
+            from kubeflow_tpu.serve.grpc_server import GRPCInferenceServer
+
+            self.grpc_server = GRPCInferenceServer(self, host=host,
+                                                   port=grpc_port)
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -73,12 +81,16 @@ class ModelServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="model-server")
         self._thread.start()
+        if self.grpc_server is not None:
+            self.grpc_server.start()
 
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
         if self.engine is not None:
             self.engine.stop()
         if self.repository is not None:
@@ -135,6 +147,22 @@ class ModelServer:
         if entry is None:
             raise KeyError(name)
         return entry.cfg
+
+    def generate_text(self, prompt: str, body: dict, model: Optional[str],
+                      strict: bool = False) -> tuple[str, "Request"]:
+        """Pre-hop → tokenize → engine → detokenize → post-hop: the one
+        generation path every protocol surface (REST v1/v2, OpenAI, gRPC)
+        shares."""
+        if self.transformer is not None:
+            prompt = self.transformer(prompt, "pre")
+        with self.lease(model, strict=strict) as (engine, tokenizer, _):
+            toks = tokenizer.encode(prompt)
+            req = engine.submit(toks, self.sampling_from(body, tokenizer))
+            out = req.result(timeout=float(body.get("timeout", 300)))
+            text = tokenizer.decode([t for t in out if t != tokenizer.eos_id])
+        if self.transformer is not None:
+            text = self.transformer(text, "post")
+        return text, req
 
     # -- request plumbing ------------------------------------------------------
 
@@ -301,18 +329,7 @@ def _make_handler(server: ModelServer):
         def _generate_text(self, prompt: str, body: dict,
                            model: Optional[str],
                            strict: bool = False) -> tuple[str, Request]:
-            if server.transformer is not None:
-                prompt = server.transformer(prompt, "pre")
-            with server.lease(model, strict=strict) as (engine, tokenizer, _):
-                toks = tokenizer.encode(prompt)
-                req = engine.submit(toks,
-                                    server.sampling_from(body, tokenizer))
-                out = req.result(timeout=float(body.get("timeout", 300)))
-                text = tokenizer.decode(
-                    [t for t in out if t != tokenizer.eos_id])
-            if server.transformer is not None:
-                text = server.transformer(text, "post")
-            return text, req
+            return server.generate_text(prompt, body, model, strict=strict)
 
         def _v1_predict(self, body: dict, model: str) -> None:
             instances = body.get("instances")
