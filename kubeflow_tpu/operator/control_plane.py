@@ -71,8 +71,18 @@ class ControlPlane:
 
         self.experiment_reconciler = ExperimentController(
             self.store, recorder=self.recorder)
+        # Durable observation history (katib db-manager analog): trials
+        # write every collected point into the native metadata store, so
+        # cross-experiment queries survive object GC.
+        from kubeflow_tpu.pipelines.metadata import MetadataStore
+        from kubeflow_tpu.tune.observations import ObservationLog
+
+        self.observation_store = MetadataStore(
+            os.path.join(self.config.base_dir, "observations.db"))
+        self.observations = ObservationLog(self.observation_store)
         self.trial_reconciler = TrialController(
-            self.store, base_dir=self.config.base_dir, recorder=self.recorder)
+            self.store, base_dir=self.config.base_dir, recorder=self.recorder,
+            observations=self.observations)
         from kubeflow_tpu.pipelines.controller import (
             PipelineRunController, ScheduledRunController,
         )
@@ -158,6 +168,7 @@ class ControlPlane:
         self.pipelinerun_reconciler.shutdown()
         self.notebook_reconciler.shutdown()
         self.tensorboard_reconciler.shutdown()
+        self.observation_store.close()
 
     def step(self) -> int:
         """Deterministic single-threaded pump (test mode)."""

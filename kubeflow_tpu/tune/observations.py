@@ -1,0 +1,199 @@
+"""Queryable observation-log history backed by the native metadata store —
+the katib-db-manager analog ((U) katib cmd/db-manager + pkg/db: gRPC
+ReportObservationLog/GetObservationLog over MySQL; SURVEY.md §2.4#33).
+
+Trial observations so far lived only on Trial status (lost with the
+object); here every reported point also lands in the C++ metadata store
+(pipelines/metadata.py — SQLite, ctypes ABI), giving:
+
+- durable per-step logs per (trial, metric), resume-safe (reporting is an
+  upsert keyed by step);
+- cross-experiment queries: every experiment is a context, every trial an
+  execution associated with it, so "all trials of every Gemma sweep this
+  month" is a store query, not a status crawl.
+
+Schema (MLMD node mapping):
+- context type ``tune_experiment``, name = "<namespace>/<experiment>";
+- execution type ``tune_trial`` with properties ``trial_name``,
+  ``experiment`` and one ``obs:<metric>:<step08d>`` float property per
+  observation point (the observation_logs table analog — property keys
+  order lexicographically, so the zero-padded step reconstructs the
+  series).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from kubeflow_tpu.pipelines.metadata import (
+    CONTEXT, EXECUTION, EXEC_COMPLETE, EXEC_FAILED, EXEC_RUNNING,
+    MetadataStore,
+)
+
+_CTX_TYPE = "tune_experiment"
+_EXEC_TYPE = "tune_trial"
+_OBS = "obs:"
+
+
+class ObservationLog:
+    """Write/read observation series against a MetadataStore."""
+
+    def __init__(self, store: MetadataStore):
+        self.store = store
+        self._lock = threading.Lock()
+        self._ctx_cache: dict[str, int] = {}
+        self._trial_cache: dict[str, int] = {}
+        # Highest step already written per (trial, metric): collectors
+        # re-report the full history every poll, and re-upserting O(points)
+        # properties twice a second would grow quadratically. A restart
+        # clears this map → one full (idempotent) re-upsert, then deltas.
+        self._reported: dict[tuple[str, str], int] = {}
+
+    # -- registration ------------------------------------------------------
+
+    def experiment_context(self, experiment_key: str) -> int:
+        """Get-or-create the experiment's context id (resume-safe: found by
+        property scan over contexts of the tune type)."""
+        with self._lock:
+            cid = self._ctx_cache.get(experiment_key)
+            if cid is not None:
+                return cid
+            tid = self.store._b.put_type(CONTEXT, _CTX_TYPE)
+            for existing in self.store._b.list_by_type(CONTEXT, tid):
+                props = self.store._get_props(CONTEXT, existing)
+                if props.get("experiment") == experiment_key:
+                    self._ctx_cache[experiment_key] = existing
+                    return existing
+            cid = self.store.create_context(
+                _CTX_TYPE, experiment_key,
+                properties={"experiment": experiment_key})
+            self._ctx_cache[experiment_key] = cid
+            return cid
+
+    def trial_execution(self, experiment_key: str, trial_name: str,
+                        parameters: Optional[dict] = None) -> int:
+        """Get-or-create the trial's execution id, associated with its
+        experiment's context."""
+        with self._lock:
+            eid = self._trial_cache.get(trial_name)
+            if eid is not None:
+                return eid
+        for eid in self.store.find_executions_by_property("trial_name",
+                                                          trial_name):
+            with self._lock:
+                self._trial_cache[trial_name] = eid
+            return eid
+        props = {"trial_name": trial_name, "experiment": experiment_key}
+        for k, v in (parameters or {}).items():
+            props[f"param:{k}"] = v if isinstance(v, (int, float)) else str(v)
+        eid = self.store.create_execution(_EXEC_TYPE, EXEC_RUNNING,
+                                          properties=props)
+        self.store.add_association(
+            self.experiment_context(experiment_key), eid)
+        with self._lock:
+            self._trial_cache[trial_name] = eid
+        return eid
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self, experiment_key: str, trial_name: str, metric: str,
+               points: list[tuple[int, float]],
+               parameters: Optional[dict] = None) -> None:
+        """Upsert observation points (ReportObservationLog analog). Only
+        points beyond the last reported step write (collectors resend the
+        whole series every poll)."""
+        if not points:
+            return
+        with self._lock:
+            last = self._reported.get((trial_name, metric))
+        # >= : a collector may refine the newest step's value between polls.
+        fresh = [p for p in points if last is None or p[0] >= last]
+        if not fresh:
+            return
+        eid = self.trial_execution(experiment_key, trial_name, parameters)
+        self.store._set_props(EXECUTION, eid, {
+            f"{_OBS}{metric}:{step:08d}": float(value)
+            for step, value in fresh})
+        with self._lock:
+            self._reported[(trial_name, metric)] = max(
+                s for s, _ in fresh)
+
+    def finish_trial(self, trial_name: str, succeeded: bool = True) -> None:
+        eid = self._trial_cache.get(trial_name)
+        if eid is None:
+            hits = self.store.find_executions_by_property("trial_name",
+                                                          trial_name)
+            if not hits:
+                return
+            eid = hits[0]
+        self.store.update_execution(
+            eid, EXEC_COMPLETE if succeeded else EXEC_FAILED)
+
+    # -- queries (GetObservationLog analog + cross-experiment) -------------
+
+    def get_log(self, trial_name: str,
+                metric: Optional[str] = None) -> dict[str, list[tuple[int, float]]]:
+        """All observation series of a trial (optionally one metric)."""
+        hits = self.store.find_executions_by_property("trial_name",
+                                                      trial_name)
+        if not hits:
+            return {}
+        props = self.store.get_execution(hits[0])["properties"]
+        out: dict[str, list[tuple[int, float]]] = {}
+        for key in sorted(props):
+            if not key.startswith(_OBS):
+                continue
+            _, name, step = key.rsplit(":", 2)
+            name = key[len(_OBS):-(len(step) + 1)]
+            if metric is not None and name != metric:
+                continue
+            out.setdefault(name, []).append((int(step), float(props[key])))
+        return out
+
+    def experiments(self) -> list[str]:
+        tid = self.store._b.get_type(CONTEXT, _CTX_TYPE)
+        if tid is None:
+            return []
+        out = []
+        for cid in self.store._b.list_by_type(CONTEXT, tid):
+            key = self.store._get_props(CONTEXT, cid).get("experiment")
+            if key:
+                out.append(str(key))
+        return out
+
+    def trials(self, experiment_key: str) -> list[dict]:
+        """Trial summaries (name, state, params) for one experiment."""
+        cid = self.experiment_context(experiment_key)
+        out = []
+        for eid in self.store.context_executions(cid):
+            ex = self.store.get_execution(eid)
+            if ex is None:
+                continue
+            props = ex["properties"]
+            out.append({
+                "trial": props.get("trial_name"),
+                "state": ex["state"],
+                "parameters": {k[len("param:"):]: v for k, v in props.items()
+                               if k.startswith("param:")},
+            })
+        return out
+
+    def best(self, experiment_key: str, metric: str,
+             goal: str = "minimize") -> Optional[tuple[str, float]]:
+        """Best (trial, value) across an experiment's logged observations —
+        a query the status-only path couldn't answer after trial GC."""
+        best: Optional[tuple[str, float]] = None
+        for summary in self.trials(experiment_key):
+            name = summary["trial"]
+            if not name:
+                continue
+            series = self.get_log(name, metric).get(metric) or []
+            if not series:
+                continue
+            vals = [v for _, v in series]
+            v = min(vals) if goal == "minimize" else max(vals)
+            if best is None or (v < best[1] if goal == "minimize"
+                                else v > best[1]):
+                best = (name, v)
+        return best

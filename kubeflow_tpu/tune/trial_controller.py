@@ -32,11 +32,16 @@ class TrialController:
     def __init__(self, store: ObjectStore, *,
                  base_dir: Optional[str] = None,
                  recorder: Optional[EventRecorder] = None,
-                 poll_interval: float = 0.5):
+                 poll_interval: float = 0.5,
+                 observations=None):
         self.store = store
         self.base_dir = base_dir
         self.recorder = recorder or EventRecorder()
         self.poll_interval = poll_interval
+        # Optional ObservationLog (tune/observations.py): every collected
+        # point also lands in the durable metadata store — the db-manager
+        # analog; trial status stays the fast path.
+        self.observations = observations
 
     def key_for(self, ev: WatchEvent) -> Optional[str]:
         obj = ev.object
@@ -138,6 +143,15 @@ class TrialController:
                         existing[-1] = (step, value)
             else:
                 trial.status.observations[name] = pts
+        if self.observations is not None:
+            exp_key = f"{trial.metadata.namespace}/{trial.spec.experiment}"
+            for name, pts in trial.status.observations.items():
+                try:
+                    self.observations.report(
+                        exp_key, trial.metadata.name, name, pts,
+                        parameters=trial.spec.parameter_assignments)
+                except Exception:           # durable log must not wedge trials
+                    logger.exception("observation log write failed")
 
     def _finalize(self, trial: Trial, *, succeeded: bool, reason: str) -> None:
         obj = trial.spec.objective
@@ -157,6 +171,12 @@ class TrialController:
                                    reason=reason)
         self.recorder.normal(trial, reason,
                              f"objective={trial.status.final_objective}")
+        if self.observations is not None:
+            try:
+                self.observations.finish_trial(trial.metadata.name,
+                                               succeeded=succeeded)
+            except Exception:
+                logger.exception("observation log finalize failed")
         self._update_status(trial)
 
     def _update_status(self, trial: Trial) -> None:

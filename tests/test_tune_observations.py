@@ -1,0 +1,110 @@
+"""Durable observation-log history (katib db-manager analog, (U) katib
+cmd/db-manager + pkg/db; SURVEY.md §2.4#33): per-step logs in the native
+metadata store, resume-safe upserts, cross-experiment queries."""
+
+import pytest
+
+from kubeflow_tpu.pipelines.metadata import (
+    EXEC_COMPLETE, EXEC_FAILED, MetadataStore,
+)
+from kubeflow_tpu.tune.observations import ObservationLog
+
+
+@pytest.fixture(params=["python", "native"])
+def log(request, tmp_path):
+    try:
+        store = MetadataStore(str(tmp_path / "obs.db"),
+                              backend=request.param)
+    except RuntimeError:
+        pytest.skip("native backend unavailable")
+    yield ObservationLog(store)
+    store.close()
+
+
+def test_report_and_get_log(log):
+    log.report("default/exp1", "t1", "loss", [(0, 2.0), (10, 1.5), (20, 1.1)],
+               parameters={"lr": 0.01})
+    log.report("default/exp1", "t1", "accuracy", [(10, 0.4)])
+    got = log.get_log("t1")
+    assert got["loss"] == [(0, 2.0), (10, 1.5), (20, 1.1)]
+    assert got["accuracy"] == [(10, 0.4)]
+    assert log.get_log("t1", "loss") == {"loss": [(0, 2.0), (10, 1.5),
+                                                 (20, 1.1)]}
+
+
+def test_report_is_resume_safe_upsert(log):
+    log.report("default/exp1", "t1", "loss", [(0, 2.0), (10, 1.5)])
+    # Re-reporting the full history (restart) must not duplicate points and
+    # must take the newest value for a step.
+    log.report("default/exp1", "t1", "loss", [(0, 2.0), (10, 1.4), (20, 1.0)])
+    assert log.get_log("t1")["loss"] == [(0, 2.0), (10, 1.4), (20, 1.0)]
+
+
+def test_cross_experiment_queries(log):
+    log.report("default/sweep-a", "a-0", "loss", [(0, 3.0), (5, 1.0)])
+    log.report("default/sweep-a", "a-1", "loss", [(0, 3.0), (5, 2.0)])
+    log.report("default/sweep-b", "b-0", "loss", [(0, 0.5)])
+    assert sorted(log.experiments()) == ["default/sweep-a", "default/sweep-b"]
+    trials = log.trials("default/sweep-a")
+    assert sorted(t["trial"] for t in trials) == ["a-0", "a-1"]
+    assert log.best("default/sweep-a", "loss") == ("a-0", 1.0)
+    assert log.best("default/sweep-b", "loss") == ("b-0", 0.5)
+
+
+def test_trial_params_and_state(log):
+    log.report("default/e", "t9", "loss", [(0, 1.0)],
+               parameters={"lr": 0.1, "opt": "adam"})
+    log.finish_trial("t9", succeeded=True)
+    (t,) = log.trials("default/e")
+    assert t["parameters"] == {"lr": 0.1, "opt": "adam"}
+    assert t["state"] == EXEC_COMPLETE
+    log.finish_trial("t9", succeeded=False)
+    (t,) = log.trials("default/e")
+    assert t["state"] == EXEC_FAILED
+
+
+def test_survives_reopen(tmp_path):
+    path = str(tmp_path / "obs.db")
+    store = MetadataStore(path, backend="python")
+    ObservationLog(store).report("default/e", "t1", "loss", [(0, 1.0)])
+    store.close()
+    store = MetadataStore(path, backend="python")
+    log = ObservationLog(store)
+    assert log.get_log("t1")["loss"] == [(0, 1.0)]
+    assert log.experiments() == ["default/e"]
+    store.close()
+
+
+def test_trial_controller_writes_observations(tmp_path):
+    """The tune flow must land observations in the durable store — queryable
+    after the Trial objects are gone."""
+    from kubeflow_tpu.operator.control_plane import (
+        ControlPlane, ControlPlaneConfig,
+    )
+    from kubeflow_tpu.tune.client import build_experiment, parameter
+
+    plane = ControlPlane(ControlPlaneConfig(
+        base_dir=str(tmp_path), launch_processes=False,
+        metrics_sync_interval=None))
+    exp = build_experiment(
+        "sweep", entrypoint="noop",
+        parameters=[parameter("lr", min=0.001, max=0.1)],
+        objective_metric="loss", max_trial_count=2, parallel_trial_count=2,
+        metric_source="push")
+    plane.submit(exp)
+    plane.step()
+    # Fabricate job metrics (envtest style: no processes run).
+    from kubeflow_tpu.core.jobs import JAXJob
+
+    for job in plane.store.list(JAXJob):
+        job.status.metrics.step = 3
+        job.status.metrics.loss = 0.5
+        job.status.set_condition("Running")
+        plane.store.update_status(job)
+    plane.step()
+    trials = plane.observations.trials("default/sweep")
+    assert len(trials) >= 1
+    name = trials[0]["trial"]
+    assert plane.observations.get_log(name)["loss"]
+    assert "lr" in trials[0]["parameters"]
+    plane.stop()
