@@ -516,6 +516,13 @@ _ALGORITHMS["bayesianoptimization"] = GPExpectedImprovement
 
 def get_suggester(spec: ExperimentSpec) -> Suggester:
     name = spec.algorithm.name
+    if name in ("darts", "enas") and name not in _ALGORITHMS:
+        # NAS suggesters live in tune/nas.py (they carry a JAX supernet);
+        # imported lazily so the numpy-only algorithms stay jax-free.
+        from kubeflow_tpu.tune.nas import DARTS, ENAS
+
+        _ALGORITHMS["darts"] = DARTS
+        _ALGORITHMS["enas"] = ENAS
     try:
         cls = _ALGORITHMS[name]
     except KeyError:

@@ -1,0 +1,347 @@
+"""Neural architecture search suggesters — DARTS and ENAS analogs ((U) katib
+pkg/suggestion/v1beta1/nas/{darts,enas}; SURVEY.md §2.4#34).
+
+Katib's NAS services train a search model INSIDE the suggestion service
+(DARTS: differentiable relaxation over a supernet; ENAS: an RL controller
+whose candidate architectures share one set of supernet weights) and emit
+discrete architectures as trials. Same shape here, TPU-native: the search
+model is a tiny JAX decoder **supernet** trained in-process on the same
+synthetic LM stream the trial jobs use; the experiment's recognized
+architecture parameters map onto it:
+
+- ``n_layers`` (int range)     → per-layer depth gates (sigmoid, trained);
+- ``mlp_dim``  (discrete list) → parallel MLP branches per layer, one per
+                                  choice — attention weights are SHARED
+                                  across all branches (the weight-sharing
+                                  core of both methods);
+- ``hidden_act`` (categorical of silu/gelu) → branch activation choices
+  (crossed with mlp_dim into one choice axis).
+
+Non-architecture parameters (lr, …) ride along sampled from their spaces.
+
+**DARTS** (first-order): alternate steps — supernet weights on a train
+batch, architecture logits (softmax over branch mixture + depth gates) on a
+held-out batch; discretize by argmax/threshold and propose the top-ranked
+architectures as trials.
+
+**ENAS**: a categorical controller samples architectures; sampled subnets
+train the SHARED supernet weights (hard one-hot branch selection); the
+controller updates by REINFORCE on held-out subnet loss with a moving
+baseline; proposals are the controller's top architectures re-scored with
+the shared weights.
+
+The search runs once per experiment (first ``suggest`` call; results cached
+in the algorithm state, so resumed experiments don't re-search) and is
+deterministic per seed. Trials then VALIDATE proposals with real training
+runs — the search cuts the budget, the trials stay ground truth.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+import numpy as np
+
+from kubeflow_tpu.core.tuning import ParameterSpec, ParameterType
+from kubeflow_tpu.tune import search_space as ss
+from kubeflow_tpu.tune.algorithms import Suggester, _rng
+
+_ARCH_KEYS = ("n_layers", "mlp_dim", "hidden_act")
+
+
+def _split_params(specs: list[ParameterSpec]):
+    arch = {s.name: s for s in specs if s.name in _ARCH_KEYS}
+    other = [s for s in specs if s.name not in _ARCH_KEYS]
+    return arch, other
+
+
+def _choices(arch: dict[str, ParameterSpec]):
+    """The branch-choice axis (mlp_dim × hidden_act) and the depth range."""
+    mlp_dims = [128]
+    acts = ["silu"]
+    if "mlp_dim" in arch:
+        mlp_dims = [int(v) for v in arch["mlp_dim"].feasible_space.list]
+    if "hidden_act" in arch:
+        acts = [str(v) for v in arch["hidden_act"].feasible_space.list]
+    combos = list(itertools.product(mlp_dims, acts))
+    if "n_layers" in arch:
+        fs = arch["n_layers"].feasible_space
+        depths = list(range(int(fs.min), int(fs.max) + 1))
+    else:
+        depths = [2]
+    return combos, depths
+
+
+# -- the supernet --------------------------------------------------------------
+
+class _Supernet:
+    """Tiny decoder supernet: per layer, one SHARED attention + one MLP
+    branch per (mlp_dim, act) choice. Branch mixture weights (softmax alpha)
+    and depth gates (sigmoid beta) are the architecture parameters."""
+
+    def __init__(self, combos, max_depth, *, hidden=64, vocab=256, seq=32,
+                 batch=8, seed=0):
+        import jax
+        import jax.numpy as jnp
+
+        self.jax, self.jnp = jax, jnp
+        self.combos = combos
+        self.max_depth = max_depth
+        self.hidden, self.vocab, self.seq, self.batch = hidden, vocab, seq, batch
+        k = jax.random.PRNGKey(seed)
+        ks = jax.random.split(k, 4 + max_depth * (1 + len(combos)))
+        init = lambda key, shape, scale: (
+            jax.random.normal(key, shape, jnp.float32) * scale)
+        d = hidden
+        self.params = {
+            "embed": init(ks[0], (vocab, d), 0.05),
+            "layers": [],
+        }
+        ki = 4
+        for _ in range(max_depth):
+            layer = {
+                # shared single-head attention per layer
+                "wq": init(ks[ki], (d, d), d ** -0.5),
+                "wk": init(ks[ki], (d, d), d ** -0.5),
+                "wv": init(ks[ki], (d, d), d ** -0.5),
+                "branches": [],
+            }
+            ki += 1
+            for (m, act) in combos:
+                layer["branches"].append({
+                    "up": init(ks[ki], (d, m), d ** -0.5),
+                    "down": init(ks[ki], (m, d), m ** -0.5),
+                })
+                ki += 1
+            self.params["layers"].append(layer)
+        # Static per-branch activations live OUTSIDE the param pytree
+        # (optimizers only see arrays).
+        self.branch_acts = [act for (_, act) in combos]
+
+    def forward(self, params, alphas, tokens, *, hard_choice=None,
+                hard_depth=None):
+        """Mixture forward. ``alphas`` = {"mix": [C], "depth": [L]} logits.
+        ``hard_choice``/``hard_depth`` (ints) switch to one-hot subnet
+        evaluation against the same shared weights (the ENAS path)."""
+        jnp = self.jnp
+        jax = self.jax
+        x = params["embed"][tokens]                       # [B,S,D]
+        s = x.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        if hard_choice is None:
+            mix = jax.nn.softmax(alphas["mix"])
+        else:
+            mix = jax.nn.one_hot(hard_choice, len(self.combos))
+        if hard_depth is None:
+            gates = jax.nn.sigmoid(alphas["depth"])
+        else:
+            gates = (jnp.arange(self.max_depth) < hard_depth).astype(
+                jnp.float32)
+        for li, layer in enumerate(params["layers"]):
+            g = gates[li]
+            q, k_, v = x @ layer["wq"], x @ layer["wk"], x @ layer["wv"]
+            scores = (q @ k_.swapaxes(-1, -2)) * (self.hidden ** -0.5)
+            scores = jnp.where(mask[None], scores, -1e30)
+            attn = jax.nn.softmax(scores, axis=-1) @ v
+            x = x + g * attn
+            out = 0.0
+            for ci, br in enumerate(layer["branches"]):
+                h = x @ br["up"]
+                h = (jax.nn.silu(h) if self.branch_acts[ci] == "silu"
+                     else jax.nn.gelu(h))
+                out = out + mix[ci] * (h @ br["down"])
+            x = x + g * out
+        logits = x @ params["embed"].T
+        return logits
+
+    def loss(self, params, alphas, tokens, **kw):
+        jnp = self.jnp
+        logits = self.forward(params, alphas, tokens[:, :-1], **kw)
+        targets = tokens[:, 1:]
+        logp = self.jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def batches(self, seed: int):
+        """Synthetic LM stream (matches train/data.py's task family: ngram-
+        ish structure a bigger MLP genuinely fits better)."""
+        rng = np.random.default_rng(seed)
+        while True:
+            base = rng.integers(0, self.vocab, (self.batch, self.seq + 1))
+            # inject learnable structure: t[i+1] depends on t[i]
+            for j in range(1, self.seq + 1):
+                dep = (base[:, j - 1] * 31 + 7) % self.vocab
+                flip = rng.random(self.batch) < 0.7
+                base[flip, j] = dep[flip]
+            yield base.astype(np.int32)
+
+
+def _search_darts(combos, depths, *, steps, seed, lr=3e-3, alpha_lr=0.05):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    net = _Supernet(combos, max(depths), seed=seed)
+    alphas = {"mix": jnp.zeros((len(combos),)),
+              "depth": jnp.full((max(depths),), 1.0)}
+    w_opt = optax.adam(lr)
+    a_opt = optax.adam(alpha_lr)
+    w_state = w_opt.init(net.params)
+    a_state = a_opt.init(alphas)
+    train = net.batches(seed)
+    val = net.batches(seed + 1)
+
+    w_grad = jax.jit(jax.value_and_grad(net.loss, argnums=0))
+    a_grad = jax.jit(jax.value_and_grad(net.loss, argnums=1))
+
+    params = net.params
+    for _ in range(steps):
+        _, gw = w_grad(params, alphas, jnp.asarray(next(train)))
+        up, w_state = w_opt.update(gw, w_state)
+        params = optax.apply_updates(params, up)
+        _, ga = a_grad(params, alphas, jnp.asarray(next(val)))
+        up, a_state = a_opt.update(ga, a_state)
+        alphas = optax.apply_updates(alphas, up)
+
+    mix = np.asarray(jax.nn.softmax(alphas["mix"]))
+    gates = np.asarray(jax.nn.sigmoid(alphas["depth"]))
+    depth_hat = int(np.clip((gates > 0.5).sum(), min(depths), max(depths)))
+    order = list(np.argsort(-mix))
+    # Ranked (choice, depth) proposals: best depth with each choice by mix
+    # weight, then neighboring depths.
+    proposals = []
+    for ci in order:
+        for dd in sorted(depths, key=lambda d: abs(d - depth_hat)):
+            proposals.append({"choice": int(ci), "depth": int(dd),
+                              "score": float(mix[ci])})
+    return proposals
+
+
+def _search_enas(combos, depths, *, rounds, seed, k_sample=4, lr=3e-3,
+                 ctrl_lr=0.15):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    net = _Supernet(combos, max(depths), seed=seed)
+    rng = np.random.default_rng(seed)
+    theta_mix = np.zeros(len(combos))
+    theta_depth = np.zeros(len(depths))
+    w_opt = optax.adam(lr)
+    w_state = w_opt.init(net.params)
+    train = net.batches(seed)
+    val = net.batches(seed + 1)
+    dummy_alphas = {"mix": jnp.zeros((len(combos),)),
+                    "depth": jnp.zeros((max(depths),))}
+
+    w_grad = jax.jit(jax.value_and_grad(net.loss, argnums=0),
+                     static_argnames=("hard_choice", "hard_depth"))
+    val_loss = jax.jit(net.loss, static_argnames=("hard_choice", "hard_depth"))
+
+    def softmax(z):
+        e = np.exp(z - z.max())
+        return e / e.sum()
+
+    params = net.params
+    baseline = None
+    for _ in range(rounds):
+        p_mix, p_depth = softmax(theta_mix), softmax(theta_depth)
+        samples = [(int(rng.choice(len(combos), p=p_mix)),
+                    int(rng.choice(len(depths), p=p_depth)))
+                   for _ in range(k_sample)]
+        # shared-weight training on the sampled subnets
+        for ci, di in samples:
+            _, gw = w_grad(params, dummy_alphas, jnp.asarray(next(train)),
+                           hard_choice=ci, hard_depth=depths[di])
+            up, w_state = w_opt.update(gw, w_state)
+            params = optax.apply_updates(params, up)
+        # REINFORCE on held-out loss of the shared-weight subnets
+        for ci, di in samples:
+            l = float(val_loss(params, dummy_alphas, jnp.asarray(next(val)),
+                               hard_choice=ci, hard_depth=depths[di]))
+            reward = -l
+            baseline = reward if baseline is None else (
+                0.9 * baseline + 0.1 * reward)
+            adv = reward - baseline
+            g_mix = -p_mix
+            g_mix[ci] += 1.0
+            g_depth = -p_depth
+            g_depth[di] += 1.0
+            theta_mix += ctrl_lr * adv * g_mix
+            theta_depth += ctrl_lr * adv * g_depth
+
+    # Final ranking: controller probabilities × shared-weight validation.
+    p_mix, p_depth = softmax(theta_mix), softmax(theta_depth)
+    scored = []
+    vb = jnp.asarray(next(val))
+    for ci in range(len(combos)):
+        for di in range(len(depths)):
+            l = float(val_loss(params, dummy_alphas, vb,
+                               hard_choice=ci, hard_depth=depths[di]))
+            scored.append({"choice": ci, "depth": depths[di],
+                           "score": float(p_mix[ci] * p_depth[di]) - l * 1e-3,
+                           "val_loss": l})
+    scored.sort(key=lambda s: s["val_loss"])
+    return scored
+
+
+class _NASSuggester(Suggester):
+    """Shared driving logic: search once, cache ranked proposals in state,
+    emit them (deduped) as trial assignments."""
+
+    search_kind = "darts"
+
+    def _run_search(self, combos, depths, state):
+        raise NotImplementedError
+
+    def suggest(self, n, history, state):
+        state = dict(state)
+        arch, other = _split_params(self.specs)
+        combos, depths = _choices(arch)
+        if "proposals" not in state:
+            state["proposals"] = self._run_search(combos, depths, state)
+            state["cursor"] = 0
+        out = []
+        rng_state = {"draws": state.get("draws", 0)}
+        cursor = state.get("cursor", 0)
+        proposals = state["proposals"]
+        while len(out) < n and cursor < len(proposals):
+            prop = proposals[cursor]
+            cursor += 1
+            m, act = combos[prop["choice"]]
+            assignment = {}
+            if "mlp_dim" in arch:
+                assignment["mlp_dim"] = m
+            if "hidden_act" in arch:
+                assignment["hidden_act"] = act
+            if "n_layers" in arch:
+                assignment["n_layers"] = prop["depth"]
+            rng = _rng(rng_state, self.seed)
+            for spec in other:
+                assignment[spec.name] = ss.sample([spec], rng)[spec.name]
+            out.append(assignment)
+        if len(out) < n:
+            # search space exhausted: fall back to random over everything
+            rng = _rng(rng_state, self.seed)
+            while len(out) < n:
+                out.append(ss.sample(self.specs, rng))
+        state["cursor"] = cursor
+        state["draws"] = rng_state["draws"]
+        return out, state
+
+
+class DARTS(_NASSuggester):
+    name = "darts"
+
+    def _run_search(self, combos, depths, state):
+        steps = int(self.settings.get("search_steps", 80))
+        return _search_darts(combos, depths, steps=steps, seed=self.seed)
+
+
+class ENAS(_NASSuggester):
+    name = "enas"
+
+    def _run_search(self, combos, depths, state):
+        rounds = int(self.settings.get("search_rounds", 12))
+        return _search_enas(combos, depths, rounds=rounds, seed=self.seed)
