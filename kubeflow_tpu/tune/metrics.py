@@ -81,6 +81,155 @@ def collect_stdout(log_path: str, metric_names: set[str]) -> Series:
     return series
 
 
+def _tfrecord_frames(path: str):
+    """TFRecord framing: u64 length, u32 length-crc, payload, u32 data-crc.
+    CRCs are skipped (katib's collector tolerates truncated tails the same
+    way — a live trial appends concurrently)."""
+    import struct
+
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            f.read(4)                       # length crc
+            payload = f.read(length)
+            if len(payload) < length:
+                return                      # truncated live tail
+            f.read(4)                       # data crc
+            yield payload
+
+
+def _pb_fields(buf: bytes):
+    """Minimal protobuf wire-format walk: yields (field_number, wire_type,
+    value) — varints and length-delimited payloads, fixed32/64 raw."""
+    import struct
+
+    i, n = 0, len(buf)
+    while i < n:
+        key = 0
+        shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            key |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        field, wire = key >> 3, key & 7
+        if wire == 0:                       # varint
+            val = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                val |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wire, val
+        elif wire == 1:                     # fixed64
+            yield field, wire, struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        elif wire == 2:                     # length-delimited
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wire, buf[i:i + ln]
+            i += ln
+        elif wire == 5:                     # fixed32
+            yield field, wire, struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        else:
+            return                          # groups: not emitted by TB
+
+
+def collect_tfevent(path_or_dir: str, metric_names: set[str]) -> Series:
+    """TensorBoard event-file scalars ((U) katib TensorFlowEvent collector,
+    pkg/metricscollector/v1beta1/tfevent-metricscollector). Zero-dependency:
+    TFRecord framing + a protobuf wire walk over Event{step=2, summary=5
+    {value=1{tag=1, simple_value=2}}} — covers tf.summary scalar files
+    without a tensorflow import."""
+    import glob
+    import os as _os
+
+    if _os.path.isdir(path_or_dir):
+        paths = sorted(glob.glob(
+            _os.path.join(path_or_dir, "**", "*tfevents*"), recursive=True))
+    else:
+        paths = [path_or_dir]
+    import struct
+
+    series: Series = {}
+    for path in paths:
+        try:
+            frames = list(_tfrecord_frames(path))
+        except OSError:
+            continue
+        for frame in frames:
+            try:
+                step = 0
+                values: list[tuple[str, float]] = []
+                for field, wire, val in _pb_fields(frame):
+                    if field == 2 and wire == 0:       # Event.step
+                        step = int(val)
+                    elif field == 5 and wire == 2:     # Event.summary
+                        for f2, w2, v2 in _pb_fields(val):
+                            if f2 != 1 or w2 != 2:     # Summary.value
+                                continue
+                            tag, simple = None, None
+                            for f3, w3, v3 in _pb_fields(v2):
+                                if f3 == 1 and w3 == 2:      # tag
+                                    tag = v3.decode("utf-8", "replace")
+                                elif f3 == 2 and w3 == 5:    # simple_value
+                                    simple = float(v3)
+                            if tag in metric_names and simple is not None:
+                                values.append((tag, simple))
+                for tag, v in values:
+                    _append(series, tag, step, v)
+            except (IndexError, struct.error):
+                # Corrupt / partially-flushed frame (CRCs aren't checked —
+                # live trials append concurrently): skip it, keep the rest.
+                continue
+    return series
+
+
+def collect_prometheus(url: str, metric_names: set[str],
+                       step: int = 0, timeout: float = 1.0) -> Series:
+    """Scrape a Prometheus text-format endpoint ((U) katib Prometheus
+    collector kind): one point per metric at the job's current step."""
+    import urllib.request
+
+    series: Series = {}
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            text = r.read().decode("utf-8", "replace")
+    except OSError:
+        return series
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) < 2:
+            continue
+        name = parts[0].split("{", 1)[0]
+        if name not in metric_names:
+            continue
+        try:
+            _append(series, name, step, float(parts[-1]))
+        except ValueError:
+            continue
+    return series
+
+
 def collect_push(job: JAXJob, metric_names: set[str]) -> Series:
     """Lift the job's own status metrics (one point at the current step)."""
     m = job.status.metrics
@@ -121,4 +270,20 @@ def collect(
             base, "logs",
             f"{job.metadata.namespace}.{job.metadata.name}-worker-0.log")
         return collect_stdout(log, metric_names)
+    if source == "tfevent":
+        # metrics_file points at an event file or a logdir (default: the
+        # worker's tensorboard dir).
+        if metrics_file:
+            path = (metrics_file if os.path.isabs(metrics_file)
+                    else os.path.join(job_dir, metrics_file))
+        else:
+            path = os.path.join(job_dir, "worker-0", "tensorboard")
+        return collect_tfevent(path, metric_names)
+    if source == "prometheus":
+        # metrics_file carries the scrape URL (katib's collector takes the
+        # pod's metrics port/path the same way).
+        if not metrics_file:
+            return {}
+        return collect_prometheus(metrics_file, metric_names,
+                                  step=job.status.metrics.step)
     raise ValueError(f"unknown metric source {source!r}")

@@ -260,3 +260,112 @@ class TestSubstitution:
     def test_no_placeholder_untouched(self):
         src = {"x": 1, "y": "plain"}
         assert substitute_parameters(src, {"lr": 1}, "t") == src
+
+
+# -- collector kinds: tfevent + prometheus ((U) katib metricscollector) -------
+
+def _write_tfevent(path, records):
+    """Minimal tf.summary scalar event writer (TFRecord + protobuf wire
+    format) — the inverse of metrics.collect_tfevent's reader."""
+    import struct
+
+    def varint(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def field(num, wire, payload):
+        return varint((num << 3) | wire) + payload
+
+    frames = b""
+    for step, tag, value in records:
+        tag_b = tag.encode()
+        val_msg = (field(1, 2, varint(len(tag_b)) + tag_b)
+                   + field(2, 5, struct.pack("<f", value)))
+        summary = field(1, 2, varint(len(val_msg)) + val_msg)
+        event = field(2, 0, varint(step)) + field(
+            5, 2, varint(len(summary)) + summary)
+        frames += (struct.pack("<Q", len(event)) + b"\x00" * 4 + event
+                   + b"\x00" * 4)
+    with open(path, "wb") as f:
+        f.write(frames)
+
+
+def test_tfevent_collector(tmp_path):
+    from kubeflow_tpu.tune.metrics import collect_tfevent
+
+    logdir = tmp_path / "tb"
+    logdir.mkdir()
+    _write_tfevent(str(logdir / "events.out.tfevents.123.host"), [
+        (0, "loss", 2.5), (0, "accuracy", 0.1),
+        (10, "loss", 1.5), (20, "loss", 1.1), (20, "ignored", 9.0),
+    ])
+    got = collect_tfevent(str(logdir), {"loss", "accuracy"})
+    assert got["loss"] == [(0, 2.5), (10, 1.5), (20, pytest.approx(1.1))]
+    assert got["accuracy"] == [(0, pytest.approx(0.1))]
+
+
+def test_tfevent_collector_tolerates_truncated_tail(tmp_path):
+    from kubeflow_tpu.tune.metrics import collect_tfevent
+
+    p = tmp_path / "events.out.tfevents.1.h"
+    _write_tfevent(str(p), [(0, "loss", 2.0), (5, "loss", 1.0)])
+    data = p.read_bytes()
+    p.write_bytes(data[:-7])   # live trial mid-append
+    got = collect_tfevent(str(p), {"loss"})
+    assert got["loss"][0] == (0, 2.0)
+
+
+def test_prometheus_collector(tmp_path):
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from kubeflow_tpu.tune.metrics import collect_prometheus
+
+    body = (b"# HELP loss training loss\n"
+            b"loss{replica=\"0\"} 0.75\n"
+            b"tokens_total 12345\n"
+            b"malformed_line\n")
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/metrics"
+        got = collect_prometheus(url, {"loss", "tokens_total"}, step=7)
+        assert got == {"loss": [(7, 0.75)], "tokens_total": [(7, 12345.0)]}
+        assert collect_prometheus("http://127.0.0.1:1/none", {"loss"}) == {}
+    finally:
+        srv.shutdown()
+
+
+def test_tfevent_collector_skips_corrupt_frame(tmp_path):
+    import struct
+
+    from kubeflow_tpu.tune.metrics import collect_tfevent
+
+    p = tmp_path / "events.out.tfevents.09.h"
+    _write_tfevent(str(p), [(0, "loss", 2.0)])
+    # Append a frame whose length is intact but whose payload is a
+    # truncated varint (worst-case partial flush).
+    bad = b"\xff\xff\xff"
+    with open(p, "ab") as f:
+        f.write(struct.pack("<Q", len(bad)) + b"\0" * 4 + bad + b"\0" * 4)
+    _write_tfevent(str(tmp_path / "events.out.tfevents.10.h"),
+                   [(5, "loss", 1.0)])
+    got = collect_tfevent(str(tmp_path), {"loss"})
+    assert got["loss"] == [(0, 2.0), (5, 1.0)]
