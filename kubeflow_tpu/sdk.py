@@ -128,24 +128,36 @@ class Client:
         parallelism: Optional[dict[str, int]] = None,
         optimizer: Optional[dict] = None,
         data: Optional[dict] = None,
+        dataset_uri: Optional[str] = None,
+        tokenizer_uri: Optional[str] = None,
+        train_tokenizer_vocab: Optional[int] = None,
         checkpoint: bool = True,
         namespace: str = "default",
         wait: bool = False,
         timeout: float = 3600.0,
     ) -> JAXJob:
         """High-level LLM training (TrainingClient.train analog — the
-        reference downloads HF weights into a PVC; here the model zoo and
-        checkpoint store are first-class)."""
+        reference downloads HF model+dataset into a PVC via its
+        storage-initializer initContainer; ``dataset_uri`` stages the
+        dataset into the job dir the same way, tokenizing through a staged
+        or freshly-trained BPE artifact)."""
+        config = {
+            "model": model,
+            "model_overrides": model_overrides or {},
+            "steps": steps,
+            "optimizer": optimizer or {},
+            "data": data or {},
+        }
+        if dataset_uri:
+            config["dataset_uri"] = dataset_uri
+        if tokenizer_uri:
+            config["tokenizer_uri"] = tokenizer_uri
+        if train_tokenizer_vocab:
+            config["train_tokenizer_vocab"] = train_tokenizer_vocab
         job = self.create_job(
             name,
             entrypoint="llm_pretrain",
-            config={
-                "model": model,
-                "model_overrides": model_overrides or {},
-                "steps": steps,
-                "optimizer": optimizer or {},
-                "data": data or {},
-            },
+            config=config,
             workers=workers, chips_per_worker=chips_per_worker,
             parallelism=parallelism, namespace=namespace,
             submit=False)   # finish the spec BEFORE the controller sees it

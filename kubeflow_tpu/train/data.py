@@ -16,12 +16,16 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
-    kind: str = "synthetic"        # synthetic | grain
+    kind: str = "synthetic"        # synthetic | grain | text
     vocab_size: int = 256
     seq_len: int = 128
     global_batch: int = 8
     seed: int = 0
-    path: Optional[str] = None     # grain: arrayrecord/parquet path
+    path: Optional[str] = None     # grain: token .npy; text: raw text file
+    # text kind: tokenizer name from the registry ("byte") or a staged BPE
+    # json path (serve/tokenizer.py BPETokenizer artifact).
+    tokenizer: str = "byte"
+    tokenizer_path: Optional[str] = None
 
 
 class SyntheticLM:
@@ -64,7 +68,108 @@ def make_data_source(cfg: DataConfig, shard: int = 0, num_shards: int = 1):
         return SyntheticLM(cfg, shard, num_shards)
     if cfg.kind == "grain":
         return _grain_source(cfg, shard, num_shards)
+    if cfg.kind == "text":
+        return TextLM(cfg, shard, num_shards)
     raise ValueError(f"unknown data kind {cfg.kind!r}")
+
+
+class TextLM:
+    """Raw text → tokenizer → packed sequences → grain pipeline → batches.
+
+    The real-data path the reference's ``train()`` stages via its
+    storage-initializer ((U) training-operator sdk train(): HF dataset
+    download + transformers tokenization; SURVEY.md §2.2#22). Here:
+
+    - the text file is tokenized ONCE (byte tokenizer or a staged BPE
+      artifact) and cached next to the source as ``<path>.<tag>.tokens.npy``
+      — the staging artifact the trainer mmaps;
+    - the token stream is packed into ``seq_len+1`` windows and served
+      through a ``grain.MapDataset`` epoch-shuffle: random access by global
+      step index means a restarted worker fast-forwards EXACTLY (the
+      data-iterator contract elastic restart needs) — no iterator state to
+      persist, the step number is the state.
+    """
+
+    def __init__(self, cfg: DataConfig, shard: int = 0, num_shards: int = 1):
+        if cfg.global_batch % num_shards:
+            raise ValueError(f"global_batch {cfg.global_batch} not divisible "
+                             f"by num_shards {num_shards}")
+        if not cfg.path:
+            raise ValueError("text data source needs DataConfig.path")
+        self.cfg = cfg
+        self.shard = shard
+        self.num_shards = num_shards
+        self.local_batch = cfg.global_batch // num_shards
+        self.tokens = self._tokenize_cached()
+        if int(self.tokens.max(initial=0)) >= cfg.vocab_size:
+            # Checked on EVERY load (a cached tokenization from a previous
+            # larger-vocab run must not silently feed out-of-range ids).
+            raise ValueError(
+                f"tokenized data has ids up to {int(self.tokens.max())} but "
+                f"the data config vocab is {cfg.vocab_size}")
+        s = cfg.seq_len + 1
+        if len(self.tokens) < s:
+            raise ValueError(
+                f"text at {cfg.path} tokenizes to {len(self.tokens)} tokens "
+                f"— need at least seq_len+1 = {s} for one window")
+        self.per_epoch = (len(self.tokens) - 1) // s or 1
+        import grain.python as grain
+
+        # window index -> packed [seq_len+1] slice; shuffle reshuffles every
+        # epoch (grain's index semantics), repeat makes any step addressable.
+        self._ds = (
+            grain.MapDataset.source(list(range(self.per_epoch)))
+            .shuffle(seed=cfg.seed)
+            .repeat()
+        )
+
+    def _tokenize_cached(self) -> np.ndarray:
+        import hashlib
+        import os
+
+        from kubeflow_tpu.serve.tokenizer import BPETokenizer, get_tokenizer
+
+        if self.cfg.tokenizer_path:
+            tok = BPETokenizer.load(self.cfg.tokenizer_path)
+            tag = "bpe-" + hashlib.sha256(
+                open(self.cfg.tokenizer_path, "rb").read()).hexdigest()[:8]
+        else:
+            tok = get_tokenizer(self.cfg.tokenizer)
+            tag = self.cfg.tokenizer
+        cache = f"{self.cfg.path}.{tag}.tokens.npy"
+        if os.path.exists(cache) and (os.path.getmtime(cache)
+                                      >= os.path.getmtime(self.cfg.path)):
+            return np.load(cache, mmap_mode="r")
+        with open(self.cfg.path, errors="replace") as f:
+            ids = tok.encode(f.read())
+        arr = np.asarray(ids, np.int32)
+        if arr.max(initial=0) >= self.cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {int(arr.max()) + 1} exceeds data config "
+                f"vocab {self.cfg.vocab_size}")
+        tmp = cache + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, arr)
+        os.replace(tmp, cache)   # atomic publish: racing workers see either
+        return np.load(cache, mmap_mode="r")
+
+    def batch_at(self, step: int) -> np.ndarray:
+        """[local_batch, seq_len+1] for this shard at global ``step`` —
+        pure function of (config, step, shard): the fast-forward contract."""
+        s = self.cfg.seq_len + 1
+        out = np.empty((self.local_batch, s), np.int32)
+        base = (step * self.cfg.global_batch
+                + self.shard * self.local_batch)
+        for j in range(self.local_batch):
+            w = self._ds[base + j]
+            out[j] = self.tokens[w * s:(w + 1) * s]
+        return out
+
+    def iterate(self, start_step: int = 0) -> Iterator[np.ndarray]:
+        step = start_step
+        while True:
+            yield self.batch_at(step)
+            step += 1
 
 
 def _grain_source(cfg: DataConfig, shard: int, num_shards: int):

@@ -86,6 +86,7 @@ def llm_pretrain(ctx: WorkerContext) -> int:
         process_id=ctx.env.process_id,
         num_processes=ctx.env.num_processes,
         metrics_path=metrics_path,
+        workdir=ctx.env.workdir,
     )
     trainer.run()
     return 0

@@ -1,0 +1,73 @@
+"""Train-side input staging — the storage-initializer analog for training
+jobs ((U) training-operator sdk `train()`: creates a PVC and an
+initContainer that downloads the HF model/dataset before the trainer
+starts; SURVEY.md §2.2#22).
+
+``stage_inputs`` resolves dataset/tokenizer URIs into the worker's job dir
+before the data pipeline constructs, and can TRAIN a BPE tokenizer from the
+staged dataset when asked (the hermetic counterpart of downloading a
+pretrained tokenizer). URI schemes: ``file://`` and bare paths (the
+platform's storage surface; serve/storage.py handles the serving side the
+same way)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+def _resolve(uri: str) -> str:
+    if uri.startswith("file://"):
+        return uri[len("file://"):]
+    if "://" in uri:
+        raise ValueError(f"unsupported staging scheme in {uri!r} "
+                         "(file:// or a bare path)")
+    return uri
+
+
+def stage_inputs(
+    workdir: str,
+    *,
+    dataset_uri: Optional[str] = None,
+    tokenizer_uri: Optional[str] = None,
+    train_tokenizer_vocab: Optional[int] = None,
+) -> dict:
+    """Copy inputs into ``<workdir>/staged`` and return their local paths:
+    {"dataset": path|None, "tokenizer": path|None}. Idempotent (restart
+    re-runs it; copies are skipped when sizes match)."""
+    staged = os.path.join(workdir, "staged")
+    os.makedirs(staged, exist_ok=True)
+    out: dict = {"dataset": None, "tokenizer": None}
+
+    if dataset_uri:
+        src = _resolve(dataset_uri)
+        dst = os.path.join(staged, os.path.basename(src))
+        if not (os.path.exists(dst)
+                and os.path.getsize(dst) == os.path.getsize(src)):
+            shutil.copy2(src, dst)
+        out["dataset"] = dst
+
+    if tokenizer_uri:
+        src = _resolve(tokenizer_uri)
+        dst = os.path.join(staged, os.path.basename(src))
+        if not (os.path.exists(dst)
+                and os.path.getsize(dst) == os.path.getsize(src)
+                and os.path.getmtime(dst) >= os.path.getmtime(src)):
+            shutil.copy2(src, dst)   # refresh when the artifact changed
+        out["tokenizer"] = dst
+    elif train_tokenizer_vocab and out["dataset"]:
+        from kubeflow_tpu.serve.tokenizer import BPETokenizer
+
+        dst = os.path.join(staged, "tokenizer.bpe.json")
+        if not (os.path.exists(dst)
+                and os.path.getmtime(dst) >= os.path.getmtime(out["dataset"])):
+            # (Re)train when missing or the dataset is newer than the
+            # trained artifact.
+            with open(out["dataset"], errors="replace") as f:
+                tok = BPETokenizer.train(f.read(), train_tokenizer_vocab)
+            tok.save(dst + ".tmp")
+            os.replace(dst + ".tmp", dst)
+        out["tokenizer"] = dst
+
+    return out

@@ -35,6 +35,12 @@ class TrainerConfig:
     data: dict = dataclasses.field(default_factory=dict)
     steps: int = 100
     log_every: int = 10
+    # Input staging (storage-initializer analog, train/staging.py): staged
+    # into the worker dir before the data pipeline constructs; a staged
+    # dataset flips the data kind to "text" automatically.
+    dataset_uri: Optional[str] = None
+    tokenizer_uri: Optional[str] = None
+    train_tokenizer_vocab: Optional[int] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 100
     max_checkpoints: int = 3
@@ -59,7 +65,8 @@ class TrainerConfig:
 class Trainer:
     def __init__(self, cfg: TrainerConfig, mesh, *,
                  process_id: int = 0, num_processes: int = 1,
-                 metrics_path: Optional[str] = None):
+                 metrics_path: Optional[str] = None,
+                 workdir: Optional[str] = None):
         self.cfg = cfg
         self.mesh = mesh
         self.process_id = process_id
@@ -70,10 +77,23 @@ class Trainer:
         self.model_cfg: DecoderConfig = preset(cfg.model, **cfg.model_overrides)
         opt_cfg = OptimizerConfig.from_dict(
             {"total_steps": cfg.steps, **cfg.optimizer})
+        data_overrides = dict(cfg.data)
+        if cfg.dataset_uri:
+            from kubeflow_tpu.train.staging import stage_inputs
+
+            staged = stage_inputs(
+                workdir or cfg.checkpoint_dir or ".",
+                dataset_uri=cfg.dataset_uri,
+                tokenizer_uri=cfg.tokenizer_uri,
+                train_tokenizer_vocab=cfg.train_tokenizer_vocab)
+            data_overrides.setdefault("kind", "text")
+            data_overrides["path"] = staged["dataset"]
+            if staged["tokenizer"]:
+                data_overrides["tokenizer_path"] = staged["tokenizer"]
         data_cfg = DataConfig(**{
             "vocab_size": self.model_cfg.vocab_size,
             "seq_len": self.model_cfg.max_seq_len,
-            **cfg.data,
+            **data_overrides,
         })
         if data_cfg.vocab_size > self.model_cfg.vocab_size:
             raise ValueError("data vocab exceeds model vocab")
