@@ -66,7 +66,8 @@ def run_bench():
                           global_batch=per_chip_batch * n)
     source = make_data_source(data_cfg)
     task = setup_train(
-        cfg, OptimizerConfig(total_steps=(warm_disp + disp) * k_dispatch),
+        cfg, OptimizerConfig(total_steps=(warm_disp + disp) * k_dispatch,
+                             mu_dtype="bfloat16" if on_tpu else None),
         mesh)
 
     def dispatch(i0, state):
