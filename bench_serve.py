@@ -16,7 +16,7 @@ import time
 
 
 def run_bench(requests: int, concurrency: int, prompt_len: int,
-              max_new: int) -> dict:
+              max_new: int, paged: bool = False) -> dict:
     import jax
     import numpy as np
 
@@ -39,6 +39,7 @@ def run_bench(requests: int, concurrency: int, prompt_len: int,
     engine = LLMEngine(cfg, BatchingSpec(
         max_batch_size=min(16, concurrency), max_seq_len=cfg.max_seq_len,
         prefill_buckets=[prompt_len],
+        paged=paged, page_size=128,
         weights_dtype="bfloat16" if on_tpu else None))
     engine.start()
 
@@ -85,7 +86,8 @@ def run_bench(requests: int, concurrency: int, prompt_len: int,
     p = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))]  # noqa: E731
     return {
         "metric": f"serve_req_per_sec[{model_tag},prompt{prompt_len},"
-                  f"gen{max_new},c{concurrency}]",
+                  f"gen{max_new},c{concurrency}"
+                  f"{',paged' if paged else ''}]",
         "value": round(len(results) / wall, 2),
         "unit": "req/s",
         "vs_baseline": 1.0,
@@ -105,6 +107,9 @@ if __name__ == "__main__":
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=512)
     ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV cache + prefix caching engine")
     args = ap.parse_args()
     print(json.dumps(run_bench(args.requests, args.concurrency,
-                               args.prompt_len, args.max_new)))
+                               args.prompt_len, args.max_new,
+                               paged=args.paged)))
