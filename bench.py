@@ -30,16 +30,70 @@ import time
 ROUND1_TOKS_PER_SEC_CHIP = 13673.23
 
 
-def run_bench():
+def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
+                       mu_dtype=None, learning_rate=None):
+    """The one train-throughput measurement loop every bench shares
+    (bench.py headline + scripts/bench_configs.py rows): K steps per
+    dispatch over an fsdp mesh, warm dispatches excluded, and a host fetch
+    of the loss per dispatch as the execution fence — on the axon
+    remote-TPU tunnel, block_until_ready returns before the chain actually
+    runs, so the round-trip is the only reliable fence. Returns
+    {tok_s_chip, step_ms, mfu, loss}."""
     import jax
     import numpy as np
 
-    from kubeflow_tpu.models.config import preset
     from kubeflow_tpu.runtime.mesh import build_mesh
     from kubeflow_tpu.runtime.topology import detect_local_cluster
     from kubeflow_tpu.train.data import DataConfig, make_data_source
     from kubeflow_tpu.train.optim import OptimizerConfig
     from kubeflow_tpu.train.step import setup_train
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = build_mesh({"fsdp": n}, devices)
+    data_cfg = DataConfig(vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
+                          global_batch=per_chip_batch * n)
+    source = make_data_source(data_cfg)
+    opt_kw = {}
+    if learning_rate is not None:
+        opt_kw["learning_rate"] = learning_rate
+    task = setup_train(
+        cfg, OptimizerConfig(total_steps=max((warm_disp + disp) * k_dispatch,
+                                             10_000),
+                             mu_dtype=mu_dtype, **opt_kw),
+        mesh)
+
+    def dispatch(i0, state):
+        batch = np.stack([source.batch_at(i0 + j) for j in range(k_dispatch)])
+        batch = jax.device_put(batch, task.multi_batch_sharding)
+        state, metrics = task.multi_step_fn(state, batch)
+        return state, float(metrics["loss"])   # host fetch = the fence
+
+    state = task.state
+    for i in range(warm_disp):
+        state, loss = dispatch(i * k_dispatch, state)
+    t0 = time.perf_counter()
+    for i in range(warm_disp, warm_disp + disp):
+        state, loss = dispatch(i * k_dispatch, state)
+    dt = time.perf_counter() - t0
+
+    steps = disp * k_dispatch
+    tps_chip = data_cfg.global_batch * data_cfg.seq_len * steps / dt / n
+    gen = detect_local_cluster().slices[0].gen
+    mfu = (cfg.flops_per_token() * tps_chip) / (gen.bf16_tflops * 1e12)
+    return {
+        "tok_s_chip": round(tps_chip, 2),
+        "step_ms": round(dt / steps * 1e3, 2),
+        "mfu": round(mfu, 4),
+        "loss": round(loss, 4),
+    }
+
+
+
+def run_bench():
+    import jax
+
+    from kubeflow_tpu.models.config import preset
 
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
@@ -61,52 +115,22 @@ def run_bench():
         model_tag = "tiny"
         per_chip_batch, k_dispatch, warm_disp, disp = 8, 4, 1, 3
 
-    mesh = build_mesh({"fsdp": n}, devices)
-    data_cfg = DataConfig(vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
-                          global_batch=per_chip_batch * n)
-    source = make_data_source(data_cfg)
-    task = setup_train(
-        cfg, OptimizerConfig(total_steps=(warm_disp + disp) * k_dispatch,
-                             mu_dtype="bfloat16" if on_tpu else None),
-        mesh)
-
-    def dispatch(i0, state):
-        batch = np.stack([source.batch_at(i0 + j) for j in range(k_dispatch)])
-        batch = jax.device_put(batch, task.multi_batch_sharding)
-        state, metrics = task.multi_step_fn(state, batch)
-        # Fetching the loss scalar forces execution of the whole chain: on
-        # the axon remote-TPU tunnel, block_until_ready returns before the
-        # chain actually runs, so a host round-trip is the only reliable
-        # fence.
-        return state, float(metrics["loss"])
-
-    state = task.state
-    for i in range(warm_disp):
-        state, loss = dispatch(i * k_dispatch, state)
-
-    t0 = time.perf_counter()
-    for i in range(warm_disp, warm_disp + disp):
-        state, loss = dispatch(i * k_dispatch, state)
-    dt = time.perf_counter() - t0
-
-    steps = disp * k_dispatch
-    tokens_per_step = data_cfg.global_batch * data_cfg.seq_len
-    tps_chip = tokens_per_step * steps / dt / n
-    gen = detect_local_cluster().slices[0].gen
-    mfu = (cfg.flops_per_token() * tps_chip) / (gen.bf16_tflops * 1e12)
+    out = measure_train_rate(
+        cfg, per_chip_batch, k_dispatch=k_dispatch, warm_disp=warm_disp,
+        disp=disp, mu_dtype="bfloat16" if on_tpu else None)
 
     return {
         "metric": f"jaxjob_train_tokens_per_sec_per_chip[{model_tag},"
-                  f"seq{data_cfg.seq_len},{'tpu' if on_tpu else 'cpu'}x{n}]",
-        "value": round(tps_chip, 2),
+                  f"seq{cfg.max_seq_len},{'tpu' if on_tpu else 'cpu'}x{n}]",
+        "value": out["tok_s_chip"],
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tps_chip / ROUND1_TOKS_PER_SEC_CHIP, 4)
+        "vs_baseline": round(out["tok_s_chip"] / ROUND1_TOKS_PER_SEC_CHIP, 4)
         if on_tpu else 1.0,
         "detail": {
-            "step_time_ms": round(dt / steps * 1e3, 2),
-            "mfu_vs_v5e_peak": round(mfu, 4) if on_tpu else None,
+            "step_time_ms": out["step_ms"],
+            "mfu_vs_v5e_peak": out["mfu"] if on_tpu else None,
             "steps_per_dispatch": k_dispatch,
-            "loss": round(loss, 4),
+            "loss": out["loss"],
             "params": cfg.num_params(),
         },
     }

@@ -103,18 +103,20 @@ def decoder_param_specs(cfg: DecoderConfig) -> Params:
 def _block_forward(block_params, x, positions, cfg: DecoderConfig,
                    kv_cache=None, attn_impl="xla", mesh=None,
                    rules=DEFAULT_RULES, prefill=False,
-                   expert_axis=None, seq_axis=None):
+                   expert_axis=None, seq_axis=None, tp_axis=None):
     h = L.rmsnorm(x, block_params["ln1"], cfg)
     attn_out, new_cache = L.attention_block(
         block_params["attn"], h, positions, cfg,
-        kv_cache=kv_cache, attn_impl=attn_impl, mesh=mesh, prefill=prefill)
+        kv_cache=kv_cache, attn_impl=attn_impl, mesh=mesh, prefill=prefill,
+        tp_axis=tp_axis)
     x = x + attn_out
     h = L.rmsnorm(x, block_params["ln2"], cfg)
     if cfg.is_moe:
         mlp_out, aux = L.moe_block(block_params["mlp"], h, cfg,
                                    expert_axis=expert_axis, seq_axis=seq_axis)
     else:
-        mlp_out, aux = L.mlp_block(block_params["mlp"], h, cfg), jnp.float32(0)
+        mlp_out, aux = (L.mlp_block(block_params["mlp"], h, cfg,
+                                    tp_axis=tp_axis), jnp.float32(0))
     x = x + mlp_out
     if mesh is not None:
         x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"), mesh, rules)
@@ -285,6 +287,9 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
     - **PP×SP (ring/Ulysses)**: the streamed activation is additionally
       sharded on the sequence dim over ``seq``; attention runs the
       collective form over that axis inside the stage.
+    - **PP×TP**: head/mlp dims keep their Megatron sharding over ``model``
+      inside the stage; layers.py runs the output-projection psums (the
+      manual form of the GSPMD split the non-pp path derives from rules).
     Positions are computed inside the stage from the seq-shard offset
     (contiguous training positions only — the decode/kv path never takes
     this branch), which keeps every streamed leaf inexact so the 1F1B
@@ -296,6 +301,17 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
     n_stages = axis_sizes["pipeline"]
     sp = attn_impl in ("ring", "ulysses") and axis_sizes.get("seq", 1) > 1
     ep = cfg.is_moe and axis_sizes.get("expert", 1) > 1
+    tp = axis_sizes.get("model", 1)
+    if tp > 1 and cfg.is_moe:
+        raise NotImplementedError(
+            "pipeline x TP x MoE is not composed (expert parallelism covers "
+            "the MoE mlp); use pipeline x EP for MoE models")
+    if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp
+                   or cfg.mlp_dim % tp):
+        raise ValueError(
+            f"model={tp} must divide n_heads={cfg.n_heads}, "
+            f"n_kv_heads={cfg.n_kv_heads} and mlp_dim={cfg.mlp_dim} "
+            "for pipeline x TP")
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"pipeline={n_stages} must divide n_layers={cfg.n_layers}")
@@ -309,10 +325,14 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
         lambda p: p.reshape(n_stages, per, *p.shape[1:]), layer_params)
 
     # Per-leaf partition specs: stage dim over pipeline; the expert dim keeps
-    # its sharding for local-EP compute; everything else replicated within
-    # the stage (TP inside a stage would need psums the stage doesn't do).
+    # its sharding for local-EP compute; head/mlp dims keep their Megatron
+    # sharding for in-stage TP (layers.py runs the matching psums).
+    tp_logical = {"heads", "kv_heads", "mlp"} if tp > 1 else set()
+
     def leaf_spec(spec):
-        rest = tuple("expert" if (ep and name == "expert") else None
+        rest = tuple("expert" if (ep and name == "expert")
+                     else "model" if name in tp_logical
+                     else None
                      for name in spec)
         return P("pipeline", None, *rest)
 
@@ -344,7 +364,8 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
             out, _, aux = _block_forward(
                 bp, h, pos, cfg, attn_impl=impl,
                 expert_axis="expert" if ep else None,
-                seq_axis="seq" if sp else None)
+                seq_axis="seq" if sp else None,
+                tp_axis="model" if tp > 1 else None)
             return out, aux
 
         h, auxs = jax.lax.scan(body, h, blocks)

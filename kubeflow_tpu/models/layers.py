@@ -89,8 +89,15 @@ def attention_block(
     attn_impl: str = "xla",
     mesh=None,
     prefill: bool = False,              # static: cache start is known to be 0
+    tp_axis: Optional[str] = None,      # inside shard_map: heads sharded here
 ):
-    """Returns (out [B,S,D], new_kv_cache|None)."""
+    """Returns (out [B,S,D], new_kv_cache|None).
+
+    ``tp_axis`` (Megatron-style TP inside shard_map — the pipeline×TP
+    composition): ``wq/wk/wv/wo`` hold this device's head shard, attention
+    runs over local heads (heads are independent), and the output
+    projection's partial sum psums over the axis — the manual form of the
+    split GSPMD derives from the sharding rules outside shard_map."""
     dt = cfg.activation_dtype
     q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(dt))
@@ -150,6 +157,8 @@ def attention_block(
     else:
         out = multi_head_attention(q, k, v, causal=True, impl=attn_impl)
     out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
     return checkpoint_name(out, "attn_out"), new_cache
 
 
@@ -175,11 +184,17 @@ def _act(x: jax.Array, name: str) -> jax.Array:
     raise ValueError(f"unknown activation {name!r}")
 
 
-def mlp_block(p: dict, x: jax.Array, cfg: DecoderConfig) -> jax.Array:
+def mlp_block(p: dict, x: jax.Array, cfg: DecoderConfig,
+              tp_axis: Optional[str] = None) -> jax.Array:
+    """``tp_axis``: gate/up hold this device's slice of the mlp dim and
+    down's partial products psum over the axis (Megatron MLP split, manual
+    form for inside shard_map)."""
     dt = cfg.activation_dtype
     gate = _act(jnp.einsum("bsd,dm->bsm", x, p["gate"].astype(dt)), cfg.hidden_act)
     up = jnp.einsum("bsd,dm->bsm", x, p["up"].astype(dt))
     out = jnp.einsum("bsm,md->bsd", gate * up, p["down"].astype(dt))
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
     return checkpoint_name(out, "mlp_out")
 
 

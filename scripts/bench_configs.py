@@ -32,44 +32,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _train_rate(cfg, per_chip_batch, *, k_dispatch=8, disp=3, warm=2,
                 mu="bfloat16", lr=None):
-    import jax
-    import numpy as np
+    """Thin wrapper over bench.measure_train_rate — ONE measurement
+    methodology for every training-throughput row (same dispatch loop,
+    fencing, and MFU accounting as the headline bench)."""
+    from bench import measure_train_rate
 
-    from kubeflow_tpu.runtime.mesh import build_mesh
-    from kubeflow_tpu.train.data import DataConfig, make_data_source
-    from kubeflow_tpu.train.optim import OptimizerConfig
-    from kubeflow_tpu.train.step import setup_train
-
-    devices = jax.devices()
-    n = len(devices)
-    mesh = build_mesh({"fsdp": n}, devices)
-    data_cfg = DataConfig(vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
-                          global_batch=per_chip_batch * n)
-    source = make_data_source(data_cfg)
-    opt_kw = {"learning_rate": lr} if lr else {}
-    task = setup_train(
-        cfg, OptimizerConfig(total_steps=10_000, mu_dtype=mu, **opt_kw), mesh)
-
-    def dispatch(i0, state):
-        b = np.stack([source.batch_at(i0 + j) for j in range(k_dispatch)])
-        b = jax.device_put(b, task.multi_batch_sharding)
-        state, m = task.multi_step_fn(state, b)
-        return state, float(m["loss"])
-
-    state = task.state
-    for w in range(warm):
-        state, loss = dispatch(w * k_dispatch, state)
-    t0 = time.perf_counter()
-    for d in range(disp):
-        state, loss = dispatch((warm + d) * k_dispatch, state)
-    dt = time.perf_counter() - t0
-    steps = disp * k_dispatch
-    tokens = data_cfg.global_batch * data_cfg.seq_len * steps
-    return {
-        "tok_s_chip": round(tokens / dt / n, 1),
-        "step_ms": round(dt / steps * 1e3, 2),
-        "loss": round(loss, 4),
-    }
+    return measure_train_rate(cfg, per_chip_batch, k_dispatch=k_dispatch,
+                              warm_disp=warm, disp=disp, mu_dtype=mu,
+                              learning_rate=lr)
 
 
 def bench_mixtral():

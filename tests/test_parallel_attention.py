@@ -453,3 +453,61 @@ class TestPipeline:
             self.stage_fn, p, x, mesh=self.mesh, num_microbatches=4))
         rel_close(sequential_apply(self.stage_fn, self.params, self.x),
                   jitted(self.params, self.x))
+
+
+class TestPipelineTensorParallel:
+    """PP×TP: Megatron head/mlp splits inside the pipeline stage (manual
+    psums in layers.py; 1F1B derives the gradient sync from the specs)."""
+
+    def _cfg(self, schedule="gpipe"):
+        from kubeflow_tpu.models.config import preset
+
+        return preset("tiny", n_layers=4, n_heads=4, n_kv_heads=2,
+                      max_seq_len=64, dtype="float32",
+                      pipeline_schedule=schedule)
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_pp_tp_matches_unstaged(self, schedule):
+        from kubeflow_tpu.models.decoder import (
+            decoder_loss, init_decoder_params)
+        from kubeflow_tpu.runtime.mesh import build_mesh
+
+        cfg = self._cfg(schedule)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, 256)
+        mesh = build_mesh({"pipeline": 2, "model": 2, "data": 2})
+
+        ref, g_ref = jax.value_and_grad(
+            lambda p: decoder_loss(p, tokens, cfg)[0])(params)
+        out, g_pp = jax.jit(jax.value_and_grad(
+            lambda p: decoder_loss(p, tokens, cfg, mesh=mesh)[0]))(params)
+        assert abs(float(ref) - float(out)) < 5e-4 * max(1.0, abs(float(ref)))
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
+            rel_close(a, b, rtol=2e-3)
+
+    def test_indivisible_heads_rejected(self):
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.models.decoder import (
+            decoder_loss, init_decoder_params)
+        from kubeflow_tpu.runtime.mesh import build_mesh
+
+        cfg = preset("tiny", n_layers=4, n_heads=4, n_kv_heads=1,
+                     max_seq_len=64)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, 256)
+        mesh = build_mesh({"pipeline": 2, "model": 2, "data": 2})
+        with pytest.raises(ValueError, match="divide"):
+            decoder_loss(params, tokens, cfg, mesh=mesh)
+
+    def test_pp_tp_moe_rejected(self):
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.models.decoder import (
+            decoder_loss, init_decoder_params)
+        from kubeflow_tpu.runtime.mesh import build_mesh
+
+        cfg = preset("tiny-moe", n_layers=4)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, 256)
+        mesh = build_mesh({"pipeline": 2, "model": 2, "data": 2})
+        with pytest.raises(NotImplementedError, match="TP x MoE"):
+            decoder_loss(params, tokens, cfg, mesh=mesh)
