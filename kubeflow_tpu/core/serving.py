@@ -53,6 +53,10 @@ class BatchingSpec(BaseModel):
     page_size: int = 128             # KV cache page (tokens)
     max_pages: Optional[int] = None  # default: slots × max_seq_len / page
     enable_prefix_caching: bool = True
+    # Paged decode attention: "gather" (materialize pages, XLA attention —
+    # 2× KV read), "pallas" (direct page reads via the paged-attention
+    # kernel), or "auto" (pallas on TPU, gather elsewhere).
+    paged_attn_impl: str = "auto"
     # Long prompts split into chunks with decode interleaving; this many may
     # chunk concurrently (no head-of-line blocking between long prompts).
     max_concurrent_prefills: int = 2

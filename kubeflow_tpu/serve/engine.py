@@ -479,14 +479,22 @@ class LLMEngine:
                 paged_chunk_prefill, paged_decode_multi,
             )
 
+            pattn = b.paged_attn_impl
+            if pattn == "auto":
+                pattn = "pallas" if on_tpu else "gather"
+            if pattn not in ("gather", "pallas"):
+                raise ValueError(
+                    f"unknown paged_attn_impl {b.paged_attn_impl!r}; "
+                    "one of auto|gather|pallas")
             self._paged_chunk = jax.jit(
                 lambda p, c, t, tr, st, cp: paged_chunk_prefill(
                     p, c, t, tr, st, cp, cfg),
                 donate_argnums=(1,))
             self._paged_decode_n = jax.jit(
-                lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m:
+                lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m,
+                _impl=pattn:
                 paged_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k,
-                                   cfg, n, sample_mode=m),
+                                   cfg, n, sample_mode=m, attn_impl=_impl),
                 static_argnums=(11, 12), donate_argnums=(1,))
         self._preempted: list[Request] = []
         self._backlog: list[Request] = []   # scheduler-side admission queue

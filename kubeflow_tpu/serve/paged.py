@@ -24,8 +24,12 @@ Device side, the paged variants mirror the contiguous ones (engine.py): the
 page table rides into the dispatch as a ``[B, max_pages_per_slot]`` int32
 array; reads gather pages back into the ``[B, S, KV, Dh]`` layout XLA
 already tiles well, writes scatter ``(page, offset)`` with out-of-bounds
-drops for dead rows. Exactness: same einsums over the same values — the
-paged engine is bit-compatible with the contiguous one (tests pin this).
+drops for dead rows. Exactness: with the "gather" attention impl the same
+einsums run over the same values, so the paged engine is bit-compatible
+with the contiguous one (tests pin this); the "pallas" impl
+(ops/paged_attention.py) is mathematically exact blockwise softmax with
+fp32 accumulation — numerically equal, not bitwise (its probabilities are
+never rounded to bf16 before the PV product).
 """
 
 from __future__ import annotations
@@ -180,9 +184,14 @@ def paged_gather(pool: jax.Array, table: jax.Array) -> jax.Array:
 
 
 def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,
-                        table, cfg: DecoderConfig):
+                        table, cfg: DecoderConfig, attn_impl: str = "gather"):
     """One transformer block for a [B,1] decode step against the page pool.
-    Mirrors engine._decode_block; only the KV residency differs."""
+    Mirrors engine._decode_block; only the KV residency differs.
+
+    ``attn_impl``: "gather" materializes the slot's pages into the
+    contiguous layout and runs the XLA decode attention (2× KV read);
+    "pallas" reads pages directly via the paged-attention kernel
+    (ops/paged_attention.py — one DMA per page)."""
     from kubeflow_tpu.serve.engine import _decode_attention
 
     dt = cfg.activation_dtype
@@ -203,9 +212,14 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,
     off = lengths % pg
     nk = pool_k.at[pidx, off].set(k[:, 0], mode="drop")
     nv = pool_v.at[pidx, off].set(v[:, 0], mode="drop")
-    ck = paged_gather(nk, table)
-    cv = paged_gather(nv, table)
-    attn = _decode_attention(q, ck, cv, lengths, cfg)
+    if attn_impl == "pallas":
+        from kubeflow_tpu.ops.paged_attention import paged_decode_attention
+
+        attn = paged_decode_attention(q, nk, nv, table, lengths)
+    else:
+        ck = paged_gather(nk, table)
+        cv = paged_gather(nv, table)
+        attn = _decode_attention(q, ck, cv, lengths, cfg)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
     h = L.rmsnorm(x, bp["ln2"], cfg)
     if cfg.is_moe:
@@ -217,7 +231,7 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,
 
 def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,
                        lengths: jax.Array, live: jax.Array,
-                       cfg: DecoderConfig):
+                       cfg: DecoderConfig, attn_impl: str = "gather"):
     """One [B,1] decode step over the page pool (≈ engine._decode_step)."""
     dt = cfg.activation_dtype
     x = params["embed"].astype(dt)[tokens[:, None]]
@@ -229,7 +243,8 @@ def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,
     def body(x, scan_in):
         bp, pk, pv = scan_in
         x, nk, nv = _paged_decode_block(bp, x, positions, lengths, live,
-                                        pk, pv, table, cfg)
+                                        pk, pv, table, cfg,
+                                        attn_impl=attn_impl)
         return x, (nk, nv)
 
     x, (nk, nv) = jax.lax.scan(body, x, (params["layers"],
@@ -248,7 +263,7 @@ def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,
                        top_k: jax.Array, top_p: jax.Array,
                        stop_tokens: jax.Array, budgets: jax.Array,
                        key: jax.Array, cfg: DecoderConfig, num_steps: int,
-                       sample_mode: str = "full"):
+                       sample_mode: str = "full", attn_impl: str = "gather"):
     """Up to ``num_steps`` decode+sample steps in ONE dispatch over the page
     pool (≈ engine._decode_multi; the host pre-allocates pages covering
     ``lengths + num_steps`` so mid-dispatch page-boundary crossings always
@@ -268,7 +283,7 @@ def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,
     def body(carry):
         i, cache, tokens, lengths, live, budgets, key, out = carry
         logits, cache = _paged_decode_step(params, cache, tokens, lengths,
-                                           live, cfg)
+                                           live, cfg, attn_impl=attn_impl)
         key, sub = jax.random.split(key)
         sampled = _sample_batch(logits, sub, temps, top_k, top_p,
                                 mode=sample_mode)
