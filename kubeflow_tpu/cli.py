@@ -167,6 +167,31 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_volumes(args) -> int:
+    """Volume browser (pvcviewer/volumes-web-app analog over the REST
+    surface): list volumes, list one volume's files, or print a file."""
+    from urllib.parse import quote
+
+    ns = quote(args.namespace, safe="")
+    if args.volume is None:
+        got = _req(args.server, "GET", f"/volumes/{ns}", user=args.user)
+        for v in got["volumes"]:
+            print(f"{v['name']:40} {v['used_bytes']:>12} bytes")
+        return 0
+    vol = quote(args.volume, safe="")
+    if args.path is None:
+        got = _req(args.server, "GET", f"/volumes/{ns}/{vol}",
+                   user=args.user)
+        for f in got["files"]:
+            print(f"{f['path']:50} {f['bytes']:>12} bytes")
+        return 0
+    out = _req(args.server, "GET",
+               f"/volumes/{ns}/{vol}/files/{quote(args.path)}",
+               user=args.user)
+    print(out, end="" if isinstance(out, str) else "\n")
+    return 0
+
+
 def cmd_exec(args) -> int:
     out = _req(args.server, "GET",
                f"/apis/Notebook/{args.namespace}/{args.name}")
@@ -289,6 +314,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("metrics", help="Prometheus metrics")
     common(sp)
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("volumes", help="browse per-workload storage")
+    sp.add_argument("volume", nargs="?")
+    sp.add_argument("path", nargs="?")
+    common(sp)
+    sp.set_defaults(fn=cmd_volumes)
 
     sp = sub.add_parser("exec", help="run a cell in a notebook session")
     sp.add_argument("name")

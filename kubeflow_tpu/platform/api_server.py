@@ -15,6 +15,8 @@ Routes:
 - ``DELETE /apis/{kind}/{ns}/{name}``
 - ``GET  /events?ref={Kind/ns/name}``
 - ``GET  /logs/{ns}/{job}/{replica_index}`` worker log tail
+- ``GET/POST/DELETE /volumes/...``          volume browser (pvcviewer +
+  volumes-web-app analog; see the volumes section below)
 
 Identity: requests may carry ``X-Kftpu-User``; profile-namespace writes are
 checked against the Profile's owner/contributors (the KFAM authz surface).
@@ -24,12 +26,16 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 import yaml
+
+_SEGMENT_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9_.-]*")
 
 from kubeflow_tpu.core.manifest import load_manifest
 from kubeflow_tpu.core.registry import known_kinds
@@ -145,9 +151,24 @@ class ApiServer:
             return h._send(200, {"items": [dataclasses.asdict(e) for e in evs]})
         if parts[:1] == ["logs"] and len(parts) == 4:
             return self._logs(h, parts[1], parts[2], parts[3])
+        if parts[:1] == ["volumes"]:
+            return self._volumes_get(h, [unquote(p) for p in parts[1:]])
         h._send(404, {"error": "no route"})
 
     def _post(self, h) -> None:
+        parts = [p for p in urlparse(h.path).path.split("/") if p]
+        if parts[:1] == ["volumes"] and len(parts) == 3:
+            # PVC-create analog: provision an empty volume directory.
+            ns, vol = unquote(parts[1]), unquote(parts[2])
+            if not self._safe_segment(ns):
+                return h._send(400, {"error": "bad namespace"})
+            if not self._authorized(h, ns):
+                return h._send(403, {"error": "forbidden"})
+            root = self._volume_root(ns, vol)
+            if root is None:
+                return h._send(400, {"error": "bad volume name"})
+            os.makedirs(root, exist_ok=True)
+            return h._send(200, {"volume": f"{ns}/{vol}"})
         if h.path != "/apis":
             return h._send(404, {"error": "no route"})
         length = int(h.headers.get("Content-Length", 0))
@@ -164,6 +185,8 @@ class ApiServer:
 
     def _delete(self, h) -> None:
         parts = [p for p in urlparse(h.path).path.split("/") if p]
+        if parts[:1] == ["volumes"]:
+            return self._volumes_delete(h, [unquote(p) for p in parts[1:]])
         if parts[:1] != ["apis"] or len(parts) != 4:
             return h._send(404, {"error": "no route"})
         cls = self._kind(parts[1])
@@ -177,9 +200,126 @@ class ApiServer:
             return h._send(404, {"error": "not found"})
         h._send(200, {"deleted": f"{parts[1]}/{parts[2]}/{parts[3]}"})
 
-    def _logs(self, h, namespace: str, job: str, index: str) -> None:
-        import os
+    # -- volumes (pvcviewer + volumes-web-app analog) --------------------------
+    #
+    # The platform's "volumes" are the per-workload directories under the
+    # base dir ((U) kubeflow pvcviewer-controller: filebrowser pod over a
+    # PVC; volumes-web-app: PVC CRUD — SURVEY.md §2.1#6/#10). Surface:
+    #   GET    /volumes/{ns}                    list volumes + usage
+    #   GET    /volumes/{ns}/{vol}              file listing (recursive)
+    #   GET    /volumes/{ns}/{vol}/files/<rel>  download raw bytes
+    #   POST   /volumes/{ns}/{vol}              provision (PVC create)
+    #   DELETE /volumes/{ns}/{vol}              delete the whole volume
+    #   DELETE /volumes/{ns}/{vol}/files/<rel>  delete one file
+    # All namespace-authorized via the KFAM-analog contributor check.
 
+    @staticmethod
+    def _safe_segment(name: str) -> bool:
+        """Namespace/volume names: no separators, no dot-names ('.'/'..'
+        would remap the path BEFORE the authz check — the namespace string
+        that passes authz must be the directory that is touched)."""
+        return bool(_SEGMENT_RE.fullmatch(name))
+
+    def _volume_root(self, namespace: str, name: str):
+        """Resolve a volume path, refusing traversal outside the base dir."""
+        if not (self._safe_segment(namespace) and self._safe_segment(name)):
+            return None
+        base = os.path.realpath(self.cp.config.base_dir)
+        root = os.path.realpath(os.path.join(base, namespace, name))
+        if not root.startswith(os.path.join(base, "")) or root == base:
+            return None
+        return root
+
+    def _volume_file(self, root: str, rel: str):
+        full = os.path.realpath(os.path.join(root, rel))
+        if full != root and not full.startswith(os.path.join(root, "")):
+            return None
+        return full
+
+    @staticmethod
+    def _stat_or_none(path: str):
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None   # deleted mid-walk (checkpoint rotation): skip
+        return st
+
+    def _volumes_get(self, h, parts: list) -> None:
+        if not parts:
+            return h._send(404, {"error": "no route"})
+        namespace = parts[0]
+        if not self._safe_segment(namespace):
+            return h._send(404, {"error": "bad namespace"})
+        if not self._authorized(h, namespace):
+            return h._send(403, {"error": "forbidden"})
+        ns_dir = os.path.join(self.cp.config.base_dir, namespace)
+        if len(parts) == 1:
+            vols = []
+            try:
+                names = sorted(os.listdir(ns_dir))
+            except OSError:
+                names = []
+            for name in names:
+                root = os.path.join(ns_dir, name)
+                if not os.path.isdir(root):
+                    continue
+                used = 0
+                for r, _, files in os.walk(root):
+                    for f in files:
+                        st = self._stat_or_none(os.path.join(r, f))
+                        used += st.st_size if st else 0
+                vols.append({"name": name, "used_bytes": used})
+            return h._send(200, {"namespace": namespace, "volumes": vols})
+        root = self._volume_root(namespace, parts[1])
+        if root is None or not os.path.isdir(root):
+            return h._send(404, {"error": "no such volume"})
+        if len(parts) == 2:
+            files = []
+            for r, _, names in os.walk(root):
+                for n in sorted(names):
+                    full = os.path.join(r, n)
+                    st = self._stat_or_none(full)
+                    if st is None:
+                        continue
+                    files.append({
+                        "path": os.path.relpath(full, root),
+                        "bytes": st.st_size,
+                        "mtime": st.st_mtime})
+            return h._send(200, {"volume": f"{namespace}/{parts[1]}",
+                                 "files": files})
+        if parts[2] == "files" and len(parts) > 3:
+            full = self._volume_file(root, "/".join(parts[3:]))
+            if full is None or not os.path.isfile(full):
+                return h._send(404, {"error": "no such file"})
+            with open(full, "rb") as f:
+                return h._send(200, f.read(), "application/octet-stream")
+        h._send(404, {"error": "no route"})
+
+    def _volumes_delete(self, h, parts: list) -> None:
+        import shutil
+
+        if len(parts) < 2:
+            return h._send(404, {"error": "no route"})
+        namespace = parts[0]
+        if not self._safe_segment(namespace):
+            return h._send(404, {"error": "bad namespace"})
+        if not self._authorized(h, namespace):
+            return h._send(403, {"error": "forbidden"})
+        root = self._volume_root(namespace, parts[1])
+        if root is None or not os.path.isdir(root):
+            return h._send(404, {"error": "no such volume"})
+        if len(parts) == 2:
+            shutil.rmtree(root)
+            return h._send(200, {"deleted": f"{namespace}/{parts[1]}"})
+        if parts[2] == "files" and len(parts) > 3:
+            full = self._volume_file(root, "/".join(parts[3:]))
+            if full is None or not os.path.isfile(full):
+                return h._send(404, {"error": "no such file"})
+            os.remove(full)
+            return h._send(200, {"deleted_file": "/".join(parts[3:])})
+        h._send(404, {"error": "no route"})
+
+    def _logs(self, h, namespace: str, job: str, index: str) -> None:
         log = os.path.join(self.cp.config.base_dir, "logs",
                            f"{namespace}.{job}-worker-{index}.log")
         try:

@@ -2,6 +2,7 @@
 CLI — the L6/L7 gateway analogs of SURVEY.md's layer map."""
 
 import json
+import os
 import urllib.request
 
 import pytest
@@ -161,3 +162,127 @@ class TestCliRun:
         out = capsys.readouterr().out
         assert rc == 0, out
         assert "Succeeded" in out
+
+
+class TestVolumes:
+    """Volume browser (pvcviewer + volumes-web-app analog, (U) kubeflow
+    components/pvcviewer-controller + crud-web-apps/volumes): list, browse,
+    download, delete over the platform's per-workload storage."""
+
+    def _seed(self, cp, tmp_path):
+        vol = tmp_path / "default" / "train-1"
+        (vol / "ckpt").mkdir(parents=True)
+        (vol / "metrics.jsonl").write_text('{"step":1,"loss":2.0}\n')
+        (vol / "ckpt" / "state.bin").write_bytes(b"\x00" * 64)
+        return vol
+
+    def test_list_browse_download(self, api, tmp_path):
+        cp, server = api
+        self._seed(cp, tmp_path)
+        code, got = call(server, "GET", "/volumes/default")
+        assert code == 200
+        (v,) = got["volumes"]
+        assert v["name"] == "train-1" and v["used_bytes"] > 0
+        code, got = call(server, "GET", "/volumes/default/train-1")
+        assert code == 200
+        paths = {f["path"] for f in got["files"]}
+        assert paths == {"metrics.jsonl", os.path.join("ckpt", "state.bin")}
+        code, raw = call(server, "GET",
+                         "/volumes/default/train-1/files/metrics.jsonl")
+        assert code == 200 and "loss" in raw
+
+    def test_create_and_delete(self, api, tmp_path):
+        cp, server = api
+        code, got = call(server, "POST", "/volumes/default/scratch", body=b"")
+        assert code == 200
+        assert (tmp_path / "default" / "scratch").is_dir()
+        vol = self._seed(cp, tmp_path)
+        code, got = call(server, "DELETE",
+                         "/volumes/default/train-1/files/metrics.jsonl")
+        assert code == 200
+        assert not (vol / "metrics.jsonl").exists()
+        code, got = call(server, "DELETE", "/volumes/default/train-1")
+        assert code == 200
+        assert not vol.exists()
+
+    def test_traversal_blocked(self, api, tmp_path):
+        cp, server = api
+        self._seed(cp, tmp_path)
+        (tmp_path / "secret.txt").write_text("s3cret")
+        for path in ("/volumes/default/train-1/files/../../secret.txt",
+                     "/volumes/default/../secret.txt"):
+            code, got = call(server, "GET", path)
+            assert code == 404, path
+        # and namespace ".." can't escape the base dir
+        code, got = call(server, "GET", "/volumes/../default/train-1")
+        assert code in (400, 404)
+
+    def test_namespace_authz(self, api, tmp_path):
+        from kubeflow_tpu.core.object import ObjectMeta
+        from kubeflow_tpu.core.workspace_specs import Profile, ProfileSpec
+
+        cp, server = api
+        self._seed(cp, tmp_path)
+        cp.submit(Profile(metadata=ObjectMeta(name="default"),
+                          spec=ProfileSpec(owner="alice")))
+        code, _ = call(server, "GET", "/volumes/default", user="mallory")
+        assert code == 403
+        code, _ = call(server, "GET", "/volumes/default", user="alice")
+        assert code == 200
+
+
+def test_cli_volumes(api, tmp_path, capsys):
+    from kubeflow_tpu import cli
+
+    cp, server = api
+    vol = tmp_path / "default" / "train-1"
+    vol.mkdir(parents=True)
+    (vol / "metrics.jsonl").write_text('{"step":1}\n')
+    for argv, want in (
+        (["volumes"], "train-1"),
+        (["volumes", "train-1"], "metrics.jsonl"),
+        (["volumes", "train-1", "metrics.jsonl"], '"step"'),
+    ):
+        rc = cli.main(argv + ["--server", server.url])
+        assert rc == 0
+        assert want in capsys.readouterr().out
+
+
+def test_volumes_dot_segments_and_encoded_names(api, tmp_path):
+    """Review regressions: '.'/'..' segments must not remap the path after
+    authz (DELETE /volumes/./default once rmtree'd a namespace the caller
+    couldn't touch by name), and percent-encoded file names round-trip."""
+    from kubeflow_tpu.core.object import ObjectMeta
+    from kubeflow_tpu.core.workspace_specs import Profile, ProfileSpec
+
+    cp, server = api
+    vol = tmp_path / "default" / "train-1"
+    vol.mkdir(parents=True)
+    (vol / "eval results.json").write_text('{"acc": 1}')
+    cp.submit(Profile(metadata=ObjectMeta(name="default"),
+                      spec=ProfileSpec(owner="alice")))
+
+    for path in ("/volumes/./default", "/volumes/.."):
+        code, _ = call(server, "GET", path, user="mallory")
+        assert code in (400, 404), path
+    code, _ = call(server, "DELETE", "/volumes/./default", user="mallory")
+    assert code in (400, 404)
+    code, _ = call(server, "DELETE", "/volumes/default/.", user="alice")
+    assert code in (400, 404)
+    assert vol.exists()
+
+    # Percent-encoded names download and delete.
+    code, raw = call(server, "GET",
+                     "/volumes/default/train-1/files/eval%20results.json",
+                     user="alice")
+    assert code == 200 and "acc" in raw
+    code, _ = call(server, "DELETE",
+                   "/volumes/default/train-1/files/eval%20results.json",
+                   user="alice")
+    assert code == 200
+    assert not (vol / "eval results.json").exists()
+    # And the CLI sends identity on volume routes (mallory refused).
+    from kubeflow_tpu import cli
+
+    with pytest.raises(SystemExit, match="403"):
+        cli.main(["volumes", "--server", server.url, "--user", "mallory"])
