@@ -31,7 +31,7 @@ ROUND1_TOKS_PER_SEC_CHIP = 13673.23
 
 
 def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
-                       mu_dtype=None, learning_rate=None):
+                       mu_dtype=None, learning_rate=None, attn_impl="xla"):
     """The one train-throughput measurement loop every bench shares
     (bench.py headline + scripts/bench_configs.py rows): K steps per
     dispatch over an fsdp mesh, warm dispatches excluded, and a host fetch
@@ -61,7 +61,7 @@ def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
         cfg, OptimizerConfig(total_steps=max((warm_disp + disp) * k_dispatch,
                                              10_000),
                              mu_dtype=mu_dtype, **opt_kw),
-        mesh)
+        mesh, attn_impl=attn_impl)
 
     def dispatch(i0, state):
         batch = np.stack([source.batch_at(i0 + j) for j in range(k_dispatch)])

@@ -153,6 +153,17 @@ class ApiServer:
             return self._logs(h, parts[1], parts[2], parts[3])
         if parts[:1] == ["volumes"]:
             return self._volumes_get(h, [unquote(p) for p in parts[1:]])
+        if url.path == "/notebooks/form/config":
+            # Spawner form config ((U) jupyter web app spawner_ui_config.yaml
+            # — where the reference literally names `nvidia.com/gpu`; here
+            # the accelerator is google.com/tpu chips).
+            return h._send(200, {
+                "images": ["jax-notebook"],
+                "accelerator": {"resource": "google.com/tpu",
+                                "counts": [1, 4, 8]},
+                "idle_cull_seconds": {"default": 3600, "options":
+                                      [600, 1800, 3600, 0]},
+            })
         h._send(404, {"error": "no route"})
 
     def _post(self, h) -> None:
@@ -169,6 +180,8 @@ class ApiServer:
                 return h._send(400, {"error": "bad volume name"})
             os.makedirs(root, exist_ok=True)
             return h._send(200, {"volume": f"{ns}/{vol}"})
+        if h.path == "/notebooks/form":
+            return self._notebook_form(h)
         if h.path != "/apis":
             return h._send(404, {"error": "no route"})
         length = int(h.headers.get("Content-Length", 0))
@@ -199,6 +212,45 @@ class ApiServer:
         except NotFoundError:
             return h._send(404, {"error": "not found"})
         h._send(200, {"deleted": f"{parts[1]}/{parts[2]}/{parts[3]}"})
+
+    def _notebook_form(self, h) -> None:
+        """Spawner form backend ((U) jupyter-web-app
+        backend/apps/default/routes/post.py::post_notebook): a flat form
+        document becomes a Notebook CR — the form is sugar, the CR is the
+        API."""
+        from kubeflow_tpu.core.jobs import TPUResourceSpec
+        from kubeflow_tpu.core.object import ObjectMeta
+        from kubeflow_tpu.core.workspace_specs import Notebook, NotebookSpec
+
+        length = int(h.headers.get("Content-Length", 0))
+        try:
+            form = json.loads(h.rfile.read(length).decode() or "{}")
+            name = form["name"]
+        except (ValueError, KeyError) as exc:
+            return h._send(400, {"error": f"bad form: {exc}"})
+        namespace = form.get("namespace", "default")
+        if not self._authorized(h, namespace):
+            return h._send(403, {"error": "forbidden"})
+        try:
+            nb = Notebook(
+                metadata=ObjectMeta(name=name, namespace=namespace),
+                spec=NotebookSpec(
+                    image=form.get("image", "jax-notebook"),
+                    resources=TPUResourceSpec(
+                        tpu_chips=int(form.get("tpu_chips", 1)),
+                        memory_gb=form.get("memory_gb")),
+                    env={str(k): str(v)
+                         for k, v in (form.get("env") or {}).items()},
+                    volumes=list(form.get("volumes") or []),
+                    idle_cull_seconds=form.get("idle_cull_seconds", 3600.0),
+                    pod_default_labels={
+                        str(k): str(v) for k, v in
+                        (form.get("pod_default_labels") or {}).items()},
+                ))
+        except Exception as exc:  # noqa: BLE001 — bad form is a 400
+            return h._send(400, {"error": f"bad form: {exc}"})
+        applied = self.cp.apply(nb)
+        h._send(200, applied.to_manifest())
 
     # -- volumes (pvcviewer + volumes-web-app analog) --------------------------
     #

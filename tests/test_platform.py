@@ -286,3 +286,45 @@ def test_volumes_dot_segments_and_encoded_names(api, tmp_path):
 
     with pytest.raises(SystemExit, match="403"):
         cli.main(["volumes", "--server", server.url, "--user", "mallory"])
+
+
+class TestNotebookForm:
+    """Spawner form backend ((U) jupyter-web-app post_notebook): flat form
+    JSON -> Notebook CR through the gateway."""
+
+    def test_form_config(self, api):
+        cp, server = api
+        code, got = call(server, "GET", "/notebooks/form/config")
+        assert code == 200
+        assert got["accelerator"]["resource"] == "google.com/tpu"
+        assert "jax-notebook" in got["images"]
+
+    def test_spawn_from_form(self, api):
+        from kubeflow_tpu.core.workspace_specs import Notebook
+
+        cp, server = api
+        form = {"name": "nb1", "tpu_chips": 4,
+                "env": {"SEED": 7}, "idle_cull_seconds": 600,
+                "pod_default_labels": {"team": "ml"}}
+        code, got = call(server, "POST", "/notebooks/form",
+                         body=json.dumps(form).encode())
+        assert code == 200
+        nb = cp.store.get(Notebook, "nb1")
+        assert nb.spec.resources.tpu_chips == 4
+        assert nb.spec.env == {"SEED": "7"}
+        assert nb.spec.idle_cull_seconds == 600
+        assert nb.spec.pod_default_labels == {"team": "ml"}
+
+    def test_bad_form_and_authz(self, api):
+        from kubeflow_tpu.core.object import ObjectMeta
+        from kubeflow_tpu.core.workspace_specs import Profile, ProfileSpec
+
+        cp, server = api
+        code, _ = call(server, "POST", "/notebooks/form", body=b"{}")
+        assert code == 400                       # name required
+        cp.submit(Profile(metadata=ObjectMeta(name="default"),
+                          spec=ProfileSpec(owner="alice")))
+        code, _ = call(server, "POST", "/notebooks/form",
+                       body=json.dumps({"name": "nb2"}).encode(),
+                       user="mallory")
+        assert code == 403
