@@ -226,11 +226,16 @@ class ApiServer:
         try:
             form = json.loads(h.rfile.read(length).decode() or "{}")
             name = form["name"]
-        except (ValueError, KeyError) as exc:
+        except (ValueError, KeyError, TypeError) as exc:
             return h._send(400, {"error": f"bad form: {exc}"})
         namespace = form.get("namespace", "default")
         if not self._authorized(h, namespace):
             return h._send(403, {"error": "forbidden"})
+        # The form's 0 means "never cull" (the Kubeflow convention the
+        # config advertises); the spec encodes that as None.
+        cull = form.get("idle_cull_seconds", 3600.0)
+        if not cull:
+            cull = None
         try:
             nb = Notebook(
                 metadata=ObjectMeta(name=name, namespace=namespace),
@@ -242,7 +247,7 @@ class ApiServer:
                     env={str(k): str(v)
                          for k, v in (form.get("env") or {}).items()},
                     volumes=list(form.get("volumes") or []),
-                    idle_cull_seconds=form.get("idle_cull_seconds", 3600.0),
+                    idle_cull_seconds=cull,
                     pod_default_labels={
                         str(k): str(v) for k, v in
                         (form.get("pod_default_labels") or {}).items()},

@@ -328,3 +328,17 @@ class TestNotebookForm:
                        body=json.dumps({"name": "nb2"}).encode(),
                        user="mallory")
         assert code == 403
+
+
+def test_notebook_form_zero_cull_and_bad_body(api):
+    from kubeflow_tpu.core.workspace_specs import Notebook
+
+    cp, server = api
+    code, _ = call(server, "POST", "/notebooks/form",
+                   body=json.dumps({"name": "nb0",
+                                    "idle_cull_seconds": 0}).encode())
+    assert code == 200
+    assert cp.store.get(Notebook, "nb0").spec.idle_cull_seconds is None
+    for body in (b"[]", b'"x"', b"5"):
+        code, _ = call(server, "POST", "/notebooks/form", body=body)
+        assert code == 400, body
