@@ -152,9 +152,15 @@ class ISVCController:
         if canary_active:
             # The previous generation keeps serving at full strength; the
             # canary generation gets a traffic-proportional slice (>=1).
+            # Both groups are CONVERGED every pass — crashed previous-
+            # generation replicas are recreated and autoscaler resizes apply
+            # to both, so a long-lived canary never bleeds stable capacity
+            # while its group still claims 100-p percent of traffic.
             n_latest = min(max(1, round(desired * canary_p / 100)), desired)
+            n_prev = desired
         else:
             n_latest = desired
+            n_prev = 0
 
         # Converge the latest generation: create missing, trim extras.
         for i in range(n_latest):
@@ -163,6 +169,25 @@ class ISVCController:
         for (g, i) in sorted(by):
             if g == gen and i >= n_latest:
                 self._delete_worker(by.pop((g, i)))
+        pg = prev_gens[-1] if prev_gens else None
+        if canary_active:
+            # Converge the newest previous generation to its share. A
+            # recreated replica MUST run the previous generation's config —
+            # the isvc spec already holds the canary's — so it is cloned
+            # from a surviving same-generation sibling. canary_active implies
+            # a sibling exists: prev_gens is derived from live workers in
+            # ``by``. (If EVERY stable replica crashed at once, the crash
+            # loop above already deleted them, prev_gens is empty, and the
+            # rolling path promotes the canary to 100% — total loss of the
+            # stable set has nothing left to route the 100-p share to.)
+            sibling = next(w for (g, _), w in sorted(by.items()) if g == pg)
+            for i in range(n_prev):
+                if (pg, i) not in by:
+                    by[(pg, i)] = self._create_replica(
+                        isvc, i, pg, clone_from=sibling)
+            for (g, i) in sorted(by):
+                if g == pg and i >= n_prev:
+                    self._delete_worker(by.pop((g, i)))
 
         # Readiness probing, per generation.
         ready_by_gen: dict[int, list[str]] = {}
@@ -177,6 +202,15 @@ class ISVCController:
                 in_flight += got.get("in_flight", 0)
 
         latest_ready = ready_by_gen.get(gen, [])
+        if canary_active and ready_by_gen.get(pg):
+            # Retire generations older than the newest previous one only
+            # once that group is actually serving — mirroring the rolling
+            # path's no-outage handover (they still back the 100-p share
+            # until then via prev_urls below).
+            for (g, i) in sorted(by):
+                if g != gen and g != pg:
+                    self._delete_worker(by.pop((g, i)))
+                    ready_by_gen.pop(g, None)
         if not canary_active:
             # Rolling update: drop old generations once the new one is ready
             # (or immediately when scaling to zero — nothing to hand over to).
@@ -275,19 +309,31 @@ class ISVCController:
                                label_selector={LABEL_ISVC: name})
 
     def _create_replica(self, isvc: InferenceService, index: int,
-                        generation: int) -> Worker:
+                        generation: int,
+                        clone_from: Optional[Worker] = None) -> Worker:
         pred = isvc.spec.predictor
-        model = pred.model
         port = free_port()
-        config = {
-            "service": model.model_name or isvc.metadata.name,
-            "model": model.config or {"preset": "tiny"},
-            "storage_uri": model.storage_uri,
-            "batching": pred.batching.model_dump(),
-            "port": port,
-        }
-        if isvc.spec.transformer is not None:
-            config["transformer"] = isvc.spec.transformer.model_dump()
+        resources = pred.resources
+        if clone_from is not None:
+            # Previous-generation replacement: the isvc spec holds the NEW
+            # generation's model — take the stable config AND resources from
+            # a surviving sibling of the same generation (fresh port only);
+            # the stable model under the canary's resource request could
+            # OOM and crash-loop the 100-p traffic share.
+            config = dict(clone_from.spec.template.config)
+            config["port"] = port
+            resources = clone_from.spec.resources
+        else:
+            model = pred.model
+            config = {
+                "service": model.model_name or isvc.metadata.name,
+                "model": model.config or {"preset": "tiny"},
+                "storage_uri": model.storage_uri,
+                "batching": pred.batching.model_dump(),
+                "port": port,
+            }
+            if isvc.spec.transformer is not None:
+                config["transformer"] = isvc.spec.transformer.model_dump()
         w = Worker(
             metadata=ObjectMeta(
                 name=f"{isvc.metadata.name}-predictor-g{generation}-{index}",
@@ -302,7 +348,7 @@ class ISVCController:
                 replica_index=index,
                 num_workers=1,
                 template=WorkloadSpec(entrypoint="model_server", config=config),
-                resources=pred.resources,
+                resources=resources,
                 restart_policy=RestartPolicy.ON_FAILURE,
             ),
             status=WorkerStatus(),

@@ -126,6 +126,7 @@ class TextLM:
     def _tokenize_cached(self) -> np.ndarray:
         import hashlib
         import os
+        import uuid
 
         from kubeflow_tpu.serve.tokenizer import BPETokenizer, get_tokenizer
 
@@ -147,10 +148,22 @@ class TextLM:
             raise ValueError(
                 f"tokenizer vocab {int(arr.max()) + 1} exceeds data config "
                 f"vocab {self.cfg.vocab_size}")
-        tmp = cache + ".tmp"
-        with open(tmp, "wb") as f:
-            np.save(f, arr)
-        os.replace(tmp, cache)   # atomic publish: racing workers see either
+        # Unique per writer (pid alone collides across containers where
+        # every main process is PID 1): concurrent stagers must not
+        # interleave into one tmp file before the atomic replace. Unlinked
+        # on failure — unique names don't self-overwrite on retry, so a
+        # crash loop would otherwise accrete full-size orphans.
+        tmp = f"{cache}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.save(f, arr)
+            os.replace(tmp, cache)   # atomic publish: racing workers see either
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return np.load(cache, mmap_mode="r")
 
     def batch_at(self, step: int) -> np.ndarray:

@@ -43,9 +43,14 @@ def stage_inputs(
     if dataset_uri:
         src = _resolve(dataset_uri)
         dst = os.path.join(staged, os.path.basename(src))
+        # copy2 preserves the source mtime, so a staged copy is current
+        # exactly when sizes AND mtimes match — `>=` would treat a source
+        # re-materialized with an older preserved timestamp as already
+        # staged.
         if not (os.path.exists(dst)
-                and os.path.getsize(dst) == os.path.getsize(src)):
-            shutil.copy2(src, dst)
+                and os.path.getsize(dst) == os.path.getsize(src)
+                and os.path.getmtime(dst) == os.path.getmtime(src)):
+            shutil.copy2(src, dst)   # refresh when the dataset changed
         out["dataset"] = dst
 
     if tokenizer_uri:
@@ -53,7 +58,7 @@ def stage_inputs(
         dst = os.path.join(staged, os.path.basename(src))
         if not (os.path.exists(dst)
                 and os.path.getsize(dst) == os.path.getsize(src)
-                and os.path.getmtime(dst) >= os.path.getmtime(src)):
+                and os.path.getmtime(dst) == os.path.getmtime(src)):
             shutil.copy2(src, dst)   # refresh when the artifact changed
         out["tokenizer"] = dst
     elif train_tokenizer_vocab and out["dataset"]:

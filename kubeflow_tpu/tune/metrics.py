@@ -223,8 +223,16 @@ def collect_prometheus(url: str, metric_names: set[str],
         name = parts[0].split("{", 1)[0]
         if name not in metric_names:
             continue
+        # Value = first field after the name/labels section; the line may
+        # carry an optional trailing timestamp (`name value timestamp`).
+        # rsplit: label VALUES may contain a literal '}' (only \ " and
+        # newline are escaped in the exposition format).
+        rest = (line.rsplit("}", 1)[1] if "}" in line
+                else line.split(None, 1)[1]).split()
+        if not rest:
+            continue
         try:
-            _append(series, name, step, float(parts[-1]))
+            _append(series, name, step, float(rest[0]))
         except ValueError:
             continue
     return series

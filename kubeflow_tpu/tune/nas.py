@@ -90,7 +90,8 @@ class _Supernet:
         self.max_depth = max_depth
         self.hidden, self.vocab, self.seq, self.batch = hidden, vocab, seq, batch
         k = jax.random.PRNGKey(seed)
-        ks = jax.random.split(k, 4 + max_depth * (1 + len(combos)))
+        ks = jax.random.split(
+            k, 4 + max_depth * (3 + 2 * len(combos)))  # one key per tensor
         init = lambda key, shape, scale: (
             jax.random.normal(key, shape, jnp.float32) * scale)
         d = hidden
@@ -101,19 +102,21 @@ class _Supernet:
         ki = 4
         for _ in range(max_depth):
             layer = {
-                # shared single-head attention per layer
+                # shared single-head attention per layer; distinct key per
+                # tensor (identical wq==wk==wv inits collapse the attention
+                # logits to a gram matrix and weaken the search signal)
                 "wq": init(ks[ki], (d, d), d ** -0.5),
-                "wk": init(ks[ki], (d, d), d ** -0.5),
-                "wv": init(ks[ki], (d, d), d ** -0.5),
+                "wk": init(ks[ki + 1], (d, d), d ** -0.5),
+                "wv": init(ks[ki + 2], (d, d), d ** -0.5),
                 "branches": [],
             }
-            ki += 1
+            ki += 3
             for (m, act) in combos:
                 layer["branches"].append({
                     "up": init(ks[ki], (d, m), d ** -0.5),
-                    "down": init(ks[ki], (m, d), m ** -0.5),
+                    "down": init(ks[ki + 1], (m, d), m ** -0.5),
                 })
-                ki += 1
+                ki += 2
             self.params["layers"].append(layer)
         # Static per-branch activations live OUTSIDE the param pytree
         # (optimizers only see arrays).

@@ -260,6 +260,48 @@ def test_canary_split_and_promotion(cp):
     assert get_isvc(cp).status.traffic == {"latest": 100}
 
 
+def test_canary_converges_previous_generation(cp):
+    """A crashed previous-generation replica is RECREATED while the canary
+    is active — a long-lived canary must not bleed stable-gen capacity
+    (its group still claims 100-p percent of traffic)."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=2, max_replicas=2))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    isvc = get_isvc(cp)
+    gen1 = isvc.metadata.generation
+    isvc.spec.predictor.model.config = {"preset": "tiny-gemma"}
+    isvc.spec.predictor.canary_traffic_percent = 50
+    cp.store.update(isvc)
+    recon()
+    ws = replicas(cp)
+    assert len(ws) == 3   # 2 previous + 1 canary
+    mark_running(cp, ws)
+    recon()
+    # Crash one previous-generation replica.
+    from kubeflow_tpu.core.jobs import WorkerPhase
+    prev = [w for w in replicas(cp)
+            if int(w.metadata.labels[
+                "serving.tpu.kubeflow.dev/generation"]) == gen1]
+    assert len(prev) == 2
+    crashed = prev[0]
+    crashed.status.phase = WorkerPhase.FAILED
+    crashed.status.exit_code = 1
+    cp.store.update_status(crashed)
+    recon()   # deletes the crashed replica, recreates its index
+    recon()
+    prev_after = [w for w in replicas(cp)
+                  if int(w.metadata.labels[
+                      "serving.tpu.kubeflow.dev/generation"]) == gen1]
+    assert len(prev_after) == 2, "crashed prev-gen replica must be recreated"
+    assert len(replicas(cp)) == 3
+    # The replacement must run the STABLE generation's model (cloned from a
+    # surviving sibling), not the canary spec the isvc now holds.
+    for w in prev_after:
+        assert w.spec.template.config["model"] == {"preset": "tiny"}
+
+
 def test_canary_not_ready_keeps_previous_serving(cp):
     recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
     cp.submit(mkisvc())

@@ -327,7 +327,7 @@ def test_prometheus_collector(tmp_path):
     from kubeflow_tpu.tune.metrics import collect_prometheus
 
     body = (b"# HELP loss training loss\n"
-            b"loss{replica=\"0\"} 0.75\n"
+            b"loss{replica=\"0\"} 0.75 1700000000123\n"   # trailing timestamp
             b"tokens_total 12345\n"
             b"malformed_line\n")
 
