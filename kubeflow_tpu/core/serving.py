@@ -96,6 +96,22 @@ class PredictorSpec(BaseModel):
             0 <= self.canary_traffic_percent <= 100
         ):
             raise ValueError("canary_traffic_percent must be in [0,100]")
+        # Serving scale-out: replicas handle request parallelism; the mesh
+        # handles models bigger than one chip (tensor parallel). Other axes
+        # (pipeline/fsdp/...) have no serving dispatch path.
+        p = self.parallelism
+        if p.total > 1 and p.total != p.model:
+            raise ValueError(
+                "serving parallelism supports the model (tensor-parallel) "
+                f"axis only; got {p.axis_sizes()}")
+        # Mirror JAXJobSpec's invariant: an explicit chip request must match
+        # the mesh (a mismatch would crash-loop the worker at build_mesh
+        # instead of failing here, at spec time).
+        if p.total > 1 and self.resources.tpu_chips not in (1, p.total):
+            raise ValueError(
+                f"resources.tpu_chips={self.resources.tpu_chips} does not "
+                f"match parallelism product {p.total} (set it to "
+                f"{p.total}, or leave it 1 to derive it)")
         return self
 
 

@@ -19,6 +19,15 @@ Design, driven by XLA's compilation model rather than CUDA streams:
 - **Scheduler in plain Python** between device steps: admit → prefill →
   decode → emit. The hot loop holds no Python per-token state beyond the
   slot table; everything tensor-shaped lives on device.
+- **Tensor-parallel mesh mode** ((U) kserve huggingfaceserver → vLLM
+  ``tensor_parallel_size``; SURVEY.md §2.3#27): pass a ``mesh`` and the
+  engine shards weights by the same logical rules training uses
+  (parallel/sharding.py — Megatron head/mlp/vocab splits over ``model``)
+  and the KV cache on the kv-head dim. Dispatches stay the SAME jitted
+  functions — GSPMD partitions them and inserts the per-layer psums over
+  ICI. This is what serves models bigger than one chip's HBM (the 8B-on-
+  v5e-8 north star: 16 GB of bf16 params cannot fit one 16 GB chip).
+  The scheduler is unchanged: one engine = one process = N chips.
 """
 
 from __future__ import annotations
@@ -33,6 +42,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kubeflow_tpu.core.serving import BatchingSpec
 from kubeflow_tpu.models import layers as L
@@ -331,6 +341,13 @@ class _Chunking:
     stalls: int = 0       # consecutive page-starved attempts (paged mode)
 
 
+def _pin2(out, pin):
+    """Apply the cache-sharding pin to a dispatch's returned cache (always
+    the second tuple element) — keeps donated in/out layouts identical so
+    GSPMD never re-lays the KV cache between steps in mesh mode."""
+    return (out[0], pin(out[1])) + tuple(out[2:])
+
+
 # -- the engine ----------------------------------------------------------------
 
 class EngineMetrics:
@@ -381,10 +398,12 @@ class LLMEngine:
     """Slot-based continuous-batching engine over a decoder LLM."""
 
     def __init__(self, cfg: DecoderConfig, batching: Optional[BatchingSpec] = None,
-                 *, params: Optional[Params] = None, seed: int = 0):
+                 *, params: Optional[Params] = None, seed: int = 0,
+                 mesh: Optional[Mesh] = None):
         self.cfg = cfg
         self.batching = batching or BatchingSpec()
         b = self.batching
+        self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         if b.max_seq_len > cfg.max_seq_len:
             raise ValueError("batching.max_seq_len exceeds model max_seq_len")
         self.num_slots = b.max_batch_size
@@ -402,6 +421,24 @@ class LLMEngine:
             self.params = jax.tree.map(
                 lambda x: x.astype(wdt) if jnp.issubdtype(x.dtype, jnp.floating)
                 else x, self.params)
+        self._cache_sh: Optional[NamedSharding] = None
+        if self.mesh is not None:
+            from kubeflow_tpu.models.decoder import decoder_param_specs
+            from kubeflow_tpu.parallel.sharding import shard_params
+
+            # Weights: the exact logical rules training uses (heads/mlp/kv/
+            # vocab → `model`); non-divisible dims auto-replicate. KV cache:
+            # sharded on the kv-head dim — the same split wk/wv produce, so
+            # cache writes and decode attention are collective-free; only
+            # wo's output psum and the vocab-parallel logits ride ICI.
+            self.params = jax.device_put(
+                self.params,
+                shard_params(self.params, decoder_param_specs(cfg),
+                             self.mesh))
+            kv_ps = PartitionSpec(None, None, None, "model", None)
+            if cfg.n_kv_heads % self.mesh.shape.get("model", 1):
+                kv_ps = PartitionSpec()      # GQA heads don't divide: replicate
+            self._cache_sh = NamedSharding(self.mesh, kv_ps)
         self._rng = jax.random.PRNGKey(seed + 1)
 
         self.paged = bool(b.paged)
@@ -430,19 +467,21 @@ class LLMEngine:
             self._slot_pages: list[list[int]] = [
                 [] for _ in range(self.num_slots)]
             self.cache = {
-                "k": jnp.zeros((cfg.n_layers, self._num_pages, pg,
-                                cfg.n_kv_heads, cfg.head_dim),
-                               cfg.activation_dtype),
-                "v": jnp.zeros((cfg.n_layers, self._num_pages, pg,
-                                cfg.n_kv_heads, cfg.head_dim),
-                               cfg.activation_dtype),
+                "k": self._zeros((cfg.n_layers, self._num_pages, pg,
+                                  cfg.n_kv_heads, cfg.head_dim),
+                                 cfg.activation_dtype),
+                "v": self._zeros((cfg.n_layers, self._num_pages, pg,
+                                  cfg.n_kv_heads, cfg.head_dim),
+                                 cfg.activation_dtype),
             }
         else:
             self.cache = {
-                "k": jnp.zeros((cfg.n_layers, self.num_slots, self.max_len,
-                                cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
-                "v": jnp.zeros((cfg.n_layers, self.num_slots, self.max_len,
-                                cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
+                "k": self._zeros((cfg.n_layers, self.num_slots, self.max_len,
+                                  cfg.n_kv_heads, cfg.head_dim),
+                                 cfg.activation_dtype),
+                "v": self._zeros((cfg.n_layers, self.num_slots, self.max_len,
+                                  cfg.n_kv_heads, cfg.head_dim),
+                                 cfg.activation_dtype),
             }
 
         # Compiled programs: donate the cache so it mutates in place in HBM.
@@ -452,12 +491,16 @@ class LLMEngine:
             # Per-bucket impl choice (shape is static per trace): measured on
             # v5e, the flash kernel overtakes fused XLA attention in the full
             # model around S≈2k (XLA wins below — matmul-dominated regime).
+            # Mesh mode pins XLA: a pallas_call can't be GSPMD-partitioned
+            # over sharded operands (it would need an explicit shard_map).
             impl = b.prefill_attn_impl
             if impl == "auto":
                 # Flash kernel needs the bucket to divide its 128 block.
-                impl = ("pallas" if on_tpu and t.shape[1] >= 2048
+                impl = ("pallas" if on_tpu and self.mesh is None
+                        and t.shape[1] >= 2048
                         and t.shape[1] % 128 == 0 else "xla")
-            return _prefill_step(p, c, t, s, ln, cfg, impl)
+            out, cache = _prefill_step(p, c, t, s, ln, cfg, impl)
+            return out, self._pin(cache)
 
         self._prefill = jax.jit(_prefill_fn, donate_argnums=(1,))
         # Chunked prefill for prompts longer than the chunk size: one chunk
@@ -470,7 +513,8 @@ class LLMEngine:
                            or self.chunk_size % self.page_size):
             self.chunk_size = self.page_size
         self._prefill_chunk = jax.jit(
-            lambda p, c, t, s, st: _chunk_prefill_step(p, c, t, s, st, cfg),
+            lambda p, c, t, s, st: _pin2(
+                _chunk_prefill_step(p, c, t, s, st, cfg), self._pin),
             donate_argnums=(1,))
         self._chunkings: list[_Chunking] = []
         self.max_concurrent_prefills = max(1, int(b.max_concurrent_prefills))
@@ -481,20 +525,23 @@ class LLMEngine:
 
             pattn = b.paged_attn_impl
             if pattn == "auto":
-                pattn = "pallas" if on_tpu else "gather"
+                # Mesh mode: gather (pure XLA ops — GSPMD-partitionable);
+                # the direct-page-read kernel would need a shard_map.
+                pattn = "pallas" if on_tpu and self.mesh is None else "gather"
             if pattn not in ("gather", "pallas"):
                 raise ValueError(
                     f"unknown paged_attn_impl {b.paged_attn_impl!r}; "
                     "one of auto|gather|pallas")
             self._paged_chunk = jax.jit(
-                lambda p, c, t, tr, st, cp: paged_chunk_prefill(
-                    p, c, t, tr, st, cp, cfg),
+                lambda p, c, t, tr, st, cp: _pin2(paged_chunk_prefill(
+                    p, c, t, tr, st, cp, cfg), self._pin),
                 donate_argnums=(1,))
             self._paged_decode_n = jax.jit(
                 lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m,
                 _impl=pattn:
-                paged_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k,
-                                   cfg, n, sample_mode=m, attn_impl=_impl),
+                _pin2(paged_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd,
+                                         k, cfg, n, sample_mode=m,
+                                         attn_impl=_impl), self._pin),
                 static_argnums=(11, 12), donate_argnums=(1,))
         self._preempted: list[Request] = []
         self._backlog: list[Request] = []   # scheduler-side admission queue
@@ -507,8 +554,8 @@ class LLMEngine:
         self.decode_steps = max(1, int(b.decode_steps))
         self._decode_n = jax.jit(
             lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m:
-            _decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k, cfg, n,
-                          sample_mode=m),
+            _pin2(_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k, cfg,
+                                n, sample_mode=m), self._pin),
             static_argnums=(11, 12), donate_argnums=(1,))
 
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots
@@ -518,6 +565,24 @@ class LLMEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
+
+    # -- mesh-mode helpers -----------------------------------------------------
+
+    def _zeros(self, shape, dtype) -> jax.Array:
+        """KV-cache allocation. Mesh mode materializes each shard directly on
+        its device (a host-side full array would bound the servable model by
+        ONE chip's HBM — the exact limit mesh mode removes)."""
+        if self._cache_sh is None:
+            return jnp.zeros(shape, dtype)
+        return jax.jit(lambda: jnp.zeros(shape, dtype),
+                       out_shardings=self._cache_sh)()
+
+    def _pin(self, cache: dict) -> dict:
+        if self._cache_sh is None:
+            return cache
+        return {k: (jax.lax.with_sharding_constraint(v, self._cache_sh)
+                    if k in ("k", "v") else v)
+                for k, v in cache.items()}
 
     # -- submission ------------------------------------------------------------
 

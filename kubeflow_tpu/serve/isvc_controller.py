@@ -314,6 +314,16 @@ class ISVCController:
         pred = isvc.spec.predictor
         port = free_port()
         resources = pred.resources
+        parallelism: dict[str, int] = {}
+        if pred.parallelism.total > 1:
+            # Tensor-parallel predictor: ONE replica process spanning
+            # parallelism.total chips (the serving gang — the engine builds
+            # a mesh and GSPMD-shards weights/KV over it). The chip request
+            # must cover the mesh; the gang allocator places it like any
+            # other multi-chip worker.
+            parallelism = pred.parallelism.axis_sizes()
+            resources = resources.model_copy(
+                update={"tpu_chips": pred.parallelism.total})
         if clone_from is not None:
             # Previous-generation replacement: the isvc spec holds the NEW
             # generation's model — take the stable config AND resources from
@@ -323,6 +333,7 @@ class ISVCController:
             config = dict(clone_from.spec.template.config)
             config["port"] = port
             resources = clone_from.spec.resources
+            parallelism = dict(clone_from.spec.parallelism)
         else:
             model = pred.model
             config = {
@@ -349,6 +360,7 @@ class ISVCController:
                 num_workers=1,
                 template=WorkloadSpec(entrypoint="model_server", config=config),
                 resources=resources,
+                parallelism=parallelism,
                 restart_policy=RestartPolicy.ON_FAILURE,
             ),
             status=WorkerStatus(),

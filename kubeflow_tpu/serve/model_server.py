@@ -57,7 +57,10 @@ def model_server(ctx: WorkerContext) -> int:
                  **model_conf.get("overrides", {}))
     params = load_params(conf.get("storage_uri"), cfg)
     batching = BatchingSpec(**conf.get("batching", {}))
-    engine = LLMEngine(cfg, batching, params=params)
+    # ctx.mesh is non-None when the predictor requested tensor parallelism
+    # (PredictorSpec.parallelism → WorkerSpec.parallelism → bootstrap): the
+    # engine shards weights + KV over it — one replica process, N chips.
+    engine = LLMEngine(cfg, batching, params=params, mesh=ctx.mesh)
     transformer = None
     t_conf = conf.get("transformer")
     if t_conf:

@@ -26,6 +26,16 @@ def _resolve(uri: str) -> str:
     return uri
 
 
+def _same_mtime(dst: str, src: str) -> bool:
+    """Staged copy carries the source's mtime (copy2). Tolerance of 2s
+    covers filesystems that can't preserve timestamps exactly (FAT's 2s
+    granularity is the coarsest in practice) — strict equality would
+    re-copy the artifact on every start across such mounts, while `>=`
+    would treat a source re-materialized with an older preserved timestamp
+    as already staged."""
+    return abs(os.path.getmtime(dst) - os.path.getmtime(src)) < 2.0
+
+
 def stage_inputs(
     workdir: str,
     *,
@@ -43,13 +53,9 @@ def stage_inputs(
     if dataset_uri:
         src = _resolve(dataset_uri)
         dst = os.path.join(staged, os.path.basename(src))
-        # copy2 preserves the source mtime, so a staged copy is current
-        # exactly when sizes AND mtimes match — `>=` would treat a source
-        # re-materialized with an older preserved timestamp as already
-        # staged.
         if not (os.path.exists(dst)
                 and os.path.getsize(dst) == os.path.getsize(src)
-                and os.path.getmtime(dst) == os.path.getmtime(src)):
+                and _same_mtime(dst, src)):
             shutil.copy2(src, dst)   # refresh when the dataset changed
         out["dataset"] = dst
 
@@ -58,7 +64,7 @@ def stage_inputs(
         dst = os.path.join(staged, os.path.basename(src))
         if not (os.path.exists(dst)
                 and os.path.getsize(dst) == os.path.getsize(src)
-                and os.path.getmtime(dst) == os.path.getmtime(src)):
+                and _same_mtime(dst, src)):
             shutil.copy2(src, dst)   # refresh when the artifact changed
         out["tokenizer"] = dst
     elif train_tokenizer_vocab and out["dataset"]:

@@ -120,3 +120,31 @@ def test_scale_to_zero_cold_start_e2e(cp):
     assert out["usage"]["completion_tokens"] >= 1
     cur = cp.store.get(InferenceService, "szero")
     assert cur.status.ready_replicas >= 1
+
+
+@pytest.mark.slow
+def test_tensor_parallel_predictor_e2e(cp):
+    """A tensor-parallel InferenceService: ONE replica process spanning 2
+    (virtual) chips, engine GSPMD-sharded over the mesh ((U) kserve
+    huggingfaceserver vLLM tensor_parallel_size; SURVEY.md §2.3#27)."""
+    from kubeflow_tpu.core.jobs import ParallelismSpec
+
+    isvc = cp.submit(InferenceService(
+        metadata=ObjectMeta(name="tp"),
+        spec=InferenceServiceSpec(predictor=PredictorSpec(
+            model=ModelSpec(model_name="tp",
+                            config={"preset": "tiny",
+                                    "overrides": {"vocab_size": 512}}),
+            parallelism=ParallelismSpec(model=2),
+            batching=BatchingSpec(max_batch_size=2, max_seq_len=64,
+                                  prefill_buckets=[32])))))
+    ready = cp.wait_for(isvc, "Ready", timeout=240)
+    # The replica worker is a 2-chip gang member, not two replicas.
+    ws = cp.store.list(Worker, label_selector={
+        "serving.tpu.kubeflow.dev/service": "tp"})
+    assert len(ws) == 1
+    assert ws[0].spec.resources.tpu_chips == 2
+    assert ws[0].spec.parallelism.get("model") == 2
+    out = _post(ready.status.url + "/v1/completions",
+                {"prompt": "hi", "max_tokens": 4})
+    assert out["usage"]["completion_tokens"] >= 1
