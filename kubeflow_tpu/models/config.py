@@ -35,6 +35,14 @@ class DecoderConfig:
     # MoE (0 => dense)
     num_experts: int = 0
     experts_per_token: int = 2
+    # "dispatch": capacity-factor top-k routing — only selected experts
+    # compute (k/E of dense FLOPs; tokens over a full expert drop).
+    # "dense": every expert computes every token, one-hot combine — the
+    # FLOP-inefficient but drop-free oracle the dispatch path tests against.
+    moe_impl: str = "dispatch"
+    # Per-expert buffer size = capacity_factor * k * T / E (rounded up to a
+    # multiple of 8 for TPU tiling). 1.0 = perfectly balanced load fits.
+    capacity_factor: float = 1.25
     # compile-time policy
     scan_layers: bool = True
     remat_policy: str = "nothing_saveable"   # none | nothing_saveable | full

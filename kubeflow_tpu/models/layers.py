@@ -223,10 +223,131 @@ def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig,
               seq_axis: Optional[str] = None):
     """Top-k MoE (Mixtral semantics: softmax over the selected k logits).
 
-    Einsum-dense formulation: every expert computes every token and a one-hot
-    combine weights the results. FLOP-inefficient (E/k overcompute) but fully
-    static-shaped — under GSPMD the ``expert`` sharding of the weight specs
-    turns the expert einsums into expert-parallel partials XLA combines.
+    Dispatches on ``cfg.moe_impl``: "dispatch" (default) routes tokens into
+    per-expert capacity buffers so only selected experts compute — k/E of
+    the dense FLOPs; "dense" is the drop-free every-expert oracle the
+    dispatch path is equivalence-tested against. Returns (out, aux_loss)."""
+    if cfg.moe_impl == "dispatch":
+        return _moe_dispatch(p, x, cfg, expert_axis=expert_axis,
+                             seq_axis=seq_axis)
+    if cfg.moe_impl != "dense":
+        raise ValueError(f"unknown moe_impl {cfg.moe_impl!r}")
+    return _moe_dense(p, x, cfg, expert_axis=expert_axis, seq_axis=seq_axis)
+
+
+def _moe_aux_loss(router_logits, onehot_sum, cfg: DecoderConfig,
+                  seq_axis: Optional[str]):
+    """Switch-style load-balancing loss: E * sum(frac_tokens * frac_probs).
+    ``onehot_sum`` [B,S,E] = how many of the k choices hit each expert."""
+    probs = jax.nn.softmax(router_logits, axis=-1)                   # [B,S,E]
+    frac_tokens = jnp.mean(onehot_sum, axis=(0, 1))                  # [E]
+    frac_probs = jnp.mean(probs, axis=(0, 1))                        # [E]
+    if seq_axis is not None:
+        frac_tokens = jax.lax.pmean(frac_tokens, seq_axis)
+        frac_probs = jax.lax.pmean(frac_probs, seq_axis)
+    return cfg.num_experts * jnp.sum(frac_tokens * frac_probs)
+
+
+def moe_capacity(cfg: DecoderConfig, tokens: int) -> int:
+    """Static per-expert buffer size for a ``tokens``-token dispatch:
+    ceil(capacity_factor * k * T / E), rounded up to a multiple of 8
+    (TPU sublane tiling), capped at k*T (beyond that nothing can drop)."""
+    e, k = cfg.num_experts, cfg.experts_per_token
+    c = -(-int(cfg.capacity_factor * k * tokens) // e)
+    c = -(-max(c, 1) // 8) * 8
+    return min(c, k * tokens)
+
+
+def _moe_dispatch(p: dict, x: jax.Array, cfg: DecoderConfig,
+                  expert_axis: Optional[str] = None,
+                  seq_axis: Optional[str] = None):
+    """Capacity-factor top-k dispatch (SURVEY.md §2.6 EP row: the TPU-native
+    MoE data path; (U) training-operator-era Mixtral recipes route via NCCL
+    all-to-all — here the routing is scatter/gather into static [E, C]
+    buffers and GSPMD/psum provides the cross-device movement).
+
+    - Priority is choice-major: every token's FIRST choice claims capacity
+      before any token's second choice (a token never loses its primary
+      expert to a neighbor's secondary).
+    - A (token, choice) pair over capacity is DROPPED: its combine weight
+      contributes nothing (remaining choices are NOT renormalized — Switch/
+      Mixtral drop semantics); with capacity_factor >= E/... ample, the
+      output matches the dense oracle exactly.
+    - Static shapes throughout: C is a compile-time function of T, so one
+      trace serves all traffic; the scatter/gather are O(k·T·D) data
+      movement instead of the dense path's E/k compute overhead.
+    - Capacity is per DISPATCH BATCH: under pipeline microbatching each
+      microbatch competes for its own C slots, so drop patterns differ
+      from a full-batch run (the standard GPipe×MoE trade) — equivalence
+      across schedules holds exactly only when capacity is ample.
+
+    With ``expert_axis`` (inside shard_map): weights hold the local expert
+    slice; positions are computed on the replicated router output (identical
+    on every shard), each shard scatters/computes only rows routed to its
+    local experts, and the combined partial psums over the axis.
+    """
+    dt = cfg.activation_dtype
+    b, s, d = x.shape
+    e, k = cfg.num_experts, cfg.experts_per_token
+    t = b * s
+    xf = x.reshape(t, d)
+    router_logits = jnp.einsum(
+        "td,de->te", xf, p["router"].astype(dt)).astype(jnp.float32)
+    topk_logits, topk_idx = jax.lax.top_k(router_logits, k)          # [T,k]
+    topk_w = jax.nn.softmax(topk_logits, axis=-1)                    # [T,k]
+
+    c = moe_capacity(cfg, t)
+    # Choice-major flattening: row r = (choice r // T) of token (r % T).
+    flat_e = topk_idx.T.reshape(-1)                                  # [kT]
+    oh = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)                  # [kT,E]
+    pos = jnp.cumsum(oh, axis=0) - 1
+    pos_in_e = jnp.take_along_axis(pos, flat_e[:, None], 1)[:, 0]    # [kT]
+    keep = pos_in_e < c
+
+    e_local, offset = e, 0
+    if expert_axis is not None:
+        e_local = p["gate"].shape[0]
+        offset = jax.lax.axis_index(expert_axis) * e_local
+        keep = keep & (flat_e >= offset) & (flat_e < offset + e_local)
+    rows = jnp.where(keep, (flat_e - offset) * c + pos_in_e, e_local * c)
+    tok_of = jnp.tile(jnp.arange(t), k)                              # [kT]
+    # TPU lowers row-granular scatters poorly (measured 2.9× slower than
+    # dense!): invert the slot permutation with a SCALAR scatter (cheap),
+    # then fill the buffers with a row GATHER — empty slots read OOB and
+    # fill with zeros.
+    row_of_slot = jnp.full((e_local * c,), t, jnp.int32).at[rows].set(
+        tok_of, mode="drop")
+    buf = jnp.take(xf, row_of_slot, axis=0, mode="fill",
+                   fill_value=0).reshape(e_local, c, d)
+
+    gate = _act(jnp.einsum("ecd,edm->ecm", buf, p["gate"].astype(dt)),
+                cfg.hidden_act)
+    up = jnp.einsum("ecd,edm->ecm", buf, p["up"].astype(dt))
+    y = jnp.einsum("ecm,emd->ecd", gate * up,
+                   p["down"].astype(dt)).reshape(e_local * c, d)
+
+    back = jnp.take(y, rows, axis=0, mode="fill", fill_value=0)      # [kT,D]
+    w_flat = topk_w.T.reshape(-1, 1).astype(dt)
+    out = (back * w_flat).reshape(k, t, d).sum(0).reshape(b, s, d)
+    if expert_axis is not None:
+        out = jax.lax.psum(out, expert_axis)
+
+    aux = _moe_aux_loss(
+        router_logits.reshape(b, s, e),
+        oh.astype(jnp.float32).reshape(k, t, e).sum(0).reshape(b, s, e),
+        cfg, seq_axis)
+    return checkpoint_name(out, "mlp_out"), aux
+
+
+def _moe_dense(p: dict, x: jax.Array, cfg: DecoderConfig,
+               expert_axis: Optional[str] = None,
+               seq_axis: Optional[str] = None):
+    """Einsum-dense formulation: every expert computes every token and a
+    one-hot combine weights the results. FLOP-inefficient (E/k overcompute)
+    but fully static-shaped and drop-free — under GSPMD the ``expert``
+    sharding of the weight specs turns the expert einsums into
+    expert-parallel partials XLA combines; serves as the dispatch path's
+    correctness oracle.
 
     With ``expert_axis`` (inside shard_map — the pipeline×EP composition),
     ``p["gate"]/["up"]/["down"]`` hold this device's expert slice: the block
@@ -234,9 +355,7 @@ def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig,
     offset, and psums the combined output over the axis. The router is
     replicated, so top-k runs on full logits. ``seq_axis`` (sequence-sharded
     activations, PP×SP): the load-balancing fractions pmean over the axis so
-    the aux loss sees full-sequence statistics.
-
-    Returns (out, aux_loss)."""
+    the aux loss sees full-sequence statistics."""
     dt = cfg.activation_dtype
     e, k = cfg.num_experts, cfg.experts_per_token
     router_logits = jnp.einsum("bsd,de->bse", x, p["router"].astype(dt)).astype(jnp.float32)
@@ -257,14 +376,7 @@ def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig,
     if expert_axis is not None:
         out = jax.lax.psum(out, expert_axis)
 
-    # Load-balancing aux loss (Switch-style): E * sum(frac_tokens * frac_router_prob)
-    probs = jax.nn.softmax(router_logits, axis=-1)                   # [B,S,E]
-    frac_tokens = jnp.mean(onehot.sum(axis=2), axis=(0, 1))          # [E]
-    frac_probs = jnp.mean(probs, axis=(0, 1))                        # [E]
-    if seq_axis is not None:
-        frac_tokens = jax.lax.pmean(frac_tokens, seq_axis)
-        frac_probs = jax.lax.pmean(frac_probs, seq_axis)
-    aux = e * jnp.sum(frac_tokens * frac_probs)
+    aux = _moe_aux_loss(router_logits, onehot.sum(axis=2), cfg, seq_axis)
     return out, aux
 
 

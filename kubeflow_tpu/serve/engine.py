@@ -400,6 +400,15 @@ class LLMEngine:
     def __init__(self, cfg: DecoderConfig, batching: Optional[BatchingSpec] = None,
                  *, params: Optional[Params] = None, seed: int = 0,
                  mesh: Optional[Mesh] = None):
+        if cfg.is_moe and cfg.moe_impl == "dispatch":
+            # Serving must be drop-free AND batch-independent: a request's
+            # tokens must not change because co-batched traffic filled an
+            # expert's capacity buffer. The dense formulation guarantees
+            # both (drop-free capacity costs the same E/k FLOPs anyway; a
+            # dropless ragged grouped-GEMM is the future fast path).
+            import dataclasses as _dc
+
+            cfg = _dc.replace(cfg, moe_impl="dense")
         self.cfg = cfg
         self.batching = batching or BatchingSpec()
         b = self.batching
