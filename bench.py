@@ -102,11 +102,15 @@ def run_bench():
     if on_tpu:
         # Llama-3 architecture sized to fit one v5e chip's HBM with fp32
         # Adam state (~0.6B params): the per-chip unit of the 8B recipe.
+        # Round-3 winners (A/B'd on-chip, BASELINE.md): the tuned Pallas
+        # flash kernels beat XLA's fused S×S attention at this shape
+        # (486 -> 349 ms/step), which frees enough HBM that dots_no_batch
+        # remat and an UNchunked CE head win over block_outs + chunking.
         cfg = preset(
             "llama3-8b",
             n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
             mlp_dim=8192, vocab_size=32000, max_seq_len=2048,
-            remat_policy="block_outs",
+            remat_policy="dots_no_batch",
         )
         model_tag = "llama3-0.6b"
         per_chip_batch, k_dispatch, warm_disp, disp = 4, 16, 2, 3
@@ -117,7 +121,8 @@ def run_bench():
 
     out = measure_train_rate(
         cfg, per_chip_batch, k_dispatch=k_dispatch, warm_disp=warm_disp,
-        disp=disp, mu_dtype="bfloat16" if on_tpu else None)
+        disp=disp, mu_dtype="bfloat16" if on_tpu else None,
+        attn_impl="pallas" if on_tpu else "xla")
 
     return {
         "metric": f"jaxjob_train_tokens_per_sec_per_chip[{model_tag},"

@@ -406,9 +406,7 @@ class LLMEngine:
             # expert's capacity buffer. The dense formulation guarantees
             # both (drop-free capacity costs the same E/k FLOPs anyway; a
             # dropless ragged grouped-GEMM is the future fast path).
-            import dataclasses as _dc
-
-            cfg = _dc.replace(cfg, moe_impl="dense")
+            cfg = dataclasses.replace(cfg, moe_impl="dense")
         self.cfg = cfg
         self.batching = batching or BatchingSpec()
         b = self.batching

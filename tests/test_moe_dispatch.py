@@ -89,7 +89,7 @@ def test_drop_policy_over_capacity():
     # A fully-dropped token's MoE output is exactly zero.
     full = np.abs(out).sum(-1)
     assert (full[:c] > 0).all()          # first C kept their primary choice
-    assert (full[-1] == 0) or c * 2 >= 2 * t  # tail dropped when over cap
+    assert full[-1] == 0                 # tail token fully dropped
 
 
 def test_decoder_loss_trains_with_dispatch():

@@ -109,7 +109,7 @@ class TestFlashAttention:
 
     def test_bad_block_divisibility(self):
         q, k, v = qkv(S=100)
-        with pytest.raises(ValueError, match="divide"):
+        with pytest.raises(ValueError, match="multiple of block size"):
             flash_attention(q, k, v, block_q=64, block_kv=64)
 
 
