@@ -66,6 +66,11 @@ class BatchingSpec(BaseModel):
     # this many tokens emit per host round-trip (amortizes dispatch latency;
     # early-exits when all slots finish). 1 = one step per dispatch.
     decode_steps: int = 16
+    # Decode steps per dispatch WHILE a chunked prefill is in flight: the
+    # prefill's next chunk waits at most this many decode steps (TPOT-spike
+    # bound for running streams vs dispatch amortization; 1 = the old
+    # strict interleave, which costs concurrent paged traffic ~40% req/s).
+    prefill_interleave_steps: int = 4
     # Cast model weights once at engine load (e.g. "bfloat16" — halves the
     # per-step HBM param read, the decode bottleneck; standard for serving).
     # None keeps the checkpoint dtype.

@@ -154,6 +154,15 @@ def attention_block(
         fn = (ring_attention if attn_impl == "ring_local"
               else ulysses_attention)
         out = fn(q, k, v, causal=True)
+    elif attn_impl == "pallas" and mesh is not None and mesh.size > 1:
+        # Mosaic kernels can't be GSPMD-auto-partitioned: run the flash
+        # kernel per-shard via shard_map (block-diagonal over batch/heads);
+        # shapes that don't shard cleanly fall back to XLA attention.
+        from kubeflow_tpu.ops.flash_attention import flash_attention_sharded
+
+        out = flash_attention_sharded(q, k, v, mesh, causal=True)
+        if out is None:
+            out = multi_head_attention(q, k, v, causal=True, impl="xla")
     else:
         out = multi_head_attention(q, k, v, causal=True, impl=attn_impl)
     out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))

@@ -470,6 +470,52 @@ def _flash_vjp_bwd(causal, sm_scale, softcap, q_offset, block_q, block_kv,
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def flash_attention_sharded(
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh, *,
+    causal: bool = True, logits_softcap: Optional[float] = None,
+) -> Optional[jax.Array]:
+    """Flash attention under a multi-device GSPMD mesh.
+
+    Mosaic kernels cannot be auto-partitioned by GSPMD (XLA raises at
+    lowering — caught by the 8B AOT validation, scripts/aot_validate_8b.py),
+    so the kernel runs inside a shard_map over the batch (dcn/data/fsdp)
+    and head (model) axes. Attention is block-diagonal over batch AND heads
+    — every shard computes its slice independently, no collectives, and the
+    custom VJP differentiates per-shard exactly (no replicated operands, so
+    no psum-transpose corrections are needed). Sequence-sharded meshes
+    belong to ring/Ulysses attention, not here.
+
+    Returns None when the shape doesn't shard cleanly (caller falls back to
+    the XLA path): batch not divisible by the data degree, q/kv heads not
+    divisible by the model degree, or a seq-sharded mesh."""
+    import functools as _ft
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    shape = dict(mesh.shape)
+    batch_axes = tuple(a for a in ("dcn", "data", "fsdp")
+                       if shape.get(a, 1) > 1)
+    bdeg = 1
+    for a in batch_axes:
+        bdeg *= shape[a]
+    tp = shape.get("model", 1)
+    b, _, h, _ = q.shape
+    kh = k.shape[2]
+    if (shape.get("seq", 1) > 1 or b % bdeg
+            or (tp > 1 and (h % tp or kh % tp))):
+        return None
+    bspec = batch_axes if batch_axes else None
+    model_ax = "model" if tp > 1 else None
+    spec = P(bspec, None, model_ax, None)
+    fn = shard_map(
+        _ft.partial(flash_attention, causal=causal,
+                    logits_softcap=logits_softcap),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
 def flash_attention(
     q: jax.Array,                     # [B, Sq, H, D]
     k: jax.Array,                     # [B, Skv, K, D]

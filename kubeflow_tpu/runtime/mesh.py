@@ -60,6 +60,16 @@ def build_mesh(
                              axis_types=axis_types)
     except (TypeError, AttributeError):
         pass
+    except NotImplementedError:
+        # Topology-aware assignment needs each logical axis to be a product
+        # of physical torus axes (e.g. fsdp=8 over a 4x4x4 pod wants a
+        # split 4x2). Retry allowing physical-axis splits — still
+        # locality-aware, unlike a raw reshape.
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(
+            sizes, devices=list(devices), allow_split_physical_axes=True)
+        return Mesh(dev_array, MESH_AXES)
     try:
         # JAX without AxisType but with make_mesh: keep the topology-aware
         # device assignment (losing it silently reorders ICI neighbors).

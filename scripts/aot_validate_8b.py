@@ -1,0 +1,144 @@
+"""AOT-validate the flagship Llama-3-8B recipe without hardware (VERDICT
+round-2 next #5; SURVEY.md §6 "Llama-3-8B-class pretrain, v5p-64").
+
+Uses libtpu's topology-only AOT path (`jax.experimental.topologies`) to
+lower + compile — never execute — the REAL train step (fwd+bwd+Adam,
+Pallas flash attention, dots_no_batch remat) and the serving decode step
+on virtual v5p/v5e meshes, then reads the compiled executable's
+per-chip memory analysis against the chip HBM budget (v5p: 95 GB,
+v5e: 16 GB).
+
+Run: python scripts/aot_validate_8b.py   (one JSON line per config)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mesh_on(topology: str, axes: dict):
+    from jax.experimental import topologies
+
+    from kubeflow_tpu.runtime.mesh import build_mesh
+
+    topo = topologies.get_topology_desc(topology, "tpu")
+    return build_mesh(axes, topo.devices)
+
+
+def train_step_analysis(topology: str, axes: dict, *, per_chip_batch=1,
+                        pp_layers=None):
+    """Compile the 8B train step for `axes` on `topology`; return per-chip
+    memory totals in GB from the compiled executable."""
+    import jax
+
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.train.data import DataConfig
+    from kubeflow_tpu.train.optim import OptimizerConfig
+    from kubeflow_tpu.train.step import make_state_init, setup_train
+
+    mesh = _mesh_on(topology, axes)
+    over = {"remat_policy": "dots_no_batch"}
+    if pp_layers:
+        over["pipeline_schedule"] = "1f1b"
+    cfg = preset("llama3-8b", **over)
+    task = setup_train(cfg, OptimizerConfig(total_steps=10), mesh,
+                       attn_impl="pallas", init_state=False)
+    state_sds = jax.eval_shape(make_state_init(cfg, task.optimizer))
+    # Global batch: per_chip_batch per data shard; pipeline runs 2*pp
+    # microbatches through the stages.
+    batch_shards = 1
+    for a in ("dcn", "data", "fsdp"):
+        batch_shards *= axes.get(a, 1)
+    pp = axes.get("pipeline", 1)
+    global_batch = per_chip_batch * batch_shards * (2 * pp if pp > 1 else 1)
+    batch_sds = jax.ShapeDtypeStruct((global_batch, cfg.max_seq_len + 1),
+                                     jax.numpy.int32)
+    compiled = task.step_fn.lower(state_sds, batch_sds).compile()
+    m = compiled.memory_analysis()
+    gb = 1 << 30
+    return {
+        "params_b": round(cfg.num_params() / 1e9, 2),
+        "argument_gb": round(m.argument_size_in_bytes / gb, 2),
+        "output_gb": round(m.output_size_in_bytes / gb, 2),
+        "temp_gb": round(m.temp_size_in_bytes / gb, 2),
+        "total_gb": round((m.argument_size_in_bytes + m.temp_size_in_bytes)
+                          / gb, 2),
+        "global_batch": global_batch,
+    }
+
+
+def serve_decode_analysis(topology: str, tp: int, *, slots=16,
+                          max_len=2048):
+    """Compile the 8B serving decode step (K steps + sampling on device)
+    TP-sharded over `tp` chips; per-chip memory vs the v5e 16 GB budget."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.models.decoder import (
+        decoder_param_specs, init_decoder_params)
+    from kubeflow_tpu.parallel.sharding import shard_params
+    from kubeflow_tpu.serve.engine import _decode_multi
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _mesh_on(topology, {"model": tp})
+    cfg = preset("llama3-8b", dtype="bfloat16", param_dtype="bfloat16")
+    params_sds = jax.eval_shape(
+        lambda: init_decoder_params(jax.random.PRNGKey(0), cfg))
+    psh = shard_params(params_sds, decoder_param_specs(cfg), mesh)
+    params_sds = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        params_sds, psh)
+    kv_sh = NamedSharding(mesh, PartitionSpec(None, None, None, "model",
+                                              None))
+    cache_sds = {
+        n: jax.ShapeDtypeStruct(
+            (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim),
+            jnp.bfloat16, sharding=kv_sh) for n in ("k", "v")}
+    i32 = lambda: jax.ShapeDtypeStruct((slots,), jnp.int32)
+    f32 = lambda: jax.ShapeDtypeStruct((slots,), jnp.float32)
+    b1 = jax.ShapeDtypeStruct((slots,), jnp.bool_)
+    keys = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    fn = jax.jit(
+        lambda p, c, t, l, lv, tp_, tk, tpp, st, bd, k:
+        _decode_multi(p, c, t, l, lv, tp_, tk, tpp, st, bd, k, cfg, 16,
+                      sample_mode="full"),
+        donate_argnums=(1,))
+    compiled = fn.lower(params_sds, cache_sds, i32(), i32(), b1, f32(),
+                        i32(), f32(), i32(), i32(), keys).compile()
+    m = compiled.memory_analysis()
+    gb = 1 << 30
+    return {
+        "argument_gb": round(m.argument_size_in_bytes / gb, 2),
+        "temp_gb": round(m.temp_size_in_bytes / gb, 2),
+        "total_gb": round((m.argument_size_in_bytes + m.temp_size_in_bytes)
+                          / gb, 2),
+    }
+
+
+CONFIGS = [
+    ("train", "v5p:2x2x4", {"fsdp": 8, "model": 2}, {"per_chip_batch": 1}),
+    ("train", "v5p:4x4x4", {"fsdp": 16, "model": 4}, {"per_chip_batch": 1}),
+    ("train", "v5p:4x4x4", {"pipeline": 4, "fsdp": 8, "model": 2},
+     {"per_chip_batch": 1, "pp_layers": True}),
+]
+
+
+def main():
+    budget = {"v5p": 95.0, "v5e": 16.0}
+    for kind, topo, axes, kw in CONFIGS:
+        out = train_step_analysis(topo, axes, **kw)
+        out.update(kind=kind, topology=topo, axes=axes,
+                   budget_gb=budget["v5p"],
+                   fits=out["total_gb"] < budget["v5p"])
+        print(json.dumps(out), flush=True)
+    out = serve_decode_analysis("v5e:2x4x1", 8)
+    out.update(kind="serve_decode", topology="v5e-8", axes={"model": 8},
+               budget_gb=budget["v5e"], fits=out["total_gb"] < budget["v5e"])
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
