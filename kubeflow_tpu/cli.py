@@ -167,6 +167,24 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    """One aggregated view of the whole platform (centraldashboard analog):
+    per-namespace per-kind counts with condition rollups + recent events."""
+    data = _req(args.server, "GET", "/dashboard")
+    print(f"{'NAMESPACE':16} {'KIND':20} {'COUNT':>5}  STATES")
+    for ns, info in sorted(data["namespaces"].items()):
+        for kind, row in sorted(info["kinds"].items()):
+            states = ", ".join(f"{s}={n}" for s, n
+                               in sorted(row["by_state"].items()))
+            print(f"{ns:16} {kind:20} {row['total']:>5}  {states}")
+    if data["recent_events"] and args.tail > 0:
+        print("\nRECENT EVENTS")
+        for e in data["recent_events"][-args.tail:]:
+            print(f"{e['type']:8} {e['object_ref']:40} {e['reason']:20} "
+                  f"{e['message']}")
+    return 0
+
+
 def cmd_volumes(args) -> int:
     """Volume browser (pvcviewer/volumes-web-app analog over the REST
     surface): list volumes, list one volume's files, or print a file."""
@@ -314,6 +332,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("metrics", help="Prometheus metrics")
     common(sp)
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("dashboard",
+                        help="aggregated per-namespace platform view")
+    sp.add_argument("--tail", type=int, default=10,
+                    help="recent events to show")
+    common(sp)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("volumes", help="browse per-workload storage")
     sp.add_argument("volume", nargs="?")

@@ -22,6 +22,39 @@ from kubeflow_tpu.core.registry import register_kind
 from kubeflow_tpu.core.jobs import TPUResourceSpec
 
 
+#: Kernel-profile registry — the example-notebook-servers image family
+#: ((U) kubeflow/kubeflow components/example-notebook-servers: base →
+#: jupyter/codeserver variants with distinct preinstalled stacks;
+#: SURVEY.md §2.1#11). A "profile" replaces a container image: what gets
+#: preimported into the session, extra env, and the advertised package set
+#: the spawner form shows. The controller injects `preimports`/`env`;
+#: workspace/session_main.py executes them.
+KERNEL_PROFILES: dict[str, dict] = {
+    "base": {
+        "description": "plain Python kernel — fastest start, nothing "
+                       "preloaded (the base image analog)",
+        "preimports": [],
+        "env": {},
+        "packages": ["numpy"],
+    },
+    "jax-notebook": {
+        "description": "JAX-ready kernel: jax + numpy preimported, chips "
+                       "visible (the jupyter-tensorflow/pytorch analog)",
+        "preimports": ["jax", "numpy"],
+        "env": {},
+        "packages": ["jax", "numpy"],
+    },
+    "jax-full": {
+        "description": "full-stack kernel: jax/flax/optax + numpy "
+                       "preimported and the jax profiler server enabled "
+                       "(the codeserver/full-image analog)",
+        "preimports": ["jax", "numpy", "flax", "optax"],
+        "env": {"KFTPU_NB_PROFILER": "1"},
+        "packages": ["jax", "flax", "optax", "numpy"],
+    },
+}
+
+
 class NotebookSpec(BaseModel):
     model_config = ConfigDict(extra="forbid")
 

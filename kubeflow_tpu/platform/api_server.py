@@ -153,18 +153,105 @@ class ApiServer:
             return self._logs(h, parts[1], parts[2], parts[3])
         if parts[:1] == ["volumes"]:
             return self._volumes_get(h, [unquote(p) for p in parts[1:]])
+        if url.path == "/dashboard":
+            return self._dashboard(h, q)
         if url.path == "/notebooks/form/config":
             # Spawner form config ((U) jupyter web app spawner_ui_config.yaml
             # — where the reference literally names `nvidia.com/gpu`; here
-            # the accelerator is google.com/tpu chips).
+            # the accelerator is google.com/tpu chips). Images enumerate the
+            # kernel-profile registry (the example-notebook-servers family).
+            from kubeflow_tpu.core.workspace_specs import KERNEL_PROFILES
+
             return h._send(200, {
-                "images": ["jax-notebook"],
+                "images": sorted(KERNEL_PROFILES),
+                "image_profiles": {
+                    name: {"description": p["description"],
+                           "packages": p["packages"]}
+                    for name, p in KERNEL_PROFILES.items()},
+                "default_image": "jax-notebook",
                 "accelerator": {"resource": "google.com/tpu",
                                 "counts": [1, 4, 8]},
                 "idle_cull_seconds": {"default": 3600, "options":
                                       [600, 1800, 3600, 0]},
             })
         h._send(404, {"error": "no route"})
+
+    # -- dashboard (centraldashboard analog) -----------------------------------
+
+    def _dashboard_data(self) -> dict:
+        """One aggregation surface over every namespace: per-kind counts with
+        condition rollups, recent events, and links to the other surfaces
+        ((U) components/centraldashboard — SURVEY.md §2.1#7; UI stays a
+        non-goal, the *capability* is this JSON + the trivial HTML form)."""
+        namespaces: dict[str, dict] = {}
+        for kind in sorted(known_kinds()):
+            cls = self._kind(kind)
+            if cls is None:
+                continue
+            for obj in self.cp.store.list(cls):
+                ns = namespaces.setdefault(
+                    obj.metadata.namespace, {"kinds": {}})
+                row = ns["kinds"].setdefault(
+                    kind, {"total": 0, "by_state": {}})
+                row["total"] += 1
+                state = "—"
+                conds = getattr(obj.status, "conditions", None) or []
+                # Rollup = the most recently transitioned True condition
+                # (the reference surfaces the tail of the ordered list);
+                # all-False conditions (e.g. a Failed notebook's
+                # Running=False) fall through to the phase.
+                live = [c for c in conds if c.status]
+                if live:
+                    state = max(live,
+                                key=lambda c: c.last_transition_time).type
+                elif getattr(obj.status, "phase", None) is not None:
+                    state = str(getattr(obj.status.phase, "value",
+                                        obj.status.phase))
+                row["by_state"][state] = row["by_state"].get(state, 0) + 1
+        events = [dataclasses.asdict(e) for e in self.cp.recorder.all()[-20:]]
+        return {
+            "namespaces": namespaces,
+            "recent_events": events,
+            "links": {
+                "kinds": "/apis",
+                "objects": "/apis/{kind}?namespace={ns}",
+                "events": "/events?ref={Kind/ns/name}",
+                "logs": "/logs/{ns}/{job}/{replica_index}",
+                "volumes": "/volumes/{ns}",
+                "metrics": "/metrics",
+            },
+        }
+
+    def _dashboard(self, h, q) -> None:
+        import html as _html
+
+        data = self._dashboard_data()
+        if q.get("format", [None])[0] != "html":
+            return h._send(200, data)
+        esc = _html.escape   # every interpolated field is user-controlled
+        rows = []
+        for ns, info in sorted(data["namespaces"].items()):
+            for kind, row in sorted(info["kinds"].items()):
+                states = ", ".join(f"{esc(s)}: {n}" for s, n
+                                   in sorted(row["by_state"].items()))
+                rows.append(f"<tr><td>{esc(ns)}</td>"
+                            f"<td><a href='/apis/{esc(kind)}?namespace="
+                            f"{esc(ns)}'>{esc(kind)}</a></td>"
+                            f"<td>{row['total']}</td>"
+                            f"<td>{states}</td></tr>")
+        evs = "".join(
+            f"<li>{esc(e['type'])} {esc(e['object_ref'])} "
+            f"{esc(e['reason'])}: {esc(e['message'])}</li>"
+            for e in data["recent_events"][-10:])
+        html = ("<html><body><h1>kubeflow-tpu dashboard</h1>"
+                "<table border=1><tr><th>namespace</th><th>kind</th>"
+                "<th>count</th><th>states</th></tr>"
+                + "".join(rows) + "</table><h2>recent events</h2><ul>"
+                + evs + "</ul>"
+                "<p><a href='/metrics'>metrics</a> · "
+                "<a href='/apis'>kinds</a> · "
+                "<a href='/events'>events</a></p></body></html>")
+        h._send(200, html, "text/html")
 
     def _post(self, h) -> None:
         parts = [p for p in urlparse(h.path).path.split("/") if p]

@@ -64,6 +64,10 @@ def _ensure_builtin() -> None:
     except ImportError:
         pass
     try:
+        import kubeflow_tpu.train.adapters  # noqa: F401
+    except ImportError:
+        pass   # second-framework adapters are optional (torch may be absent)
+    try:
         import kubeflow_tpu.serve.model_server  # noqa: F401
     except ImportError:
         pass

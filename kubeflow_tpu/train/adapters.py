@@ -1,0 +1,92 @@
+"""Second-framework training adapters.
+
+The reference runs XGBoost/MXNet/Paddle through per-framework controllers
+whose only real job is injecting the cluster spec and watching exit codes
+((U) training-operator pkg/controller.v1/{xgboost,mxnet,paddlepaddle};
+SURVEY.md §2.2#19). Here a framework adapter is just a registered
+entrypoint: it reads the SAME WorkerEnv the operator injects for JAX jobs
+(coordinator address, world size, rank — the SetClusterSpec analog), does
+framework-native rendezvous, and reports through the same metrics.jsonl
+convention the controllers/Katib scrape. No per-framework controller
+exists because none is needed — the JAXJob controller is framework-neutral
+(gangs, restarts, exit-code policy all apply unchanged).
+
+``torch_train``: PyTorch (CPU) data-parallel training with gloo all-reduce
+— the live proof that a non-JAX framework runs as a first-class job.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeflow_tpu.runtime.entrypoints import WorkerContext, register_entrypoint
+
+
+@register_entrypoint("torch_train")
+def torch_train(ctx: WorkerContext) -> int:
+    """Distributed PyTorch regression on synthetic data.
+
+    Config: {"steps": int, "batch": int, "hidden": int, "in_dim": int,
+    "lr": float, "log_every": int}. Multi-worker jobs rendezvous with gloo
+    at the operator's coordinator address (port+1 — the JAX coordination
+    service owns the base port) and all-reduce gradients; the coordinator
+    writes metrics.jsonl and a final checkpoint.pt.
+    """
+    import torch
+    import torch.distributed as dist
+
+    cfg = ctx.config
+    steps = int(cfg.get("steps", 20))
+    batch = int(cfg.get("batch", 32))
+    hidden = int(cfg.get("hidden", 32))
+    in_dim = int(cfg.get("in_dim", 8))
+    lr = float(cfg.get("lr", 1e-2))
+    log_every = int(cfg.get("log_every", 1))
+
+    world = ctx.env.num_processes
+    rank = ctx.env.process_id
+    if world > 1:
+        host, port = ctx.env.coordinator_address.rsplit(":", 1)
+        dist.init_process_group(
+            "gloo", init_method=f"tcp://{host}:{int(port) + 1}",
+            world_size=world, rank=rank)
+
+    torch.manual_seed(0)                      # identical init on all ranks
+    model = torch.nn.Sequential(
+        torch.nn.Linear(in_dim, hidden), torch.nn.Tanh(),
+        torch.nn.Linear(hidden, 1))
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+    # Fixed teacher so the loss floor is 0 and descent is observable.
+    teacher = torch.nn.Linear(in_dim, 1)
+    for p in teacher.parameters():
+        p.requires_grad_(False)
+
+    from kubeflow_tpu.train.metrics import MetricsEmitter
+
+    emitter = MetricsEmitter(
+        jsonl_path=(os.path.join(ctx.env.workdir, "metrics.jsonl")
+                    if ctx.env.workdir and ctx.is_coordinator else None))
+    gen = torch.Generator().manual_seed(1234 + rank)   # per-rank data shard
+    try:
+        for step in range(steps):
+            x = torch.randn(batch, in_dim, generator=gen)
+            y = teacher(x).detach()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            opt.zero_grad()
+            loss.backward()
+            if world > 1:
+                for p in model.parameters():
+                    dist.all_reduce(p.grad)
+                    p.grad /= world
+            opt.step()
+            if ctx.is_coordinator and ((step + 1) % log_every == 0
+                                       or step + 1 == steps):
+                emitter.emit(step, {"loss": float(loss.detach())})
+        if ctx.is_coordinator and ctx.env.workdir:
+            torch.save(model.state_dict(),
+                       os.path.join(ctx.env.workdir, "checkpoint.pt"))
+    finally:
+        emitter.close()
+        if world > 1:
+            dist.destroy_process_group()
+    return 0

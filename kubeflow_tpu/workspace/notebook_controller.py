@@ -96,13 +96,33 @@ class NotebookController:
     # -- lifecycle -------------------------------------------------------------
 
     def _start(self, key: str, nb: Notebook) -> Optional[ReconcileResult]:
+        from kubeflow_tpu.core.workspace_specs import KERNEL_PROFILES
+
         namespace, name = nb.metadata.namespace, nb.metadata.name
+        profile = KERNEL_PROFILES.get(nb.spec.image)
+        if profile is None:
+            # Unknown image = unpullable container: Failed with an event,
+            # not a crash loop. Terminal — write status ONCE (the update
+            # itself emits a watch event; an unconditional write here would
+            # re-enqueue and spin forever).
+            if not nb.status.has_condition("Running", status=False) or \
+                    nb.status.get_condition("Running").reason != "UnknownImage":
+                nb.status.phase = "Failed"
+                nb.status.set_condition("Running", False,
+                                        reason="UnknownImage")
+                self.recorder.warning(
+                    nb, "UnknownImage",
+                    f"kernel profile {nb.spec.image!r} not in "
+                    f"{sorted(KERNEL_PROFILES)}")
+                self._update_status(nb)
+            return None
         d = self._dir(namespace, name)
         os.makedirs(d, exist_ok=True)
         defaults = self.store.list(PodDefault, namespace=namespace)
         env = apply_pod_defaults(
             {**nb.metadata.labels, **nb.spec.pod_default_labels},
-            dict(nb.spec.env), defaults)
+            {**profile["env"], **nb.spec.env}, defaults)
+        env["KFTPU_NB_PREIMPORTS"] = ",".join(profile["preimports"])
 
         sock = self.socket_path(namespace, name)
         activity = self.activity_path(namespace, name)

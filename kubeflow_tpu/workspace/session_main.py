@@ -82,13 +82,36 @@ def main() -> int:
     srv = _Server(sock_path, _Handler)
     srv.activity_file = activity
     srv.user_globals = {"__name__": "__kftpu_notebook__"}
-    # Pre-import jax so the first cell is fast (the JAX-ready image analog).
-    if os.environ.get("KFTPU_NB_PREIMPORT", "1") == "1":
-        try:
-            import jax  # noqa: F401
+    # Kernel-profile preimports (the image family's preinstalled stack —
+    # core/workspace_specs.py::KERNEL_PROFILES): the controller passes the
+    # profile's module list; the legacy KFTPU_NB_PREIMPORT=1 flag keeps
+    # meaning "jax" for sessions launched without a controller.
+    pre = os.environ.get("KFTPU_NB_PREIMPORTS")
+    if pre is None:
+        pre = "jax" if os.environ.get("KFTPU_NB_PREIMPORT", "1") == "1" else ""
+    import importlib
 
-            srv.user_globals["jax"] = jax
+    for mod in filter(None, pre.split(",")):
+        try:
+            srv.user_globals[mod] = importlib.import_module(mod)
         except ImportError:
+            pass
+    if os.environ.get("KFTPU_NB_PROFILER") == "1":
+        # jax-full profile: expose the profiler server so tensorboard can
+        # attach to live kernels (port 0 = ephemeral is not supported by
+        # start_server; pick one from the OS first).
+        try:
+            import socket as _socket
+
+            import jax as _jax
+
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            _jax.profiler.start_server(port)
+            srv.user_globals["_kftpu_profiler_port"] = port
+        except Exception:  # noqa: BLE001 — profiler is best-effort
             pass
     touch(activity)
     try:

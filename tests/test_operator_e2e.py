@@ -184,3 +184,32 @@ def test_elastic_resize_resharded_restore_e2e(cp, tmp_path):
     log = tmp_path / "logs" / "default.elastic-worker-0.log"
     assert log.exists()
     assert "resumed from checkpoint at step" in log.read_text()
+
+
+@pytest.mark.slow
+def test_torch_adapter_distributed_e2e(cp):
+    """Second-framework adapter (SURVEY.md §2.2#19, the XGBoost/Paddle
+    controller analog): a 2-worker PyTorch job rendezvouses with gloo from
+    the operator-injected cluster env, all-reduces gradients, reports
+    through metrics.jsonl, and checkpoints — no framework-specific
+    controller anywhere."""
+    import json
+    import os
+
+    job = cp.submit(job_of(
+        "torch_train",
+        {"steps": 15, "batch": 16, "log_every": 1},
+        name="torch",
+        replicas=2,
+        parallelism=ParallelismSpec(data=2),
+    ))
+    done = cp.wait_for(job, "Succeeded", timeout=240)
+    workdir = os.path.join(cp.config.base_dir, "default", "torch",
+                           "worker-0")
+    mpath = os.path.join(workdir, "metrics.jsonl")
+    rows = [json.loads(l) for l in open(mpath)]
+    assert len(rows) == 15
+    assert rows[-1]["loss"] < rows[0]["loss"], rows
+    assert os.path.exists(os.path.join(workdir, "checkpoint.pt"))
+    # The operator scraped the adapter's metrics like any JAX job's.
+    assert done.status.metrics.loss is not None

@@ -297,7 +297,11 @@ class TestNotebookForm:
         code, got = call(server, "GET", "/notebooks/form/config")
         assert code == 200
         assert got["accelerator"]["resource"] == "google.com/tpu"
-        assert "jax-notebook" in got["images"]
+        # The image family is enumerated from the kernel-profile registry.
+        assert got["images"] == ["base", "jax-full", "jax-notebook"]
+        assert got["default_image"] == "jax-notebook"
+        assert "flax" in got["image_profiles"]["jax-full"]["packages"]
+        assert got["image_profiles"]["base"]["description"]
 
     def test_spawn_from_form(self, api):
         from kubeflow_tpu.core.workspace_specs import Notebook
@@ -342,3 +346,55 @@ def test_notebook_form_zero_cull_and_bad_body(api):
     for body in (b"[]", b'"x"', b"5"):
         code, _ = call(server, "POST", "/notebooks/form", body=body)
         assert code == 400, body
+
+
+class TestDashboard:
+    """centraldashboard-analog aggregation surface (SURVEY.md §2.1#7)."""
+
+    def test_dashboard_counts_and_rollups(self, api, capsys):
+        cp, server = api
+        cp.submit(JAXJob.from_manifest(JOB_MANIFEST))
+        m2 = dict(JOB_MANIFEST, metadata={"name": "other-job",
+                                          "namespace": "team-a"})
+        cp.submit(JAXJob.from_manifest(m2))
+        cp.submit(Profile(metadata=ObjectMeta(name="team-a"),
+                          spec=ProfileSpec(owner="alice")))
+        code, data = call(server, "GET", "/dashboard")
+        assert code == 200
+        assert data["namespaces"]["default"]["kinds"]["JAXJob"]["total"] == 1
+        assert data["namespaces"]["team-a"]["kinds"]["JAXJob"]["total"] == 1
+        # Profiles are namespaced under "default" (the profile NAME is the
+        # namespace it manages).
+        assert "Profile" in data["namespaces"]["default"]["kinds"]
+        # Condition rollup buckets exist per state.
+        row = data["namespaces"]["default"]["kinds"]["JAXJob"]
+        assert sum(row["by_state"].values()) == row["total"]
+        assert "links" in data and data["links"]["metrics"] == "/metrics"
+
+        # HTML form renders the same table.
+        code, html = call(server, "GET", "/dashboard?format=html")
+        assert code == 200 and "<table" in html and "team-a" in html
+
+        # CLI renders it.
+        from kubeflow_tpu.cli import main as cli_main
+        rc = cli_main(["dashboard", "--server", server.url])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "JAXJob" in out and "team-a" in out
+
+
+def test_dashboard_html_escapes_user_fields(api):
+    """Stored-markup injection: object/event fields render escaped."""
+    cp, server = api
+    from kubeflow_tpu.core.object import ObjectMeta
+    from kubeflow_tpu.core.workspace_specs import Notebook, NotebookSpec
+
+    nb = Notebook(metadata=ObjectMeta(name="evil"),
+                  spec=NotebookSpec(image="<script>alert(1)</script>"))
+    cp.submit(nb)
+    cp.recorder.warning(nb, "UnknownImage",
+                        "kernel profile '<script>alert(1)</script>'")
+    code, html = call(server, "GET", "/dashboard?format=html")
+    assert code == 200
+    assert "<script>alert(1)</script>" not in html
+    assert "&lt;script&gt;" in html

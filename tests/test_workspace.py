@@ -186,3 +186,60 @@ class TestProfileQuota:
         assert not can_access(p, "eve")
         remove_contributor(cp.store, "team-a", "bob")
         assert not can_access(cp.store.get(Profile, "team-a"), "bob")
+
+
+class TestKernelProfiles:
+    """The example-notebook-servers image family (SURVEY.md §2.1#11): each
+    kernel profile spawns with its own preimported stack."""
+
+    @pytest.fixture()
+    def cp(self, tmp_path):
+        plane = make_cp(tmp_path, launch=True)
+        plane.start()
+        yield plane
+        plane.stop()
+
+    def _spawn(self, cp, name, image):
+        cp.submit(Notebook(metadata=ObjectMeta(name=name),
+                           spec=NotebookSpec(image=image,
+                                             idle_cull_seconds=None)))
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            nb = cp.store.try_get(Notebook, name)
+            if nb is not None and nb.status.phase in ("Running", "Failed"):
+                break
+            time.sleep(0.1)
+        return cp.store.get(Notebook, name)
+
+    def test_base_has_no_preloads_jax_notebook_has_jax(self, cp):
+        nb = self._spawn(cp, "nb-base", "base")
+        assert nb.status.phase == "Running"
+        sock = nb.status.url.removeprefix("unix://")
+        TestNotebookSession._wait_session(sock)
+        from kubeflow_tpu.workspace.session_main import exec_code
+        res = exec_code(sock, "print('jax' in dir())")
+        assert res["ok"] and res["output"].strip() == "False"
+
+        nb2 = self._spawn(cp, "nb-jax", "jax-notebook")
+        sock2 = nb2.status.url.removeprefix("unix://")
+        TestNotebookSession._wait_session(sock2, timeout=120)
+        res = exec_code(sock2, "print(jax.__name__, numpy.__name__)",
+                        timeout=90)
+        assert res["ok"] and res["output"].strip() == "jax numpy"
+
+    def test_full_profile_preloads_stack(self, cp):
+        nb = self._spawn(cp, "nb-full", "jax-full")
+        assert nb.status.phase == "Running"
+        sock = nb.status.url.removeprefix("unix://")
+        TestNotebookSession._wait_session(sock, timeout=120)
+        from kubeflow_tpu.workspace.session_main import exec_code
+        res = exec_code(sock, "print(flax.__name__, optax.__name__)",
+                        timeout=90)
+        assert res["ok"] and res["output"].strip() == "flax optax"
+
+    def test_unknown_image_fails_with_event(self, cp):
+        nb = self._spawn(cp, "nb-bogus", "pytorch-notebook")
+        assert nb.status.phase == "Failed"
+        assert nb.status.has_condition("Running", status=False)
+        evs = cp.recorder.for_object(nb)
+        assert any(e.reason == "UnknownImage" for e in evs)
