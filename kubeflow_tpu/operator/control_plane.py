@@ -80,6 +80,20 @@ class ControlPlane:
         self.observation_store = MetadataStore(
             os.path.join(self.config.base_dir, "observations.db"))
         self.observations = ObservationLog(self.observation_store)
+        # gRPC front (db-manager protocol surface): lets separate-process
+        # workers write observations directly; workers find it via the
+        # KFTPU_OBS_TARGET env the runtime injects.
+        self.observation_service = None
+        try:
+            from kubeflow_tpu.tune.observation_service import (
+                ObservationGRPCServer,
+            )
+
+            self.observation_service = ObservationGRPCServer(
+                self.observations)
+            self.observation_service.start()
+        except ImportError:
+            pass   # grpcio not installed: in-process reporting only
         self.trial_reconciler = TrialController(
             self.store, base_dir=self.config.base_dir, recorder=self.recorder,
             observations=self.observations)
@@ -127,6 +141,11 @@ class ControlPlane:
                 heartbeat_timeout=self.config.heartbeat_timeout,
                 rendezvous_timeout=self.config.rendezvous_timeout,
                 recorder=self.recorder)
+            if self.observation_service is not None:
+                # Workers report observations straight to the store's gRPC
+                # front (the db-manager path), not through the controller.
+                self.runtime.service_env["KFTPU_OBS_TARGET"] = \
+                    self.observation_service.target
         self._stop = threading.Event()
         self._runtime_thread: Optional[threading.Thread] = None
 
@@ -168,6 +187,8 @@ class ControlPlane:
         self.pipelinerun_reconciler.shutdown()
         self.notebook_reconciler.shutdown()
         self.tensorboard_reconciler.shutdown()
+        if self.observation_service is not None:
+            self.observation_service.stop()
         self.observation_store.close()
 
     def step(self) -> int:

@@ -49,6 +49,9 @@ class WorkerRuntime:
         self.heartbeat_startup_grace = heartbeat_startup_grace
         self.rendezvous_timeout = rendezvous_timeout
         self.recorder = recorder or default_recorder
+        # Platform services advertised to every worker (e.g. the
+        # observation-log gRPC target) — merged into launch env.
+        self.service_env: dict[str, str] = {}
         self.procman = procman or LocalProcessManager(
             log_dir=os.path.join(base_dir, "logs"))
         self._watch: Watch = store.watch(kinds=[Worker.KIND])
@@ -121,7 +124,7 @@ class WorkerRuntime:
         import kubeflow_tpu
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.abspath(kubeflow_tpu.__file__)))
-        extra = dict(tmpl.env or {})
+        extra = {**self.service_env, **(tmpl.env or {})}
         extra["PYTHONPATH"] = os.pathsep.join(
             p for p in (pkg_root, extra.get("PYTHONPATH"),
                         os.environ.get("PYTHONPATH")) if p)

@@ -195,7 +195,11 @@ class ApiServer:
                     kind, {"total": 0, "by_state": {}})
                 row["total"] += 1
                 state = "—"
-                conds = getattr(obj.status, "conditions", None) or []
+                # Some kinds (Pipeline, PodDefault, ServingRuntime) have no
+                # status at all — pydantic raises on attribute access, so
+                # fetch the status object defensively first.
+                status = getattr(obj, "status", None)
+                conds = getattr(status, "conditions", None) or []
                 # Rollup = the most recently transitioned True condition
                 # (the reference surfaces the tail of the ordered list);
                 # all-False conditions (e.g. a Failed notebook's
@@ -204,9 +208,9 @@ class ApiServer:
                 if live:
                     state = max(live,
                                 key=lambda c: c.last_transition_time).type
-                elif getattr(obj.status, "phase", None) is not None:
-                    state = str(getattr(obj.status.phase, "value",
-                                        obj.status.phase))
+                elif getattr(status, "phase", None) is not None:
+                    state = str(getattr(status.phase, "value",
+                                        status.phase))
                 row["by_state"][state] = row["by_state"].get(state, 0) + 1
         events = [dataclasses.asdict(e) for e in self.cp.recorder.all()[-20:]]
         return {

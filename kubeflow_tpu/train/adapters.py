@@ -46,9 +46,17 @@ def torch_train(ctx: WorkerContext) -> int:
     world = ctx.env.num_processes
     rank = ctx.env.process_id
     if world > 1:
-        host, port = ctx.env.coordinator_address.rsplit(":", 1)
+        # Rendezvous over the SHARED job directory (workdirs are
+        # base/ns/job/worker-i), not a TCP port — the operator only
+        # reserves the JAX coordinator's port, so any fixed offset could
+        # collide with another job's listener. The store file is keyed by
+        # the coordinator port, which is freshly allocated per gang
+        # attempt, so a restart never reuses a stale store.
+        port = ctx.env.coordinator_address.rsplit(":", 1)[1]
+        shared = os.path.dirname(ctx.env.workdir.rstrip(os.sep))
         dist.init_process_group(
-            "gloo", init_method=f"tcp://{host}:{int(port) + 1}",
+            "gloo",
+            init_method=f"file://{os.path.join(shared, f'gloo_{port}')}",
             world_size=world, rank=rank)
 
     torch.manual_seed(0)                      # identical init on all ranks

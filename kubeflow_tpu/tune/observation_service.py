@@ -1,0 +1,148 @@
+"""gRPC front for the observation log — the katib-db-manager *protocol*
+surface ((U) katib cmd/db-manager: a gRPC DBManager service with
+ReportObservationLog / GetObservationLog; SURVEY.md §2.4#33).
+
+Round 2 argued an in-process store ("a gRPC hop would be pure overhead",
+native/metadata_store/metadata_store.cc) — true for the controller, but it
+left trial workers in SEPARATE processes reporting through the controller
+instead of writing observations directly. This closes that last
+protocol-parity gap: a thin gRPC service over the control plane's
+ObservationLog, same no-codegen recipe as serve/grpc_server.py (the protoc
+gRPC plugin isn't in the image; messages are JSON bytes over generic
+handlers — the method set, not the wire schema, is the parity surface).
+
+Server side: ``ObservationGRPCServer(control_plane.observations)``.
+Client side: ``RemoteObservationLog(target)`` duck-types ObservationLog's
+reporting/query surface, so a trial worker (or any external process) uses
+one object either in-process or remote.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from typing import Optional
+
+SERVICE = "kubeflow_tpu.tune.ObservationService"
+
+_METHODS = ("Report", "GetLog", "Experiments", "Trials", "Best",
+            "FinishTrial")
+
+
+def _json_bytes(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+class ObservationGRPCServer:
+    """DBManager-analog service over an ObservationLog."""
+
+    def __init__(self, log, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 4):
+        import grpc
+
+        self.log = log
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers,
+                                       thread_name_prefix="grpc-obs"))
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(self, f"_{name.lower()}"),
+                request_deserializer=json.loads,
+                response_serializer=_json_bytes)
+            for name in _METHODS
+        }
+        self.server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        self._started = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.start()
+        self._started.set()
+
+    def stop(self, grace: float = 2.0) -> None:
+        self.server.stop(grace).wait()
+
+    @property
+    def target(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    # -- methods (ReportObservationLog / GetObservationLog analogs) --------
+
+    def _report(self, req, context):
+        self.log.report(req["experiment"], req["trial"], req["metric"],
+                        [(int(s), float(v)) for s, v in req["points"]],
+                        parameters=req.get("parameters"))
+        return {"ok": True}
+
+    def _getlog(self, req, context):
+        series = self.log.get_log(req["trial"], req.get("metric"))
+        return {"series": series}
+
+    def _experiments(self, req, context):
+        return {"experiments": self.log.experiments()}
+
+    def _trials(self, req, context):
+        return {"trials": self.log.trials(req["experiment"])}
+
+    def _best(self, req, context):
+        best = self.log.best(req["experiment"], req["metric"],
+                             req.get("goal", "minimize"))
+        return {"best": list(best) if best else None}
+
+    def _finishtrial(self, req, context):
+        self.log.finish_trial(req["trial"], bool(req.get("succeeded", True)))
+        return {"ok": True}
+
+
+class RemoteObservationLog:
+    """Client with ObservationLog's surface, over the gRPC front — what a
+    separate-process trial worker holds to write observations directly."""
+
+    def __init__(self, target: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(target)
+
+        def unary(name):
+            return self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=_json_bytes,
+                response_deserializer=json.loads)
+
+        self._rpc = {name: unary(name) for name in _METHODS}
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def report(self, experiment_key: str, trial_name: str, metric: str,
+               points, parameters: Optional[dict] = None) -> None:
+        self._rpc["Report"]({
+            "experiment": experiment_key, "trial": trial_name,
+            "metric": metric, "points": [[int(s), float(v)]
+                                         for s, v in points],
+            "parameters": parameters})
+
+    def get_log(self, trial_name: str, metric: Optional[str] = None):
+        out = self._rpc["GetLog"]({"trial": trial_name, "metric": metric})
+        return {k: [(int(s), float(v)) for s, v in pts]
+                for k, pts in out["series"].items()}
+
+    def experiments(self) -> list:
+        return self._rpc["Experiments"]({})["experiments"]
+
+    def trials(self, experiment_key: str) -> list:
+        return self._rpc["Trials"]({"experiment": experiment_key})["trials"]
+
+    def best(self, experiment_key: str, metric: str,
+             goal: str = "minimize"):
+        out = self._rpc["Best"]({"experiment": experiment_key,
+                                 "metric": metric, "goal": goal})["best"]
+        return tuple(out) if out else None
+
+    def finish_trial(self, trial_name: str, succeeded: bool = True) -> None:
+        self._rpc["FinishTrial"]({"trial": trial_name,
+                                  "succeeded": succeeded})

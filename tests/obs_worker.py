@@ -1,0 +1,21 @@
+"""Worker entrypoint for the observation gRPC e2e: reports observations
+DIRECTLY to the control plane's observation service (the db-manager path)
+from a separate process, via the KFTPU_OBS_TARGET env the runtime
+injects."""
+
+import os
+
+
+def report_obs(ctx) -> int:
+    from kubeflow_tpu.tune.observation_service import RemoteObservationLog
+
+    target = os.environ["KFTPU_OBS_TARGET"]
+    log = RemoteObservationLog(target)
+    try:
+        log.report("default/grpc-exp", "grpc-trial", "loss",
+                   [(0, 3.0), (1, 2.0), (2, 1.0)],
+                   parameters={"lr": 0.5})
+        log.finish_trial("grpc-trial", succeeded=True)
+    finally:
+        log.close()
+    return 0

@@ -359,8 +359,16 @@ class TestDashboard:
         cp.submit(JAXJob.from_manifest(m2))
         cp.submit(Profile(metadata=ObjectMeta(name="team-a"),
                           spec=ProfileSpec(owner="alice")))
+        # A status-less kind must not 500 the aggregation (Pipeline has
+        # only metadata+spec).
+        from kubeflow_tpu.core.pipeline_specs import (
+            Pipeline, PipelineIR, PipelineSpecModel)
+        cp.submit(Pipeline(
+            metadata=ObjectMeta(name="p1"),
+            spec=PipelineSpecModel(ir=PipelineIR(name="p1"))))
         code, data = call(server, "GET", "/dashboard")
         assert code == 200
+        assert "Pipeline" in data["namespaces"]["default"]["kinds"]
         assert data["namespaces"]["default"]["kinds"]["JAXJob"]["total"] == 1
         assert data["namespaces"]["team-a"]["kinds"]["JAXJob"]["total"] == 1
         # Profiles are namespaced under "default" (the profile NAME is the

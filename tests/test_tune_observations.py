@@ -108,3 +108,68 @@ def test_trial_controller_writes_observations(tmp_path):
     assert plane.observations.get_log(name)["loss"]
     assert "lr" in trials[0]["parameters"]
     plane.stop()
+
+
+def test_grpc_front_round_trip(tmp_path):
+    """The db-manager gRPC surface: report/query through the wire equals
+    the in-process log."""
+    from kubeflow_tpu.tune.observation_service import (
+        ObservationGRPCServer, RemoteObservationLog,
+    )
+
+    store = MetadataStore(str(tmp_path / "obs.db"))
+    log = ObservationLog(store)
+    srv = ObservationGRPCServer(log)
+    srv.start()
+    try:
+        remote = RemoteObservationLog(srv.target)
+        remote.report("default/e1", "t1", "loss", [(0, 2.0), (5, 1.0)],
+                      parameters={"lr": 0.1})
+        assert remote.get_log("t1")["loss"] == [(0, 2.0), (5, 1.0)]
+        assert remote.experiments() == ["default/e1"]
+        (t,) = remote.trials("default/e1")
+        assert t["trial"] == "t1" and t["parameters"] == {"lr": 0.1}
+        assert remote.best("default/e1", "loss") == ("t1", 1.0)
+        remote.finish_trial("t1")
+        remote.close()
+        # The same data is visible to the in-process log object.
+        assert log.get_log("t1")["loss"] == [(0, 2.0), (5, 1.0)]
+    finally:
+        srv.stop()
+        store.close()
+
+
+def test_worker_reports_directly_over_grpc(tmp_path):
+    """A REAL worker process writes observations straight to the store's
+    gRPC front (no controller relay): the runtime injects KFTPU_OBS_TARGET
+    and the points land in the durable log."""
+    from kubeflow_tpu.core.jobs import (
+        JAXJob, JAXJobSpec, ReplicaSpec, TPUResourceSpec, WorkloadSpec,
+    )
+    from kubeflow_tpu.core.object import ObjectMeta
+    from kubeflow_tpu.operator.control_plane import (
+        ControlPlane, ControlPlaneConfig,
+    )
+    from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+
+    cp = ControlPlane(ControlPlaneConfig(
+        base_dir=str(tmp_path),
+        cluster=Cluster(slices=[SliceTopology(name="s0", generation="cpu",
+                                              dims=(2, 2))]),
+        platform="cpu"))
+    cp.start()
+    try:
+        job = cp.submit(JAXJob(
+            metadata=ObjectMeta(name="obsjob"),
+            spec=JAXJobSpec(replica_specs={"worker": ReplicaSpec(
+                replicas=1,
+                template=WorkloadSpec(
+                    entrypoint="tests.obs_worker:report_obs"),
+                resources=TPUResourceSpec(tpu_chips=1))})))
+        cp.wait_for(job, "Succeeded", timeout=120)
+        got = cp.observations.get_log("grpc-trial")
+        assert got["loss"] == [(0, 3.0), (1, 2.0), (2, 1.0)]
+        (t,) = cp.observations.trials("default/grpc-exp")
+        assert t["parameters"] == {"lr": 0.5}
+    finally:
+        cp.stop()
