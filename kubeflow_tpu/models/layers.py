@@ -120,8 +120,22 @@ def attention_block(
             # Prefill from an empty scratch cache: start is statically 0 and
             # the cache length equals the block, so the flash kernel applies
             # directly (its big win is exactly this forward-only pass).
-            out = multi_head_attention(q, ck, cv, causal=True, q_offset=0,
-                                       impl="pallas")
+            # Under a multi-device mesh the kernel must run per-shard
+            # (Mosaic can't be GSPMD-partitioned) — the TP serving engine's
+            # sharded prefill path; non-dividing shapes fall back to XLA.
+            # Same pattern as the no-cache training branch below.
+            if mesh is not None and mesh.size > 1:
+                from kubeflow_tpu.ops.flash_attention import (
+                    flash_attention_sharded,
+                )
+
+                out = flash_attention_sharded(q, ck, cv, mesh, causal=True)
+                if out is None:
+                    out = multi_head_attention(q, ck, cv, causal=True,
+                                               q_offset=0, impl="xla")
+            else:
+                out = multi_head_attention(q, ck, cv, causal=True, q_offset=0,
+                                           impl="pallas")
         else:
             # Decode with a traced cache offset: the masked XLA path (the
             # pallas kernel needs a static q_offset).

@@ -263,12 +263,13 @@ def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
 
 def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
                   slot: jax.Array, length: jax.Array, cfg: DecoderConfig,
-                  attn_impl: str = "xla"):
+                  attn_impl: str = "xla", mesh: Optional[Mesh] = None):
     """Prefill a [1, S_bucket] prompt into slot ``slot``.
 
     Runs the training forward with a scratch contiguous cache, scatters the
     resulting K/V into the slot row, and returns the last-real-token logits
-    [V] (the basis of the first sampled token — TTFT ends when it lands)."""
+    [V] (the basis of the first sampled token — TTFT ends when it lands).
+    ``mesh`` (TP serving): the flash path runs per-shard via shard_map."""
     scratch = {
         "k": jnp.zeros((cfg.n_layers, 1, tokens.shape[1],
                         cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
@@ -280,7 +281,7 @@ def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
         "prefill": True,
     }
     logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=scratch,
-                                        attn_impl=attn_impl)
+                                        attn_impl=attn_impl, mesh=mesh)
     bucket = tokens.shape[1]
     ck = jax.lax.dynamic_update_slice(
         cache["k"], filled["k"], (0, slot, 0, 0, 0))
@@ -498,15 +499,16 @@ class LLMEngine:
             # Per-bucket impl choice (shape is static per trace): measured on
             # v5e, the flash kernel overtakes fused XLA attention in the full
             # model around S≈2k (XLA wins below — matmul-dominated regime).
-            # Mesh mode pins XLA: a pallas_call can't be GSPMD-partitioned
-            # over sharded operands (it would need an explicit shard_map).
+            # Mesh mode runs the kernel per-shard via shard_map (Mosaic
+            # can't be GSPMD-partitioned); non-dividing head counts fall
+            # back to XLA inside attention_block.
             impl = b.prefill_attn_impl
             if impl == "auto":
                 # Flash kernel needs the bucket to divide its 128 block.
-                impl = ("pallas" if on_tpu and self.mesh is None
-                        and t.shape[1] >= 2048
+                impl = ("pallas" if on_tpu and t.shape[1] >= 2048
                         and t.shape[1] % 128 == 0 else "xla")
-            out, cache = _prefill_step(p, c, t, s, ln, cfg, impl)
+            out, cache = _prefill_step(p, c, t, s, ln, cfg, impl,
+                                       mesh=self.mesh)
             return out, self._pin(cache)
 
         self._prefill = jax.jit(_prefill_fn, donate_argnums=(1,))

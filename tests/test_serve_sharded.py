@@ -133,3 +133,12 @@ def test_gqa_nondivisible_kv_replicates(params):
     assert eng._cache_sh.spec == jax.sharding.PartitionSpec()
     got = eng.generate(PROMPTS[0], SamplingParams(max_new_tokens=6))
     assert got == want
+
+
+def test_tp2_flash_prefill_matches(cfg, params):
+    """Forced pallas prefill under the TP mesh: the flash kernel runs
+    per-shard via shard_map (Mosaic can't be GSPMD-partitioned) and must
+    match the single-device pallas engine token-exactly."""
+    want = run_all(mk_engine(cfg, params, prefill_attn_impl="pallas"))
+    got = run_all(mk_engine(cfg, params, tp=2, prefill_attn_impl="pallas"))
+    assert got == want
