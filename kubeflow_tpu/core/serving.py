@@ -65,12 +65,14 @@ class BatchingSpec(BaseModel):
     # Decode steps per device dispatch: sampling runs on-device and up to
     # this many tokens emit per host round-trip (amortizes dispatch latency;
     # early-exits when all slots finish). 1 = one step per dispatch.
-    decode_steps: int = 16
+    # 32 beat 16 by +14-17% req/s in order-reversed on-chip A/Bs (the
+    # dispatch floor dominates at this model size).
+    decode_steps: int = 32
     # Decode steps per dispatch WHILE a chunked prefill is in flight: the
     # prefill's next chunk waits at most this many decode steps (TPOT-spike
     # bound for running streams vs dispatch amortization; 1 = the old
     # strict interleave, which costs concurrent paged traffic ~40% req/s).
-    prefill_interleave_steps: int = 4
+    prefill_interleave_steps: int = 8
     # Cast model weights once at engine load (e.g. "bfloat16" — halves the
     # per-step HBM param read, the decode bottleneck; standard for serving).
     # None keeps the checkpoint dtype.

@@ -123,16 +123,12 @@ def attention_block(
             # Under a multi-device mesh the kernel must run per-shard
             # (Mosaic can't be GSPMD-partitioned) — the TP serving engine's
             # sharded prefill path; non-dividing shapes fall back to XLA.
-            # Same pattern as the no-cache training branch below.
             if mesh is not None and mesh.size > 1:
                 from kubeflow_tpu.ops.flash_attention import (
-                    flash_attention_sharded,
+                    flash_sharded_or_xla,
                 )
 
-                out = flash_attention_sharded(q, ck, cv, mesh, causal=True)
-                if out is None:
-                    out = multi_head_attention(q, ck, cv, causal=True,
-                                               q_offset=0, impl="xla")
+                out = flash_sharded_or_xla(q, ck, cv, mesh, causal=True)
             else:
                 out = multi_head_attention(q, ck, cv, causal=True, q_offset=0,
                                            impl="pallas")
@@ -172,11 +168,9 @@ def attention_block(
         # Mosaic kernels can't be GSPMD-auto-partitioned: run the flash
         # kernel per-shard via shard_map (block-diagonal over batch/heads);
         # shapes that don't shard cleanly fall back to XLA attention.
-        from kubeflow_tpu.ops.flash_attention import flash_attention_sharded
+        from kubeflow_tpu.ops.flash_attention import flash_sharded_or_xla
 
-        out = flash_attention_sharded(q, k, v, mesh, causal=True)
-        if out is None:
-            out = multi_head_attention(q, k, v, causal=True, impl="xla")
+        out = flash_sharded_or_xla(q, k, v, mesh, causal=True)
     else:
         out = multi_head_attention(q, k, v, causal=True, impl=attn_impl)
     out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))

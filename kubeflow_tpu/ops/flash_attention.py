@@ -516,6 +516,22 @@ def flash_attention_sharded(
     return fn(q, k, v)
 
 
+def flash_sharded_or_xla(q, k, v, mesh, *, causal: bool = True,
+                         logits_softcap: Optional[float] = None):
+    """Flash per-shard under a multi-device mesh, XLA attention when the
+    shape doesn't shard cleanly — the one fallback rule shared by the
+    training no-cache path and the serving prefill path (layers.py)."""
+    out = flash_attention_sharded(q, k, v, mesh, causal=causal,
+                                  logits_softcap=logits_softcap)
+    if out is None:
+        from kubeflow_tpu.ops.attention import multi_head_attention
+
+        out = multi_head_attention(q, k, v, causal=causal,
+                                   logits_softcap=logits_softcap,
+                                   impl="xla")
+    return out
+
+
 def flash_attention(
     q: jax.Array,                     # [B, Sq, H, D]
     k: jax.Array,                     # [B, Skv, K, D]
