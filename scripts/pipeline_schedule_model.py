@@ -1,0 +1,67 @@
+"""FLOP/bubble cost model for the two pipeline schedules (round-2 weak #6:
+the 1F1B-vs-GPipe trade was implemented but never quantified — a
+single-host box cannot measure a real multi-stage wall-clock, so this is
+the analytical model grounded in the measured on-chip step decomposition).
+
+Per microbatch per stage:
+- checkpointed GPipe: 2 forwards + 1 backward (checkpoint recomputes the
+  stage forward in its backward), bubble fraction (n-1)/(m+n-1) with m
+  memory-capped at 2n (activation stash grows with m) → bubble → 1/3
+  from below as n grows.
+- 1F1B (this repo's m-independent ring): 3 forwards + 1 backward (the
+  forward lane refills the 2n-1 ring AND the vjp's primal re-runs the
+  stage), bubble fraction (n-1)/(m+n-1) with NO memory cap on m.
+
+With f = forward cost and b = backward-proper cost (measured on-chip:
+fwd 117 ms of a 391 ms fwd+bwd → b ≈ 2.3 f), per-microbatch work is
+w_gpipe = 2f+b, w_1f1b = 3f+b, and total step time ∝ w · (m+n-1)/m.
+1F1B wins exactly when its extra forward costs less than the bubble it
+removes by raising m past GPipe's 2n cap.
+
+Run: python scripts/pipeline_schedule_model.py   (prints the crossover
+table; one JSON line at the end).
+"""
+
+import json
+
+
+def step_cost(w: float, m: int, n: int) -> float:
+    """Relative wall per step: per-microbatch work × occupied ticks / m."""
+    return w * (m + n - 1) / m
+
+
+def crossover(n: int, f: float = 1.0, b: float = 2.3,
+              gpipe_m_cap_factor: int = 2):
+    import math
+
+    w_g = 2 * f + b
+    w_1 = 3 * f + b
+    m_g = gpipe_m_cap_factor * n           # GPipe's activation-stash cap
+    g = step_cost(w_g, m_g, n)
+    rows = [{"m": m, "oneFoneB_rel": round(step_cost(w_1, m, n) / g, 4)}
+            for m in (m_g, 2 * m_g, 4 * m_g, 8 * m_g, 16 * m_g)]
+    # Closed form: w_1·(m+n−1)/m < g  ⇔  m > (n−1)·w_1 / (g − w_1).
+    wins_at = (math.floor((n - 1) * w_1 / (g - w_1)) + 1
+               if g > w_1 else None)
+    return {"stages": n, "gpipe_m": m_g, "gpipe_cost": round(g, 3),
+            "rows": rows, "asymptote_rel": round(w_1 / g, 4),
+            "wins_at_m": wins_at}
+
+
+def main():
+    out = []
+    for n in (4, 8, 16):
+        r = crossover(n)
+        out.append(r)
+        print(f"n={n:3d} stages: GPipe (m={r['gpipe_m']}) = {r['gpipe_cost']}"
+              f" | 1F1B rel cost by m: "
+              + ", ".join(f"m={row['m']}:{row['oneFoneB_rel']}"
+                          for row in r["rows"])
+              + f"  -> 1F1B wins from m={r['wins_at_m']} "
+              f"(asymptote {r['asymptote_rel']})")
+    print(json.dumps({"metric": "pipeline_schedule_crossover",
+                      "fwd_bwd_ratio": 2.3, "configs": out}))
+
+
+if __name__ == "__main__":
+    main()
