@@ -31,7 +31,9 @@ from kubeflow_tpu.ops.attention import NEG_INF
 # 32k grid steps and lose to XLA's fused S×S path; (1024, 1024) cuts the
 # grid 64× and wins (isolated: fwd 15.0 vs 17.3 ms, recompute-train 22.9
 # vs 39.8 ms; full train step 349 vs 486 ms). Shapes the defaults don't
-# divide fall back to the largest power-of-two divisor (_fit_block).
+# divide fall back to the largest 128-aligned divisor (_fit_block); lengths
+# >= 128 with no 128-aligned divisor raise rather than reach Mosaic with a
+# tile-misaligned block.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
 
@@ -45,9 +47,13 @@ def _fit_block(pref: int, s: int) -> int:
     the 128-lane tile (a sub-128 block would violate Mosaic tiling and
     explode the grid). s < 128 uses s itself when it divides."""
     b = min(pref, s)
-    while b >= 128 and s % b:
+    while b >= 128 and (s % b or b % 128):
         b //= 2
-    if s % b:
+    if s % b or (s >= 128 and b % 128):
+        # Covers both the no-divisor case and s in [128, 1024) that is not
+        # itself 128-aligned (e.g. 136): such an s used to slip through as a
+        # single full-size block and die inside Mosaic lowering with an
+        # opaque tile-misalignment error.
         raise ValueError(
             f"no default block size >= 128 divides sequence length {s}; "
             "pass block_q/block_kv explicitly")

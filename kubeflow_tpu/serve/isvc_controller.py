@@ -174,20 +174,27 @@ class ISVCController:
             # Converge the newest previous generation to its share. A
             # recreated replica MUST run the previous generation's config —
             # the isvc spec already holds the canary's — so it is cloned
-            # from a surviving same-generation sibling. canary_active implies
-            # a sibling exists: prev_gens is derived from live workers in
-            # ``by``. (If EVERY stable replica crashed at once, the crash
-            # loop above already deleted them, prev_gens is empty, and the
-            # rolling path promotes the canary to 100% — total loss of the
-            # stable set has nothing left to route the 100-p share to.)
-            sibling = next(w for (g, _), w in sorted(by.items()) if g == pg)
-            for i in range(n_prev):
-                if (pg, i) not in by:
-                    by[(pg, i)] = self._create_replica(
-                        isvc, i, pg, clone_from=sibling)
-            for (g, i) in sorted(by):
-                if g == pg and i >= n_prev:
-                    self._delete_worker(by.pop((g, i)))
+            # from a surviving same-generation sibling. Today prev_gens is
+            # derived from live workers in ``by`` so a sibling exists, but
+            # that is an invariant of this pass's bookkeeping, not of the
+            # store — guard it so a refactor (or a concurrent delete racing
+            # the worker list) degrades to "skip convergence this pass"
+            # instead of killing the reconcile loop with StopIteration.
+            sibling = next(
+                (w for (g, _), w in sorted(by.items()) if g == pg), None)
+            if sibling is None:
+                self.recorder.warning(
+                    isvc, "CanaryNoSibling",
+                    f"previous generation {pg} has no surviving replica to "
+                    "clone; skipping its convergence this pass")
+            else:
+                for i in range(n_prev):
+                    if (pg, i) not in by:
+                        by[(pg, i)] = self._create_replica(
+                            isvc, i, pg, clone_from=sibling)
+                for (g, i) in sorted(by):
+                    if g == pg and i >= n_prev:
+                        self._delete_worker(by.pop((g, i)))
 
         # Readiness probing, per generation.
         ready_by_gen: dict[int, list[str]] = {}

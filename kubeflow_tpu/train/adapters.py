@@ -54,10 +54,12 @@ def torch_train(ctx: WorkerContext) -> int:
         # attempt, so a restart never reuses a stale store.
         port = ctx.env.coordinator_address.rsplit(":", 1)[1]
         shared = os.path.dirname(ctx.env.workdir.rstrip(os.sep))
+        store_file = os.path.join(shared, f"gloo_{port}")
         dist.init_process_group(
-            "gloo",
-            init_method=f"file://{os.path.join(shared, f'gloo_{port}')}",
+            "gloo", init_method=f"file://{store_file}",
             world_size=world, rank=rank)
+    else:
+        store_file = None
 
     torch.manual_seed(0)                      # identical init on all ranks
     model = torch.nn.Sequential(
@@ -93,6 +95,23 @@ def torch_train(ctx: WorkerContext) -> int:
         if ctx.is_coordinator and ctx.env.workdir:
             torch.save(model.state_dict(),
                        os.path.join(ctx.env.workdir, "checkpoint.pt"))
+        if world > 1:
+            # Success path only: retire the store file so the shared job dir
+            # never accumulates stale stores (a recycled coordinator port
+            # would otherwise join the old store and hang at rendezvous).
+            # The explicit barrier guarantees every peer has finished
+            # init_process_group before the file disappears — without it a
+            # steps=0 run could unlink while a descheduled rank is still
+            # polling the store, and FileStore's O_CREAT reopen would leave
+            # that rank waiting on an empty file until timeout. On failure
+            # paths the file is left behind; fresh-port keying keeps that
+            # correct.
+            dist.barrier()
+            if ctx.is_coordinator and store_file is not None:
+                try:
+                    os.unlink(store_file)
+                except OSError:
+                    pass
     finally:
         emitter.close()
         if world > 1:

@@ -111,8 +111,13 @@ def main() -> int:
             s.close()
             _jax.profiler.start_server(port)
             srv.user_globals["_kftpu_profiler_port"] = port
-        except Exception:  # noqa: BLE001 — profiler is best-effort
-            pass
+        except Exception as e:  # noqa: BLE001 — profiler is best-effort
+            # Best-effort, but never silent: the bind→close→start_server
+            # dance can lose the port to another process (TOCTOU), and a
+            # jax-full profile without its profiler should be diagnosable
+            # from the session log.
+            print(f"kftpu-session: profiler server failed to start: {e!r}",
+                  file=sys.stderr)
     touch(activity)
     try:
         srv.serve_forever(poll_interval=0.2)

@@ -112,6 +112,19 @@ class TestFlashAttention:
         with pytest.raises(ValueError, match="multiple of block size"):
             flash_attention(q, k, v, block_q=64, block_kv=64)
 
+    def test_unaligned_seq_rejected_loudly(self):
+        # 128 <= S < 1024 but not 128-aligned: S used to be accepted as a
+        # single full-size block and fail deep inside Mosaic lowering;
+        # _fit_block must reject it with the explicit error instead.
+        from kubeflow_tpu.ops.flash_attention import _fit_block
+        for s in (136, 160, 1000):
+            with pytest.raises(ValueError, match="pass block_q/block_kv"):
+                _fit_block(1024, s)
+        # Aligned sizes keep working, including the sub-128 escape hatch.
+        assert _fit_block(1024, 2048) == 1024
+        assert _fit_block(1024, 384) == 384   # 128-aligned, divides itself
+        assert _fit_block(1024, 64) == 64
+
 
 @pytest.fixture(scope="module")
 def seq_mesh():
