@@ -34,7 +34,9 @@ class ModelSpec(BaseModel):
     model_config = ConfigDict(extra="forbid", protected_namespaces=())
 
     model_format: ModelFormat = ModelFormat.LLM
-    storage_uri: Optional[str] = None   # file:///..., ckpt://..., hf://... (gated)
+    # file:///ckpt-dir, artifact://<digest>|<name>[@<ver>] (the platform
+    # artifact store — pipeline-published models), random:// (fresh init).
+    storage_uri: Optional[str] = None
     runtime: Optional[str] = None       # explicit ServingRuntime name
     model_name: Optional[str] = None    # name exposed on the protocol surface
     config: dict[str, Any] = Field(default_factory=dict)  # model arch/config

@@ -146,6 +146,13 @@ class ControlPlane:
                 # front (the db-manager path), not through the controller.
                 self.runtime.service_env["KFTPU_OBS_TARGET"] = \
                     self.observation_service.target
+            # artifact:// resolution in worker processes (model servers
+            # loading a published model, trainers staging a published
+            # dataset): point every worker at the platform artifact store.
+            from kubeflow_tpu.pipelines.artifacts import ROOT_ENV
+
+            self.runtime.service_env[ROOT_ENV] = \
+                self.pipelinerun_reconciler.artifacts.root
         self._stop = threading.Event()
         self._runtime_thread: Optional[threading.Thread] = None
 
@@ -203,6 +210,12 @@ class ControlPlane:
         return n
 
     # -- user surface (the SDK analog) ----------------------------------------
+
+    @property
+    def artifact_store(self):
+        """The platform artifact store (pipelines outputs, published models,
+        artifact:// resolution) — one store, every subsystem."""
+        return self.pipelinerun_reconciler.artifacts
 
     def submit(self, obj: ApiObject) -> ApiObject:
         return self.store.create(obj)

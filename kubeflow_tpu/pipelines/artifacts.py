@@ -6,7 +6,26 @@ Here artifacts are content-addressed (sha256) on the local filesystem, which
 gives cache reuse integrity for free: equal content = equal uri.
 
 Values are stored as a 1-byte codec tag + payload: JSON for plain data
-(readable, cross-version) and pickle for arbitrary Python objects.
+(readable, cross-version), pickle for arbitrary Python objects, and "T" for
+directory-tree manifests (an orbax checkpoint is a directory; the manifest
+maps relpath → per-file blob digest, so trees dedup across versions that
+share shards).
+
+``artifact://`` is the platform's cross-subsystem storage scheme — the
+train→deploy seam ((U) kserve python/kserve/kserve/storage consuming the
+KFP object store; SURVEY.md §2.3#28 + §2.5#44, §3.4→§3.2):
+
+- ``artifact://<sha256-digest>``      content address (any artifact)
+- ``artifact://<name>@<version>``     named register entry
+- ``artifact://<name>``               newest registered version
+
+``InferenceService.storageUri`` (serve/storage.py) and ``train()`` staging
+(train/staging.py) both resolve it against the store rooted at
+``$KFTPU_ARTIFACT_ROOT`` — the env the control plane injects into every
+worker — so a pipeline-trained model is nameable by digest or name with no
+file paths crossing subsystems. Components publish through
+``publish_model``/``publish_file``, which also record Artifact lineage when
+called inside a pipeline task (executor task context).
 """
 
 from __future__ import annotations
@@ -15,10 +34,18 @@ import hashlib
 import json
 import os
 import pickle
+import re
 import tempfile
-from typing import Any
+from typing import Any, Optional
 
 SCHEME = "cas://"
+ARTIFACT_SCHEME = "artifact://"
+ROOT_ENV = "KFTPU_ARTIFACT_ROOT"
+
+_HEX_DIGEST = re.compile(r"^[0-9a-f]{64}$")
+_NAME_OK = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+_TREE_KEY = "kftpu_tree"       # manifest sentinel (see _manifest_of)
+_MARKER = ".complete"          # materialization commit marker
 
 
 class ArtifactStore:
@@ -71,4 +98,291 @@ class ArtifactStore:
             return json.loads(data[1:])
         if data[:1] == b"P":
             return pickle.loads(data[1:])
+        if data[:1] == b"T":
+            return json.loads(data[1:])[_TREE_KEY]   # {relpath: digest}
         raise ValueError(f"unknown artifact codec {data[:1]!r}")
+
+    # -- directory trees (orbax checkpoints, staged bundles) -------------------
+
+    def put_tree(self, src_dir: str) -> str:
+        """Store a directory as per-file blobs + a "T"-codec manifest.
+
+        Files are content-addressed individually, so checkpoints that share
+        shards (e.g. consecutive orbax steps with unchanged leaves) store
+        the changed bytes only. Whole-file reads are fine at this store's
+        scale (local disk, no egress); a streaming hasher is the upgrade
+        path if blobs outgrow memory."""
+        files: dict[str, str] = {}
+        src_dir = os.path.abspath(src_dir)
+        if not os.path.isdir(src_dir):
+            raise NotADirectoryError(f"put_tree: {src_dir} is not a directory")
+        for dirpath, dirnames, filenames in os.walk(src_dir):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if dirpath == src_dir and fn == _MARKER:
+                    # Re-publishing a materialized tree must not capture the
+                    # store's own commit marker (it would sort first in the
+                    # manifest and masquerade as a committed layout).
+                    continue
+                p = os.path.join(dirpath, fn)
+                rel = os.path.relpath(p, src_dir)
+                with open(p, "rb") as f:
+                    files[rel] = self.put_bytes(f.read())[len(SCHEME):]
+        payload = b"T" + json.dumps({_TREE_KEY: files},
+                                    sort_keys=True).encode()
+        return self.put_bytes(payload)
+
+    def _manifest_of(self, uri: str) -> Optional[dict[str, str]]:
+        """The tree manifest, or None for non-tree artifacts. Raw blobs are
+        untagged, so tree-ness requires the full contract — "T" prefix AND
+        a JSON object holding exactly the sentinel key. A text file that
+        merely starts with "T" fails the parse; a file that IS byte-equal
+        to a manifest has the manifest's digest and behaves identically by
+        CAS construction."""
+        with open(self.path_for(uri), "rb") as f:
+            head = f.read(2)
+            if head[:1] != b"T":
+                return None
+            data = head[1:] + f.read()
+        try:
+            doc = json.loads(data)
+        except ValueError:
+            return None
+        if isinstance(doc, dict) and set(doc) == {_TREE_KEY} \
+                and isinstance(doc[_TREE_KEY], dict):
+            return doc[_TREE_KEY]
+        return None
+
+    def is_tree(self, uri: str) -> bool:
+        return self._manifest_of(uri) is not None
+
+    def materialize_tree(self, uri: str, dest: Optional[str] = None) -> str:
+        """Lay a tree artifact out as a real directory and return its path.
+
+        Default dest is ``<root>/trees/<digest>`` — content-addressed, so
+        materialization is idempotent and shared across consumers (a served
+        model and a warm restart hit the same dir). Files hardlink to the
+        CAS blobs (copy-via-tmp fallback for filesystems that refuse
+        links, so a killed copy never lands at the final name); the marker
+        file commits the layout, so a killed materialization re-runs
+        instead of serving a half-written checkpoint."""
+        files = self._manifest_of(uri)
+        if files is None:
+            raise ValueError(
+                f"{uri} is not a tree artifact; model storageUris need a "
+                "publish_model/put_tree artifact")
+        if dest is None:
+            dest = os.path.join(self.root, "trees", uri[len(SCHEME):])
+        marker = os.path.join(dest, _MARKER)
+        if os.path.exists(marker):
+            return dest
+        os.makedirs(dest, exist_ok=True)
+        for rel, digest in files.items():
+            blob = self._path(digest)
+            out = os.path.join(dest, rel)
+            if os.path.exists(out):
+                continue   # link/replace are atomic: existing = complete
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            try:
+                os.link(blob, out)
+            except OSError:
+                import shutil
+
+                fd, tmp = tempfile.mkstemp(dir=os.path.dirname(out))
+                os.close(fd)
+                shutil.copyfile(blob, tmp)
+                os.replace(tmp, out)
+        with open(marker, "w") as f:
+            f.write(uri)
+        return dest
+
+    # -- named register (name@version → digest) --------------------------------
+
+    def register(self, name: str, version: str, uri: str) -> str:
+        """Bind ``name@version`` to a stored artifact; returns the
+        ``artifact://name@version`` uri. Versions are immutable — rebinding
+        to different content raises (same content is a no-op), matching the
+        registry contract serving relies on for rollback-by-version."""
+        if not _NAME_OK.match(name) or _HEX_DIGEST.match(name):
+            raise ValueError(f"bad artifact name {name!r}")
+        if not _NAME_OK.match(version):
+            raise ValueError(f"bad artifact version {version!r}")
+        if not self.exists(uri):
+            raise FileNotFoundError(f"register {name}@{version}: {uri} "
+                                    "is not in the store")
+        entry = os.path.join(self.root, "named", name, version)
+        os.makedirs(os.path.dirname(entry), exist_ok=True)
+        try:
+            # O_EXCL makes first-writer-wins atomic across processes that
+            # share the root — a concurrent same-version register with
+            # different content must LOSE loudly, not silently flip what a
+            # deployed storageUri resolves to.
+            fd = os.open(entry, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+        except FileExistsError:
+            with open(entry) as f:
+                existing = f.read().strip()
+            if existing != uri:
+                raise ValueError(
+                    f"{name}@{version} is already bound to {existing}; "
+                    "versions are immutable, register a new one") from None
+            return f"{ARTIFACT_SCHEME}{name}@{version}"
+        with os.fdopen(fd, "w") as f:
+            f.write(uri)
+        return f"{ARTIFACT_SCHEME}{name}@{version}"
+
+    def versions(self, name: str) -> list[str]:
+        d = os.path.join(self.root, "named", name)
+        try:
+            entries = [v for v in os.listdir(d)
+                       if not v.startswith(".")
+                       and os.path.isfile(os.path.join(d, v))]
+        except FileNotFoundError:
+            return []
+        # Registration order (mtime), name tiebreak: "latest" means newest
+        # registered, not lexicographically largest ("10" vs "9").
+        return sorted(entries,
+                      key=lambda v: (os.path.getmtime(os.path.join(d, v)), v))
+
+    def lookup(self, name: str, version: Optional[str] = None) -> str:
+        """name[@version] → cas:// uri (latest registered when no version)."""
+        if version is None:
+            all_v = self.versions(name)
+            if not all_v:
+                raise FileNotFoundError(f"no registered artifact {name!r}")
+            version = all_v[-1]
+        entry = os.path.join(self.root, "named", name, version)
+        try:
+            with open(entry) as f:
+                return f.read().strip()
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"artifact {name}@{version} is not registered "
+                f"(known versions: {self.versions(name) or 'none'})") from None
+
+    # -- artifact:// resolution -----------------------------------------------
+
+    def resolve(self, uri: str) -> str:
+        """Any artifact uri → the underlying cas:// content address."""
+        if uri.startswith(SCHEME):
+            return uri
+        if not uri.startswith(ARTIFACT_SCHEME):
+            raise ValueError(f"not an artifact uri: {uri!r}")
+        ref = uri[len(ARTIFACT_SCHEME):]
+        if _HEX_DIGEST.match(ref):
+            return SCHEME + ref
+        name, sep, version = ref.partition("@")
+        if sep and not _NAME_OK.match(version):
+            raise ValueError(f"bad version in {uri!r}")
+        return self.lookup(name, version if sep else None)
+
+    def localize(self, uri: str) -> str:
+        """Resolve to a local filesystem path: tree artifacts materialize to
+        a directory, blob artifacts return the CAS file itself (read-only —
+        consumers that mutate must copy, which train staging does anyway)."""
+        cas = self.resolve(uri)
+        if not self.exists(cas):
+            raise FileNotFoundError(f"{uri} ({cas}) is not in the store")
+        if self.is_tree(cas):
+            return self.materialize_tree(cas)
+        return self.path_for(cas)
+
+
+def artifact_store_from_env(root: Optional[str] = None) -> ArtifactStore:
+    """The store every subsystem shares: explicit root, or the
+    ``KFTPU_ARTIFACT_ROOT`` env the control plane injects into workers."""
+    root = root or os.environ.get(ROOT_ENV)
+    if not root:
+        raise RuntimeError(
+            "artifact:// uri but no artifact store: set KFTPU_ARTIFACT_ROOT "
+            "or pass artifact_root (the control plane injects the env into "
+            "workers automatically)")
+    return ArtifactStore(root)
+
+
+def _task_lineage(store: ArtifactStore, uri: str, type_name: str,
+                  name: Optional[str], version: Optional[str]) -> None:
+    """Record Artifact + OUTPUT event + run attribution when publishing from
+    inside a pipeline task (no-op elsewhere)."""
+    from kubeflow_tpu.pipelines.executor import current_task_context
+
+    ctx = current_task_context()
+    if ctx is None:
+        return
+    props = {"uri": uri}
+    if name:
+        props["name"] = name
+    if version:
+        props["version"] = version
+    aid = ctx.metadata.create_artifact(
+        type_name, uri=store.resolve(uri), state=_ART_LIVE(),
+        properties=props)
+    ctx.metadata.put_event(ctx.execution_id, aid, _EVENT_OUTPUT(),
+                           name or type_name.lower())
+    ctx.metadata.add_attribution(ctx.context_id, aid)
+
+
+def _ART_LIVE() -> int:
+    from kubeflow_tpu.pipelines import metadata as md
+
+    return md.ART_LIVE
+
+
+def _EVENT_OUTPUT() -> int:
+    from kubeflow_tpu.pipelines import metadata as md
+
+    return md.EVENT_OUTPUT
+
+
+def publish_model(checkpoint_dir: str, *, name: Optional[str] = None,
+                  version: Optional[str] = None,
+                  store: Optional[ArtifactStore] = None) -> str:
+    """Publish an orbax checkpoint directory as a typed Model artifact.
+
+    The KFP Output[Model] analog: inside a pipeline component the run's
+    store is implicit (executor task context) and Artifact/Event/Attribution
+    lineage is recorded against the current execution; outside a pipeline
+    pass ``store`` explicitly. Returns ``artifact://name@version`` when
+    named, else ``artifact://<digest>`` — either is a valid
+    ``InferenceService.storageUri``."""
+    if name is None and version is not None:
+        raise ValueError("version requires name (a digest-form artifact "
+                         "has no register entry to version)")
+    store = store or _context_store()
+    cas = store.put_tree(checkpoint_dir)
+    if name is not None:
+        version = version or "1"
+        uri = store.register(name, version, cas)
+    else:
+        uri = ARTIFACT_SCHEME + cas[len(SCHEME):]
+    _task_lineage(store, uri, "Model", name, version)
+    return uri
+
+
+def publish_file(path: str, *, name: Optional[str] = None,
+                 version: Optional[str] = None,
+                 store: Optional[ArtifactStore] = None,
+                 type_name: str = "Dataset") -> str:
+    """Publish a single file (dataset, tokenizer) as a raw-blob artifact
+    consumable by ``train(dataset_uri="artifact://...")``."""
+    if name is None and version is not None:
+        raise ValueError("version requires name (a digest-form artifact "
+                         "has no register entry to version)")
+    store = store or _context_store()
+    with open(path, "rb") as f:
+        cas = store.put_bytes(f.read())
+    if name is not None:
+        version = version or "1"
+        uri = store.register(name, version, cas)
+    else:
+        uri = ARTIFACT_SCHEME + cas[len(SCHEME):]
+    _task_lineage(store, uri, type_name, name, version)
+    return uri
+
+
+def _context_store() -> ArtifactStore:
+    from kubeflow_tpu.pipelines.executor import current_task_context
+
+    ctx = current_task_context()
+    if ctx is not None:
+        return ctx.artifacts
+    return artifact_store_from_env()

@@ -19,6 +19,7 @@ import hashlib
 import importlib
 import json
 import logging
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -40,6 +41,26 @@ _OPS: dict[str, Callable[[Any, Any], bool]] = {
     ">": lambda a, b: a > b,
     ">=": lambda a, b: a >= b,
 }
+
+
+@dataclass
+class TaskContext:
+    """What a running component may reach implicitly (KFP gives components
+    Output[Model]/Input[Dataset] handles; here ``publish_model``/
+    ``publish_file`` find the run's store + lineage ids through this)."""
+
+    artifacts: ArtifactStore
+    metadata: MetadataStore
+    execution_id: int
+    context_id: int
+
+
+_TASK_CTX = threading.local()
+
+
+def current_task_context() -> Optional[TaskContext]:
+    """The pipeline task executing on THIS thread, if any."""
+    return getattr(_TASK_CTX, "ctx", None)
 
 
 @dataclass
@@ -270,6 +291,7 @@ class PipelineExecutor:
                 self.metadata.put_event(eid, aid, md.EVENT_INPUT, k)
 
         callable_fn = fn.fn if isinstance(fn, Component) else fn
+        _TASK_CTX.ctx = TaskContext(self.artifacts, self.metadata, eid, ctx)
         try:
             result = callable_fn(**call_args)
         except Exception as exc:
@@ -279,6 +301,8 @@ class PipelineExecutor:
                 phase=RunPhase.FAILED, execution_id=eid,
                 error=f"{type(exc).__name__}: {exc}")
             return
+        finally:
+            _TASK_CTX.ctx = None
 
         out_values = self._split_outputs(comp.outputs, result)
         self._record_io(state, c, eid, ctx, out_values)

@@ -199,6 +199,29 @@ class Client:
             return self.cp.wait_for(run, "Succeeded", timeout=timeout)
         return run
 
+    # -- artifacts (the train→deploy seam) -------------------------------------
+
+    @property
+    def artifacts(self):
+        """The platform artifact store. ``publish_model(ckpt_dir,
+        name=..., store=client.artifacts)`` → an ``artifact://`` uri usable
+        as an InferenceService storageUri or a ``train()`` dataset_uri."""
+        return self.cp.artifact_store
+
+    def publish_model(self, checkpoint_dir: str, *, name=None,
+                      version=None) -> str:
+        from kubeflow_tpu.pipelines.artifacts import publish_model
+
+        return publish_model(checkpoint_dir, name=name, version=version,
+                             store=self.artifacts)
+
+    def publish_file(self, path: str, *, name=None, version=None,
+                     type_name: str = "Dataset") -> str:
+        from kubeflow_tpu.pipelines.artifacts import publish_file
+
+        return publish_file(path, name=name, version=version,
+                            store=self.artifacts, type_name=type_name)
+
     # -- generic ---------------------------------------------------------------
 
     def apply(self, obj: ApiObject) -> ApiObject:

@@ -2,10 +2,12 @@
 the storage-initializer analog ((U) kserve python/kserve/kserve/storage
 downloads s3/gcs/pvc/http into /mnt/models; SURVEY.md §2.3#28).
 
-Hermetic environment: only ``file://`` (an orbax checkpoint directory written
-by the trainer) and ``random://`` (fresh init, for load tests) schemes exist;
-cloud schemes raise with a clear message rather than pretending.
-"""
+Schemes: ``file://`` (an orbax checkpoint directory written by the trainer),
+``artifact://`` (the platform's own object store — a pipeline-published
+model named by digest or name@version, the KFP→storage-initializer seam;
+SURVEY.md §3.4→§3.2), and ``random://`` (fresh init, for load tests).
+Cloud schemes raise with a clear message rather than pretending (hermetic
+environment)."""
 
 from __future__ import annotations
 
@@ -19,29 +21,59 @@ from kubeflow_tpu.models.decoder import Params, init_decoder_params
 
 
 def load_params(storage_uri: Optional[str], cfg: DecoderConfig, *,
-                seed: int = 0) -> Params:
+                seed: int = 0,
+                artifact_root: Optional[str] = None) -> Params:
     """Resolve ``storage_uri`` into a decoder param tree.
 
     file:///path — orbax checkpoint dir (a trainer run's checkpoint_dir);
     restores the latest step's ``params`` subtree, cast per model config.
+    artifact://<digest> | artifact://<name>[@<version>] — a published model
+    tree in the platform artifact store (``artifact_root`` or the
+    control-plane-injected $KFTPU_ARTIFACT_ROOT); materialized
+    content-addressed, so replicas and restarts share one layout.
     random:// or None — fresh random init (benchmarks, smoke tests)."""
     if storage_uri is None or storage_uri.startswith("random://"):
         return init_decoder_params(jax.random.PRNGKey(seed), cfg)
     parsed = urlparse(storage_uri)
     if parsed.scheme == "file":
         return _load_orbax(parsed.path, cfg)
+    if parsed.scheme == "artifact":
+        from kubeflow_tpu.pipelines.artifacts import artifact_store_from_env
+
+        store = artifact_store_from_env(artifact_root)
+        ckpt_dir = store.materialize_tree(store.resolve(storage_uri))
+        return _load_orbax(ckpt_dir, cfg)
     raise ValueError(
         f"unsupported storageUri scheme {parsed.scheme!r} "
-        "(hermetic build: file:// and random:// only)")
+        "(hermetic build: file://, artifact:// and random:// only)")
 
 
 def _load_orbax(path: str, cfg: DecoderConfig) -> Params:
+    """Topology-agnostic restore: a trainer checkpoint carries the SAVING
+    mesh's shardings, and a bare ``restore(step)`` demands those devices
+    exist — a pipeline-trained (8-way CPU mesh) model could never load in a
+    single-chip server. Restoring onto explicit single-device shardings
+    from the checkpoint's own shape/dtype metadata decouples serving
+    topology from training topology (the engine reshards afterwards)."""
     import orbax.checkpoint as ocp
 
-    with ocp.CheckpointManager(path) as mgr:
+    # The explicit handler primes item_metadata (it returns None on a
+    # registry-less manager — no shapes, no cross-topology restore).
+    with ocp.CheckpointManager(
+            path, item_handlers=ocp.StandardCheckpointHandler()) as mgr:
         step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint steps under {path}")
-        state = mgr.restore(step)
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+        def _absify(m):
+            if hasattr(m, "shape") and hasattr(m, "dtype"):
+                return jax.ShapeDtypeStruct(m.shape, m.dtype,
+                                            sharding=sharding)
+            return m          # non-array leaf (restores as saved)
+
+        abstract = jax.tree.map(_absify, mgr.item_metadata(step),
+                                is_leaf=lambda x: hasattr(x, "shape"))
+        state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
     params = state.get("params", state)
     return jax.tree.map(jax.numpy.asarray, params)

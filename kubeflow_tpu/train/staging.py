@@ -6,9 +6,11 @@ starts; SURVEY.md §2.2#22).
 ``stage_inputs`` resolves dataset/tokenizer URIs into the worker's job dir
 before the data pipeline constructs, and can TRAIN a BPE tokenizer from the
 staged dataset when asked (the hermetic counterpart of downloading a
-pretrained tokenizer). URI schemes: ``file://`` and bare paths (the
-platform's storage surface; serve/storage.py handles the serving side the
-same way)."""
+pretrained tokenizer). URI schemes: ``file://``, bare paths, and
+``artifact://`` — a dataset/tokenizer published into the platform artifact
+store (pipelines/artifacts.py), resolved against $KFTPU_ARTIFACT_ROOT the
+way serve/storage.py resolves model storageUris. That closes the
+pipelines→training seam: ``train(dataset_uri="artifact://corpus@1")``."""
 
 from __future__ import annotations
 
@@ -20,9 +22,23 @@ from typing import Optional
 def _resolve(uri: str) -> str:
     if uri.startswith("file://"):
         return uri[len("file://"):]
+    if uri.startswith("artifact://") or uri.startswith("cas://"):
+        from kubeflow_tpu.pipelines.artifacts import artifact_store_from_env
+
+        store = artifact_store_from_env()
+        cas = store.resolve(uri)
+        if not store.exists(cas):
+            raise FileNotFoundError(f"{uri} ({cas}) is not in the store")
+        if store.is_tree(cas):
+            # Reject BEFORE localize: materializing a multi-GB checkpoint
+            # tree just to refuse it would pay the full copy.
+            raise ValueError(
+                f"{uri} is a tree artifact; staging consumes file artifacts "
+                "(publish the dataset/tokenizer with publish_file)")
+        return store.path_for(cas)
     if "://" in uri:
         raise ValueError(f"unsupported staging scheme in {uri!r} "
-                         "(file:// or a bare path)")
+                         "(file://, artifact:// or a bare path)")
     return uri
 
 
