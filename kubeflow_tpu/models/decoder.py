@@ -299,7 +299,8 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
 
     axis_sizes = dict(mesh.shape)
     n_stages = axis_sizes["pipeline"]
-    sp = attn_impl in ("ring", "ulysses") and axis_sizes.get("seq", 1) > 1
+    sp = (attn_impl in ("ring", "ring_flash", "ulysses")
+          and axis_sizes.get("seq", 1) > 1)
     ep = cfg.is_moe and axis_sizes.get("expert", 1) > 1
     tp = axis_sizes.get("model", 1)
     if tp > 1 and cfg.is_moe:
@@ -347,8 +348,8 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
         xs["aux"] = jnp.zeros((x.shape[0], 1), jnp.float32)
         x_specs["aux"] = P(batch_axes or None, None)
 
-    impl = {"ring": "ring_local", "ulysses": "ulysses_local"}.get(
-        attn_impl, attn_impl)
+    impl = {"ring": "ring_local", "ring_flash": "ring_flash_local",
+            "ulysses": "ulysses_local"}.get(attn_impl, attn_impl)
 
     def stage_fn(blocks, xs_mb):
         h = xs_mb["x"]

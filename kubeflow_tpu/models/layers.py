@@ -135,15 +135,18 @@ def attention_block(
         else:
             # Decode with a traced cache offset: the masked XLA path (the
             # pallas kernel needs a static q_offset).
-            impl = "xla" if attn_impl in ("pallas", "ring", "ulysses") \
-                else attn_impl
+            impl = "xla" if attn_impl in ("pallas", "ring", "ring_flash",
+                                          "ulysses") else attn_impl
             out = multi_head_attention(
                 q, ck, cv, causal=True, q_offset=start, impl=impl,
             )
-    elif attn_impl in ("ring", "ulysses"):
+    elif attn_impl in ("ring", "ring_flash", "ulysses"):
         # Sequence-parallel attention over the mesh 'seq' axis (SURVEY.md
         # §2.6 SP/CP rows). Degenerates to XLA attention when the mesh has
         # no seq sharding (keeps tiny/test configs running unchanged).
+        # "ring" resolves its inner block impl by backend (flash kernels on
+        # TPU); "ring_flash" forces the kernels (interpret off-TPU) — the
+        # dryrun's way of exercising the kernel ring without chips.
         if mesh is None or dict(mesh.shape).get("seq", 1) == 1:
             out = multi_head_attention(q, k, v, causal=True, impl="xla")
         else:
@@ -151,19 +154,25 @@ def attention_block(
                 ring_attention_sharded, ulysses_attention_sharded,
             )
 
-            fn = (ring_attention_sharded if attn_impl == "ring"
-                  else ulysses_attention_sharded)
-            out = fn(q, k, v, mesh, causal=True)
-    elif attn_impl in ("ring_local", "ulysses_local"):
+            if attn_impl == "ulysses":
+                out = ulysses_attention_sharded(q, k, v, mesh, causal=True)
+            else:
+                out = ring_attention_sharded(
+                    q, k, v, mesh, causal=True,
+                    impl="pallas" if attn_impl == "ring_flash" else "auto")
+    elif attn_impl in ("ring_local", "ring_flash_local", "ulysses_local"):
         # Already inside shard_map with Q/K/V sharded on dim 1 over 'seq'
         # (the pipeline×SP composition): call the collective form directly.
         from kubeflow_tpu.parallel.ring_attention import (
             ring_attention, ulysses_attention,
         )
 
-        fn = (ring_attention if attn_impl == "ring_local"
-              else ulysses_attention)
-        out = fn(q, k, v, causal=True)
+        if attn_impl == "ulysses_local":
+            out = ulysses_attention(q, k, v, causal=True)
+        else:
+            out = ring_attention(
+                q, k, v, causal=True,
+                impl="pallas" if attn_impl == "ring_flash_local" else "auto")
     elif attn_impl == "pallas" and mesh is not None and mesh.size > 1:
         # Mosaic kernels can't be GSPMD-auto-partitioned: run the flash
         # kernel per-shard via shard_map (block-diagonal over batch/heads);

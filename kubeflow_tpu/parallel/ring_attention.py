@@ -15,9 +15,22 @@ absent'). Here it is first-class (§2.6 rows SP/CP/ring/Ulysses):
   impl, incl. the Pallas flash kernel), and swaps back — cheaper at moderate
   S when heads ≥ ring size.
 
-Both are plain differentiable JAX (scan/ppermute/all_to_all have transposes),
-so the same code path serves training and inference. Call them inside
-``shard_map`` (the model does), or use the ``*_sharded`` wrappers.
+The ring's per-step block math has two impls: ``impl="pallas"`` runs the
+tuned flash kernels per KV shard (the S=2048-headline retune — bf16 MXU
+inputs, fp32 softmax stats — applied at ring scale, where long-context
+actually lives) under a hand-written custom_vjp whose backward is a second
+ring rotating dK/dV accumulators with the KV shards; ``impl="xla"`` keeps
+the einsum/scan online-softmax as the anywhere-runnable numerics oracle.
+The traced ring offset never reaches a kernel: for causal attention the
+(q_shard, kv_shard) relation is one of three STATIC cases — fully visible
+(past shards), the causal diagonal, fully masked (future) — picked by
+``lax.switch``, so each branch calls the kernel with a static causal flag
+and q_offset=0, and the masked branch skips the matmul entirely.
+
+Both schedules are differentiable (the XLA path by construction —
+scan/ppermute/all_to_all have transposes — and the Pallas path via its
+custom ring VJP), so the same code serves training and inference. Call them
+inside ``shard_map`` (the model does), or use the ``*_sharded`` wrappers.
 """
 
 from __future__ import annotations
@@ -63,6 +76,154 @@ def _block_attn_step(q, k, v, m, l, acc, *, q_start, kv_start, causal,
     return m_new, l_new, acc_new
 
 
+def _ring_merge(o_acc, lse_acc, o_t, lse_t):
+    """Merge a new normalized partial (o_t, lse_t) into the running one.
+
+    Both partials are softmax-normalized over their own key sets; the
+    unnormalized sums are exp(lse)·o, so the merge is the usual max-rescaled
+    combine. A fully-masked partial carries lse = NEG_INF and contributes
+    exp(NEG_INF − m) = 0; when BOTH sides are masked the denominator is 2
+    with zero numerators — still exact zeros, no special case."""
+    m = jnp.maximum(lse_acc, lse_t)
+    a = jnp.exp(lse_acc - m)                       # [B,H,Sq]
+    b = jnp.exp(lse_t - m)
+    denom = a + b
+    o_new = (a[..., None] * o_acc
+             + b[..., None] * o_t.astype(jnp.float32)) / denom[..., None]
+    return o_new, m + jnp.log(denom)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, causal, sm_scale, softcap, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                  softcap, interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, softcap,
+                         interpret):
+    from kubeflow_tpu.ops.flash_attention import _flash_fwd
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    qt = jnp.swapaxes(q, 1, 2)                     # [B,H,Sq,D]
+    kt = jnp.swapaxes(k, 1, 2)                     # [B,KH,Skv,D] (raw GQA)
+    vt = jnp.swapaxes(v, 1, 2)
+    b, h, sq, d = qt.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def visible(args):                             # past shard: no mask
+        k_c, v_c = args
+        return _flash_fwd(qt, k_c, v_c, causal=False, sm_scale=sm_scale,
+                          softcap=softcap, q_offset=0, block_q=None,
+                          block_kv=None, interpret=interpret)
+
+    def diagonal(args):                            # own shard: square causal
+        k_c, v_c = args
+        return _flash_fwd(qt, k_c, v_c, causal=True, sm_scale=sm_scale,
+                          softcap=softcap, q_offset=0, block_q=None,
+                          block_kv=None, interpret=interpret)
+
+    def masked(args):                              # future shard: skip
+        return (jnp.zeros((b, h, sq, d), qt.dtype),
+                jnp.full((b, h, sq), NEG_INF, jnp.float32))
+
+    def step(carry, t):
+        k_c, v_c, o_acc, lse_acc = carry
+        shard = (idx - t) % n
+        if causal:
+            case = jnp.where(shard == idx, 1, jnp.where(shard < idx, 0, 2))
+            o_t, lse_t = jax.lax.switch(case, [visible, diagonal, masked],
+                                        (k_c, v_c))
+        else:
+            o_t, lse_t = visible((k_c, v_c))
+        o_acc, lse_acc = _ring_merge(o_acc, lse_acc, o_t, lse_t)
+        k_nxt = jax.lax.ppermute(k_c, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_nxt, v_nxt, o_acc, lse_acc), None
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    (_, _, o_acc, lse), _ = jax.lax.scan(step, (kt, vt, o0, lse0),
+                                         jnp.arange(n))
+    return jnp.swapaxes(o_acc.astype(q.dtype), 1, 2), lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, sm_scale, softcap,
+                        interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                    softcap, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, softcap, interpret,
+                        res, do):
+    """The backward ring: dK/dV accumulators travel WITH their KV shard (n
+    rotations return both to the home device), dQ accumulates locally. Each
+    step calls the flash backward kernels with the GLOBAL lse/delta, which
+    makes per-shard contributions exact — the same property that lets the
+    single-chip VJP be one recompute sweep."""
+    from kubeflow_tpu.ops.flash_attention import _flash_bwd_pallas
+
+    q, k, v, out, lse = res
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    ot = jnp.swapaxes(out, 1, 2)
+    dot_ = jnp.swapaxes(do, 1, 2)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def grads(k_c, v_c, diag):
+        return _flash_bwd_pallas(
+            qt, k_c, v_c, ot, lse, dot_, causal=diag, sm_scale=sm_scale,
+            softcap=softcap, q_offset=0, block_q=None, block_kv=None,
+            interpret=interpret)
+
+    def visible(args):
+        return grads(args[0], args[1], False)
+
+    def diagonal(args):
+        return grads(args[0], args[1], True)
+
+    def masked(args):
+        k_c, v_c = args
+        return (jnp.zeros_like(qt), jnp.zeros_like(k_c),
+                jnp.zeros_like(v_c))
+
+    def step(carry, t):
+        k_c, v_c, dk_c, dv_c, dq_acc = carry
+        shard = (idx - t) % n
+        if causal:
+            case = jnp.where(shard == idx, 1, jnp.where(shard < idx, 0, 2))
+            dq_t, dk_t, dv_t = jax.lax.switch(
+                case, [visible, diagonal, masked], (k_c, v_c))
+        else:
+            dq_t, dk_t, dv_t = visible((k_c, v_c))
+        dq_acc = dq_acc + dq_t.astype(jnp.float32)
+        dk_c = dk_c + dk_t.astype(jnp.float32)
+        dv_c = dv_c + dv_t.astype(jnp.float32)
+        # Rotate the shard and its gradient accumulator together; fp32
+        # accumulators double the backward's ring traffic vs the bf16 KV —
+        # the price of exact accumulation across n partial sums.
+        k_c, v_c, dk_c, dv_c = (jax.lax.ppermute(x, axis_name, perm)
+                                for x in (k_c, v_c, dk_c, dv_c))
+        return (k_c, v_c, dk_c, dv_c, dq_acc), None
+
+    dk0 = jnp.zeros(kt.shape, jnp.float32)
+    dv0 = jnp.zeros(vt.shape, jnp.float32)
+    dq0 = jnp.zeros(qt.shape, jnp.float32)
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        step, (kt, vt, dk0, dv0, dq0), jnp.arange(n))
+    return (jnp.swapaxes(dq, 1, 2).astype(q.dtype),
+            jnp.swapaxes(dk, 1, 2).astype(k.dtype),
+            jnp.swapaxes(dv, 1, 2).astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def ring_attention(
     q: jax.Array,                     # [B, S_local, H, D] (seq shard)
     k: jax.Array,                     # [B, S_local, K, D]
@@ -72,9 +233,23 @@ def ring_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     logits_softcap: Optional[float] = None,
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention over the full (ring-distributed) sequence. Must run
-    inside shard_map with q/k/v sharded on dim 1 over ``axis_name``."""
+    inside shard_map with q/k/v sharded on dim 1 over ``axis_name``.
+
+    ``impl``: "pallas" runs the tuned flash kernels per KV shard (custom
+    ring VJP); "xla" is the einsum/scan oracle; "auto" picks pallas on TPU.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+        return _ring_flash(q, k, v, axis_name, causal, scale,
+                           logits_softcap, interpret)
+    if impl != "xla":
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -159,12 +334,14 @@ def ring_attention_sharded(
     axis_name: str = "seq", batch_axes=("dcn", "data", "fsdp"),
     causal: bool = True, sm_scale: Optional[float] = None,
     logits_softcap: Optional[float] = None,
+    impl: str = "auto", interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Convenience wrapper: applies shard_map over the mesh (batch sharded on
     the data axes, sequence on ``axis_name``)."""
     batch = tuple(a for a in batch_axes if a in mesh.axis_names)
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal,
-                           sm_scale=sm_scale, logits_softcap=logits_softcap)
+                           sm_scale=sm_scale, logits_softcap=logits_softcap,
+                           impl=impl, interpret=interpret)
     return _sharded(fn, mesh, axis_name, batch)(q, k, v)
 
 

@@ -48,6 +48,14 @@ _TREE_KEY = "kftpu_tree"       # manifest sentinel (see _manifest_of)
 _MARKER = ".complete"          # materialization commit marker
 
 
+def _version_key(v: str):
+    """Dotted-numeric versions sort numerically, others lexically after."""
+    try:
+        return (0, tuple(int(p) for p in v.split(".")), "")
+    except ValueError:
+        return (1, (), v)
+
+
 class ArtifactStore:
     def __init__(self, root: str):
         self.root = root
@@ -134,14 +142,15 @@ class ArtifactStore:
 
     def _manifest_of(self, uri: str) -> Optional[dict[str, str]]:
         """The tree manifest, or None for non-tree artifacts. Raw blobs are
-        untagged, so tree-ness requires the full contract — "T" prefix AND
+        untagged, so tree-ness requires the full contract — "T{" prefix AND
         a JSON object holding exactly the sentinel key. A text file that
-        merely starts with "T" fails the parse; a file that IS byte-equal
-        to a manifest has the manifest's digest and behaves identically by
-        CAS construction."""
+        merely starts with "T" fails the two-byte check without reading the
+        body (a multi-GB corpus must not be slurped just to say "not a
+        tree"); a file that IS byte-equal to a manifest has the manifest's
+        digest and behaves identically by CAS construction."""
         with open(self.path_for(uri), "rb") as f:
             head = f.read(2)
-            if head[:1] != b"T":
+            if head != b"T{":
                 return None
             data = head[1:] + f.read()
         try:
@@ -188,7 +197,12 @@ class ArtifactStore:
             except OSError:
                 import shutil
 
-                fd, tmp = tempfile.mkstemp(dir=os.path.dirname(out))
+                # tmp lives OUTSIDE dest: a killed copy must not leave a
+                # stray inside a directory the marker later commits as a
+                # complete checkpoint.
+                staging = os.path.join(self.root, ".tmp")
+                os.makedirs(staging, exist_ok=True)
+                fd, tmp = tempfile.mkstemp(dir=staging)
                 os.close(fd)
                 shutil.copyfile(blob, tmp)
                 os.replace(tmp, out)
@@ -212,25 +226,39 @@ class ArtifactStore:
                                     "is not in the store")
         entry = os.path.join(self.root, "named", name, version)
         os.makedirs(os.path.dirname(entry), exist_ok=True)
+        # Write-then-link keeps first-writer-wins atomic across processes
+        # AND crash-safe: the entry appears fully written or not at all (an
+        # O_EXCL-create-then-write window would let a crash bind the
+        # version to an empty string forever, unrepairable under the
+        # immutability rule). A concurrent same-version register with
+        # different content must LOSE loudly, not silently flip what a
+        # deployed storageUri resolves to.
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(entry))
         try:
-            # O_EXCL makes first-writer-wins atomic across processes that
-            # share the root — a concurrent same-version register with
-            # different content must LOSE loudly, not silently flip what a
-            # deployed storageUri resolves to.
-            fd = os.open(entry, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
-        except FileExistsError:
-            with open(entry) as f:
-                existing = f.read().strip()
-            if existing != uri:
-                raise ValueError(
-                    f"{name}@{version} is already bound to {existing}; "
-                    "versions are immutable, register a new one") from None
-            return f"{ARTIFACT_SCHEME}{name}@{version}"
-        with os.fdopen(fd, "w") as f:
-            f.write(uri)
+            with os.fdopen(fd, "w") as f:
+                f.write(uri)
+            try:
+                os.link(tmp, entry)
+            except FileExistsError:
+                with open(entry) as f:
+                    existing = f.read().strip()
+                if existing != uri:
+                    raise ValueError(
+                        f"{name}@{version} is already bound to {existing}; "
+                        "versions are immutable, register a new one") from None
+        finally:
+            os.unlink(tmp)
         return f"{ARTIFACT_SCHEME}{name}@{version}"
 
     def versions(self, name: str) -> list[str]:
+        """Registered versions of ``name``, ascending by version ORDER:
+        dotted-numeric versions compare numerically ("10" after "9",
+        "1.10" after "1.9"), non-numeric ones lexicographically after all
+        numeric ones — deterministic regardless of filesystem timestamp
+        granularity (mtime ordering silently served the OLDER model when
+        two registrations landed in one mtime quantum)."""
+        if not _NAME_OK.match(name):
+            raise ValueError(f"bad artifact name {name!r}")
         d = os.path.join(self.root, "named", name)
         try:
             entries = [v for v in os.listdir(d)
@@ -238,18 +266,22 @@ class ArtifactStore:
                        and os.path.isfile(os.path.join(d, v))]
         except FileNotFoundError:
             return []
-        # Registration order (mtime), name tiebreak: "latest" means newest
-        # registered, not lexicographically largest ("10" vs "9").
-        return sorted(entries,
-                      key=lambda v: (os.path.getmtime(os.path.join(d, v)), v))
+        return sorted(entries, key=_version_key)
 
     def lookup(self, name: str, version: Optional[str] = None) -> str:
-        """name[@version] → cas:// uri (latest registered when no version)."""
+        """name[@version] → cas:// uri (highest version when none given)."""
+        if not _NAME_OK.match(name):
+            # Also the path-traversal gate: storage_uri / dataset_uri are
+            # user-facing and flow straight here — a name like "../.." or
+            # "/etc" must never reach os.path.join.
+            raise ValueError(f"bad artifact name {name!r}")
         if version is None:
             all_v = self.versions(name)
             if not all_v:
                 raise FileNotFoundError(f"no registered artifact {name!r}")
             version = all_v[-1]
+        elif not _NAME_OK.match(version):
+            raise ValueError(f"bad artifact version {version!r}")
         entry = os.path.join(self.root, "named", name, version)
         try:
             with open(entry) as f:

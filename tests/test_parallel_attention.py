@@ -166,6 +166,66 @@ class TestRingAttention:
             rel_close(a, b, rtol=5e-4)
 
 
+class TestRingFlash:
+    """The flash-kernel ring (VERDICT r3 #2): ring(impl="pallas") must equal
+    the single-device oracle — forward AND gradients — at ≥2 shard counts,
+    with GQA, softcap, and the non-causal path."""
+
+    def _mesh(self, n):
+        return Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+    @pytest.mark.parametrize("nshard", [2, 4])
+    def test_forward_matches_oracle(self, nshard):
+        from kubeflow_tpu.parallel.ring_attention import ring_attention_sharded
+
+        q, k, v = qkv(S=128, H=4, K=2)           # GQA n_rep=2
+        ref = multi_head_attention(q, k, v, causal=True)
+        out = ring_attention_sharded(q, k, v, self._mesh(nshard),
+                                     impl="pallas", interpret=True)
+        rel_close(ref, out)
+
+    @pytest.mark.parametrize("nshard", [2, 4])
+    def test_gradients_match_oracle(self, nshard):
+        from kubeflow_tpu.parallel.ring_attention import ring_attention_sharded
+
+        q, k, v = qkv(S=128, H=4, K=2)
+        mesh = self._mesh(nshard)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(multi_head_attention(q, k, v, causal=True) ** 2)
+
+        def ring_loss(q, k, v):
+            return jnp.sum(ring_attention_sharded(
+                q, k, v, mesh, impl="pallas", interpret=True) ** 2)
+
+        g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_ring):
+            rel_close(a, b, rtol=5e-4)
+
+    def test_non_causal_and_softcap(self, seq_mesh):
+        from kubeflow_tpu.parallel.ring_attention import ring_attention_sharded
+
+        q, k, v = qkv(S=64)
+        ref = multi_head_attention(q, k, v, causal=False, logits_softcap=15.0)
+        out = ring_attention_sharded(q, k, v, seq_mesh, causal=False,
+                                     logits_softcap=15.0,
+                                     impl="pallas", interpret=True)
+        rel_close(ref, out)
+
+    def test_matches_xla_ring(self, seq_mesh):
+        # Kernel ring vs oracle ring on the same mesh — the seam the rest
+        # of the suite leans on when impl="auto" resolves differently by
+        # backend.
+        from kubeflow_tpu.parallel.ring_attention import ring_attention_sharded
+
+        q, k, v = qkv(S=128)
+        a = ring_attention_sharded(q, k, v, seq_mesh, impl="xla")
+        b = ring_attention_sharded(q, k, v, seq_mesh,
+                                   impl="pallas", interpret=True)
+        rel_close(a, b)
+
+
 class TestUlysses:
     def test_matches_full_attention(self, seq_mesh):
         from kubeflow_tpu.parallel.ring_attention import \
@@ -191,7 +251,7 @@ class TestModelSeqParallel:
     match the unsharded XLA forward — the SURVEY.md §4 sharded-vs-unsharded
     equivalence family at the model level."""
 
-    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    @pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
     def test_decoder_loss_matches_xla(self, impl):
         from kubeflow_tpu.models.config import preset
         from kubeflow_tpu.models.decoder import (
