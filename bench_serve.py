@@ -205,10 +205,86 @@ def run_bench(workload: str, requests: int, concurrency: int,
     }
 
 
+def run_moe_ab(requests: int, concurrency: int, prompt_len: int,
+               max_new: int, only: str = "all") -> list[dict]:
+    """Mixtral-0.8b served A/B (VERDICT r3 #3): dense oracle vs the
+    dispatch prefill (k/E of dense MLP FLOPs on the TTFT-dominating pass)
+    vs zero-drop dispatch decode — same engine pool, same warmed two-
+    segment methodology. Prefill-heavy workload (long prompts, short
+    generations) so the prefill impl is what the req/s measures."""
+    import jax
+
+    from kubeflow_tpu.core.serving import BatchingSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.serve.engine import (
+        EngineMetrics, LLMEngine, SamplingParams,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = preset(
+            "mixtral-8x7b",
+            n_layers=8, hidden=1024, n_heads=16, n_kv_heads=4, head_dim=64,
+            mlp_dim=3584, vocab_size=32000, max_seq_len=2048)
+        model_tag = "mixtral-0.8b-8e-top2"
+    else:
+        cfg = preset("tiny-moe")
+        model_tag = "tiny-moe"
+        prompt_len = min(prompt_len, 64)
+    cap = cfg.max_seq_len - max_new - 1
+    prompt_len = min(prompt_len, cap)
+    slots = min(16, concurrency)
+    rng = np.random.default_rng(0)
+    params = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+
+    variants = [
+        ("dense", {"moe_prefill_impl": "dense", "moe_decode_impl": "dense"}),
+        ("dispatch_prefill", {"moe_prefill_impl": "dispatch",
+                              "moe_decode_impl": "dense"}),
+        ("dispatch_prefill+zd_decode", {"moe_prefill_impl": "dispatch",
+                                        "moe_decode_impl": "zero_drop"}),
+    ]
+    if only != "all":
+        variants = [vk for vk in variants if vk[0] == only]
+    rows = []
+    for tag, knobs in variants:
+        engine = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=slots, max_seq_len=cfg.max_seq_len,
+            prefill_buckets=[prompt_len],
+            weights_dtype="bfloat16" if on_tpu else None, **knobs))
+        engine.start()
+        warm = [rng.integers(1, cfg.vocab_size, size=prompt_len).tolist()
+                for _ in range(2 * slots)]
+        _drive(engine, warm, params, concurrency)
+        engine.metrics = EngineMetrics()
+        segs = []
+        for _ in range(2):
+            prompts = [rng.integers(1, cfg.vocab_size,
+                                    size=prompt_len).tolist()
+                       for _ in range(requests)]
+            wall, results = _drive(engine, prompts, params, concurrency)
+            segs.append(_summarize(wall, results))
+        engine.stop()
+        vals = [s["req_s"] for s in segs]
+        rows.append({
+            "metric": f"serve_moe_req_per_sec[{model_tag},{tag},"
+                      f"p{prompt_len},gen{max_new},c{concurrency}]",
+            "value": round(sum(vals) / len(vals), 2),
+            "unit": "req/s",
+            "vs_baseline": 1.0,
+            "detail": {"segments": segs,
+                       "spread_pct": round(
+                           100 * abs(vals[0] - vals[1]) / max(vals), 1),
+                       "slots": slots,
+                       "requests_per_segment": requests},
+        })
+    return rows
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="uniform",
-                    choices=["uniform", "mixed", "prefix", "all"])
+                    choices=["uniform", "mixed", "prefix", "all", "moe"])
     ap.add_argument("--requests", type=int, default=48,
                     help="per measured segment (two segments run)")
     ap.add_argument("--concurrency", type=int, default=16)
@@ -216,7 +292,20 @@ if __name__ == "__main__":
     ap.add_argument("--max-new", type=int, default=64)
     ap.add_argument("--paged", action="store_true",
                     help="paged KV cache + prefix caching engine")
+    ap.add_argument("--moe-variant", default="all",
+                    choices=["all", "dense", "dispatch_prefill",
+                             "dispatch_prefill+zd_decode"],
+                    help="moe workload: run one variant per process to fit "
+                         "tunnel-compile time budgets (cross-process "
+                         "comparisons carry session noise — prefer one "
+                         "process for the A/B)")
     args = ap.parse_args()
+    if args.workload == "moe":
+        for row in run_moe_ab(args.requests, args.concurrency,
+                              args.prompt_len, args.max_new,
+                              only=args.moe_variant):
+            print(json.dumps(row), flush=True)
+        raise SystemExit(0)
     wls = (["uniform", "mixed", "prefix"] if args.workload == "all"
            else [args.workload])
     for wl in wls:

@@ -103,7 +103,8 @@ def decoder_param_specs(cfg: DecoderConfig) -> Params:
 def _block_forward(block_params, x, positions, cfg: DecoderConfig,
                    kv_cache=None, attn_impl="xla", mesh=None,
                    rules=DEFAULT_RULES, prefill=False,
-                   expert_axis=None, seq_axis=None, tp_axis=None):
+                   expert_axis=None, seq_axis=None, tp_axis=None,
+                   valid_len=None):
     h = L.rmsnorm(x, block_params["ln1"], cfg)
     attn_out, new_cache = L.attention_block(
         block_params["attn"], h, positions, cfg,
@@ -113,7 +114,8 @@ def _block_forward(block_params, x, positions, cfg: DecoderConfig,
     h = L.rmsnorm(x, block_params["ln2"], cfg)
     if cfg.is_moe:
         mlp_out, aux = L.moe_block(block_params["mlp"], h, cfg,
-                                   expert_axis=expert_axis, seq_axis=seq_axis)
+                                   expert_axis=expert_axis, seq_axis=seq_axis,
+                                   valid_len=valid_len)
     else:
         mlp_out, aux = (L.mlp_block(block_params["mlp"], h, cfg,
                                     tp_axis=tp_axis), jnp.float32(0))
@@ -162,10 +164,14 @@ def decoder_forward(
     mesh=None,
     rules: LogicalRules = DEFAULT_RULES,
     skip_head: bool = False,
+    valid_len: Optional[jax.Array] = None,
 ):
     """Returns (logits [B,S,V] float32, new_kv_caches|None, aux_loss).
     With ``skip_head``, returns the final-norm hidden states [B,S,D] instead
-    of logits (the chunked-CE loss applies the head blockwise)."""
+    of logits (the chunked-CE loss applies the head blockwise).
+    ``valid_len`` (traced scalar or [B]): marks trailing positions as
+    padding for the MoE dispatch path (serving prefill buckets) — see
+    layers.moe_block."""
     custom_positions = positions is not None
     if positions is None:
         # Decode with a cache: absolute positions continue from the cache
@@ -216,7 +222,7 @@ def decoder_forward(
             out, new_cache, aux = _block_forward(
                 block_params, x, positions, cfg,
                 kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules,
-                prefill=prefill)
+                prefill=prefill, valid_len=valid_len)
             return out, (new_cache, aux)
 
         body = _remat(scan_body, cfg.remat_policy)
@@ -245,7 +251,7 @@ def decoder_forward(
             lambda bp, x, cache: _block_forward(
                 bp, x, positions, cfg,
                 kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules,
-                prefill=prefill),
+                prefill=prefill, valid_len=valid_len),
             cfg.remat_policy)
         for i, block_params in enumerate(params["layers"]):
             cache = None

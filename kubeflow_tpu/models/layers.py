@@ -246,16 +246,24 @@ def init_moe(key, cfg: DecoderConfig):
 
 def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig,
               expert_axis: Optional[str] = None,
-              seq_axis: Optional[str] = None):
+              seq_axis: Optional[str] = None,
+              valid_len: Optional[jax.Array] = None):
     """Top-k MoE (Mixtral semantics: softmax over the selected k logits).
 
     Dispatches on ``cfg.moe_impl``: "dispatch" (default) routes tokens into
     per-expert capacity buffers so only selected experts compute — k/E of
     the dense FLOPs; "dense" is the drop-free every-expert oracle the
-    dispatch path is equivalence-tested against. Returns (out, aux_loss)."""
+    dispatch path is equivalence-tested against. Returns (out, aux_loss).
+
+    ``valid_len`` (scalar or [B], traced OK): positions >= it are padding
+    whose router choices must not claim expert capacity — the serving
+    prefill pads prompts to a bucket, and without the mask hundreds of
+    identical pad tokens would displace real tokens' choices under
+    choice-major priority. Dense ignores it (every expert computes every
+    token, pads can't affect real rows)."""
     if cfg.moe_impl == "dispatch":
         return _moe_dispatch(p, x, cfg, expert_axis=expert_axis,
-                             seq_axis=seq_axis)
+                             seq_axis=seq_axis, valid_len=valid_len)
     if cfg.moe_impl != "dense":
         raise ValueError(f"unknown moe_impl {cfg.moe_impl!r}")
     return _moe_dense(p, x, cfg, expert_axis=expert_axis, seq_axis=seq_axis)
@@ -286,7 +294,8 @@ def moe_capacity(cfg: DecoderConfig, tokens: int) -> int:
 
 def _moe_dispatch(p: dict, x: jax.Array, cfg: DecoderConfig,
                   expert_axis: Optional[str] = None,
-                  seq_axis: Optional[str] = None):
+                  seq_axis: Optional[str] = None,
+                  valid_len: Optional[jax.Array] = None):
     """Capacity-factor top-k dispatch (SURVEY.md §2.6 EP row: the TPU-native
     MoE data path; (U) training-operator-era Mixtral recipes route via NCCL
     all-to-all — here the routing is scatter/gather into static [E, C]
@@ -326,9 +335,21 @@ def _moe_dispatch(p: dict, x: jax.Array, cfg: DecoderConfig,
     # Choice-major flattening: row r = (choice r // T) of token (r % T).
     flat_e = topk_idx.T.reshape(-1)                                  # [kT]
     oh = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)                  # [kT,E]
+    valid_flat = None
+    if valid_len is not None:
+        # Padding rows claim no capacity (zeroed before the cumsum) and
+        # are dropped outright (below) — and they vanish from the balance
+        # loss, which otherwise reads a bucket of identical pads as a
+        # catastrophically unbalanced router.
+        vl = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(valid_len)), (b,))
+        valid = (jnp.arange(s)[None, :] < vl[:, None]).reshape(t)
+        valid_flat = jnp.tile(valid, k)
+        oh = oh * valid_flat[:, None].astype(oh.dtype)
     pos = jnp.cumsum(oh, axis=0) - 1
     pos_in_e = jnp.take_along_axis(pos, flat_e[:, None], 1)[:, 0]    # [kT]
     keep = pos_in_e < c
+    if valid_flat is not None:
+        keep = keep & valid_flat
 
     e_local, offset = e, 0
     if expert_axis is not None:

@@ -233,7 +233,9 @@ class ArtifactStore:
         # immutability rule). A concurrent same-version register with
         # different content must LOSE loudly, not silently flip what a
         # deployed storageUri resolves to.
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(entry))
+        # Dot-prefixed temp: a crash must not leave a file versions() would
+        # list as a phantom "latest".
+        fd, tmp = tempfile.mkstemp(prefix=".reg-", dir=os.path.dirname(entry))
         try:
             with os.fdopen(fd, "w") as f:
                 f.write(uri)
@@ -246,8 +248,16 @@ class ArtifactStore:
                     raise ValueError(
                         f"{name}@{version} is already bound to {existing}; "
                         "versions are immutable, register a new one") from None
+            except OSError:
+                # Filesystems that refuse hardlinks (materialize_tree's
+                # copy-fallback case): os.replace keeps publishes atomic
+                # (never a partial entry) at the cost of last-writer-wins
+                # on a same-instant conflicting register.
+                os.replace(tmp, entry)
+                tmp = None
         finally:
-            os.unlink(tmp)
+            if tmp is not None:
+                os.unlink(tmp)
         return f"{ARTIFACT_SCHEME}{name}@{version}"
 
     def versions(self, name: str) -> list[str]:

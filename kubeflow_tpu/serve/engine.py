@@ -241,7 +241,8 @@ def _decode_multi(params: Params, cache: dict, tokens: jax.Array,
 
 def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
                         slot: jax.Array, start: jax.Array,
-                        cfg: DecoderConfig):
+                        cfg: DecoderConfig,
+                        valid_len: Optional[jax.Array] = None):
     """Prefill ONE chunk of a prompt into slot ``slot`` at position ``start``.
 
     Chunked prefill (SURVEY.md §5 long-context serving): long prompts are
@@ -253,7 +254,8 @@ def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
     ck = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1)
     cv = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1)
     caches = {"k": ck, "v": cv, "len": start}
-    logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=caches)
+    logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=caches,
+                                        valid_len=valid_len)
     nk = jax.lax.dynamic_update_slice_in_dim(cache["k"], filled["k"], slot,
                                              axis=1)
     nv = jax.lax.dynamic_update_slice_in_dim(cache["v"], filled["v"], slot,
@@ -281,7 +283,8 @@ def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
         "prefill": True,
     }
     logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=scratch,
-                                        attn_impl=attn_impl, mesh=mesh)
+                                        attn_impl=attn_impl, mesh=mesh,
+                                        valid_len=length)
     bucket = tokens.shape[1]
     ck = jax.lax.dynamic_update_slice(
         cache["k"], filled["k"], (0, slot, 0, 0, 0))
@@ -401,16 +404,45 @@ class LLMEngine:
     def __init__(self, cfg: DecoderConfig, batching: Optional[BatchingSpec] = None,
                  *, params: Optional[Params] = None, seed: int = 0,
                  mesh: Optional[Mesh] = None):
-        if cfg.is_moe and cfg.moe_impl == "dispatch":
-            # Serving must be drop-free AND batch-independent: a request's
-            # tokens must not change because co-batched traffic filled an
-            # expert's capacity buffer. The dense formulation guarantees
-            # both (drop-free capacity costs the same E/k FLOPs anyway; a
-            # dropless ragged grouped-GEMM is the future fast path).
-            cfg = dataclasses.replace(cfg, moe_impl="dense")
         self.cfg = cfg
         self.batching = batching or BatchingSpec()
         b = self.batching
+        # Serving MoE must be batch-independent: a request's tokens must not
+        # change because co-batched traffic filled an expert's capacity
+        # buffer. Two phases, two resolutions (VERDICT r3 #3):
+        # - PREFILL runs per-request on a [1, bucket] block, so capacity
+        #   drops are a function of that request alone — the training
+        #   dispatch path applies as-is and WINS the on-chip serving A/B
+        #   (7.0 vs 6.5 req/s, p50 TTFT -15% at mixtral-0.8b p1024).
+        # - DECODE co-batches slots; dispatch is only batch-independent at
+        #   zero-drop capacity (C = k*T). The same A/B measured it a tie
+        #   within session noise, so dense (simpler, drop-free by
+        #   construction) stays the default (bench_serve.py --workload moe).
+        cfg_prefill, cfg_decode = cfg, cfg
+        if cfg.is_moe:
+            pre = b.moe_prefill_impl
+            if pre == "auto":
+                pre = cfg.moe_impl          # the model's training-time path
+            if pre not in ("dispatch", "dense"):
+                raise ValueError(
+                    f"unknown moe_prefill_impl {b.moe_prefill_impl!r}")
+            cfg_prefill = dataclasses.replace(cfg, moe_impl=pre)
+            dec = b.moe_decode_impl
+            if dec == "auto":
+                dec = "dense"
+            if dec == "zero_drop":
+                # cf = E caps capacity at k*T: nothing can ever drop, so
+                # outputs are exactly the dense oracle's (tested) while the
+                # buffers stay dispatch-shaped for the A/B.
+                cfg_decode = dataclasses.replace(
+                    cfg, moe_impl="dispatch",
+                    capacity_factor=float(cfg.num_experts))
+            elif dec == "dense":
+                cfg_decode = dataclasses.replace(cfg, moe_impl="dense")
+            else:
+                raise ValueError(
+                    f"unknown moe_decode_impl {b.moe_decode_impl!r}")
+        self._cfg_prefill, self._cfg_decode = cfg_prefill, cfg_decode
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         if b.max_seq_len > cfg.max_seq_len:
             raise ValueError("batching.max_seq_len exceeds model max_seq_len")
@@ -507,7 +539,7 @@ class LLMEngine:
                 # Flash kernel needs the bucket to divide its 128 block.
                 impl = ("pallas" if on_tpu and t.shape[1] >= 2048
                         and t.shape[1] % 128 == 0 else "xla")
-            out, cache = _prefill_step(p, c, t, s, ln, cfg, impl,
+            out, cache = _prefill_step(p, c, t, s, ln, cfg_prefill, impl,
                                        mesh=self.mesh)
             return out, self._pin(cache)
 
@@ -522,8 +554,9 @@ class LLMEngine:
                            or self.chunk_size % self.page_size):
             self.chunk_size = self.page_size
         self._prefill_chunk = jax.jit(
-            lambda p, c, t, s, st: _pin2(
-                _chunk_prefill_step(p, c, t, s, st, cfg), self._pin),
+            lambda p, c, t, s, st, vl: _pin2(
+                _chunk_prefill_step(p, c, t, s, st, cfg_prefill, vl),
+                self._pin),
             donate_argnums=(1,))
         self._chunkings: list[_Chunking] = []
         self.max_concurrent_prefills = max(1, int(b.max_concurrent_prefills))
@@ -542,14 +575,15 @@ class LLMEngine:
                     f"unknown paged_attn_impl {b.paged_attn_impl!r}; "
                     "one of auto|gather|pallas")
             self._paged_chunk = jax.jit(
-                lambda p, c, t, tr, st, cp, ncp: _pin2(paged_chunk_prefill(
-                    p, c, t, tr, st, cp, cfg, context_pages=ncp), self._pin),
-                static_argnums=(6,), donate_argnums=(1,))
+                lambda p, c, t, tr, st, cp, vl, ncp: _pin2(paged_chunk_prefill(
+                    p, c, t, tr, st, cp, cfg_prefill, context_pages=ncp,
+                    valid_len=vl), self._pin),
+                static_argnums=(7,), donate_argnums=(1,))
             self._paged_decode_n = jax.jit(
                 lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m,
                 _impl=pattn:
                 _pin2(paged_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd,
-                                         k, cfg, n, sample_mode=m,
+                                         k, cfg_decode, n, sample_mode=m,
                                          attn_impl=_impl), self._pin),
                 static_argnums=(11, 12), donate_argnums=(1,))
         self._preempted: list[Request] = []
@@ -564,8 +598,8 @@ class LLMEngine:
         self.prefill_interleave_steps = max(1, int(b.prefill_interleave_steps))
         self._decode_n = jax.jit(
             lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m:
-            _pin2(_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k, cfg,
-                                n, sample_mode=m), self._pin),
+            _pin2(_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k,
+                                cfg_decode, n, sample_mode=m), self._pin),
             static_argnums=(11, 12), donate_argnums=(1,))
 
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots
@@ -689,11 +723,11 @@ class LLMEngine:
             logits, self.cache = self._paged_chunk(
                 self.params, self.cache, jnp.asarray(chunk),
                 jnp.asarray(self._table[slot_idx]), jnp.int32(ch.pos),
-                jnp.asarray(ids), ctx)
+                jnp.asarray(ids), jnp.int32(real), ctx)
         else:
             logits, self.cache = self._prefill_chunk(
                 self.params, self.cache, jnp.asarray(chunk),
-                jnp.int32(slot_idx), jnp.int32(ch.pos))
+                jnp.int32(slot_idx), jnp.int32(ch.pos), jnp.int32(real))
         ch.pos += real
         if ch.pos >= plen:
             self._chunkings.remove(ch)

@@ -318,7 +318,8 @@ def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,
                         table_row: jax.Array, start: jax.Array,
                         chunk_pages: jax.Array, cfg: DecoderConfig,
                         attn_impl: str = "xla",
-                        context_pages: Optional[int] = None):
+                        context_pages: Optional[int] = None,
+                        valid_len: Optional[jax.Array] = None):
     """Prefill ONE chunk (``tokens`` [1,C], positions [start, start+C)) of a
     slot whose pages are ``table_row`` [mpp]; write the chunk's K/V into
     ``chunk_pages`` [C//pg] (OOB-padded ids → dropped writes for the pages a
@@ -356,7 +357,8 @@ def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,
     caches = {"k": jnp.pad(row_k, pad), "v": jnp.pad(row_v, pad),
               "len": start}
     logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=caches,
-                                        attn_impl=attn_impl)
+                                        attn_impl=attn_impl,
+                                        valid_len=valid_len)
     # Scatter the chunk's pages back into the pool: the chunk occupies
     # positions [start, start+C) = page slots start//pg .. +npages.
     written_k = jax.lax.dynamic_slice_in_dim(filled["k"], start, c, axis=2)

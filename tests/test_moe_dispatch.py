@@ -128,14 +128,18 @@ def test_dispatch_sharded_matches_unsharded():
     np.testing.assert_allclose(vals[0], vals[1], rtol=2e-5)
 
 
-def test_serving_engine_forces_drop_free_moe():
-    """A request's tokens must not depend on co-batched traffic: the engine
-    replaces dispatch (capacity drops are batch-dependent) with the
-    drop-free dense formulation at load."""
+def test_serving_engine_moe_phase_resolution():
+    """A request's tokens must not depend on co-batched traffic. Decode
+    co-batches slots, so it resolves to the drop-free dense formulation;
+    prefill runs per-request, so the dispatch path is batch-independent by
+    construction and stays (the measured winner — tests/test_serve_moe.py
+    pins both paths token-exact against dense)."""
     from kubeflow_tpu.core.serving import BatchingSpec
     from kubeflow_tpu.serve.engine import LLMEngine
 
     cfg = preset("tiny-moe", moe_impl="dispatch")
     eng = LLMEngine(cfg, BatchingSpec(max_batch_size=2, max_seq_len=32,
                                       prefill_buckets=[16]))
-    assert eng.cfg.moe_impl == "dense"
+    assert eng._cfg_decode.moe_impl == "dense"
+    assert eng._cfg_prefill.moe_impl == "dispatch"
+    assert eng.cfg.moe_impl == "dispatch"    # model cfg left untouched
