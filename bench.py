@@ -9,16 +9,21 @@ on every local device and prints ONE JSON line:
 llama3-0.6b / seq2048 / batch-4-per-chip config (the reference platform
 publishes no training numbers — BASELINE.md).
 
-Round-2 configuration, from the on-chip sweeps (scripts/mfu_sweep*.py,
-results in BASELINE.md §perf-notes):
+Round-3 configuration, from the on-chip A/Bs (BASELINE.md round-3 table):
+- the tuned Pallas flash kernels (bf16 MXU inputs, (1024,1024) blocks)
+  beat XLA's fused S×S attention at this shape — 486 -> 349 ms/step —
+  which frees enough HBM that "dots_no_batch" remat and an UNchunked CE
+  head win over the round-2 block_outs + chunked-CE config.
 - 16 train steps per device dispatch (lax.scan over stacked batches): the
   tunnel's ~90-105 ms per-dispatch overhead amortizes to ~6 ms/step.
-- remat "block_outs": save post-rope Q/K/V + block outputs (~0.94 GB),
-  recompute norms/attention/MLP-interior — faster than nothing_saveable,
-  fits where dots_no_batch OOMs.
-- XLA fused attention: A/B'd against the Pallas flash kernels (fwd+bwd);
-  XLA wins the full train step at S=2048, d=64 on this chip. The Pallas
-  path is the long-context prefill winner (S >= 4k) and stays default there.
+- bf16 Adam first moment (mu_dtype) halves optimizer-state bandwidth.
+
+Methodology (round-4, matching bench_serve.py): warm dispatches compile and
+settle the exact dispatch set, then TWO back-to-back measured segments run
+and both are reported with their spread — the tunneled chip's throughput
+wanders between sessions (25%+ swings recorded in BASELINE.md), so a
+single short window cannot be distinguished from a phase artifact, while
+an in-process spread can.
 """
 
 from __future__ import annotations
@@ -31,14 +36,17 @@ ROUND1_TOKS_PER_SEC_CHIP = 13673.23
 
 
 def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
-                       mu_dtype=None, learning_rate=None, attn_impl="xla"):
+                       mu_dtype=None, learning_rate=None, attn_impl="xla",
+                       segments=2):
     """The one train-throughput measurement loop every bench shares
     (bench.py headline + scripts/bench_configs.py rows): K steps per
-    dispatch over an fsdp mesh, warm dispatches excluded, and a host fetch
-    of the loss per dispatch as the execution fence — on the axon
+    dispatch over an fsdp mesh, warm dispatches excluded, then ``segments``
+    back-to-back measured windows of ``disp`` dispatches each (the topline
+    is their mean; the per-segment rates and spread ride along). A host
+    fetch of the loss per dispatch is the execution fence — on the axon
     remote-TPU tunnel, block_until_ready returns before the chain actually
     runs, so the round-trip is the only reliable fence. Returns
-    {tok_s_chip, step_ms, mfu, loss}."""
+    {tok_s_chip, step_ms, mfu, loss, segments, spread_pct}."""
     import jax
     import numpy as np
 
@@ -72,20 +80,31 @@ def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
     state = task.state
     for i in range(warm_disp):
         state, loss = dispatch(i * k_dispatch, state)
-    t0 = time.perf_counter()
-    for i in range(warm_disp, warm_disp + disp):
-        state, loss = dispatch(i * k_dispatch, state)
-    dt = time.perf_counter() - t0
-
     steps = disp * k_dispatch
-    tps_chip = data_cfg.global_batch * data_cfg.seq_len * steps / dt / n
+    tokens_per_seg = data_cfg.global_batch * data_cfg.seq_len * steps
+    seg_rates = []
+    i0 = warm_disp
+    for _ in range(max(1, segments)):
+        t0 = time.perf_counter()
+        for i in range(i0, i0 + disp):
+            state, loss = dispatch(i * k_dispatch, state)
+        dt = time.perf_counter() - t0
+        seg_rates.append(tokens_per_seg / dt / n)
+        i0 += disp
+
+    tps_chip = sum(seg_rates) / len(seg_rates)
     gen = detect_local_cluster().slices[0].gen
     mfu = (cfg.flops_per_token() * tps_chip) / (gen.bf16_tflops * 1e12)
     return {
         "tok_s_chip": round(tps_chip, 2),
-        "step_ms": round(dt / steps * 1e3, 2),
+        # tokens/step ÷ (tokens/s across all chips) = seconds/step.
+        "step_ms": round(1e3 * data_cfg.global_batch * data_cfg.seq_len
+                         / (tps_chip * n), 2),
         "mfu": round(mfu, 4),
         "loss": round(loss, 4),
+        "segments": [round(r, 2) for r in seg_rates],
+        "spread_pct": round(100 * (max(seg_rates) - min(seg_rates))
+                            / max(seg_rates), 1),
     }
 
 
@@ -137,6 +156,8 @@ def run_bench():
             "steps_per_dispatch": k_dispatch,
             "loss": out["loss"],
             "params": cfg.num_params(),
+            "segments": out["segments"],
+            "spread_pct": out["spread_pct"],
         },
     }
 

@@ -90,17 +90,43 @@ class RunPolicy(BaseModel):
 
 
 class ElasticPolicy(BaseModel):
-    """Elastic training (≈ PyTorchJob ElasticPolicy → torchrun c10d rdzv).
+    """Elastic training (≈ PyTorchJob ElasticPolicy → torchrun c10d rdzv,
+    whose metric half the reference realizes as an HPA it creates from the
+    policy — (U) training-operator pkg/controller.v1/pytorch/hpa.go).
 
     TPU-native semantics: a resize re-gangs the job on a new mesh and resumes
     from the latest checkpoint with resharded restore (orbax handles topology
-    change)."""
+    change). The metric half drives that same resize automatically:
+
+    - ``scale_on_headroom``: grow toward ``max_replicas`` when the job's
+      slice has free chips for more workers (the capacity signal — chips
+      idling next to an elastic job are pure waste).
+    - ``yield_to_pending``: shrink one step toward ``min_replicas`` when
+      other gangs wait in the placement queue (the HPA external-metric
+      analog: cluster pressure outranks one job's width).
+    - ``min_tokens_per_sec_per_chip``: shrink when measured per-chip
+      throughput falls below the floor — scaling efficiency collapsed, the
+      extra workers are burning chips for nothing.
+
+    Auto-resizes respect ``scale_cooldown_seconds`` between moves and stop
+    for good once ``max_restarts`` auto-resizes have happened (each resize
+    is a re-gang + restore; a flapping autoscaler must not starve training).
+    """
 
     model_config = ConfigDict(extra="forbid")
 
     min_replicas: int = 1
     max_replicas: int = 1
     max_restarts: int = 10
+    scale_on_headroom: bool = False
+    yield_to_pending: bool = False
+    min_tokens_per_sec_per_chip: Optional[float] = None
+    scale_cooldown_seconds: float = 30.0
+
+    @property
+    def auto_scaling(self) -> bool:
+        return (self.scale_on_headroom or self.yield_to_pending
+                or self.min_tokens_per_sec_per_chip is not None)
 
     @model_validator(mode="after")
     def _check(self) -> "ElasticPolicy":
@@ -202,6 +228,17 @@ class JAXJobSpec(BaseModel):
             if not (self.elastic_policy.min_replicas <= w.replicas
                     <= self.elastic_policy.max_replicas):
                 raise ValueError("worker.replicas outside elastic [min,max]")
+            if self.elastic_policy.auto_scaling and self.parallelism.total > 1:
+                # The autoscaler rewrites worker count + the data axis in
+                # lockstep (data spans every chip of the shape); any other
+                # sharding (tp/pp/...) has no defined resize rule, so
+                # reject at spec time instead of wedging a live gang.
+                if self.parallelism.axis_sizes() != {
+                        **ParallelismSpec().axis_sizes(),
+                        "data": w.replicas * w.resources.tpu_chips}:
+                    raise ValueError(
+                        "elastic auto-scaling requires pure data-parallel "
+                        "parallelism (data == total chips) or none")
         total_chips = w.replicas * w.resources.tpu_chips
         if self.parallelism.total not in (1, total_chips):
             raise ValueError(
@@ -242,6 +279,9 @@ class JAXJobStatus(ConditionMixin):
     coordinator_address: Optional[str] = None
     gang_name: Optional[str] = None
     metrics: JobMetrics = Field(default_factory=JobMetrics)
+    # Elastic autoscaler bookkeeping (cooldown + budget accounting).
+    last_scale_time: Optional[Any] = None
+    elastic_resizes: int = 0
 
     @property
     def phase(self) -> str:

@@ -149,11 +149,25 @@ class TransformerSpec(BaseModel):
     config: dict[str, Any] = Field(default_factory=dict)
 
 
+class ExplainerSpec(BaseModel):
+    """Explanation hop (≈ kserve explainer — the third component of the
+    triad): a registered token-attribution handler served on the
+    ``:explain`` route. Built-ins: "grad_x_input" (saliency via a VJP
+    through the decoder) and "leave_one_out" (batched occlusion); custom
+    handlers register like transformers (serve/explain.py)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    handler: str = "grad_x_input"    # registered name or "module:function"
+    config: dict[str, Any] = Field(default_factory=dict)
+
+
 class InferenceServiceSpec(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
     predictor: PredictorSpec
     transformer: Optional[TransformerSpec] = None
+    explainer: Optional[ExplainerSpec] = None
 
 
 class InferenceServiceStatus(ConditionMixin):

@@ -165,13 +165,17 @@ def decoder_forward(
     rules: LogicalRules = DEFAULT_RULES,
     skip_head: bool = False,
     valid_len: Optional[jax.Array] = None,
+    inputs_embeds: Optional[jax.Array] = None,
 ):
     """Returns (logits [B,S,V] float32, new_kv_caches|None, aux_loss).
     With ``skip_head``, returns the final-norm hidden states [B,S,D] instead
     of logits (the chunked-CE loss applies the head blockwise).
     ``valid_len`` (traced scalar or [B]): marks trailing positions as
     padding for the MoE dispatch path (serving prefill buckets) — see
-    layers.moe_block."""
+    layers.moe_block. ``inputs_embeds`` [B,S,D] replaces the embedding
+    lookup (pre-scale) — the differentiable-input path attribution
+    explainers need (serve/explain.py); ``tokens`` still supplies shapes
+    and positions."""
     custom_positions = positions is not None
     if positions is None:
         # Decode with a cache: absolute positions continue from the cache
@@ -189,7 +193,10 @@ def decoder_forward(
         # at the embed_table rule) — vocab stays model-sharded, the gather
         # of a vocab-sharded operand GSPMD handles natively.
         table = with_logical_constraint(table, ("vocab", None), mesh, rules)
-    x = table.astype(dt)[tokens]
+    if inputs_embeds is not None:
+        x = inputs_embeds.astype(dt)
+    else:
+        x = table.astype(dt)[tokens]
     if mesh is not None:
         x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"), mesh, rules)
     if cfg.embed_scale:

@@ -37,7 +37,7 @@ from kubeflow_tpu.core.jobs import (
 )
 from kubeflow_tpu.core.object import ObjectMeta, utcnow
 from kubeflow_tpu.core.store import (
-    AlreadyExistsError, NotFoundError, ObjectStore, WatchEvent,
+    AlreadyExistsError, ConflictError, NotFoundError, ObjectStore, WatchEvent,
 )
 from kubeflow_tpu.operator.controller import ReconcileResult
 from kubeflow_tpu.runtime.allocator import (
@@ -128,6 +128,14 @@ class JAXJobController:
             result_deadline = deadline - elapsed
         else:
             result_deadline = None
+
+        # Elastic autoscaler (the reference's ElasticPolicy→HPA metric half,
+        # (U) training-operator pkg/controller.v1/pytorch/hpa.go): may write
+        # a new worker count into the spec, which the resize check below
+        # then acts on in this same pass.
+        if (job.spec.elastic_policy is not None
+                and job.spec.elastic_policy.auto_scaling):
+            self._maybe_autoscale(job)
 
         # Elastic / spec resize: desired shape changed under a live gang
         # (worker count, chips per worker, or mesh axes) → tear down and
@@ -333,6 +341,92 @@ class JAXJobController:
         # Recreate on the next pass so worker deletion events settle first.
         return ReconcileResult(requeue_after=0.05)
 
+    def _maybe_autoscale(self, job: JAXJob) -> None:
+        """Decide a new worker count from cluster + job metrics and durably
+        write it into the spec (the scale-subresource analog). The existing
+        resize machinery — re-gang, resharded restore — does the rest.
+
+        Ordering of signals: shrink signals outrank growth (yielding chips
+        under pressure beats widening), and every move respects the
+        cooldown and the ``max_restarts`` auto-resize budget."""
+        pol = job.spec.elastic_policy
+        alloc = self.allocator.allocation(job.metadata.key)
+        if alloc is None:
+            return                       # not placed: nothing to scale yet
+        if not job.status.has_condition(JobConditionType.RUNNING.value):
+            return                       # mid-restart/startup: let it settle
+        ck = job.spec.run_policy.checkpoint
+        if ck.enabled and job.status.metrics.last_checkpoint_step is None:
+            # A resize before the first checkpoint lands would trade live
+            # progress for a from-scratch restart — wait for a resume point.
+            return
+        if job.status.elastic_resizes >= pol.max_restarts:
+            return                       # budget spent: hold shape forever
+        last = job.status.last_scale_time
+        if isinstance(last, str):
+            import datetime
+
+            last = datetime.datetime.fromisoformat(last)
+        if last is not None and (
+                (utcnow() - last).total_seconds() < pol.scale_cooldown_seconds):
+            return
+        cur = job.spec.worker.replicas
+        chips = job.spec.worker.resources.tpu_chips
+        desired, why = cur, ""
+        if (pol.yield_to_pending and cur > pol.min_replicas
+                and self.allocator.pending()):
+            desired, why = cur - 1, "pending gangs waiting for chips"
+        tput = job.status.metrics.tokens_per_sec_per_chip
+        if (desired == cur and pol.min_tokens_per_sec_per_chip is not None
+                and tput is not None and cur > pol.min_replicas
+                and tput < pol.min_tokens_per_sec_per_chip):
+            desired, why = cur - 1, (
+                f"{tput:.0f} tok/s/chip below floor "
+                f"{pol.min_tokens_per_sec_per_chip:.0f}")
+        if (desired == cur and pol.scale_on_headroom
+                and cur < pol.max_replicas
+                and not self.allocator.pending()):
+            # Growth yields to ANY queued gang (not only under
+            # yield_to_pending): growing while something waits would either
+            # starve it or — with yield_to_pending set — flap grow/shrink
+            # every cooldown until the resize budget is gone.
+            free = self.allocator.free_chips(alloc.slice_name)
+            # Grow only as far as re-placement is guaranteed to succeed:
+            # after release the gang needs desired*chips on this slice, and
+            # free + cur*chips is exactly what will be available.
+            grow = min(pol.max_replicas, cur + free // chips)
+            if grow > cur:
+                desired, why = grow, (
+                    f"{free} free chips on slice {alloc.slice_name}")
+        if desired == cur:
+            return
+        job.spec.worker.replicas = desired
+        # Pure DP: the data axis always spans every chip of the new shape
+        # (a multi-worker gang cannot run on the default total==1
+        # parallelism — each process would build a 1-device mesh under a
+        # 2-device jax.distributed world).
+        from kubeflow_tpu.core.jobs import ParallelismSpec
+
+        job.spec.parallelism = ParallelismSpec(data=desired * chips)
+        job.status.elastic_resizes += 1
+        job.status.last_scale_time = utcnow()
+        try:
+            job.metadata = self.store.update(job).metadata
+        except (ConflictError, NotFoundError):
+            # Lost a spec race: drop the local mutation too — acting on an
+            # unpersisted spec would resize now and resize BACK next pass.
+            fresh = self.store.try_get(JAXJob, job.metadata.name,
+                                       job.metadata.namespace)
+            if fresh is not None:
+                job.spec = fresh.spec
+                job.status = fresh.status
+                job.metadata = fresh.metadata
+            return
+        self.recorder.normal(
+            job, "ElasticScaleUp" if desired > cur else "ElasticScaleDown",
+            f"{cur} -> {desired} workers: {why} "
+            f"(auto-resize {job.status.elastic_resizes}/{pol.max_restarts})")
+
     def _resize(self, job: JAXJob, alloc) -> Optional[ReconcileResult]:
         key = job.metadata.key
         new = job.spec.worker.replicas
@@ -344,6 +438,13 @@ class JAXJobController:
         self.allocator.release(key)
         job.status.gang_name = None
         job.status.coordinator_address = None
+        # Throughput readings from the OLD shape must not drive the next
+        # autoscale decision: the re-ganged job takes minutes to produce a
+        # fresh line, and a stale below-floor value would shrink again every
+        # cooldown down to min_replicas.
+        job.status.metrics.tokens_per_sec_per_chip = None
+        job.status.metrics.step_time_ms = None
+        job.status.metrics.mfu = None
         job.status.set_condition(JobConditionType.RESTARTING.value,
                                  reason="Resized")
         job.status.set_condition(JobConditionType.RUNNING.value,
@@ -458,6 +559,9 @@ class JAXJobController:
             for field in ("tokens_per_sec_per_chip", "step_time_ms", "mfu", "loss"):
                 if m.get(field) is not None:
                     setattr(job.status.metrics, field, float(m[field]))
+            if m.get("last_checkpoint_step") is not None:
+                job.status.metrics.last_checkpoint_step = \
+                    int(m["last_checkpoint_step"])
             return
 
     def _update_status(self, job: JAXJob) -> None:

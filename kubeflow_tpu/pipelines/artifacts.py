@@ -250,11 +250,23 @@ class ArtifactStore:
                         "versions are immutable, register a new one") from None
             except OSError:
                 # Filesystems that refuse hardlinks (materialize_tree's
-                # copy-fallback case): os.replace keeps publishes atomic
-                # (never a partial entry) at the cost of last-writer-wins
-                # on a same-instant conflicting register.
-                os.replace(tmp, entry)
-                tmp = None
+                # copy-fallback case). The immutability check must still
+                # run — EPERM can fire before the EEXIST the link path
+                # relies on, and blindly replacing would silently rebind a
+                # deployed version. Window left: a crash between this read
+                # and the replace of a brand-new entry (atomic-but-
+                # last-writer rather than first-writer — degraded mode).
+                if os.path.exists(entry):
+                    with open(entry) as f:
+                        existing = f.read().strip()
+                    if existing != uri:
+                        raise ValueError(
+                            f"{name}@{version} is already bound to "
+                            f"{existing}; versions are immutable, register "
+                            "a new one") from None
+                else:
+                    os.replace(tmp, entry)
+                    tmp = None
         finally:
             if tmp is not None:
                 os.unlink(tmp)

@@ -1,0 +1,125 @@
+"""Explainer hop — the third leg of the predictor/transformer/explainer
+triad ((U) kserve pkg/apis/serving/v1beta1 ExplainerSpec + the alibi
+explainer containers; SURVEY.md §2.3#24-25).
+
+TPU-native shape: instead of a sidecar container wrapping a black-box
+model, the explainer differentiates THROUGH the served decoder — JAX makes
+the model its own explainer:
+
+- ``grad_x_input``: embedding-gradient × embedding attribution. One
+  forward picks the model's predicted next token, one VJP through the
+  decoder w.r.t. the *embedded* inputs scores every prompt token's
+  contribution to that prediction (the saliency formulation; exact
+  directional derivative, finite-difference-tested).
+- ``leave_one_out``: occlusion attribution. All S ablations run as ONE
+  [S+1, S] batched forward — a large static-shape batch, exactly what the
+  MXU wants — scoring each token by how much its removal drops the
+  predicted token's log-probability.
+
+Handlers are registered like transformers (name or "module:function"), so
+custom explainers plug in without touching the server.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+explainer_registry: dict[str, Callable] = {}
+
+
+def register_explainer(name: str):
+    def deco(fn: Callable) -> Callable:
+        explainer_registry[name] = fn
+        return fn
+    return deco
+
+
+def resolve_explainer(handler: str) -> Callable:
+    if handler in explainer_registry:
+        return explainer_registry[handler]
+    module, sep, attr = handler.partition(":")
+    if not sep:
+        raise KeyError(
+            f"explainer {handler!r} is not registered and is not a "
+            f"'module:function' path; registered: "
+            f"{sorted(explainer_registry)}")
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)
+
+
+def _predicted_target(params, cfg, toks: jax.Array) -> tuple[int, float]:
+    """(argmax next token at the last position, its log-probability)."""
+    from kubeflow_tpu.models.decoder import decoder_forward
+
+    logits, _, _ = decoder_forward(params, toks, cfg)
+    lp = jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
+    target = int(jnp.argmax(lp))
+    return target, float(lp[target])
+
+
+@register_explainer("grad_x_input")
+def grad_x_input(tokens: list[int], *, params, cfg, **_) -> dict:
+    """Saliency: score_i = <d logp(target)/d e_i, e_i> for each prompt
+    embedding e_i — the first-order effect of removing token i."""
+    from kubeflow_tpu.models.decoder import decoder_forward
+
+    toks = jnp.asarray([tokens], jnp.int32)
+    target, lp_target = _predicted_target(params, cfg, toks)
+    dt = cfg.activation_dtype
+    embeds = params["embed"].astype(dt)[toks]        # [1, S, D] (pre-scale)
+
+    def lp_of(e):
+        logits, _, _ = decoder_forward(params, toks, cfg, inputs_embeds=e)
+        return jax.nn.log_softmax(
+            logits[0, -1].astype(jnp.float32))[target]
+
+    g = jax.grad(lp_of)(embeds)
+    scores = jnp.sum(g.astype(jnp.float32) * embeds.astype(jnp.float32),
+                     axis=-1)[0]
+    return {
+        "method": "grad_x_input",
+        "target_token": target,
+        "target_logprob": lp_target,
+        "scores": [float(s) for s in scores],
+    }
+
+
+@register_explainer("leave_one_out")
+def leave_one_out(tokens: list[int], *, params, cfg,
+                  ablate_token: int = 0, **_) -> dict:
+    """Occlusion: score_i = logp(target | prompt) - logp(target | prompt
+    with token i replaced by ``ablate_token``). One [S+1, S] forward."""
+    from kubeflow_tpu.models.decoder import decoder_forward
+
+    s = len(tokens)
+    toks = jnp.asarray([tokens], jnp.int32)
+    target, lp_full = _predicted_target(params, cfg, toks)
+    base = jnp.asarray(tokens, jnp.int32)
+    variants = jnp.where(jnp.eye(s, dtype=bool), jnp.int32(ablate_token),
+                         base[None, :])              # [S, S]
+    logits, _, _ = decoder_forward(params, variants, cfg)
+    lps = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1)[:, target]     # [S]
+    return {
+        "method": "leave_one_out",
+        "target_token": target,
+        "target_logprob": lp_full,
+        "scores": [float(lp_full - v) for v in lps],
+    }
+
+
+def build_explainer(conf: Optional[dict]) -> Optional[Callable]:
+    """ExplainerSpec.{handler,config} → callable(tokens, params, cfg) →
+    explanation dict. None config = no explainer hop."""
+    if not conf:
+        return None
+    import functools
+
+    fn = resolve_explainer(conf.get("handler", "grad_x_input"))
+    if conf.get("config"):
+        fn = functools.partial(fn, **conf["config"])
+    return fn

@@ -352,6 +352,8 @@ class ISVCController:
             }
             if isvc.spec.transformer is not None:
                 config["transformer"] = isvc.spec.transformer.model_dump()
+            if isvc.spec.explainer is not None:
+                config["explainer"] = isvc.spec.explainer.model_dump()
         w = Worker(
             metadata=ObjectMeta(
                 name=f"{isvc.metadata.name}-predictor-g{generation}-{index}",

@@ -71,8 +71,11 @@ def model_server(ctx: WorkerContext) -> int:
         if t_conf.get("config"):
             fn = functools.partial(fn, **t_conf["config"])
         transformer = fn
+    from kubeflow_tpu.serve.explain import build_explainer
+
     server = ModelServer(conf.get("service", "model"), engine,
                          transformer=transformer,
+                         explainer=build_explainer(conf.get("explainer")),
                          port=int(conf["port"]))
     server.start()
     try:

@@ -6,6 +6,8 @@ stdlib-only server (no fastapi in this image):
 
 - v1 protocol  ((U) kserve kserve/protocol/rest/v1_endpoints.py):
   POST /v1/models/{name}:predict   {"instances": [...]}
+  POST /v1/models/{name}:explain   {"instances": [...]} → per-token
+       attribution from the configured explainer hop (serve/explain.py)
 - v2 open-inference protocol ((U) kserve v2_endpoints.py):
   GET  /v2/models/{name}           metadata
   POST /v2/models/{name}/infer     {"inputs": [{name,shape,datatype,data}]}
@@ -37,6 +39,7 @@ from kubeflow_tpu.serve.engine import LLMEngine, Request, SamplingParams
 from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
 
 _V1_PREDICT = re.compile(r"^/v1/models/([^/:]+):predict$")
+_V1_EXPLAIN = re.compile(r"^/v1/models/([^/:]+):explain$")
 _V2_MODEL = re.compile(r"^/v2/models/([^/]+)$")
 _V2_INFER = re.compile(r"^/v2/models/([^/]+)/infer$")
 _REPO_ACTION = re.compile(r"^/v2/repository/models/([^/]+)/(load|unload)$")
@@ -47,6 +50,7 @@ class ModelServer:
                  repository=None,
                  tokenizer: Optional[Tokenizer] = None,
                  transformer=None,
+                 explainer=None,
                  host: str = "127.0.0.1", port: int = 0,
                  grpc_port: Optional[int] = None):
         if (engine is None) == (repository is None):
@@ -58,6 +62,10 @@ class ModelServer:
         # Pre/post-processing hop (≈ kserve transformer — SURVEY.md §2.3):
         # transformer(text, phase) with phase in {"pre", "post"}.
         self.transformer = transformer
+        # Explanation hop (≈ kserve explainer, the triad's third leg):
+        # explainer(tokens, params=..., cfg=...) -> attribution dict,
+        # served on the v1 :explain route (serve/explain.py).
+        self.explainer = explainer
         self._in_flight = 0
         self._in_flight_lock = threading.Lock()
         handler = _make_handler(self)
@@ -147,6 +155,26 @@ class ModelServer:
         if entry is None:
             raise KeyError(name)
         return entry.cfg
+
+    def explain_text(self, prompt: str, model: Optional[str]) -> dict:
+        """Tokenize → attribution handler → per-token scores with their
+        decoded token strings (the v1 ``:explain`` payload)."""
+        if self.explainer is None:
+            raise ValueError("no explainer configured on this service")
+        if self.transformer is not None:
+            prompt = self.transformer(prompt, "pre")
+        with self.lease(model, strict=True) as (engine, tokenizer, _):
+            toks = tokenizer.encode(prompt)
+            # Attribution is O(S) forwards (leave_one_out batches an [S+1,S]
+            # block): an uncapped prompt would OOM the live serving chip.
+            limit = min(engine.max_len, engine.cfg.max_seq_len)
+            if len(toks) > limit:
+                raise ValueError(
+                    f"explain prompt is {len(toks)} tokens; limit {limit}")
+            out = self.explainer(toks, params=engine.params, cfg=engine.cfg)
+            out["tokens"] = [tokenizer.decode([t]) for t in toks]
+            out["predicted_text"] = tokenizer.decode([out["target_token"]])
+        return out
 
     def generate_text(self, prompt: str, body: dict, model: Optional[str],
                       strict: bool = False) -> tuple[str, "Request"]:
@@ -299,6 +327,9 @@ def _make_handler(server: ModelServer):
                 m = _V1_PREDICT.match(self.path)
                 if m:
                     return self._v1_predict(body, m.group(1))
+                m = _V1_EXPLAIN.match(self.path)
+                if m:
+                    return self._v1_explain(body, m.group(1))
                 m = _V2_INFER.match(self.path)
                 if m:
                     return self._v2_infer(body, m.group(1))
@@ -339,6 +370,14 @@ def _make_handler(server: ModelServer):
                                          strict=True)[0]
                      for inst in instances]
             self._json(200, {"predictions": preds})
+
+        def _v1_explain(self, body: dict, model: str) -> None:
+            instances = body.get("instances")
+            if not isinstance(instances, list):
+                raise ValueError("body must contain 'instances': [...]")
+            exps = [server.explain_text(str(inst), model)
+                    for inst in instances]
+            self._json(200, {"explanations": exps})
 
         def _v2_infer(self, body: dict, model: str) -> None:
             inputs = body.get("inputs")

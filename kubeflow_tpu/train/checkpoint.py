@@ -45,6 +45,17 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def latest_committed_step(self) -> Optional[int]:
+        """Newest step that is FINALIZED ON DISK — async saves register with
+        the manager immediately but commit in the background, and a gang
+        teardown mid-write leaves nothing restorable. Consumers that gate
+        destructive moves on "a checkpoint exists" (the elastic autoscaler)
+        must use this, not latest_step()."""
+        import orbax.checkpoint as ocp
+
+        steps = ocp.utils.checkpoint_steps(self.directory)
+        return max(steps) if steps else None
+
     @staticmethod
     def make_abstract_state(init_fn, shardings) -> Any:
         """Abstract (shape/dtype/sharding) mirror of ``init_fn()``'s output."""

@@ -169,6 +169,13 @@ class Trainer:
             if (step + 1) % self.cfg.log_every == 0 or step + 1 == self.cfg.steps:
                 metrics = {k: float(jax.device_get(v)) for k, v in metrics.items()}
                 metrics.update(self.throughput.tick(step + 1 - last_tick_step))
+                # COMMITTED checkpoints only (async saves that a teardown
+                # would abort must not arm the elastic autoscaler): surfaced
+                # through metrics.jsonl onto job status.
+                if self.ckpt is not None:
+                    committed = self.ckpt.latest_committed_step()
+                    if committed is not None:
+                        metrics["last_checkpoint_step"] = committed
                 last_tick_step = step + 1
                 last_metrics = metrics
                 if self.process_id == 0:

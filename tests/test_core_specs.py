@@ -52,6 +52,30 @@ def test_elastic_bounds_validated():
         )
 
 
+def test_elastic_autoscale_requires_pure_dp():
+    """The autoscaler rewrites worker count + data axis in lockstep; any
+    other sharding has no resize rule and must be rejected at spec time."""
+    auto = ElasticPolicy(min_replicas=1, max_replicas=8,
+                         scale_on_headroom=True)
+    assert auto.auto_scaling
+    # pure DP (data == replicas) and default parallelism are fine
+    spec = job_spec(replicas=2, data=2)
+    JAXJobSpec(replica_specs=spec.replica_specs,
+               parallelism=spec.parallelism, elastic_policy=auto)
+    spec = job_spec(replicas=2)
+    JAXJobSpec(replica_specs=spec.replica_specs, elastic_policy=auto)
+    # TP/FSDP shardings are not
+    spec = job_spec(replicas=2, chips=2, data=2, model=2)
+    with pytest.raises(ValidationError, match="pure data-parallel"):
+        JAXJobSpec(replica_specs=spec.replica_specs,
+                   parallelism=spec.parallelism, elastic_policy=auto)
+    # the passive policy (no metric signals) stays unrestricted
+    spec = job_spec(replicas=2, chips=2, data=2, model=2)
+    JAXJobSpec(replica_specs=spec.replica_specs,
+               parallelism=spec.parallelism,
+               elastic_policy=ElasticPolicy(min_replicas=1, max_replicas=8))
+
+
 def test_restart_policy_enum_from_manifest():
     doc = {
         "kind": "JAXJob",

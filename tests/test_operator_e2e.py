@@ -187,6 +187,79 @@ def test_elastic_resize_resharded_restore_e2e(cp, tmp_path):
 
 
 @pytest.mark.slow
+def test_elastic_autoscale_e2e(cp):
+    """The ElasticPolicy metric half ((U) training-operator hpa.go analog):
+    a 1-worker elastic job auto-GROWS into free chips (scale_on_headroom),
+    then auto-SHRINKS when another gang queues (yield_to_pending) — both
+    through the real resize machinery (re-gang + resharded restore), with
+    events and the auto-resize budget recorded on status."""
+    from kubeflow_tpu.core.jobs import ElasticPolicy
+
+    j = job_of(
+        "llm_pretrain",
+        {
+            "model": "tiny",
+            "steps": 80,
+            "log_every": 2,
+            "data": {"global_batch": 8, "seq_len": 64, "kind": "synthetic"},
+        },
+        name="auto",
+        replicas=1,
+        parallelism=None,                    # pure DP derives from workers
+    )
+    j.spec.elastic_policy = ElasticPolicy(
+        min_replicas=1, max_replicas=2, max_restarts=4,
+        scale_on_headroom=True, yield_to_pending=True,
+        scale_cooldown_seconds=3.0)
+    j.spec.run_policy.checkpoint.enabled = True
+    j.spec.run_policy.checkpoint.interval_steps = 5
+    job = cp.submit(j)
+    cp.wait_for(job, "Running", timeout=240)
+
+    # Phase 1: the cluster has 3 free chips -> the autoscaler should grow
+    # the job to max_replicas=2 once it is Running past the cooldown.
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        cur = cp.get_job("auto")
+        ws = cp.store.list(Worker, label_selector={
+            "training.tpu.kubeflow.dev/job-name": "auto"})
+        if (cur.spec.worker.replicas == 2 and len(ws) == 2
+                and cur.status.has_condition("Running")):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(
+            f"never grew to 2 workers: replicas="
+            f"{cp.get_job('auto').spec.worker.replicas}")
+    assert cur.status.elastic_resizes == 1
+
+    # Phase 2: a competing job that needs the remaining capacity queues ->
+    # yield_to_pending shrinks the job back toward min.
+    blocker = cp.submit(job_of("sleep", {"seconds": 25.0}, name="blocker",
+                               replicas=3))
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        cur = cp.get_job("auto")
+        if cur is None or cur.status.has_condition("Succeeded"):
+            break                      # finished before the shrink landed
+        if cur.spec.worker.replicas == 1 and cur.status.elastic_resizes >= 2:
+            break
+        time.sleep(0.5)
+    cur = cp.get_job("auto")
+    assert cur.spec.worker.replicas == 1 or cur.status.has_condition(
+        "Succeeded"), "never yielded to the pending gang"
+
+    done = cp.wait_for(job, "Succeeded", timeout=420)
+    assert done.status.metrics.step == 80
+    assert done.status.elastic_resizes >= 1
+    # The resumed segments really restored (not restarted from step 0).
+    log = cp.config.base_dir + "/logs/default.auto-worker-0.log"
+    with open(log) as f:
+        assert "resumed from checkpoint at step" in f.read()
+    cp.wait_for(blocker, "Succeeded", timeout=240)
+
+
+@pytest.mark.slow
 def test_torch_adapter_distributed_e2e(cp):
     """Second-framework adapter (SURVEY.md §2.2#19, the XGBoost/Paddle
     controller analog): a 2-worker PyTorch job rendezvouses with gloo from
