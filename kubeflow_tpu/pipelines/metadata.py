@@ -106,6 +106,14 @@ def _load_native() -> Optional[ctypes.CDLL]:
                                                c.POINTER(c.c_int64), c.c_int]
     lib.ms_list_context_artifacts.argtypes = [c.c_void_p, c.c_int64,
                                               c.POINTER(c.c_int64), c.c_int]
+    lib.ms_report_observations.argtypes = [
+        c.c_void_p, c.c_int64, c.c_char_p, c.POINTER(c.c_int64),
+        c.POINTER(c.c_double), c.c_int]
+    lib.ms_get_observations.argtypes = [
+        c.c_void_p, c.c_int64, c.c_char_p, c.POINTER(c.c_int64),
+        c.POINTER(c.c_double), c.c_int]
+    lib.ms_observation_metrics.argtypes = [c.c_void_p, c.c_int64,
+                                           c.c_char_p, c.c_int]
     return lib
 
 
@@ -265,6 +273,41 @@ class _NativeBackend:
         return self._ids(lambda buf, cap: self._lib.ms_list_context_artifacts(
             self._h, ctx, buf, cap))
 
+    def report_observations(self, trial: int, metric: str,
+                            points: list[tuple[int, float]]) -> None:
+        n = len(points)
+        if not n:
+            return
+        steps = (ctypes.c_int64 * n)(*[int(s) for s, _ in points])
+        values = (ctypes.c_double * n)(*[float(v) for _, v in points])
+        self._check_rc(self._lib.ms_report_observations(
+            self._h, trial, metric.encode(), steps, values, n))
+
+    def get_observations(self, trial: int,
+                         metric: str) -> list[tuple[int, float]]:
+        cap = 1024
+        while True:
+            steps = (ctypes.c_int64 * cap)()
+            values = (ctypes.c_double * cap)()
+            n = self._lib.ms_get_observations(
+                self._h, trial, metric.encode(), steps, values, cap)
+            if n < 0:
+                raise RuntimeError("get_observations failed")
+            if n <= cap:
+                return [(steps[i], values[i]) for i in range(n)]
+            cap = n
+
+    def observation_metrics(self, trial: int) -> list[str]:
+        cap = 65536
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.ms_observation_metrics(self._h, trial, buf, cap)
+            if n < 0:
+                raise RuntimeError("observation_metrics failed")
+            if n < cap:           # joined length fits (snprintf truncates)
+                return buf.value.decode().split("\n") if n else []
+            cap = n + 1
+
     # helpers ------------------------------------------------------------------
 
     @staticmethod
@@ -332,6 +375,11 @@ class _PythonBackend:
     CREATE TABLE IF NOT EXISTS attributions(
       context_id INTEGER NOT NULL, artifact_id INTEGER NOT NULL,
       PRIMARY KEY(context_id, artifact_id));
+    CREATE TABLE IF NOT EXISTS observations(
+      trial_id INTEGER NOT NULL, metric TEXT NOT NULL, step INTEGER NOT NULL,
+      value REAL NOT NULL,
+      ts INTEGER NOT NULL DEFAULT (strftime('%s','now')),
+      PRIMARY KEY(trial_id, metric, step));
     """
 
     def __init__(self, path: str):
@@ -432,6 +480,35 @@ class _PythonBackend:
             "SELECT owner_id FROM properties"
             " WHERE kind=1 AND key=? AND sval=? ORDER BY owner_id",
             (key, sval))]
+
+    def report_observations(self, trial, metric, points):
+        if not points:
+            return
+        with self._lock:
+            try:
+                self._db.executemany(
+                    "INSERT INTO observations(trial_id,metric,step,value)"
+                    " VALUES(?,?,?,?) ON CONFLICT(trial_id,metric,step)"
+                    " DO UPDATE SET value=excluded.value,"
+                    " ts=strftime('%s','now')",
+                    [(trial, metric, int(s), float(v)) for s, v in points])
+                self._db.commit()
+            except _pysqlite.Error:
+                # Batch atomicity matches the native backend: a mid-batch
+                # failure must not leave half the rows in the implicit open
+                # transaction for the next unrelated commit to persist.
+                self._db.rollback()
+                raise
+
+    def get_observations(self, trial, metric):
+        return [(r[0], r[1]) for r in self._all(
+            "SELECT step,value FROM observations"
+            " WHERE trial_id=? AND metric=? ORDER BY step", (trial, metric))]
+
+    def observation_metrics(self, trial):
+        return [r[0] for r in self._all(
+            "SELECT DISTINCT metric FROM observations WHERE trial_id=?"
+            " ORDER BY metric", (trial,))]
 
     def put_event(self, eid, aid, etype, path):
         self._write(
@@ -630,6 +707,22 @@ class MetadataStore:
     def events_by_artifact(self, artifact_id: int) -> list[tuple[int, int]]:
         """[(execution_id, event_type)] in event order."""
         return self._b.events_by_artifact(artifact_id)
+
+    # -- observations (katib observation_logs analog — SURVEY.md §2.4#33) -----
+
+    def report_observations(self, trial_execution_id: int, metric: str,
+                            points: list[tuple[int, float]]) -> None:
+        """Batch-upsert (step, value) points for one (trial, metric) into
+        the dedicated observations table — one transaction, no string-keyed
+        property rows (the 1e5-point-log fast path)."""
+        self._b.report_observations(trial_execution_id, metric, points)
+
+    def get_observations(self, trial_execution_id: int,
+                         metric: str) -> list[tuple[int, float]]:
+        return self._b.get_observations(trial_execution_id, metric)
+
+    def observation_metrics(self, trial_execution_id: int) -> list[str]:
+        return self._b.observation_metrics(trial_execution_id)
 
     def lineage(self, artifact_id: int, max_hops: int = 20) -> dict[str, Any]:
         """Upstream provenance: which executions/artifacts produced this one.

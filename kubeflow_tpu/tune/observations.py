@@ -15,10 +15,14 @@ object); here every reported point also lands in the C++ metadata store
 Schema (MLMD node mapping):
 - context type ``tune_experiment``, name = "<namespace>/<experiment>";
 - execution type ``tune_trial`` with properties ``trial_name``,
-  ``experiment`` and one ``obs:<metric>:<step08d>`` float property per
-  observation point (the observation_logs table analog — property keys
-  order lexicographically, so the zero-padded step reconstructs the
-  series).
+  ``experiment`` and ``param:*``;
+- observation points live in the store's DEDICATED observations table
+  ((trial_id, metric, step) → value — ms_report_observations /
+  ms_get_observations in the C++ ABI), matching upstream's
+  observation_logs table. Earlier rounds packed one ``obs:<metric>:
+  <step08d>`` property row per point; that read path is kept as the
+  fallback for logs written before the table existed, and reads merge
+  table-over-properties so mixed-era trials stay complete.
 """
 
 from __future__ import annotations
@@ -112,9 +116,7 @@ class ObservationLog:
         if not fresh:
             return
         eid = self.trial_execution(experiment_key, trial_name, parameters)
-        self.store._set_props(EXECUTION, eid, {
-            f"{_OBS}{metric}:{step:08d}": float(value)
-            for step, value in fresh})
+        self.store.report_observations(eid, metric, fresh)
         with self._lock:
             self._reported[(trial_name, metric)] = max(
                 s for s, _ in fresh)
@@ -134,21 +136,38 @@ class ObservationLog:
 
     def get_log(self, trial_name: str,
                 metric: Optional[str] = None) -> dict[str, list[tuple[int, float]]]:
-        """All observation series of a trial (optionally one metric)."""
+        """All observation series of a trial (optionally one metric).
+
+        Table first, then the legacy property packing: a metric appearing
+        in both (a trial spanning the migration) merges with the table
+        winning per step."""
         hits = self.store.find_executions_by_property("trial_name",
                                                       trial_name)
         if not hits:
             return {}
-        props = self.store.get_execution(hits[0])["properties"]
+        eid = hits[0]
         out: dict[str, list[tuple[int, float]]] = {}
+        names = (self.store.observation_metrics(eid) if metric is None
+                 else [metric])
+        for name in names:
+            series = self.store.get_observations(eid, name)
+            if series:
+                out[name] = series
+        legacy: dict[str, dict[int, float]] = {}
+        props = self.store.get_execution(eid)["properties"]
         for key in sorted(props):
             if not key.startswith(_OBS):
                 continue
-            _, name, step = key.rsplit(":", 2)
+            step = key.rsplit(":", 1)[1]
+            if not step.isdigit():
+                continue   # obs:-prefixed but not step-packed: not a point
             name = key[len(_OBS):-(len(step) + 1)]
             if metric is not None and name != metric:
                 continue
-            out.setdefault(name, []).append((int(step), float(props[key])))
+            legacy.setdefault(name, {})[int(step)] = float(props[key])
+        for name, by_step in legacy.items():
+            by_step.update(dict(out.get(name, ())))   # table wins per step
+            out[name] = sorted(by_step.items())
         return out
 
     def experiments(self) -> list[str]:

@@ -75,6 +75,13 @@ CREATE TABLE IF NOT EXISTS associations(
 CREATE TABLE IF NOT EXISTS attributions(
   context_id INTEGER NOT NULL, artifact_id INTEGER NOT NULL,
   PRIMARY KEY(context_id, artifact_id));
+CREATE TABLE IF NOT EXISTS observations(
+  trial_id INTEGER NOT NULL,        -- execution id of the trial
+  metric TEXT NOT NULL,
+  step INTEGER NOT NULL,
+  value REAL NOT NULL,
+  ts INTEGER NOT NULL DEFAULT (strftime('%s','now')),
+  PRIMARY KEY(trial_id, metric, step));
 )sql";
 
 // One prepared statement executed to completion; returns last error code.
@@ -464,6 +471,92 @@ int ms_list_context_artifacts(void* h, int64_t ctx, int64_t* out, int cap) {
   if (!q.ok()) return -1;
   q.bind_int(1, ctx);
   return fill_ids(q, out, cap);
+}
+
+// -- observations (katib observation_logs analog — SURVEY.md §2.4#33) ----------
+//
+// A dedicated (trial, metric, step) → value table: one row per point, one
+// upsert per point inside one IMMEDIATE transaction. The previous encoding —
+// one PROPERTY row per point with the step packed into the key — paid a
+// string key per lookup and rode the generic properties index; a 1e5-step
+// log on one execution node was a crawl, and the gRPC DBManager surface now
+// invites external writers at exactly that scale.
+
+int ms_report_observations(void* h, int64_t trial, const char* metric,
+                           const int64_t* steps, const double* values, int n) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (n <= 0) return 0;
+  if (!exec(s, "BEGIN IMMEDIATE")) return -1;
+  Stmt q(s->db,
+         "INSERT INTO observations(trial_id,metric,step,value)"
+         " VALUES(?,?,?,?) ON CONFLICT(trial_id,metric,step)"
+         " DO UPDATE SET value=excluded.value, ts=strftime('%s','now')");
+  if (!q.ok()) {
+    exec(s, "ROLLBACK");
+    return -1;
+  }
+  for (int i = 0; i < n; ++i) {
+    sqlite3_reset(q.get());
+    q.bind_int(1, trial);
+    q.bind_text(2, metric);
+    q.bind_int(3, steps[i]);
+    q.bind_double(4, values[i]);
+    if (q.step() != SQLITE_DONE) {
+      exec(s, "ROLLBACK");
+      return -1;
+    }
+  }
+  if (!exec(s, "COMMIT")) {
+    // A failed COMMIT (e.g. SQLITE_BUSY from a cross-process reader) keeps
+    // the transaction open; without the rollback every later write on this
+    // handle would wedge or silently land in the stale transaction.
+    exec(s, "ROLLBACK");
+    return -1;
+  }
+  return 0;
+}
+
+// Series ordered by step; fills up to cap, returns TOTAL row count (callers
+// grow the buffers and retry on truncation, the fill_ids convention).
+int ms_get_observations(void* h, int64_t trial, const char* metric,
+                        int64_t* steps, double* values, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT step,value FROM observations"
+         " WHERE trial_id=? AND metric=? ORDER BY step");
+  if (!q.ok()) return -1;
+  q.bind_int(1, trial);
+  q.bind_text(2, metric);
+  int n = 0;
+  while (q.step() == SQLITE_ROW) {
+    if (n < cap) {
+      steps[n] = sqlite3_column_int64(q.get(), 0);
+      values[n] = sqlite3_column_double(q.get(), 1);
+    }
+    ++n;
+  }
+  return n;
+}
+
+// Distinct metric names of a trial, newline-joined (the
+// ms_list_property_keys convention); returns the joined byte length.
+int ms_observation_metrics(void* h, int64_t trial, char* buf, int cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Stmt q(s->db,
+         "SELECT DISTINCT metric FROM observations WHERE trial_id=?"
+         " ORDER BY metric");
+  if (!q.ok()) return -1;
+  q.bind_int(1, trial);
+  std::string joined;
+  while (q.step() == SQLITE_ROW) {
+    if (!joined.empty()) joined += '\n';
+    joined += (const char*)sqlite3_column_text(q.get(), 0);
+  }
+  if (buf && cap > 0) snprintf(buf, cap, "%s", joined.c_str());
+  return (int)joined.size();
 }
 
 }  // extern "C"

@@ -43,6 +43,11 @@ int ms_events_by_artifact(void* h, int64_t art, int64_t* exec_ids, int* types,
 int ms_add_association(void* h, int64_t ctx, int64_t exec);
 int ms_add_attribution(void* h, int64_t ctx, int64_t art);
 int ms_list_context_executions(void* h, int64_t ctx, int64_t* out, int cap);
+int ms_report_observations(void* h, int64_t trial, const char* metric,
+                           const int64_t* steps, const double* values, int n);
+int ms_get_observations(void* h, int64_t trial, const char* metric,
+                        int64_t* steps, double* values, int cap);
+int ms_observation_metrics(void* h, int64_t trial, char* buf, int cap);
 }
 
 #define CHECK(cond)                                                   \
@@ -133,6 +138,56 @@ int main(int argc, char** argv) {
   for (int i = 0; i < 20; i++) ms_create_artifact(h, t_ds, "cas://bulk", 0);
   int64_t small[4];
   CHECK(ms_list_by_type(h, 0, t_ds, small, 4) > 4);
+
+  // Observations table: batch upsert, ordered read, truncation contract,
+  // metric listing.
+  {
+    int64_t steps[6] = {30, 10, 20, 40, 50, 20};   // unordered + dup step
+    double vals[6] = {3.0, 1.0, 2.0, 4.0, 5.0, 2.5};
+    CHECK(ms_report_observations(h, e, "loss", steps, vals, 6) == 0);
+    int64_t rs[8];
+    double rv[8];
+    int nobs = ms_get_observations(h, e, "loss", rs, rv, 8);
+    CHECK(nobs == 5);                               // dup step upserted
+    CHECK(rs[0] == 10 && rs[4] == 50);              // ordered by step
+    CHECK(rv[1] == 2.5);                            // last write won step 20
+    CHECK(ms_get_observations(h, e, "loss", rs, rv, 2) == 5);  // true count
+    CHECK(ms_get_observations(h, e, "nope", rs, rv, 8) == 0);
+    int64_t s2[1] = {1};
+    double v2[1] = {0.9};
+    CHECK(ms_report_observations(h, e, "accuracy", s2, v2, 1) == 0);
+    char mbuf[128];
+    CHECK(ms_observation_metrics(h, e, mbuf, sizeof(mbuf)) > 0);
+    CHECK(std::strcmp(mbuf, "accuracy\nloss") == 0);
+  }
+
+  // Concurrent observation writers (TSan: the new table shares the handle
+  // mutex; the IMMEDIATE transaction must not interleave).
+  {
+    std::vector<std::thread> obs_workers;
+    for (int w = 0; w < 4; w++) {
+      obs_workers.emplace_back([h, e, w] {
+        char metric[32];
+        std::snprintf(metric, sizeof(metric), "m%d", w);
+        for (int i = 0; i < 25; i++) {
+          int64_t s[4] = {i * 4, i * 4 + 1, i * 4 + 2, i * 4 + 3};
+          double v[4] = {1.0 * i, 2.0 * i, 3.0 * i, 4.0 * i};
+          ms_report_observations(h, e, metric, s, v, 4);
+          int64_t rs[128];
+          double rv[128];
+          ms_get_observations(h, e, metric, rs, rv, 128);
+        }
+      });
+    }
+    for (auto& t : obs_workers) t.join();
+    int64_t rs[128];
+    double rv[128];
+    for (int w = 0; w < 4; w++) {
+      char metric[32];
+      std::snprintf(metric, sizeof(metric), "m%d", w);
+      CHECK(ms_get_observations(h, e, metric, rs, rv, 128) == 100);
+    }
+  }
 
   // Concurrent writers (the TSan target of this test).
   std::vector<std::thread> workers;

@@ -40,6 +40,25 @@ def test_report_is_resume_safe_upsert(log):
     assert log.get_log("t1")["loss"] == [(0, 2.0), (10, 1.4), (20, 1.0)]
 
 
+def test_legacy_property_rows_merge_with_table(log):
+    """A trial spanning the migration: points written as obs:* properties
+    (rounds 1-3) and points in the observations table must read as ONE
+    series, table winning on a shared step."""
+    from kubeflow_tpu.pipelines.metadata import EXECUTION
+
+    eid = log.trial_execution("default/exp1", "old")
+    log.store._set_props(EXECUTION, eid, {
+        "obs:loss:00000000": 3.0,
+        "obs:loss:00000005": 2.0,          # superseded by the table below
+        "obs:val:loss:00000002": 9.0,      # metric name containing ':'
+    })
+    log.report("default/exp1", "old", "loss", [(5, 1.5), (10, 1.0)])
+    got = log.get_log("old")
+    assert got["loss"] == [(0, 3.0), (5, 1.5), (10, 1.0)]
+    assert got["val:loss"] == [(2, 9.0)]
+    assert log.best("default/exp1", "loss") == ("old", 1.0)
+
+
 def test_cross_experiment_queries(log):
     log.report("default/sweep-a", "a-0", "loss", [(0, 3.0), (5, 1.0)])
     log.report("default/sweep-a", "a-1", "loss", [(0, 3.0), (5, 2.0)])
