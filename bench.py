@@ -9,14 +9,19 @@ on every local device and prints ONE JSON line:
 llama3-0.6b / seq2048 / batch-4-per-chip config (the reference platform
 publishes no training numbers — BASELINE.md).
 
-Round-3 configuration, from the on-chip A/Bs (BASELINE.md round-3 table):
-- the tuned Pallas flash kernels (bf16 MXU inputs, (1024,1024) blocks)
-  beat XLA's fused S×S attention at this shape — 486 -> 349 ms/step —
-  which frees enough HBM that "dots_no_batch" remat and an UNchunked CE
-  head win over the round-2 block_outs + chunked-CE config.
-- 16 train steps per device dispatch (lax.scan over stacked batches): the
-  tunnel's ~90-105 ms per-dispatch overhead amortizes to ~6 ms/step.
-- bf16 Adam first moment (mu_dtype) halves optimizer-state bandwidth.
+Round-4 configuration, from the on-chip A/Bs (BASELINE.md round-4 table):
+- per-chip batch 5 with "dots_flash" remat: dots_no_batch plus the flash
+  kernel's saved (o, lse) — without the names the backward replays the
+  forward kernel per layer just to rebuild its VJP residuals (+2.4% at
+  b5; b6 fits only under plain dots_no_batch and measures slightly lower;
+  b7 OOMs either way).
+- 32 train steps per device dispatch (k=64 measured identical — the
+  ~90-105 ms tunnel round-trip is fully amortized at 32).
+- the round-3 flash kernels (bf16 MXU inputs, (1024,1024) blocks; larger
+  blocks OOM at b5/b6), bf16 Adam first moment, unchunked CE.
+- A fused one-pass AdamW (optim.FusedAdamW) measured a TIE with the optax
+  chain — XLA already fuses the chain's elementwise stages — so it stays
+  available but off; the step-time decomposition lives in BASELINE.md.
 
 Methodology (round-4, matching bench_serve.py): warm dispatches compile and
 settle the exact dispatch set, then TWO back-to-back measured segments run
@@ -37,7 +42,7 @@ ROUND1_TOKS_PER_SEC_CHIP = 13673.23
 
 def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
                        mu_dtype=None, learning_rate=None, attn_impl="xla",
-                       segments=2):
+                       segments=2, fused_optimizer=False):
     """The one train-throughput measurement loop every bench shares
     (bench.py headline + scripts/bench_configs.py rows): K steps per
     dispatch over an fsdp mesh, warm dispatches excluded, then ``segments``
@@ -68,7 +73,8 @@ def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
     task = setup_train(
         cfg, OptimizerConfig(total_steps=max((warm_disp + disp) * k_dispatch,
                                              10_000),
-                             mu_dtype=mu_dtype, **opt_kw),
+                             mu_dtype=mu_dtype, fused=fused_optimizer,
+                             **opt_kw),
         mesh, attn_impl=attn_impl)
 
     def dispatch(i0, state):
@@ -121,18 +127,15 @@ def run_bench():
     if on_tpu:
         # Llama-3 architecture sized to fit one v5e chip's HBM with fp32
         # Adam state (~0.6B params): the per-chip unit of the 8B recipe.
-        # Round-3 winners (A/B'd on-chip, BASELINE.md): the tuned Pallas
-        # flash kernels beat XLA's fused S×S attention at this shape
-        # (486 -> 349 ms/step), which frees enough HBM that dots_no_batch
-        # remat and an UNchunked CE head win over block_outs + chunking.
+        # Round-4 winners (A/B'd on-chip, see module docstring).
         cfg = preset(
             "llama3-8b",
             n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
             mlp_dim=8192, vocab_size=32000, max_seq_len=2048,
-            remat_policy="dots_no_batch",
+            remat_policy="dots_flash",
         )
         model_tag = "llama3-0.6b"
-        per_chip_batch, k_dispatch, warm_disp, disp = 4, 16, 2, 3
+        per_chip_batch, k_dispatch, warm_disp, disp = 5, 32, 3, 2
     else:
         cfg = preset("tiny")
         model_tag = "tiny"

@@ -150,6 +150,18 @@ def _remat(fn, policy: str):
         return jax.checkpoint(
             fn,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if policy == "dots_flash":
+        # dots_no_batch + save the flash kernel's (o, lse): the custom-VJP
+        # residuals that dots_no_batch would otherwise rebuild by replaying
+        # the forward kernel in the backward. Costs [B,H,S,D] bf16 + lse
+        # per layer of HBM; wins when that fits (the headline config's
+        # round-4 default — see ops/flash_attention.py note).
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse")))
     raise ValueError(f"unknown remat policy {policy!r}")
 
 

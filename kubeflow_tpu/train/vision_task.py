@@ -23,7 +23,9 @@ from kubeflow_tpu.models.vision import (
 from kubeflow_tpu.parallel.sharding import (
     DEFAULT_RULES, LogicalRules, logical_to_mesh_axes, shard_params,
 )
-from kubeflow_tpu.train.optim import OptimizerConfig, make_optimizer
+from kubeflow_tpu.train.optim import (
+    OptimizerConfig, apply_optimizer, make_optimizer,
+)
 
 
 @dataclasses.dataclass
@@ -76,11 +78,10 @@ def _setup(cfg, init_fn, specs_fn, loss_fn, batch_spec_of, opt_cfg, mesh,
 
         (loss, metrics), grads = jax.value_and_grad(lf, has_aux=True)(
             state["params"])
-        updates, new_opt = optimizer.update(grads, state["opt_state"],
-                                            state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
+        new_params, new_opt, grad_norm = apply_optimizer(
+            optimizer, grads, state["opt_state"], state["params"])
         metrics = dict(metrics)
-        metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["grad_norm"] = grad_norm
         return ({"params": new_params, "opt_state": new_opt,
                  "step": state["step"] + 1}, metrics)
 

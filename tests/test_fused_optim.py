@@ -1,0 +1,87 @@
+"""FusedAdamW (one-pass update, VERDICT r3 #6) must be numerically
+equivalent to the optax chain it replaces: same clip, same bias-corrected
+moments, same weight decay, same schedule."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.train.optim import FusedAdamW, OptimizerConfig, make_optimizer
+
+
+def _tree(key, scale=1.0):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "w": jax.random.normal(k1, (16, 8)) * scale,
+        "b": jax.random.normal(k2, (8,)) * scale,
+        "emb": jax.random.normal(k3, (32, 16)) * scale,
+    }
+
+
+@pytest.mark.parametrize("clip_active", [False, True])
+@pytest.mark.parametrize("mu_dtype", [None, "bfloat16"])
+def test_fused_matches_optax_chain(clip_active, mu_dtype):
+    import optax
+
+    cfg = OptimizerConfig(learning_rate=1e-2, warmup_steps=2, total_steps=50,
+                          clip_norm=1.0, mu_dtype=mu_dtype,
+                          weight_decay=0.1)
+    ref_opt = make_optimizer(cfg)
+    fused = make_optimizer(OptimizerConfig(**{
+        **cfg.__dict__, "fused": True}))
+    assert isinstance(fused, FusedAdamW)
+
+    # clip_active=True drives gradients large enough that the global-norm
+    # scale actually engages; False keeps the norm under clip_norm=1.0
+    # (~0.026 expected for the 648-leaf unit tree at 0.001) so the
+    # scale==1 path is genuinely exercised too.
+    gscale = 10.0 if clip_active else 0.001
+    params_ref = _tree(jax.random.PRNGKey(0))
+    params_fused = jax.tree.map(jnp.copy, params_ref)
+    opt_ref = ref_opt.init(params_ref)
+    opt_fused = fused.init(params_fused)
+
+    for step in range(5):
+        grads = _tree(jax.random.PRNGKey(100 + step), scale=gscale)
+        updates, opt_ref = ref_opt.update(grads, opt_ref, params_ref)
+        params_ref = optax.apply_updates(params_ref, updates)
+        params_fused, opt_fused, gnorm = fused.apply(grads, opt_fused,
+                                                     params_fused)
+        assert float(gnorm) == pytest.approx(
+            float(optax.global_norm(grads)), rel=1e-6)
+
+    for name in params_ref:
+        np.testing.assert_allclose(
+            params_ref[name], params_fused[name],
+            rtol=2e-5 if mu_dtype is None else 2e-2,
+            atol=1e-6 if mu_dtype is None else 1e-4)
+
+
+def test_fused_trains_in_the_real_step(tmp_path):
+    """setup_train with fused=True: state init/shardings/step all work and
+    the loss goes down — the structural integration, not just leaf math."""
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.runtime.mesh import build_mesh
+    from kubeflow_tpu.train.data import DataConfig, make_data_source
+    from kubeflow_tpu.train.step import setup_train
+
+    cfg = preset("tiny", vocab_size=256, max_seq_len=32)
+    task = setup_train(cfg, OptimizerConfig(total_steps=20, fused=True,
+                                            warmup_steps=0),
+                       build_mesh({"data": 8}))
+    src = make_data_source(DataConfig(vocab_size=256, seq_len=32,
+                                      global_batch=8))
+    state = task.state
+    losses = []
+    for i in range(8):
+        batch = jax.device_put(src.batch_at(i), task.batch_sharding)
+        state, m = task.step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state["opt_state"]["count"]) == 8
+
+
+def test_fused_requires_adamw():
+    with pytest.raises(ValueError, match="adamw only"):
+        make_optimizer(OptimizerConfig(name="sgd", fused=True))

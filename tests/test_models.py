@@ -70,12 +70,30 @@ def test_decode_cache_matches_full_forward():
 def test_remat_policies_same_loss():
     toks = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 256)
     losses = []
-    for policy in ["none", "nothing_saveable", "full"]:
+    for policy in ["none", "nothing_saveable", "full", "dots_no_batch",
+                   "dots_flash"]:
         cfg = preset("tiny", remat_policy=policy)
         params = init_decoder_params(jax.random.PRNGKey(0), cfg)
         loss, _ = jax.jit(lambda p, t: decoder_loss(p, t, cfg))(params, toks)
         losses.append(float(loss))
     assert max(losses) - min(losses) < 1e-5
+
+
+def test_dots_flash_grads_match_unrematted():
+    """The dots_flash policy (saved flash (o,lse) residuals) must not
+    change gradients — only what the backward recomputes. Pallas impl so
+    the saved names actually appear in the trace."""
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 256)
+    grads = []
+    for policy in ["none", "dots_flash"]:
+        cfg = preset("tiny", remat_policy=policy, dtype="float32")
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        g = jax.grad(lambda p: decoder_loss(p, toks, cfg,
+                                            attn_impl="pallas")[0])(params)
+        grads.append(g)
+    for a, b in zip(jax.tree.leaves(grads[0]), jax.tree.leaves(grads[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
 
 
 def test_param_count_formula_matches_actual():
