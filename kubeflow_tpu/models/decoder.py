@@ -115,7 +115,7 @@ def _block_forward(block_params, x, positions, cfg: DecoderConfig,
     if cfg.is_moe:
         mlp_out, aux = L.moe_block(block_params["mlp"], h, cfg,
                                    expert_axis=expert_axis, seq_axis=seq_axis,
-                                   valid_len=valid_len)
+                                   valid_len=valid_len, tp_axis=tp_axis)
     else:
         mlp_out, aux = (L.mlp_block(block_params["mlp"], h, cfg,
                                     tp_axis=tp_axis), jnp.float32(0))
@@ -328,10 +328,6 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
           and axis_sizes.get("seq", 1) > 1)
     ep = cfg.is_moe and axis_sizes.get("expert", 1) > 1
     tp = axis_sizes.get("model", 1)
-    if tp > 1 and cfg.is_moe:
-        raise NotImplementedError(
-            "pipeline x TP x MoE is not composed (expert parallelism covers "
-            "the MoE mlp); use pipeline x EP for MoE models")
     if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp
                    or cfg.mlp_dim % tp):
         raise ValueError(
@@ -353,7 +349,10 @@ def _pipeline_layers(layer_params, x, positions, cfg: DecoderConfig, mesh,
     # Per-leaf partition specs: stage dim over pipeline; the expert dim keeps
     # its sharding for local-EP compute; head/mlp dims keep their Megatron
     # sharding for in-stage TP (layers.py runs the matching psums).
-    tp_logical = {"heads", "kv_heads", "mlp"} if tp > 1 else set()
+    # PP×TP×MoE composes the two: experts shard over `expert`, each
+    # expert's mlp dim over `model` — one combined psum in the moe block.
+    tp_logical = ({"heads", "kv_heads", "mlp", "expert_mlp"}
+                  if tp > 1 else set())
 
     def leaf_spec(spec):
         rest = tuple("expert" if (ep and name == "expert")

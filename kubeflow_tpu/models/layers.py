@@ -247,7 +247,8 @@ def init_moe(key, cfg: DecoderConfig):
 def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig,
               expert_axis: Optional[str] = None,
               seq_axis: Optional[str] = None,
-              valid_len: Optional[jax.Array] = None):
+              valid_len: Optional[jax.Array] = None,
+              tp_axis: Optional[str] = None):
     """Top-k MoE (Mixtral semantics: softmax over the selected k logits).
 
     Dispatches on ``cfg.moe_impl``: "dispatch" (default) routes tokens into
@@ -260,13 +261,21 @@ def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig,
     prefill pads prompts to a bucket, and without the mask hundreds of
     identical pad tokens would displace real tokens' choices under
     choice-major priority. Dense ignores it (every expert computes every
-    token, pads can't affect real rows)."""
+    token, pads can't affect real rows).
+
+    ``tp_axis`` (inside shard_map — the PP×TP×MoE composition): weights
+    additionally hold this device's slice of the expert-mlp dim (the
+    Megatron split applied INSIDE each expert); gate/up produce the local
+    m-slice and down's partial products join the expert partials in one
+    psum over both axes."""
     if cfg.moe_impl == "dispatch":
         return _moe_dispatch(p, x, cfg, expert_axis=expert_axis,
-                             seq_axis=seq_axis, valid_len=valid_len)
+                             seq_axis=seq_axis, valid_len=valid_len,
+                             tp_axis=tp_axis)
     if cfg.moe_impl != "dense":
         raise ValueError(f"unknown moe_impl {cfg.moe_impl!r}")
-    return _moe_dense(p, x, cfg, expert_axis=expert_axis, seq_axis=seq_axis)
+    return _moe_dense(p, x, cfg, expert_axis=expert_axis, seq_axis=seq_axis,
+                      tp_axis=tp_axis)
 
 
 def _moe_aux_loss(router_logits, onehot_sum, cfg: DecoderConfig,
@@ -295,7 +304,8 @@ def moe_capacity(cfg: DecoderConfig, tokens: int) -> int:
 def _moe_dispatch(p: dict, x: jax.Array, cfg: DecoderConfig,
                   expert_axis: Optional[str] = None,
                   seq_axis: Optional[str] = None,
-                  valid_len: Optional[jax.Array] = None):
+                  valid_len: Optional[jax.Array] = None,
+                  tp_axis: Optional[str] = None):
     """Capacity-factor top-k dispatch (SURVEY.md §2.6 EP row: the TPU-native
     MoE data path; (U) training-operator-era Mixtral recipes route via NCCL
     all-to-all — here the routing is scatter/gather into static [E, C]
@@ -376,8 +386,12 @@ def _moe_dispatch(p: dict, x: jax.Array, cfg: DecoderConfig,
     back = jnp.take(y, rows, axis=0, mode="fill", fill_value=0)      # [kT,D]
     w_flat = topk_w.T.reshape(-1, 1).astype(dt)
     out = (back * w_flat).reshape(k, t, d).sum(0).reshape(b, s, d)
-    if expert_axis is not None:
-        out = jax.lax.psum(out, expert_axis)
+    # One combined reduction: expert partials (each shard computed its
+    # local experts) and Megatron partials (down contracted a local
+    # m-slice) sum over both axes at once.
+    axes = tuple(a for a in (expert_axis, tp_axis) if a is not None)
+    if axes:
+        out = jax.lax.psum(out, axes)
 
     aux = _moe_aux_loss(
         router_logits.reshape(b, s, e),
@@ -388,7 +402,8 @@ def _moe_dispatch(p: dict, x: jax.Array, cfg: DecoderConfig,
 
 def _moe_dense(p: dict, x: jax.Array, cfg: DecoderConfig,
                expert_axis: Optional[str] = None,
-               seq_axis: Optional[str] = None):
+               seq_axis: Optional[str] = None,
+               tp_axis: Optional[str] = None):
     """Einsum-dense formulation: every expert computes every token and a
     one-hot combine weights the results. FLOP-inefficient (E/k overcompute)
     but fully static-shaped and drop-free — under GSPMD the ``expert``
@@ -420,8 +435,9 @@ def _moe_dense(p: dict, x: jax.Array, cfg: DecoderConfig,
     up = jnp.einsum("bsd,edm->ebsm", x, p["up"].astype(dt))
     expert_out = jnp.einsum("ebsm,emd->ebsd", gate * up, p["down"].astype(dt))
     out = jnp.einsum("ebsd,bse->bsd", expert_out, combine.astype(dt))
-    if expert_axis is not None:
-        out = jax.lax.psum(out, expert_axis)
+    axes = tuple(a for a in (expert_axis, tp_axis) if a is not None)
+    if axes:
+        out = jax.lax.psum(out, axes)
 
     aux = _moe_aux_loss(router_logits, onehot.sum(axis=2), cfg, seq_axis)
     return out, aux

@@ -72,7 +72,13 @@ class FusedAdamW(NamedTuple):
             "count": jnp.zeros((), jnp.int32),
             "mu": jax.tree.map(
                 lambda p: jnp.zeros_like(p, dtype=mu_dt or p.dtype), params),
-            "nu": jax.tree.map(jnp.zeros_like, params),
+            # nu is fp32 REGARDLESS of param dtype (its dynamic range
+            # matters — module docstring), and apply() returns it fp32:
+            # init must agree or the scan-carried state changes dtype
+            # after one step (trace error) and the abstract checkpoint
+            # target desyncs.
+            "nu": jax.tree.map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
         }
 
     def apply(self, grads, opt_state, params):

@@ -82,6 +82,23 @@ def test_fused_trains_in_the_real_step(tmp_path):
     assert int(state["opt_state"]["count"]) == 8
 
 
+def test_fused_state_dtypes_stable_for_bf16_params():
+    """Opt-state dtypes must be identical before and after apply() — a
+    scan-carried train state (multi_step_fn) trace-errors otherwise."""
+    fused = make_optimizer(OptimizerConfig(fused=True, mu_dtype="bfloat16",
+                                           warmup_steps=0, total_steps=10))
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                          _tree(jax.random.PRNGKey(0)))
+    opt = fused.init(params)
+    grads = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                         _tree(jax.random.PRNGKey(1)))
+    new_p, new_opt, _ = fused.apply(grads, opt, params)
+    assert jax.tree.map(lambda x: x.dtype, opt) \
+        == jax.tree.map(lambda x: x.dtype, new_opt)
+    assert jax.tree.map(lambda x: x.dtype, params) \
+        == jax.tree.map(lambda x: x.dtype, new_p)
+
+
 def test_fused_requires_adamw():
     with pytest.raises(ValueError, match="adamw only"):
         make_optimizer(OptimizerConfig(name="sgd", fused=True))

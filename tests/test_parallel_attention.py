@@ -351,6 +351,35 @@ class TestModelPipelineParallel:
         for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
             rel_close(a, b, rtol=2e-3)
 
+    def test_moe_pp_ep_tp_matches_unstaged(self):
+        """PP×TP×MoE (the round-3 NotImplementedError, lifted): expert
+        weights shard over `expert` AND each expert's mlp dim over `model`
+        inside the stage, attention head-sharded over `model` — one
+        combined psum. Loss and grads must match the unsharded model
+        (ample capacity: no drops, same caveat as the PP×EP test)."""
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.models.decoder import (
+            decoder_loss, init_decoder_params)
+        from kubeflow_tpu.runtime.mesh import build_mesh
+
+        cfg = preset("tiny-moe", n_layers=4, dtype="float32",
+                     capacity_factor=8.0)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, 256)
+        mesh = build_mesh({"pipeline": 2, "expert": 2, "model": 2})
+
+        def ref_loss(p, t):
+            return decoder_loss(p, t, cfg, aux_loss_weight=0.0)[0]
+
+        def pp_loss(p, t):
+            return decoder_loss(p, t, cfg, mesh=mesh, aux_loss_weight=0.0)[0]
+
+        ref, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
+        out, g_pp = jax.jit(jax.value_and_grad(pp_loss))(params, tokens)
+        assert abs(float(ref) - float(out)) < 5e-4 * max(1.0, abs(float(ref)))
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
+            rel_close(a, b, rtol=2e-3)
+
     def test_moe_pp_aux_loss_flows(self):
         """The streamed aux accumulator must surface a positive
         load-balancing loss under PP."""
