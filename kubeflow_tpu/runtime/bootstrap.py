@@ -183,19 +183,28 @@ def bootstrap_worker(wenv: Optional[WorkerEnv] = None):
     return wenv, mesh
 
 
-def single_worker_mesh(wenv: Optional["WorkerEnv"], axis: str = "data"):
-    """Mesh for a compute entrypoint on the light-start path.
+def apply_platform(wenv: Optional["WorkerEnv"]) -> None:
+    """Platform selection for a compute entrypoint on the light-start path.
 
-    Single-worker jobs with no parallelism skip the jax setup in
-    bootstrap_worker (fast start for control-plane probes); an entrypoint
-    that DOES compute calls this to apply the same platform selection and
-    get a 1-axis local mesh."""
+    bootstrap_worker returns before touching JAX for single-worker
+    no-parallelism jobs (fast start for control-plane probes), so any
+    entrypoint that initializes JAX itself must apply the selection first
+    — the axon sitecustomize force-sets jax_platforms and the env var
+    alone cannot override it. Serving replicas hit this: without it a
+    platform="cpu" model server initializes the hardware backend inside
+    load_params."""
     import jax
 
     if wenv is not None and wenv.platform == "cpu":
-        # The axon sitecustomize force-sets jax_platforms; the env var alone
-        # cannot override it (same dance as bootstrap_worker).
         jax.config.update("jax_platforms", "cpu")
+
+
+def single_worker_mesh(wenv: Optional["WorkerEnv"], axis: str = "data"):
+    """apply_platform + a 1-axis local mesh (the training entrypoints'
+    light-start path)."""
+    import jax
+
+    apply_platform(wenv)
     from kubeflow_tpu.runtime.mesh import build_mesh
 
     return build_mesh({axis: jax.local_device_count()})

@@ -47,10 +47,16 @@ def resolve_transformer(handler: str) -> Callable:
 def model_server(ctx: WorkerContext) -> int:
     from kubeflow_tpu.core.serving import BatchingSpec
     from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.runtime.bootstrap import apply_platform
     from kubeflow_tpu.serve.engine import LLMEngine
     from kubeflow_tpu.serve.server import ModelServer
     from kubeflow_tpu.serve.storage import load_params
 
+    # Single-replica servers take bootstrap's light-start path (no mesh),
+    # so the worker's platform selection must apply here, BEFORE
+    # load_params initializes JAX — a platform="cpu" replica must never
+    # grab the hardware backend.
+    apply_platform(ctx.env)
     conf = ctx.config
     model_conf = conf.get("model", {})
     cfg = preset(model_conf.get("preset", "tiny"),
