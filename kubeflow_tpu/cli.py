@@ -210,6 +210,28 @@ def cmd_volumes(args) -> int:
     return 0
 
 
+def cmd_artifacts(args) -> int:
+    """Registered artifact:// names → versions → shape/size — what an
+    operator checks before pointing a storageUri or dataset_uri at one."""
+    if not args.name:
+        items = _req(args.server, "GET", "/artifacts")["items"]
+        if not items:
+            print("no registered artifacts")
+            return 0
+        for n, d in sorted(items.items()):
+            print(f"{n:30} {d['versions']} version(s)  "
+                  f"latest=@{d['latest']} ({d['kind']}, "
+                  f"{d['bytes'] / 1e6:.1f} MB)")
+        return 0
+    info = _req(args.server, "GET", f"/artifacts/{args.name}")
+    print(f"{'VERSION':10} {'KIND':6} {'SIZE':>10}  URI")
+    for v, d in info["versions"].items():
+        extra = f" ({d['files']} files)" if d["kind"] == "tree" else ""
+        print(f"{v:10} {d['kind']:6} {d['bytes'] / 1e6:9.1f}M  "
+              f"artifact://{args.name}@{v}{extra}")
+    return 0
+
+
 def cmd_exec(args) -> int:
     out = _req(args.server, "GET",
                f"/apis/Notebook/{args.namespace}/{args.name}")
@@ -345,6 +367,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("path", nargs="?")
     common(sp)
     sp.set_defaults(fn=cmd_volumes)
+
+    sp = sub.add_parser("artifacts",
+                        help="browse the artifact register (artifact:// "
+                             "names, versions, sizes)")
+    sp.add_argument("name", nargs="?")
+    common(sp)
+    sp.set_defaults(fn=cmd_artifacts)
 
     sp = sub.add_parser("exec", help="run a cell in a notebook session")
     sp.add_argument("name")

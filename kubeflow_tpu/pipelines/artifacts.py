@@ -290,6 +290,37 @@ class ArtifactStore:
             return []
         return sorted(entries, key=_version_key)
 
+    def names(self) -> list[str]:
+        """All registered artifact names (the register's catalog). Only
+        names with at least one committed version count — a crash between
+        mkdir and the version link must not surface a phantom entry that
+        lists here but 404s on lookup."""
+        d = os.path.join(self.root, "named")
+        try:
+            return sorted(n for n in os.listdir(d)
+                          if os.path.isdir(os.path.join(d, n))
+                          and self.versions(n))
+        except FileNotFoundError:
+            return []
+
+    def describe(self, uri: str) -> dict:
+        """Shape summary of any artifact uri: its content address, whether
+        it is a tree (model checkpoint) or a blob (dataset/tokenizer), and
+        its stored size — what a registry listing shows without
+        materializing anything."""
+        cas = self.resolve(uri)
+        if not self.exists(cas):
+            raise FileNotFoundError(f"{uri} ({cas}) is not in the store")
+        manifest = self._manifest_of(cas)
+        if manifest is None:
+            return {"uri": cas, "kind": "blob",
+                    "bytes": os.path.getsize(self.path_for(cas))}
+        # Stored size: distinct blobs only — identical shards dedup in the
+        # CAS, and the size column must reflect what the store holds.
+        return {"uri": cas, "kind": "tree", "files": len(manifest),
+                "bytes": sum(os.path.getsize(self._path(d))
+                             for d in set(manifest.values()))}
+
     def lookup(self, name: str, version: Optional[str] = None) -> str:
         """name[@version] → cas:// uri (highest version when none given)."""
         if not _NAME_OK.match(name):

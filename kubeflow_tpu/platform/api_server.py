@@ -153,6 +153,8 @@ class ApiServer:
             return self._logs(h, parts[1], parts[2], parts[3])
         if parts[:1] == ["volumes"]:
             return self._volumes_get(h, [unquote(p) for p in parts[1:]])
+        if parts[:1] == ["artifacts"]:
+            return self._artifacts_get(h, [unquote(p) for p in parts[1:]])
         if url.path == "/dashboard":
             return self._dashboard(h, q)
         if url.path == "/notebooks/form/config":
@@ -174,6 +176,48 @@ class ApiServer:
                 "idle_cull_seconds": {"default": 3600, "options":
                                       [600, 1800, 3600, 0]},
             })
+        h._send(404, {"error": "no route"})
+
+    # -- artifacts (the register's read surface) -------------------------------
+
+    def _artifacts_get(self, h, parts: list) -> None:
+        """GET /artifacts                      registered names
+           GET /artifacts/<name>               versions + shape summaries
+           GET /artifacts/<name>/<version>     one entry (cas uri, kind,
+                                               size) — what an operator
+        checks before pointing a storageUri at it."""
+        store = self.cp.artifact_store
+        try:
+            if not parts:
+                # One latest-version summary per name: the listing must not
+                # stat every shard of every historical version (O(versions
+                # x files)); the per-name route is the full detail view.
+                items = {}
+                for n in store.names():
+                    versions = store.versions(n)
+                    items[n] = {
+                        "versions": len(versions), "latest": versions[-1],
+                        **store.describe(store.lookup(n, versions[-1]))}
+                return h._send(200, {"names": sorted(items), "items": items})
+            name = parts[0]
+            if len(parts) == 1:
+                versions = store.versions(name)
+                if not versions:
+                    return h._send(404, {"error": f"no artifact {name!r}"})
+                return h._send(200, {
+                    "name": name,
+                    "versions": {
+                        v: store.describe(store.lookup(name, v))
+                        for v in versions},
+                    "latest": versions[-1]})
+            if len(parts) == 2:
+                out = store.describe(store.lookup(name, parts[1]))
+                out["artifact_uri"] = f"artifact://{name}@{parts[1]}"
+                return h._send(200, out)
+        except FileNotFoundError as exc:
+            return h._send(404, {"error": str(exc)})
+        except ValueError as exc:
+            return h._send(400, {"error": str(exc)})
         h._send(404, {"error": "no route"})
 
     # -- dashboard (centraldashboard analog) -----------------------------------

@@ -406,3 +406,56 @@ def test_dashboard_html_escapes_user_fields(api):
     assert code == 200
     assert "<script>alert(1)</script>" not in html
     assert "&lt;script&gt;" in html
+
+
+class TestArtifactsSurface:
+    """The register's read surface: /artifacts routes + kftpu artifacts —
+    what an operator checks before pointing a storageUri at a version."""
+
+    def _publish(self, cp, tmp_path):
+        from kubeflow_tpu.pipelines.artifacts import publish_file, publish_model
+
+        corpus = tmp_path / "c.txt"
+        corpus.write_text("hello " * 100)
+        publish_file(str(corpus), name="corpus", store=cp.artifact_store)
+        ckpt = tmp_path / "ckpt"
+        (ckpt / "sub").mkdir(parents=True)
+        (ckpt / "sub" / "w").write_bytes(b"weights" * 50)
+        publish_model(str(ckpt), name="m", version="1",
+                      store=cp.artifact_store)
+        publish_model(str(ckpt), name="m", version="2",
+                      store=cp.artifact_store)
+
+    def test_routes(self, api, tmp_path):
+        cp, server = api
+        self._publish(cp, tmp_path)
+        code, out = call(server, "GET", "/artifacts")
+        assert code == 200 and out["names"] == ["corpus", "m"]
+        assert out["items"]["m"]["latest"] == "2"
+        assert out["items"]["m"]["kind"] == "tree"
+        # Dedup-aware size: v1 and v2 are IDENTICAL trees — the shared
+        # blob counts once.
+        assert out["items"]["m"]["bytes"] == 7 * 50
+        code, out = call(server, "GET", "/artifacts/m")
+        assert code == 200 and out["latest"] == "2"
+        assert out["versions"]["1"]["kind"] == "tree"
+        assert out["versions"]["1"]["files"] == 1
+        code, out = call(server, "GET", "/artifacts/corpus/1")
+        assert code == 200 and out["kind"] == "blob"
+        assert out["artifact_uri"] == "artifact://corpus@1"
+        code, _ = call(server, "GET", "/artifacts/ghost")
+        assert code == 404
+        code, _ = call(server, "GET", "/artifacts/..%2F..%2Fetc/passwd")
+        assert code == 400          # traversal-shaped names rejected
+
+    def test_cli(self, api, tmp_path, capsys):
+        from kubeflow_tpu.cli import main as cli_main
+
+        cp, server = api
+        self._publish(cp, tmp_path)
+        assert cli_main(["artifacts", "--server", server.url]) == 0
+        out = capsys.readouterr().out
+        assert "corpus" in out and "latest=@2" in out
+        assert cli_main(["artifacts", "m", "--server", server.url]) == 0
+        out = capsys.readouterr().out
+        assert "artifact://m@2" in out and "tree" in out
