@@ -119,9 +119,14 @@ def run_bench():
     import jax
 
     from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.runtime.bootstrap import enable_compilation_cache
 
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
+    if on_tpu:
+        # Cuts the minutes-long tunnel compile on repeat runs; measured
+        # segments warm first, so the cache never touches the numbers.
+        enable_compilation_cache()
     n = len(devices)
 
     if on_tpu:

@@ -17,6 +17,8 @@ Routes:
 - ``GET  /logs/{ns}/{job}/{replica_index}`` worker log tail
 - ``GET/POST/DELETE /volumes/...``          volume browser (pvcviewer +
   volumes-web-app analog; see the volumes section below)
+- ``GET  /artifacts[/{name}[/{version}]]``  artifact register read surface
+  (artifact:// names → versions → kind/size/cas uri)
 
 Identity: requests may carry ``X-Kftpu-User``; profile-namespace writes are
 checked against the Profile's owner/contributors (the KFAM authz surface).
@@ -187,6 +189,15 @@ class ApiServer:
                                                size) — what an operator
         checks before pointing a storageUri at it."""
         store = self.cp.artifact_store
+
+        def summary(name, version):
+            """describe() that degrades per ENTRY: one dangling register
+            binding (pruned CAS blob) must not 404 the whole catalog."""
+            try:
+                return store.describe(store.lookup(name, version))
+            except (FileNotFoundError, ValueError) as exc:
+                return {"kind": "broken", "error": str(exc)}
+
         try:
             if not parts:
                 # One latest-version summary per name: the listing must not
@@ -194,11 +205,14 @@ class ApiServer:
                 # x files)); the per-name route is the full detail view.
                 items = {}
                 for n in store.names():
+                    # Second (tiny) listdir per name — names() already
+                    # scanned to filter phantoms; register dirs are small
+                    # enough that sharing the scan isn't worth API churn.
                     versions = store.versions(n)
                     items[n] = {
                         "versions": len(versions), "latest": versions[-1],
-                        **store.describe(store.lookup(n, versions[-1]))}
-                return h._send(200, {"names": sorted(items), "items": items})
+                        **summary(n, versions[-1])}
+                return h._send(200, {"names": list(items), "items": items})
             name = parts[0]
             if len(parts) == 1:
                 versions = store.versions(name)
@@ -206,9 +220,7 @@ class ApiServer:
                     return h._send(404, {"error": f"no artifact {name!r}"})
                 return h._send(200, {
                     "name": name,
-                    "versions": {
-                        v: store.describe(store.lookup(name, v))
-                        for v in versions},
+                    "versions": {v: summary(name, v) for v in versions},
                     "latest": versions[-1]})
             if len(parts) == 2:
                 out = store.describe(store.lookup(name, parts[1]))

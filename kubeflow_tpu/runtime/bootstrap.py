@@ -157,6 +157,10 @@ def bootstrap_worker(wenv: Optional[WorkerEnv] = None):
         # The axon sitecustomize force-sets jax_platforms="axon,cpu"; the env
         # var alone cannot override it (see memory: axon-jax-env-facts).
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # Hardware workers share compiled programs across gang attempts
+        # and restarts (an elastic resize re-compiles the same shapes).
+        enable_compilation_cache()
 
     if wenv.num_processes > 1:
         try:
@@ -181,6 +185,32 @@ def bootstrap_worker(wenv: Optional[WorkerEnv] = None):
 
     mesh = build_mesh(wenv.parallelism) if wenv.parallelism else None
     return wenv, mesh
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> None:
+    """Persistent XLA compilation cache, shared across processes.
+
+    On the tunneled TPU a compile is minutes-per-variant; the cache cuts a
+    re-compile of an unchanged program ~6x (measured 3.4 s -> 0.5 s on a
+    small probe — headline programs save proportionally more). Keyed by
+    HLO hash, so code changes miss naturally. Default location comes from
+    $KFTPU_JAX_CACHE_DIR, else ~/.cache/kftpu/jax; failures are
+    non-fatal (the cache is an accelerator, never a dependency)."""
+    import jax
+
+    path = path or os.environ.get(
+        "KFTPU_JAX_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "kftpu", "jax"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        # Threshold first: if this flag is absent on some JAX version, the
+        # cache stays untouched — setting the dir first would enable it
+        # and then log "disabled", misleading anyone debugging cache
+        # behavior.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as exc:  # noqa: BLE001 — best-effort
+        print(f"kftpu: compilation cache disabled: {exc}", flush=True)
 
 
 def apply_platform(wenv: Optional["WorkerEnv"]) -> None:
