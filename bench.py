@@ -71,8 +71,8 @@ def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
     if learning_rate is not None:
         opt_kw["learning_rate"] = learning_rate
     task = setup_train(
-        cfg, OptimizerConfig(total_steps=max((warm_disp + disp) * k_dispatch,
-                                             10_000),
+        cfg, OptimizerConfig(total_steps=max(
+            (warm_disp + segments * disp) * k_dispatch, 10_000),
                              mu_dtype=mu_dtype, fused=fused_optimizer,
                              **opt_kw),
         mesh, attn_impl=attn_impl)

@@ -219,15 +219,24 @@ def cmd_artifacts(args) -> int:
             print("no registered artifacts")
             return 0
         for n, d in sorted(items.items()):
+            if d.get("kind") == "broken":
+                # The server degrades dangling register entries (blob
+                # pruned outside the platform) instead of 500ing — the
+                # listing must survive the same state.
+                print(f"{n:30} BROKEN: {d.get('error', 'missing blob')}")
+                continue
             print(f"{n:30} {d['versions']} version(s)  "
                   f"latest=@{d['latest']} ({d['kind']}, "
-                  f"{d['bytes'] / 1e6:.1f} MB)")
+                  f"{d.get('bytes', 0) / 1e6:.1f} MB)")
         return 0
     info = _req(args.server, "GET", f"/artifacts/{args.name}")
     print(f"{'VERSION':10} {'KIND':6} {'SIZE':>10}  URI")
     for v, d in info["versions"].items():
+        if d.get("kind") == "broken":
+            print(f"{v:10} BROKEN  {d.get('error', 'missing blob')}")
+            continue
         extra = f" ({d['files']} files)" if d["kind"] == "tree" else ""
-        print(f"{v:10} {d['kind']:6} {d['bytes'] / 1e6:9.1f}M  "
+        print(f"{v:10} {d['kind']:6} {d.get('bytes', 0) / 1e6:9.1f}M  "
               f"artifact://{args.name}@{v}{extra}")
     return 0
 

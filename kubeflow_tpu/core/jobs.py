@@ -228,17 +228,11 @@ class JAXJobSpec(BaseModel):
             if not (self.elastic_policy.min_replicas <= w.replicas
                     <= self.elastic_policy.max_replicas):
                 raise ValueError("worker.replicas outside elastic [min,max]")
-            if self.elastic_policy.auto_scaling and self.parallelism.total > 1:
-                # The autoscaler rewrites worker count + the data axis in
-                # lockstep (data spans every chip of the shape); any other
-                # sharding (tp/pp/...) has no defined resize rule, so
-                # reject at spec time instead of wedging a live gang.
-                if self.parallelism.axis_sizes() != {
-                        **ParallelismSpec().axis_sizes(),
-                        "data": w.replicas * w.resources.tpu_chips}:
-                    raise ValueError(
-                        "elastic auto-scaling requires pure data-parallel "
-                        "parallelism (data == total chips) or none")
+            # Auto-scaling works for ANY consistent parallelism: the
+            # autoscaler scales the data×fsdp product and preserves every
+            # other axis (dcn/pp/ep/sp/tp), stepping only to worker counts
+            # whose chip total the preserved product divides — so no shape
+            # needs rejecting here beyond the general product check below.
         total_chips = w.replicas * w.resources.tpu_chips
         if self.parallelism.total not in (1, total_chips):
             raise ValueError(

@@ -279,15 +279,34 @@ def moe_block(p: dict, x: jax.Array, cfg: DecoderConfig,
 
 
 def _moe_aux_loss(router_logits, onehot_sum, cfg: DecoderConfig,
-                  seq_axis: Optional[str]):
+                  seq_axis: Optional[str], valid=None):
     """Switch-style load-balancing loss: E * sum(frac_tokens * frac_probs).
-    ``onehot_sum`` [B,S,E] = how many of the k choices hit each expert."""
+    ``onehot_sum`` [B,S,E] = how many of the k choices hit each expert.
+    ``valid`` [B,S] (optional) masks pad rows out of BOTH fractions and
+    renormalizes by the valid-token count — pads route to whatever expert
+    the null embedding prefers and would otherwise read as imbalance."""
     probs = jax.nn.softmax(router_logits, axis=-1)                   # [B,S,E]
-    frac_tokens = jnp.mean(onehot_sum, axis=(0, 1))                  # [E]
-    frac_probs = jnp.mean(probs, axis=(0, 1))                        # [E]
-    if seq_axis is not None:
-        frac_tokens = jax.lax.pmean(frac_tokens, seq_axis)
-        frac_probs = jax.lax.pmean(frac_probs, seq_axis)
+    if valid is not None:
+        # Sum masked numerators and the valid count SEPARATELY across the
+        # sequence shards, then divide — pmean of per-shard ratios would
+        # weight a shard with 4 valid tokens equally with one holding
+        # 1024 (shard-local denominators differ once pads exist).
+        m = valid[..., None].astype(probs.dtype)                     # [B,S,1]
+        num_t = jnp.sum(onehot_sum * m, axis=(0, 1))                 # [E]
+        num_p = jnp.sum(probs * m, axis=(0, 1))                      # [E]
+        denom = jnp.sum(m)
+        if seq_axis is not None:
+            num_t = jax.lax.psum(num_t, seq_axis)
+            num_p = jax.lax.psum(num_p, seq_axis)
+            denom = jax.lax.psum(denom, seq_axis)
+        denom = jnp.maximum(denom, 1.0)
+        frac_tokens, frac_probs = num_t / denom, num_p / denom
+    else:
+        frac_tokens = jnp.mean(onehot_sum, axis=(0, 1))              # [E]
+        frac_probs = jnp.mean(probs, axis=(0, 1))                    # [E]
+        if seq_axis is not None:   # same denominator on every shard: exact
+            frac_tokens = jax.lax.pmean(frac_tokens, seq_axis)
+            frac_probs = jax.lax.pmean(frac_probs, seq_axis)
     return cfg.num_experts * jnp.sum(frac_tokens * frac_probs)
 
 
@@ -345,14 +364,15 @@ def _moe_dispatch(p: dict, x: jax.Array, cfg: DecoderConfig,
     # Choice-major flattening: row r = (choice r // T) of token (r % T).
     flat_e = topk_idx.T.reshape(-1)                                  # [kT]
     oh = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)                  # [kT,E]
-    valid_flat = None
+    valid_flat, valid_bs = None, None
     if valid_len is not None:
-        # Padding rows claim no capacity (zeroed before the cumsum) and
-        # are dropped outright (below) — and they vanish from the balance
-        # loss, which otherwise reads a bucket of identical pads as a
-        # catastrophically unbalanced router.
+        # Padding rows claim no capacity (zeroed before the cumsum), are
+        # dropped outright (below), and are masked out of both sides of
+        # the balance loss — which otherwise reads a bucket of identical
+        # pads as a catastrophically unbalanced router.
         vl = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(valid_len)), (b,))
-        valid = (jnp.arange(s)[None, :] < vl[:, None]).reshape(t)
+        valid_bs = jnp.arange(s)[None, :] < vl[:, None]              # [B,S]
+        valid = valid_bs.reshape(t)
         valid_flat = jnp.tile(valid, k)
         oh = oh * valid_flat[:, None].astype(oh.dtype)
     pos = jnp.cumsum(oh, axis=0) - 1
@@ -396,7 +416,7 @@ def _moe_dispatch(p: dict, x: jax.Array, cfg: DecoderConfig,
     aux = _moe_aux_loss(
         router_logits.reshape(b, s, e),
         oh.astype(jnp.float32).reshape(k, t, e).sum(0).reshape(b, s, e),
-        cfg, seq_axis)
+        cfg, seq_axis, valid=valid_bs)
     return checkpoint_name(out, "mlp_out"), aux
 
 

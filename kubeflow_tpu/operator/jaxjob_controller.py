@@ -341,6 +341,74 @@ class JAXJobController:
         # Recreate on the next pass so worker deletion events settle first.
         return ReconcileResult(requeue_after=0.05)
 
+    @staticmethod
+    def _elastic_parallelism(job: JAXJob, desired: int, chips: int):
+        """ParallelismSpec for ``desired`` workers that PRESERVES the job's
+        non-data axes (dcn/pipeline/expert/seq/model) and scales only the
+        data×fsdp product — an fsdp×tp job must stay fsdp×tp across an
+        auto-resize ((U) hpa.go scales worker counts regardless of the
+        inner strategy; forcing pure DP would reject any model that does
+        not fit one chip, the actual elastic-training regime).
+
+        Returns None when ``desired`` cannot host the preserved axes
+        (their product doesn't divide desired*chips) — the caller must
+        pick a different count, not silently change the strategy."""
+        from kubeflow_tpu.core.jobs import ParallelismSpec
+
+        old = job.spec.parallelism
+        total = desired * chips
+        preserved = (old.dcn * old.pipeline * old.expert * old.seq
+                     * old.model)
+        if total % preserved:
+            return None
+        product = total // preserved          # new data*fsdp pool
+        if product < 1:
+            return None
+        if old.fsdp > 1 and product % old.fsdp == 0:
+            fsdp, data = old.fsdp, product // old.fsdp
+        elif old.fsdp > 1:
+            # fsdp no longer divides the pool: absorb it all into fsdp
+            # (memory per chip only improves; resharded restore handles
+            # the layout change) rather than silently unsharding params.
+            fsdp, data = product, 1
+        else:
+            fsdp, data = 1, product
+        return ParallelismSpec(dcn=old.dcn, pipeline=old.pipeline,
+                               data=data, fsdp=fsdp, expert=old.expert,
+                               seq=old.seq, model=old.model)
+
+    def _valid_count_below(self, job: JAXJob, cur: int, chips: int,
+                           floor: int) -> Optional[int]:
+        """Largest worker count in [floor, cur) whose shape can host the
+        preserved parallelism axes."""
+        for d in range(cur - 1, floor - 1, -1):
+            if self._elastic_parallelism(job, d, chips) is not None:
+                return d
+        return None
+
+    def _shrink_helps_pending(self, job: JAXJob, alloc, cur: int,
+                              chips: int, floor: int) -> bool:
+        """Could shrinking EVER make some pending gang placeable?
+        Shrinking when the waiter needs a different slice — or more chips
+        than this job could yield even at its smallest valid shape — burns
+        the shared auto-resize budget without unblocking anyone. Judged
+        against the maximum eventual yield (not one step): shrinks go one
+        valid count per cooldown, and the gate must not block progressive
+        yielding toward a large waiter."""
+        min_valid = next(
+            (d for d in range(floor, cur)
+             if self._elastic_parallelism(job, d, chips) is not None), None)
+        if min_valid is None:
+            return False
+        max_freeable = (cur - min_valid) * chips
+        free = self.allocator.free_chips(alloc.slice_name)
+        for p in self.allocator.pending():
+            if p.slice_name not in (None, alloc.slice_name):
+                continue
+            if p.total_chips <= free + max_freeable:
+                return True
+        return False
+
     def _maybe_autoscale(self, job: JAXJob) -> None:
         """Decide a new worker count from cluster + job metrics and durably
         write it into the spec (the scale-subresource analog). The existing
@@ -372,15 +440,18 @@ class JAXJobController:
             return
         cur = job.spec.worker.replicas
         chips = job.spec.worker.resources.tpu_chips
+        down = self._valid_count_below(job, cur, chips, pol.min_replicas)
         desired, why = cur, ""
-        if (pol.yield_to_pending and cur > pol.min_replicas
-                and self.allocator.pending()):
-            desired, why = cur - 1, "pending gangs waiting for chips"
+        if (pol.yield_to_pending and down is not None
+                and self.allocator.pending()
+                and self._shrink_helps_pending(job, alloc, cur, chips,
+                                               pol.min_replicas)):
+            desired, why = down, "pending gangs waiting for chips"
         tput = job.status.metrics.tokens_per_sec_per_chip
         if (desired == cur and pol.min_tokens_per_sec_per_chip is not None
-                and tput is not None and cur > pol.min_replicas
+                and tput is not None and down is not None
                 and tput < pol.min_tokens_per_sec_per_chip):
-            desired, why = cur - 1, (
+            desired, why = down, (
                 f"{tput:.0f} tok/s/chip below floor "
                 f"{pol.min_tokens_per_sec_per_chip:.0f}")
         if (desired == cur and pol.scale_on_headroom
@@ -393,21 +464,26 @@ class JAXJobController:
             free = self.allocator.free_chips(alloc.slice_name)
             # Grow only as far as re-placement is guaranteed to succeed:
             # after release the gang needs desired*chips on this slice, and
-            # free + cur*chips is exactly what will be available.
-            grow = min(pol.max_replicas, cur + free // chips)
-            if grow > cur:
-                desired, why = grow, (
-                    f"{free} free chips on slice {alloc.slice_name}")
+            # free + cur*chips is exactly what will be available. Step down
+            # to the largest count that can host the preserved axes.
+            for grow in range(min(pol.max_replicas, cur + free // chips),
+                              cur, -1):
+                if self._elastic_parallelism(job, grow, chips) is not None:
+                    desired, why = grow, (
+                        f"{free} free chips on slice {alloc.slice_name}")
+                    break
         if desired == cur:
             return
+        new_par = self._elastic_parallelism(job, desired, chips)
+        if new_par is None:      # unreachable: counts above were validated
+            return
         job.spec.worker.replicas = desired
-        # Pure DP: the data axis always spans every chip of the new shape
-        # (a multi-worker gang cannot run on the default total==1
-        # parallelism — each process would build a 1-device mesh under a
-        # 2-device jax.distributed world).
-        from kubeflow_tpu.core.jobs import ParallelismSpec
-
-        job.spec.parallelism = ParallelismSpec(data=desired * chips)
+        # Scale the data/fsdp product; every other axis (tp/ep/sp/pp/dcn)
+        # keeps its degree — a multi-worker gang also cannot run on the
+        # default total==1 parallelism (each process would build a 1-device
+        # mesh under a 2-device jax.distributed world), so the spec is
+        # always rewritten to span desired*chips.
+        job.spec.parallelism = new_par
         job.status.elastic_resizes += 1
         job.status.last_scale_time = utcnow()
         try:
@@ -430,14 +506,27 @@ class JAXJobController:
     def _resize(self, job: JAXJob, alloc) -> Optional[ReconcileResult]:
         key = job.metadata.key
         new = job.spec.worker.replicas
+        pure_shrink = (new < alloc.request.num_workers
+                       and alloc.request.chips_per_worker
+                       == job.spec.worker.resources.tpu_chips)
         self.recorder.normal(
             job, "Resizing",
-            f"{alloc.request.num_workers} -> {new} workers; re-ganging")
+            f"{alloc.request.num_workers} -> {new} workers; "
+            + ("shrinking in place" if pure_shrink else "re-ganging"))
         for w in self._workers(key):
             self._delete_worker(w)
-        self.allocator.release(key)
-        job.status.gang_name = None
-        job.status.coordinator_address = None
+        if pure_shrink:
+            # Atomic scale-down: trailing workers' chips are freed and
+            # waiters scheduled under the allocator lock — no release→
+            # re-submit window in which a pending gang could take more
+            # than the freed chips and leave this job Pending. The gang
+            # keeps its identity; processes restart at the new world size.
+            self.allocator.shrink(key, new)
+            job.status.coordinator_address = None   # fresh rendezvous
+        else:
+            self.allocator.release(key)
+            job.status.gang_name = None
+            job.status.coordinator_address = None
         # Throughput readings from the OLD shape must not drive the next
         # autoscale decision: the re-ganged job takes minutes to produce a
         # fresh line, and a stale below-floor value would shrink again every

@@ -111,6 +111,40 @@ class GangAllocator:
             self._schedule_locked()
             return self._allocations.get(req.name)
 
+    def shrink(self, name: str, new_num_workers: int) -> Optional[GangAllocation]:
+        """Atomically shrink a placed gang to its FIRST ``new_num_workers``
+        workers: the trailing workers' chips are freed and waiters scheduled
+        inside the same critical section.
+
+        This is the elastic scale-down primitive: the release→re-submit
+        alternative opens a window in which a pending gang can take *more*
+        than the freed chips, leaving the yielding job queued indefinitely —
+        a job should never go Pending because it volunteered chips. Returns
+        the (new) allocation; no-op when the gang is absent or the count
+        does not decrease."""
+        with self._lock:
+            alloc = self._allocations.get(name)
+            if alloc is None or new_num_workers >= alloc.request.num_workers:
+                return alloc
+            if new_num_workers < 1:
+                raise ValueError(f"gang {name}: cannot shrink to "
+                                 f"{new_num_workers} workers")
+            import dataclasses
+            keep = {w: alloc.chip_assignment[w]
+                    for w in range(new_num_workers)}
+            freed = [c for w, chips in alloc.chip_assignment.items()
+                     if w >= new_num_workers for c in chips]
+            new_alloc = GangAllocation(
+                request=dataclasses.replace(alloc.request,
+                                            num_workers=new_num_workers),
+                slice_name=alloc.slice_name,
+                chip_assignment=keep,
+            )
+            self._allocations[name] = new_alloc
+            self._free[alloc.slice_name].update(freed)
+            self._schedule_locked()
+            return new_alloc
+
     def release(self, name: str) -> bool:
         """Free a gang's chips (or drop it from the queue); schedules waiters."""
         with self._lock:

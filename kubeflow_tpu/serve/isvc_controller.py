@@ -59,11 +59,13 @@ def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
         with urllib.request.urlopen(url + "/healthz", timeout=timeout) as r:
             if r.status != 200:
                 return None
-        out = {"ready": True, "in_flight": 0}
+        out = {"ready": True, "in_flight": 0, "requests_total": 0}
         with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
             for line in r.read().decode().splitlines():
                 if line.startswith("kftpu_serving_in_flight"):
                     out["in_flight"] = int(float(line.split()[-1]))
+                elif line.startswith("kftpu_serving_requests_total{"):
+                    out["requests_total"] += int(float(line.split()[-1]))
         return out
     except OSError:
         return None
@@ -80,6 +82,18 @@ class ISVCController:
         self.probe = probe
         self._routers: dict[str, Router] = {}
         self._last_scale: dict[str, float] = {}  # any scale event, per service
+        # Last observed request *traffic* per service — the KPA counts
+        # idleness from here, not from scale events ((U) Knative KPA
+        # stable-window semantics). Fed by three signals: in-flight/parked
+        # gauges, the router's per-request completion stamp, and the
+        # replicas' served-request counters (catches sub-resync requests
+        # sent straight to a replica, bypassing the router). Counters are
+        # tracked PER REPLICA: only a same-replica increase is activity —
+        # a summed counter dips when one replica's probe flakes and then
+        # "recovers", which would read as fresh traffic and grant the
+        # service another cooldown of life on every flake.
+        self._last_active: dict[str, float] = {}
+        self._req_totals: dict[str, dict[str, int]] = {}
 
     # -- event routing ---------------------------------------------------------
 
@@ -105,6 +119,8 @@ class ISVCController:
             if router is not None:
                 router.stop()
             self._last_scale.pop(key, None)
+            self._last_active.pop(key, None)
+            self._req_totals.pop(key, None)
             return None
 
         pred = isvc.spec.predictor
@@ -199,6 +215,7 @@ class ISVCController:
         # Readiness probing, per generation.
         ready_by_gen: dict[int, list[str]] = {}
         in_flight = 0
+        req_counts: dict[str, int] = {}      # replica name -> counter seen
         for (g, i), w in sorted(by.items()):
             if w.status.phase != WorkerPhase.RUNNING:
                 continue
@@ -207,6 +224,25 @@ class ISVCController:
             if got is not None:
                 ready_by_gen.setdefault(g, []).append(url)
                 in_flight += got.get("in_flight", 0)
+                req_counts[w.metadata.name] = got.get("requests_total", 0)
+
+        # Activity clock: any traffic signal resets idleness. A replica's
+        # counter counts as activity only against ITS OWN last reading
+        # (restart resets read as no activity; a flaked probe keeps the
+        # old reading rather than zeroing the baseline).
+        now = time.monotonic()
+        prev_counts = self._req_totals.get(key, {})
+        if in_flight > 0 or pending > 0:
+            self._last_active[key] = now
+        if any(n in prev_counts and c > prev_counts[n]
+               for n, c in req_counts.items()):
+            self._last_active[key] = now
+        live = {w.metadata.name for w in by.values()}
+        self._req_totals[key] = {
+            n: c for n, c in {**prev_counts, **req_counts}.items()
+            if n in live}
+        self._last_active[key] = max(self._last_active.get(key, 0.0),
+                                     router.last_activity)
 
         latest_ready = ready_by_gen.get(gen, [])
         if canary_active and ready_by_gen.get(pg):
@@ -300,7 +336,22 @@ class ISVCController:
                 return
             cooldown = (_SCALE_TO_ZERO_COOLDOWN if to_zero
                         else _SCALE_DOWN_COOLDOWN)
-            if now - self._last_scale[key] >= cooldown:
+            # Scale-to-zero counts idleness from the LATER of the last
+            # scale event and the last observed request activity ((U)
+            # Knative KPA: the stable window for the 1→0 decision is over
+            # *traffic*). Clocking from scale events alone culled
+            # cold-started replicas the instant they answered a parked
+            # request whenever the cold start outlasted the cooldown
+            # (spawn + init + compile burned the whole quiet period).
+            # N→N-1 consolidation stays concurrency-driven: low average
+            # concurrency downsizes even while trickle traffic flows —
+            # gating it on traffic silence would pin over-provisioned
+            # replicas forever.
+            idle_since = self._last_scale[key]
+            if to_zero:
+                idle_since = max(idle_since,
+                                 self._last_active.get(key, 0.0))
+            if now - idle_since >= cooldown:
                 isvc.status.desired_replicas = desired - 1
                 self._last_scale[key] = now
                 self.recorder.normal(

@@ -38,6 +38,7 @@ class Router:
         self._weights: dict[str, int] = {}         # group -> percent
         self._rr = itertools.count()
         self._pending = 0
+        self._last_activity = 0.0   # monotonic; stamped per request
         self._closed = False
         self.queue_timeout = queue_timeout
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
@@ -62,6 +63,20 @@ class Router:
         """Requests parked waiting for a backend (the activation signal)."""
         with self._lock:
             return self._pending
+
+    @property
+    def last_activity(self) -> float:
+        """Monotonic timestamp of the most recent request arrival or
+        completion through this router. The KPA-analog idle clock counts
+        from here — from *traffic*, not from scale events — so a replica
+        that just answered a request (however slow the cold start was) is
+        guaranteed a full quiet cooldown before it can be culled."""
+        with self._lock:
+            return self._last_activity
+
+    def note_activity(self) -> None:
+        with self._lock:
+            self._last_activity = time.monotonic()
 
     def _pick_locked(self) -> Optional[str]:
         groups = [(g, self._weights.get(g, 0)) for g in self._groups]
@@ -134,6 +149,17 @@ def _make_handler(router: Router):
             pass
 
         def _proxy(self) -> None:
+            router.note_activity()
+            try:
+                self._proxy_inner()
+            finally:
+                # Stamp at COMPLETION too: a request slower than the idle
+                # cooldown (e.g. a cold start that had to spawn + compile)
+                # must restart the clock when it answers, or the replica
+                # gets culled the moment in_flight drops back to zero.
+                router.note_activity()
+
+        def _proxy_inner(self) -> None:
             backend = router.pick_or_wait()
             if backend is None:
                 data = b'{"error": "no ready backends (queue timeout)"}'

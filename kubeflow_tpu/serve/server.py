@@ -171,7 +171,17 @@ class ModelServer:
             if len(toks) > limit:
                 raise ValueError(
                     f"explain prompt is {len(toks)} tokens; limit {limit}")
-            out = self.explainer(toks, params=engine.params, cfg=engine.cfg)
+            cfg = engine.cfg
+            if cfg.is_moe and cfg.moe_impl != "dense":
+                # Attribution must be batch-independent: dispatch MoE's
+                # shared [E, C] capacity buffers couple co-batched rows
+                # (leave_one_out's S ablations would perturb each other's
+                # expert drops; grad_x_input's scores would depend on
+                # capacity luck). Dense MoE routes every token exactly —
+                # the same reason decode defaults to dense in the engine.
+                import dataclasses as _dc
+                cfg = _dc.replace(cfg, moe_impl="dense")
+            out = self.explainer(toks, params=engine.params, cfg=cfg)
             out["tokens"] = [tokenizer.decode([t]) for t in toks]
             out["predicted_text"] = tokenizer.decode([out["target_token"]])
         return out

@@ -97,6 +97,26 @@ class Trainer:
         })
         if data_cfg.vocab_size > self.model_cfg.vocab_size:
             raise ValueError("data vocab exceeds model vocab")
+        # Elastic shape adaptation: the global batch must divide over BOTH
+        # the host shards (loader) and the mesh's batch axes (dcn×data×
+        # fsdp sharding of the device batch). An auto-resize can land on a
+        # world shape the configured batch doesn't divide (e.g. 8 over 3
+        # workers); round UP to the nearest valid multiple — the torchrun-
+        # elastic convention of adapting batch to world size, logged so
+        # the change is visible in the worker log.
+        import math
+
+        dp = 1
+        for ax in ("dcn", "data", "fsdp"):
+            dp *= int(dict(mesh.shape).get(ax, 1))
+        gran = math.lcm(max(num_processes, 1), max(dp, 1))
+        if data_cfg.global_batch % gran:
+            new_gb = -(-data_cfg.global_batch // gran) * gran
+            logger.info(
+                "global_batch %d not divisible by lcm(processes=%d, "
+                "batch-shards=%d)=%d; adjusted to %d for this world shape",
+                data_cfg.global_batch, num_processes, dp, gran, new_gb)
+            data_cfg = dataclasses.replace(data_cfg, global_batch=new_gb)
         self.data_cfg = data_cfg
         self.data = make_data_source(data_cfg, shard=process_id,
                                      num_shards=num_processes)

@@ -52,9 +52,10 @@ def test_elastic_bounds_validated():
         )
 
 
-def test_elastic_autoscale_requires_pure_dp():
-    """The autoscaler rewrites worker count + data axis in lockstep; any
-    other sharding has no resize rule and must be rejected at spec time."""
+def test_elastic_autoscale_parallelism_validation():
+    """The autoscaler scales the data/fsdp product and preserves the other
+    axes; the preserved product must divide the job's chip total — checked
+    at spec time instead of wedging a live gang."""
     auto = ElasticPolicy(min_replicas=1, max_replicas=8,
                          scale_on_headroom=True)
     assert auto.auto_scaling
@@ -64,11 +65,11 @@ def test_elastic_autoscale_requires_pure_dp():
                parallelism=spec.parallelism, elastic_policy=auto)
     spec = job_spec(replicas=2)
     JAXJobSpec(replica_specs=spec.replica_specs, elastic_policy=auto)
-    # TP/FSDP shardings are not
+    # TP/FSDP shardings now auto-scale too (the data/fsdp product scales,
+    # model/expert/seq/pp keep their degrees)
     spec = job_spec(replicas=2, chips=2, data=2, model=2)
-    with pytest.raises(ValidationError, match="pure data-parallel"):
-        JAXJobSpec(replica_specs=spec.replica_specs,
-                   parallelism=spec.parallelism, elastic_policy=auto)
+    JAXJobSpec(replica_specs=spec.replica_specs,
+               parallelism=spec.parallelism, elastic_policy=auto)
     # the passive policy (no metric signals) stays unrestricted
     spec = job_spec(replicas=2, chips=2, data=2, model=2)
     JAXJobSpec(replica_specs=spec.replica_specs,

@@ -286,3 +286,70 @@ def test_torch_adapter_distributed_e2e(cp):
     assert os.path.exists(os.path.join(workdir, "checkpoint.pt"))
     # The operator scraped the adapter's metrics like any JAX job's.
     assert done.status.metrics.loss is not None
+
+
+@pytest.mark.slow
+def test_elastic_autoscale_fsdp_e2e(cp):
+    """Auto-resize of a NON-pure-DP job ((U) hpa.go scales worker counts
+    regardless of inner strategy): an fsdp-sharded train auto-GROWS into
+    free chips keeping its sharding strategy (data scales, fsdp preserved),
+    then yields a step to a queued gang via the atomic in-place shrink —
+    the yielding job keeps its placement (never goes Pending) and resumes
+    resharded from checkpoint."""
+    from kubeflow_tpu.core.jobs import ElasticPolicy
+
+    j = job_of(
+        "llm_pretrain",
+        {"model": "tiny", "steps": 100, "log_every": 2,
+         "data": {"global_batch": 8, "seq_len": 64, "kind": "synthetic"}},
+        name="fauto", replicas=2,
+        parallelism=ParallelismSpec(fsdp=2))
+    j.spec.elastic_policy = ElasticPolicy(
+        min_replicas=1, max_replicas=4, max_restarts=6,
+        scale_on_headroom=True, yield_to_pending=True,
+        scale_cooldown_seconds=3.0)
+    j.spec.run_policy.checkpoint.enabled = True
+    j.spec.run_policy.checkpoint.interval_steps = 5
+    job = cp.submit(j)
+    cp.wait_for(job, "Running", timeout=240)
+
+    # Phase 1: grow 2 -> 4 preserving the fsdp axis (the data axis scales).
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        cur = cp.get_job("fauto")
+        if (cur.spec.worker.replicas == 4
+                and cur.status.has_condition("Running")):
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail("never grew to 4 workers")
+    par = cur.spec.parallelism
+    assert par.fsdp == 2 and par.data == 2 and par.total == 4, \
+        f"fsdp axis lost on auto-grow: {par.axis_sizes()}"
+
+    # Phase 2: a 1-chip gang queues -> one yield step (4 -> 3). The shrink
+    # is atomic in place: the job must never lose its allocation.
+    blocker = cp.submit(job_of("sleep", {"seconds": 20.0}, name="blk",
+                               replicas=1))
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        cur = cp.get_job("fauto")
+        if cur is None or cur.status.has_condition("Succeeded"):
+            break
+        if cur.spec.worker.replicas == 3:
+            break
+        time.sleep(0.5)
+    cur = cp.get_job("fauto")
+    if not cur.status.has_condition("Succeeded"):
+        assert cur.spec.worker.replicas == 3, "never yielded to the waiter"
+        assert cp.allocator.allocation("default/fauto") is not None, \
+            "yielding job lost its placement"
+        par = cur.spec.parallelism
+        assert par.data * par.fsdp == 3 and par.model == 1
+    cp.wait_for(blocker, "Succeeded", timeout=240)
+
+    done = cp.wait_for(job, "Succeeded", timeout=600)
+    assert done.status.metrics.step == 100
+    log = cp.config.base_dir + "/logs/default.fauto-worker-0.log"
+    with open(log) as f:
+        assert "resumed from checkpoint at step" in f.read()

@@ -459,3 +459,29 @@ class TestArtifactsSurface:
         assert cli_main(["artifacts", "m", "--server", server.url]) == 0
         out = capsys.readouterr().out
         assert "artifact://m@2" in out and "tree" in out
+
+    def test_cli_survives_broken_entry(self, api, tmp_path, capsys):
+        """A register entry whose blob was pruned outside the platform is
+        degraded to kind="broken" by the server; the CLI must print it,
+        not die with KeyError('bytes') — exactly the catalog state the
+        server-side degradation was built to survive."""
+        import os
+
+        from kubeflow_tpu.cli import main as cli_main
+
+        cp, server = api
+        self._publish(cp, tmp_path)
+        # Dangle every blob: remove the CAS objects (root/<2-hex>/<digest>)
+        # behind the register's back.
+        root = cp.artifact_store.root
+        for d in os.listdir(root):
+            full = os.path.join(root, d)
+            if len(d) == 2 and os.path.isdir(full):
+                for f in os.listdir(full):
+                    os.unlink(os.path.join(full, f))
+        assert cli_main(["artifacts", "--server", server.url]) == 0
+        out = capsys.readouterr().out
+        assert "BROKEN" in out
+        assert cli_main(["artifacts", "corpus", "--server", server.url]) == 0
+        out = capsys.readouterr().out
+        assert "BROKEN" in out

@@ -109,6 +109,35 @@ def test_gang_idempotent_submit():
     assert alloc.free_chips("v5p-a") == 6
 
 
+def test_gang_atomic_shrink_feeds_waiter_without_losing_placement():
+    """shrink() frees the trailing workers' chips and schedules waiters in
+    ONE critical section: the yielding gang keeps its (smaller) placement.
+    The release→re-submit alternative opened a window where a pending gang
+    larger than the freed amount could take everything and leave the
+    yielder queued."""
+    alloc = GangAllocator(two_slice_cluster())
+    a = alloc.submit(GangRequest(name="a", num_workers=3, chips_per_worker=2))
+    assert a is not None and a.slice_name == "v5p-a"       # 6 of 8 chips
+    b = alloc.submit(GangRequest(name="b", num_workers=6, chips_per_worker=1))
+    assert b is None                                        # needs 6 on one slice
+    new = alloc.shrink("a", 1)
+    assert new.request.num_workers == 1
+    assert new.chip_assignment == {0: a.chip_assignment[0]}  # survivors keep chips
+    placed = alloc.allocation("b")
+    assert placed is not None and placed.slice_name == "v5p-a"
+    assert not set(placed.all_chips) & set(new.all_chips)
+    assert alloc.allocation("a") is not None, "yielder displaced by waiter"
+
+
+def test_gang_shrink_noop_and_bounds():
+    alloc = GangAllocator(two_slice_cluster())
+    a = alloc.submit(GangRequest(name="a", num_workers=2))
+    assert alloc.shrink("a", 2) is a        # not a decrease: unchanged
+    assert alloc.shrink("missing", 1) is None
+    with pytest.raises(ValueError):
+        alloc.shrink("a", 0)
+
+
 # -- mesh ----------------------------------------------------------------------
 
 def test_mesh_axes_canonical_order():

@@ -213,6 +213,79 @@ def test_cold_start_on_queued_request(cp):
     assert "ColdStart" in events
 
 
+def test_cold_started_replica_survives_slow_cold_start(cp):
+    """Regression (round-4 red test): a cold start slower than the idle
+    cooldown must not get the replica culled the moment it answers the
+    parked request. The 0→1 scale event is ancient by the time the replica
+    is up (spawn + model init + compile outlasted the cooldown), so the
+    idle clock must count from the request's *completion*, not the scale
+    event."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=0, max_replicas=1))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    _backdate(cp)    # the 0→1 scale event happened long before readiness
+    router = cp.isvc_reconciler._routers["default/svc"]
+    router.note_activity()   # the parked request just completed
+    recon()
+    isvc = get_isvc(cp)
+    assert isvc.status.desired_replicas == 1, \
+        "replica culled right after answering its cold-start request"
+    assert replicas(cp)
+
+
+def test_idle_clock_counts_from_traffic_not_scale_events(cp):
+    """Requests arriving at ~cooldown cadence must not re-cold-start each
+    time; only a full cooldown of real *traffic* silence scales to zero."""
+    import time as _t
+
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=0, max_replicas=1))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    key = "default/svc"
+    for _ in range(3):
+        # A request completed more recently than the cooldown (scale
+        # events are ancient) → the replica survives.
+        _backdate(cp)
+        cp.isvc_reconciler._last_active[key] = _t.monotonic() - 8.0
+        recon()
+        assert get_isvc(cp).status.desired_replicas == 1
+    # Traffic silence past the cooldown → now it scales to zero.
+    cp.isvc_reconciler._last_active[key] = _t.monotonic() - 999.0
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 0
+
+
+def test_trickle_traffic_does_not_block_consolidation(cp):
+    """N→N-1 scale-down stays CONCURRENCY-driven: a 2-replica service with
+    steady low traffic (activity every pass, concurrency < target/2) must
+    still consolidate after the cooldown — only the 1→0 decision waits for
+    traffic silence."""
+    import time as _t
+
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc(min_replicas=1, max_replicas=3, scale_target=4))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    isvc = get_isvc(cp)
+    isvc.status.desired_replicas = 2
+    cp.store.update_status(isvc)
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    assert get_isvc(cp).status.ready_replicas == 2
+    key = "default/svc"
+    _backdate(cp)                                         # cooldown elapsed
+    cp.isvc_reconciler._last_active[key] = _t.monotonic()  # trickle traffic
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 1, \
+        "trickle traffic pinned an over-provisioned replica"
+
+
 # -- canary rollout (generation traffic split) --------------------------------
 
 def test_canary_split_and_promotion(cp):
