@@ -79,6 +79,18 @@ class BatchingSpec(BaseModel):
     # per-step HBM param read, the decode bottleneck; standard for serving).
     # None keeps the checkpoint dtype.
     weights_dtype: Optional[str] = None
+    # Weight-only quantization at engine load ((U) vLLM quantization via the
+    # HF runtime): "int8" = per-output-channel symmetric int8 on the big
+    # matmuls, dequantized in the matmul operand read (ops/quantization.py)
+    # — halves the decode-step HBM param read again vs bf16 and halves
+    # param residency (the v5e density lever). None = off.
+    quantize: Optional[str] = None
+    # KV cache storage dtype for the PAGED pool: "int8" stores K/V int8
+    # with per-token-per-head dynamic scales — doubles the pool's resident
+    # tokens at the same HBM. Requires paged=True and the "gather" paged
+    # attention impl (the direct-page-read kernel reads bf16 pages).
+    # None = the model activation dtype.
+    kv_cache_dtype: Optional[str] = None
     # "auto": Pallas flash kernel on TPU (forward-only prefill is where it
     # wins), XLA elsewhere; or force "pallas"/"xla".
     prefill_attn_impl: str = "auto"

@@ -139,11 +139,24 @@ def shard_params(params: Any, specs: Any, mesh: Mesh,
 
     def one(spec, leaf):
         ps = logical_to_mesh_axes(spec, rules)
+        from kubeflow_tpu.ops.quantization import QuantizedTensor
+
+        if isinstance(leaf, QuantizedTensor):
+            # int8 serving weights: q keeps the weight's shape and takes its
+            # spec; the scale's collapsed contraction dims (size 1) must not
+            # inherit a sharded axis — per-field drop handles both.
+            return QuantizedTensor(
+                q=NamedSharding(mesh, _drop_nondivisible(
+                    ps, tuple(leaf.q.shape), mesh)),
+                scale=NamedSharding(mesh, _drop_nondivisible(
+                    ps, tuple(leaf.scale.shape), mesh)))
         ps = _drop_nondivisible(ps, tuple(leaf.shape), mesh)
         return NamedSharding(mesh, ps)
 
     # specs first: is_leaf must stop descent at the spec tuples.
-    return jax.tree.map(one, specs, params, is_leaf=_is_spec_leaf)
+    return jax.tree.map(
+        one, specs, params,
+        is_leaf=_is_spec_leaf)
 
 
 def with_logical_constraint(

@@ -49,6 +49,26 @@ def build_mesh(
             f"mesh axes {dict(zip(MESH_AXES, sizes))} product {n} "
             f"!= device count {len(devices)}"
         )
+    # Multislice: devices spanning >1 TPU slice need the hybrid ICI×DCN
+    # assignment — the per-slice torus solver can't see a 2-slice device
+    # list as one physical mesh. The dcn axis (outermost by design) gets
+    # the slice dimension; everything else stays within a slice, so only
+    # dcn-axis collectives cross the data-center network (megascale-style).
+    slice_ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    if len(slice_ids) > 1:
+        if sizes[0] != len(slice_ids):
+            raise ValueError(
+                f"devices span {len(slice_ids)} slices but the dcn axis is "
+                f"{sizes[0]}; set dcn == slice count so only dcn collectives "
+                f"cross DCN")
+        from jax.experimental import mesh_utils
+
+        dcn_shape = (sizes[0],) + (1,) * (len(MESH_AXES) - 1)
+        ici_shape = (1,) + sizes[1:]
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=list(devices),
+            allow_split_physical_axes=True)
+        return Mesh(dev_array, MESH_AXES)
     # Auto axis types = classic GSPMD propagation (annotate params/inputs,
     # XLA infers the rest and inserts collectives). JAX 0.9's default
     # Explicit mode rejects ops whose output sharding is ambiguous (sharded

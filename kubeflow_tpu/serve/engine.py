@@ -461,7 +461,30 @@ class LLMEngine:
             self.params = jax.tree.map(
                 lambda x: x.astype(wdt) if jnp.issubdtype(x.dtype, jnp.floating)
                 else x, self.params)
+        if b.quantize is not None:
+            # Weight-only int8 ((U) vLLM quantization; VERDICT r4 #3): the
+            # big matmuls store int8 + per-channel scales and dequantize in
+            # the operand read — halves the decode HBM param read vs bf16
+            # and halves param residency. Applied after the dtype cast so
+            # scales quantize the served (not checkpoint) values.
+            if b.quantize != "int8":
+                raise ValueError(
+                    f"unknown quantize {b.quantize!r}; supported: int8")
+            from kubeflow_tpu.ops.quantization import quantize_params_int8
+
+            self.params = quantize_params_int8(self.params, cfg)
+        if b.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {b.kv_cache_dtype!r}; "
+                "supported: int8")
+        self.kv_quant = b.kv_cache_dtype == "int8"
+        if self.kv_quant and not b.paged:
+            raise ValueError(
+                "kv_cache_dtype=int8 requires paged=True (the density win "
+                "is the page pool's; the contiguous slot cache pre-reserves "
+                "slots x max_seq_len either way)")
         self._cache_sh: Optional[NamedSharding] = None
+        self._cache_scale_sh: Optional[NamedSharding] = None
         if self.mesh is not None:
             from kubeflow_tpu.models.decoder import decoder_param_specs
             from kubeflow_tpu.parallel.sharding import shard_params
@@ -476,9 +499,12 @@ class LLMEngine:
                 shard_params(self.params, decoder_param_specs(cfg),
                              self.mesh))
             kv_ps = PartitionSpec(None, None, None, "model", None)
+            scale_ps = PartitionSpec(None, None, None, "model")
             if cfg.n_kv_heads % self.mesh.shape.get("model", 1):
                 kv_ps = PartitionSpec()      # GQA heads don't divide: replicate
+                scale_ps = PartitionSpec()
             self._cache_sh = NamedSharding(self.mesh, kv_ps)
+            self._cache_scale_sh = NamedSharding(self.mesh, scale_ps)
         self._rng = jax.random.PRNGKey(seed + 1)
 
         self.paged = bool(b.paged)
@@ -506,14 +532,20 @@ class LLMEngine:
             self._table = np.full((self.num_slots, self._mpp), -1, np.int32)
             self._slot_pages: list[list[int]] = [
                 [] for _ in range(self.num_slots)]
+            kv_dt = jnp.int8 if self.kv_quant else cfg.activation_dtype
             self.cache = {
                 "k": self._zeros((cfg.n_layers, self._num_pages, pg,
-                                  cfg.n_kv_heads, cfg.head_dim),
-                                 cfg.activation_dtype),
+                                  cfg.n_kv_heads, cfg.head_dim), kv_dt),
                 "v": self._zeros((cfg.n_layers, self._num_pages, pg,
-                                  cfg.n_kv_heads, cfg.head_dim),
-                                 cfg.activation_dtype),
+                                  cfg.n_kv_heads, cfg.head_dim), kv_dt),
             }
+            if self.kv_quant:
+                # Per-token-per-head dynamic scales: +4 bytes per token per
+                # kv head against the 2x density win on the Dh-wide vectors.
+                for n in ("ks", "vs"):
+                    self.cache[n] = self._zeros(
+                        (cfg.n_layers, self._num_pages, pg, cfg.n_kv_heads),
+                        jnp.float32, scale=True)
         else:
             self.cache = {
                 "k": self._zeros((cfg.n_layers, self.num_slots, self.max_len,
@@ -569,11 +601,17 @@ class LLMEngine:
             if pattn == "auto":
                 # Mesh mode: gather (pure XLA ops — GSPMD-partitionable);
                 # the direct-page-read kernel would need a shard_map.
-                pattn = "pallas" if on_tpu and self.mesh is None else "gather"
+                # int8 pool: gather (the kernel DMAs bf16 pages).
+                pattn = ("pallas" if on_tpu and self.mesh is None
+                         and not self.kv_quant else "gather")
             if pattn not in ("gather", "pallas"):
                 raise ValueError(
                     f"unknown paged_attn_impl {b.paged_attn_impl!r}; "
                     "one of auto|gather|pallas")
+            if self.kv_quant and pattn == "pallas":
+                raise ValueError(
+                    "kv_cache_dtype=int8 requires paged_attn_impl=gather "
+                    "(the paged-attention kernel reads bf16 pages)")
             self._paged_chunk = jax.jit(
                 lambda p, c, t, tr, st, cp, vl, ncp: _pin2(paged_chunk_prefill(
                     p, c, t, tr, st, cp, cfg_prefill, context_pages=ncp,
@@ -612,20 +650,22 @@ class LLMEngine:
 
     # -- mesh-mode helpers -----------------------------------------------------
 
-    def _zeros(self, shape, dtype) -> jax.Array:
+    def _zeros(self, shape, dtype, scale: bool = False) -> jax.Array:
         """KV-cache allocation. Mesh mode materializes each shard directly on
         its device (a host-side full array would bound the servable model by
         ONE chip's HBM — the exact limit mesh mode removes)."""
-        if self._cache_sh is None:
+        sh = self._cache_scale_sh if scale else self._cache_sh
+        if sh is None:
             return jnp.zeros(shape, dtype)
-        return jax.jit(lambda: jnp.zeros(shape, dtype),
-                       out_shardings=self._cache_sh)()
+        return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sh)()
 
     def _pin(self, cache: dict) -> dict:
         if self._cache_sh is None:
             return cache
-        return {k: (jax.lax.with_sharding_constraint(v, self._cache_sh)
-                    if k in ("k", "v") else v)
+        pins = {"k": self._cache_sh, "v": self._cache_sh,
+                "ks": self._cache_scale_sh, "vs": self._cache_scale_sh}
+        return {k: (jax.lax.with_sharding_constraint(v, pins[k])
+                    if k in pins else v)
                 for k, v in cache.items()}
 
     # -- submission ------------------------------------------------------------
@@ -960,7 +1000,8 @@ class LLMEngine:
                 jnp.asarray(lengths), jnp.asarray(live), jnp.asarray(temps),
                 jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(stops),
                 jnp.asarray(budgets), self._next_key(), k_steps, mode)
-            self.cache = {"k": cache_out["k"], "v": cache_out["v"]}
+            self.cache = {n: cache_out[n] for n in cache_out
+                          if n != "table"}
         else:
             out, self.cache, _, _, _ = self._decode_n(
                 self.params, self.cache, jnp.asarray(tokens),

@@ -184,17 +184,24 @@ def paged_gather(pool: jax.Array, table: jax.Array) -> jax.Array:
 
 
 def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,
-                        table, cfg: DecoderConfig, attn_impl: str = "gather"):
+                        table, cfg: DecoderConfig, attn_impl: str = "gather",
+                        pool_ks=None, pool_vs=None):
     """One transformer block for a [B,1] decode step against the page pool.
     Mirrors engine._decode_block; only the KV residency differs.
 
     ``attn_impl``: "gather" materializes the slot's pages into the
     contiguous layout and runs the XLA decode attention (2× KV read);
     "pallas" reads pages directly via the paged-attention kernel
-    (ops/paged_attention.py — one DMA per page)."""
+    (ops/paged_attention.py — one DMA per page).
+
+    ``pool_ks``/``pool_vs`` ([P,pg,KV] f32, present iff the pool stores
+    int8): per-token-per-head dynamic scales. Writes quantize, the gather
+    reads int8 pages and dequantizes into the attention einsum's operand
+    read — the pool (the resident thing) holds 2× the tokens per byte."""
     from kubeflow_tpu.serve.engine import _decode_attention
 
     dt = cfg.activation_dtype
+    kv_quant = pool_ks is not None
     pg = pool_k.shape[1]
     h = L.rmsnorm(x, bp["ln1"], cfg)
     q = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wq"].astype(dt))
@@ -210,23 +217,41 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,
     ok = live & (page_id >= 0)
     pidx = jnp.where(ok, page_id, pool_k.shape[0])
     off = lengths % pg
-    nk = pool_k.at[pidx, off].set(k[:, 0], mode="drop")
-    nv = pool_v.at[pidx, off].set(v[:, 0], mode="drop")
-    if attn_impl == "pallas":
-        from kubeflow_tpu.ops.paged_attention import paged_decode_attention
+    nks = nvs = None
+    if kv_quant:
+        from kubeflow_tpu.ops.quantization import dequantize_kv, quantize_kv
 
-        attn = paged_decode_attention(q, nk, nv, table, lengths)
-    else:
-        ck = paged_gather(nk, table)
-        cv = paged_gather(nv, table)
+        kq, ks = quantize_kv(k[:, 0])
+        vq, vs = quantize_kv(v[:, 0])
+        nk = pool_k.at[pidx, off].set(kq, mode="drop")
+        nv = pool_v.at[pidx, off].set(vq, mode="drop")
+        nks = pool_ks.at[pidx, off].set(ks, mode="drop")
+        nvs = pool_vs.at[pidx, off].set(vs, mode="drop")
+        ck = dequantize_kv(paged_gather(nk, table),
+                           paged_gather(nks, table), dt)
+        cv = dequantize_kv(paged_gather(nv, table),
+                           paged_gather(nvs, table), dt)
         attn = _decode_attention(q, ck, cv, lengths, cfg)
+    else:
+        nk = pool_k.at[pidx, off].set(k[:, 0], mode="drop")
+        nv = pool_v.at[pidx, off].set(v[:, 0], mode="drop")
+        if attn_impl == "pallas":
+            from kubeflow_tpu.ops.paged_attention import (
+                paged_decode_attention,
+            )
+
+            attn = paged_decode_attention(q, nk, nv, table, lengths)
+        else:
+            ck = paged_gather(nk, table)
+            cv = paged_gather(nv, table)
+            attn = _decode_attention(q, ck, cv, lengths, cfg)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
     h = L.rmsnorm(x, bp["ln2"], cfg)
     if cfg.is_moe:
         mlp_out, _ = L.moe_block(bp["mlp"], h, cfg)
     else:
         mlp_out = L.mlp_block(bp["mlp"], h, cfg)
-    return x + mlp_out, nk, nv
+    return x + mlp_out, nk, nv, nks, nvs
 
 
 def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,
@@ -234,28 +259,45 @@ def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,
                        cfg: DecoderConfig, attn_impl: str = "gather"):
     """One [B,1] decode step over the page pool (≈ engine._decode_step)."""
     dt = cfg.activation_dtype
+    kv_quant = "ks" in cache
     x = params["embed"].astype(dt)[tokens[:, None]]
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.hidden ** 0.5, dt)
     positions = lengths[:, None]
     table = cache["table"]
 
-    def body(x, scan_in):
-        bp, pk, pv = scan_in
-        x, nk, nv = _paged_decode_block(bp, x, positions, lengths, live,
-                                        pk, pv, table, cfg,
-                                        attn_impl=attn_impl)
-        return x, (nk, nv)
+    if kv_quant:
+        def body(x, scan_in):
+            bp, pk, pv, pks, pvs = scan_in
+            x, nk, nv, nks, nvs = _paged_decode_block(
+                bp, x, positions, lengths, live, pk, pv, table, cfg,
+                attn_impl=attn_impl, pool_ks=pks, pool_vs=pvs)
+            return x, (nk, nv, nks, nvs)
 
-    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+        x, scanned = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"],
+                      cache["ks"], cache["vs"]))
+    else:
+        def body(x, scan_in):
+            bp, pk, pv = scan_in
+            x, nk, nv, _, _ = _paged_decode_block(
+                bp, x, positions, lengths, live, pk, pv, table, cfg,
+                attn_impl=attn_impl)
+            return x, (nk, nv)
+
+        x, scanned = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+    nk, nv = scanned[0], scanned[1]
     x = L.rmsnorm(x, params["final_norm"], cfg)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dt),
                         preferred_element_type=jnp.float32)[:, 0]
     if cfg.logits_softcap is not None:
         logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
-    return logits, {"k": nk, "v": nv, "table": table}
+    out = {"k": nk, "v": nv, "table": table}
+    if kv_quant:
+        out["ks"], out["vs"] = scanned[2], scanned[3]
+    return logits, out
 
 
 def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,
@@ -338,6 +380,7 @@ def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,
     pg = cache["k"].shape[2]
     c = tokens.shape[1]
     npages = c // pg
+    kv_quant = "ks" in cache
     if context_pages is not None:
         # Static slice: the bucket must cover the chunk's own pages too
         # (the [start, start+C) update-slice window below).
@@ -353,6 +396,16 @@ def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,
         cache["k"])
     row_v = jax.vmap(lambda pool: paged_gather(pool, table_row[None]))(
         cache["v"])
+    if kv_quant:
+        from kubeflow_tpu.ops.quantization import dequantize_kv, quantize_kv
+
+        dt = cfg.activation_dtype
+        row_ks = jax.vmap(lambda pool: paged_gather(pool, table_row[None]))(
+            cache["ks"])
+        row_vs = jax.vmap(lambda pool: paged_gather(pool, table_row[None]))(
+            cache["vs"])
+        row_k = dequantize_kv(row_k, row_ks, dt)
+        row_v = dequantize_kv(row_v, row_vs, dt)
     pad = [(0, 0), (0, 0), (0, c), (0, 0), (0, 0)]
     caches = {"k": jnp.pad(row_k, pad), "v": jnp.pad(row_v, pad),
               "len": start}
@@ -370,6 +423,12 @@ def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,
                                   *written_v.shape[3:])
     pidx = jnp.where((chunk_pages >= 0) & (chunk_pages < cache["k"].shape[1]),
                      chunk_pages, cache["k"].shape[1])
-    nk = cache["k"].at[:, pidx].set(written_k, mode="drop")
-    nv = cache["v"].at[:, pidx].set(written_v, mode="drop")
-    return logits[0], {"k": nk, "v": nv}
+    out = {}
+    if kv_quant:
+        written_k, wks = quantize_kv(written_k)
+        written_v, wvs = quantize_kv(written_v)
+        out["ks"] = cache["ks"].at[:, pidx].set(wks, mode="drop")
+        out["vs"] = cache["vs"].at[:, pidx].set(wvs, mode="drop")
+    out["k"] = cache["k"].at[:, pidx].set(written_k, mode="drop")
+    out["v"] = cache["v"].at[:, pidx].set(written_v, mode="drop")
+    return logits[0], out

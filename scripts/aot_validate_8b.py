@@ -1,12 +1,17 @@
-"""AOT-validate the flagship Llama-3-8B recipe without hardware (VERDICT
-round-2 next #5; SURVEY.md §6 "Llama-3-8B-class pretrain, v5p-64").
+"""AOT-validate the flagship recipes without hardware (VERDICT round-2
+next #5 for Llama-3-8B; round-4 next #2 for Mixtral-8x7B; SURVEY.md §6
+"Llama-3-8B-class pretrain, v5p-64" / BASELINE.json configs[2]
+"Mixtral 8x7B MoE expert-parallel across multi-slice ICI/DCN").
 
 Uses libtpu's topology-only AOT path (`jax.experimental.topologies`) to
 lower + compile — never execute — the REAL train step (fwd+bwd+Adam,
 Pallas flash attention, dots_no_batch remat) and the serving decode step
 on virtual v5p/v5e meshes, then reads the compiled executable's
 per-chip memory analysis against the chip HBM budget (v5p: 95 GB,
-v5e: 16 GB).
+v5e: 16 GB). Multi-slice topologies come from the same path
+(``num_slices=N``): devices carry distinct ``slice_index`` so GSPMD
+plans DCN collectives for the ``dcn`` mesh axis, exactly as on real
+multislice pods.
 
 Run: python scripts/aot_validate_8b.py   (one JSON line per config)
 """
@@ -18,18 +23,23 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _mesh_on(topology: str, axes: dict):
+def _mesh_on(topology: str, axes: dict, *, num_slices: int = 1,
+             topo_kwargs: dict = None):
     from jax.experimental import topologies
 
     from kubeflow_tpu.runtime.mesh import build_mesh
 
-    topo = topologies.get_topology_desc(topology, "tpu")
+    kw = dict(topo_kwargs or {})
+    if num_slices > 1:
+        kw["num_slices"] = num_slices
+    topo = topologies.get_topology_desc(topology, "tpu", **kw)
     return build_mesh(axes, topo.devices)
 
 
-def train_step_analysis(topology: str, axes: dict, *, per_chip_batch=1,
-                        pp_layers=None):
-    """Compile the 8B train step for `axes` on `topology`; return per-chip
+def train_step_analysis(topology: str, axes: dict, *, model="llama3-8b",
+                        per_chip_batch=1, pp_layers=None, num_slices=1,
+                        seq_len=None):
+    """Compile `model`'s train step for `axes` on `topology`; return per-chip
     memory totals in GB from the compiled executable."""
     import jax
 
@@ -38,11 +48,13 @@ def train_step_analysis(topology: str, axes: dict, *, per_chip_batch=1,
     from kubeflow_tpu.train.optim import OptimizerConfig
     from kubeflow_tpu.train.step import make_state_init, setup_train
 
-    mesh = _mesh_on(topology, axes)
+    mesh = _mesh_on(topology, axes, num_slices=num_slices)
     over = {"remat_policy": "dots_no_batch"}
     if pp_layers:
         over["pipeline_schedule"] = "1f1b"
-    cfg = preset("llama3-8b", **over)
+    if seq_len:
+        over["max_seq_len"] = seq_len
+    cfg = preset(model, **over)
     task = setup_train(cfg, OptimizerConfig(total_steps=10), mesh,
                        attn_impl="pallas", init_state=False)
     state_sds = jax.eval_shape(make_state_init(cfg, task.optimizer))
@@ -69,10 +81,13 @@ def train_step_analysis(topology: str, axes: dict, *, per_chip_batch=1,
     }
 
 
-def serve_decode_analysis(topology: str, tp: int, *, slots=16,
-                          max_len=2048):
-    """Compile the 8B serving decode step (K steps + sampling on device)
-    TP-sharded over `tp` chips; per-chip memory vs the v5e 16 GB budget."""
+def serve_decode_analysis(topology: str, tp: int, *, model="llama3-8b",
+                          slots=16, max_len=2048, quantize=None,
+                          topo_kwargs=None):
+    """Compile `model`'s serving decode step (K steps + sampling on device)
+    TP-sharded over `tp` chips; per-chip memory vs the v5e 16 GB budget.
+    ``quantize="int8"``: weight-only int8 (ops/quantization.py) — the AOT
+    density proof that the halved params fit smaller topologies."""
     import jax
     import jax.numpy as jnp
 
@@ -83,10 +98,23 @@ def serve_decode_analysis(topology: str, tp: int, *, slots=16,
     from kubeflow_tpu.serve.engine import _decode_multi
     from jax.sharding import NamedSharding, PartitionSpec
 
-    mesh = _mesh_on(topology, {"model": tp})
-    cfg = preset("llama3-8b", dtype="bfloat16", param_dtype="bfloat16")
-    params_sds = jax.eval_shape(
-        lambda: init_decoder_params(jax.random.PRNGKey(0), cfg))
+    mesh = _mesh_on(topology, {"model": tp}, topo_kwargs=topo_kwargs)
+    cfg = preset(model, dtype="bfloat16", param_dtype="bfloat16")
+    if cfg.is_moe:
+        # The engine's measured decode default: dense MoE (per-phase A/B in
+        # serve/engine.py — zero-drop dispatch tied, dense is simpler).
+        import dataclasses as _dc
+        cfg = _dc.replace(cfg, moe_impl="dense")
+
+    def _abstract_params():
+        p = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        if quantize == "int8":
+            from kubeflow_tpu.ops.quantization import quantize_params_int8
+
+            p = quantize_params_int8(p, cfg)
+        return p
+
+    params_sds = jax.eval_shape(_abstract_params)
     psh = shard_params(params_sds, decoder_param_specs(cfg), mesh)
     params_sds = jax.tree.map(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
@@ -111,6 +139,7 @@ def serve_decode_analysis(topology: str, tp: int, *, slots=16,
     m = compiled.memory_analysis()
     gb = 1 << 30
     return {
+        "params_b": round(cfg.num_params() / 1e9, 2),
         "argument_gb": round(m.argument_size_in_bytes / gb, 2),
         "temp_gb": round(m.temp_size_in_bytes / gb, 2),
         "total_gb": round((m.argument_size_in_bytes + m.temp_size_in_bytes)
@@ -123,6 +152,13 @@ CONFIGS = [
     ("train", "v5p:4x4x4", {"fsdp": 16, "model": 4}, {"per_chip_batch": 1}),
     ("train", "v5p:4x4x4", {"pipeline": 4, "fsdp": 8, "model": 2},
      {"per_chip_batch": 1, "pp_layers": True}),
+    # Mixtral-8x7B north star (BASELINE.json configs[2]): expert-parallel
+    # training at v5p-64, the same across a 2-slice DCN multislice, and
+    # bf16 serving on v5e-8 (below, after the train table).
+    ("train", "v5p:4x4x4", {"expert": 8, "fsdp": 8},
+     {"model": "mixtral-8x7b", "per_chip_batch": 1}),
+    ("train", "v5p:2x4x4", {"dcn": 2, "expert": 8, "fsdp": 4},
+     {"model": "mixtral-8x7b", "per_chip_batch": 1, "num_slices": 2}),
 ]
 
 
@@ -131,13 +167,33 @@ def main():
     for kind, topo, axes, kw in CONFIGS:
         out = train_step_analysis(topo, axes, **kw)
         out.update(kind=kind, topology=topo, axes=axes,
+                   model=kw.get("model", "llama3-8b"),
+                   num_slices=kw.get("num_slices", 1),
                    budget_gb=budget["v5p"],
                    fits=out["total_gb"] < budget["v5p"])
         print(json.dumps(out), flush=True)
-    out = serve_decode_analysis("v5e:2x4x1", 8)
-    out.update(kind="serve_decode", topology="v5e-8", axes={"model": 8},
-               budget_gb=budget["v5e"], fits=out["total_gb"] < budget["v5e"])
-    print(json.dumps(out), flush=True)
+    for model, slots, max_len in (("llama3-8b", 16, 2048),
+                                  ("mixtral-8x7b", 16, 2048)):
+        out = serve_decode_analysis("v5e:2x4x1", 8, model=model, slots=slots,
+                                    max_len=max_len)
+        out.update(kind="serve_decode", topology="v5e-8", axes={"model": 8},
+                   model=model, budget_gb=budget["v5e"],
+                   fits=out["total_gb"] < budget["v5e"])
+        print(json.dumps(out), flush=True)
+    # int8 density points (VERDICT r4 #3): weight-only int8 on smaller
+    # topologies than bf16 can reach.
+    for topo, tp, kw in (
+            ("v5e:1x1x1", 1,
+             {"topo_kwargs": {"chips_per_host_bounds": [1, 1, 1]},
+              "slots": 8}),
+            ("v5e:2x2x1", 4, {})):
+        out = serve_decode_analysis(topo, tp, model="llama3-8b",
+                                    quantize="int8", **kw)
+        out.update(kind="serve_decode_int8", topology=topo,
+                   axes={"model": tp}, model="llama3-8b",
+                   budget_gb=budget["v5e"],
+                   fits=out["total_gb"] < budget["v5e"])
+        print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -58,3 +58,81 @@ def test_serving_decode_8b_compiles_on_v5e8_within_hbm():
     # 16 GB a single v5e chip has — which full replication could never fit.
     assert out["total_gb"] < 16.0, out
     assert out["argument_gb"] > 1.5, out
+
+
+# -- Mixtral-8x7B north star (BASELINE.json configs[2]; VERDICT r4 #2) ---------
+
+
+@pytest.mark.slow
+def test_train_step_mixtral_compiles_on_v5p64_within_hbm():
+    """The real 46.7B MoE train step, expert×fsdp-sharded on a virtual
+    v5p-64, per-chip memory within the 95 GB budget. Measured this session:
+    30.6 GB/chip (fp32 params + Adam ≈ 560 GB sharded 64 ways + remat
+    activations)."""
+    import sys
+    sys.path.insert(0, ".")
+    from scripts.aot_validate_8b import train_step_analysis
+
+    _topo("v5p:4x4x4")
+    out = train_step_analysis("v5p:4x4x4", {"expert": 8, "fsdp": 8},
+                              model="mixtral-8x7b", per_chip_batch=1)
+    assert out["params_b"] > 45.0, out       # the real 8x7B, not a toy
+    assert out["total_gb"] < 95.0, out
+    # 560 GB of fp32 state over 64 chips ≈ 8.75 GB arguments per chip.
+    assert 5.0 < out["argument_gb"] < 20.0, out
+
+
+@pytest.mark.slow
+def test_train_step_multislice_dcn_mechanism():
+    """2-slice DCN multislice compiles end-to-end: the topology carries
+    distinct slice_index per slice, build_mesh routes through the hybrid
+    ICI×DCN assignment, and the dcn-axis collectives lower. Runs the tiny
+    MoE config so the suite stays fast; the full 46.7B 2-slice point
+    (49.9 GB/chip on v5p:2x4x4 ×2) lives in scripts/aot_validate_8b.py and
+    BASELINE.md."""
+    import sys
+    sys.path.insert(0, ".")
+    from scripts.aot_validate_8b import train_step_analysis
+
+    _topo("v5p:2x2x1")
+    out = train_step_analysis("v5p:2x2x1", {"dcn": 2, "expert": 4,
+                                            "fsdp": 2},
+                              model="tiny-moe", per_chip_batch=1,
+                              num_slices=2)
+    assert out["total_gb"] < 95.0, out
+
+
+@pytest.mark.slow
+def test_serving_decode_mixtral_compiles_on_v5e8_within_hbm():
+    """Mixtral-8x7B bf16 serving decode TP-sharded on v5e-8: ≈11.4 GB/chip
+    of params (93 GB / 8) + KV — fits the 16 GB chip with room for the
+    cache; single-chip serving could never hold it."""
+    import sys
+    sys.path.insert(0, ".")
+    from scripts.aot_validate_8b import serve_decode_analysis
+
+    _topo("v5e:2x4x1")
+    out = serve_decode_analysis("v5e:2x4x1", 8, model="mixtral-8x7b")
+    assert out["total_gb"] < 16.0, out
+    assert out["argument_gb"] > 10.0, out    # the real 46.7B resident
+
+
+# -- int8 density (VERDICT r4 #3: AOT-prove the quantization HBM win) ----------
+
+
+@pytest.mark.slow
+def test_serving_decode_8b_int8_fits_one_v5e_chip():
+    """Weight-only int8 8B decode on ONE v5e chip: 12.7 GB of 16 — a
+    deployment bf16 cannot reach (16 GB of params alone). The quantized
+    param tree lowers through the same decode step (QuantizedTensor
+    pytrees + per-field shardings)."""
+    import sys
+    sys.path.insert(0, ".")
+    from scripts.aot_validate_8b import serve_decode_analysis
+
+    _topo("v5e:2x4x1")      # libtpu-presence gate (shared skip semantics)
+    out = serve_decode_analysis(
+        "v5e:1x1x1", 1, model="llama3-8b", quantize="int8", slots=8,
+        max_len=2048, topo_kwargs={"chips_per_host_bounds": [1, 1, 1]})
+    assert out["total_gb"] < 16.0, out
+    assert out["argument_gb"] < 11.0, out    # int8 params ≈ 8 GB + KV
