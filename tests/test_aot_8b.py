@@ -95,7 +95,8 @@ def test_train_step_multislice_dcn_mechanism():
     from scripts.aot_validate_8b import train_step_analysis
 
     _topo("v5p:2x2x1")
-    out = train_step_analysis("v5p:2x2x1", {"dcn": 2, "expert": 4,
+    # 2 slices x (2x2x1 = 4 chips/slice) = 8 devices: dcn 2 x ep 2 x fsdp 2.
+    out = train_step_analysis("v5p:2x2x1", {"dcn": 2, "expert": 2,
                                             "fsdp": 2},
                               model="tiny-moe", per_chip_batch=1,
                               num_slices=2)
