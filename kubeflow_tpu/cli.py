@@ -212,7 +212,27 @@ def cmd_volumes(args) -> int:
 
 def cmd_artifacts(args) -> int:
     """Registered artifact:// names → versions → shape/size — what an
-    operator checks before pointing a storageUri or dataset_uri at one."""
+    operator checks before pointing a storageUri or dataset_uri at one.
+    ``kftpu artifacts gc`` runs platform GC (retention + mark-and-sweep)."""
+    if args.name == "gc":
+        body = {"dry_run": bool(args.dry_run)}
+        if args.keep_last is not None:
+            body["keep_last"] = args.keep_last
+        if args.min_age is not None:
+            body["min_age_s"] = args.min_age
+        rep = _req(args.server, "POST", "/artifacts/gc",
+                   body=json.dumps(body).encode(),
+                   user=getattr(args, "user", None))
+        verb = "would sweep" if rep["dry_run"] else "swept"
+        print(f"{verb} {rep['swept_blobs']} blobs "
+              f"({rep['swept_bytes'] / 1e6:.1f} MB) + {rep['swept_trees']} "
+              f"materialized trees; live {rep['live_blobs']} blobs "
+              f"({rep['live_bytes'] / 1e6:.1f} MB)")
+        for pv in rep["pruned_versions"]:
+            print(f"  pruned {pv}")
+        if rep["retired_lineage"]:
+            print(f"  retired lineage artifacts: {rep['retired_lineage']}")
+        return 0
     if not args.name:
         items = _req(args.server, "GET", "/artifacts")["items"]
         if not items:
@@ -379,8 +399,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("artifacts",
                         help="browse the artifact register (artifact:// "
-                             "names, versions, sizes)")
+                             "names, versions, sizes); 'artifacts gc' "
+                             "prunes + sweeps the store")
     sp.add_argument("name", nargs="?")
+    sp.add_argument("--keep-last", type=int, default=None,
+                    help="gc: retain only the newest N versions per name")
+    sp.add_argument("--min-age", type=float, default=None,
+                    help="gc: grace window seconds (default 600)")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="gc: report only, delete nothing")
     common(sp)
     sp.set_defaults(fn=cmd_artifacts)
 

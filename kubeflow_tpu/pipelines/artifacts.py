@@ -219,6 +219,11 @@ class ArtifactStore:
         registry contract serving relies on for rollback-by-version."""
         if not _NAME_OK.match(name) or _HEX_DIGEST.match(name):
             raise ValueError(f"bad artifact name {name!r}")
+        if name == "gc":
+            # Reserved: `kftpu artifacts gc` is the GC verb (git-style);
+            # an artifact named "gc" would be CLI-unreachable and one typo
+            # away from a destructive sweep.
+            raise ValueError("'gc' is a reserved artifact name")
         if not _NAME_OK.match(version):
             raise ValueError(f"bad artifact version {version!r}")
         if not self.exists(uri):

@@ -1,0 +1,220 @@
+"""Artifact garbage collection — mark-and-sweep over the CAS (VERDICT
+round-4 next #9; the (U) analog is MinIO lifecycle policies + KFP's
+artifact GC: running an object store for real includes pruning it).
+
+Semantics:
+
+- **Roots** are (a) every register entry that survives the retention
+  policy (``name@version`` bindings — what serving storageUris resolve
+  through), and (b) every MLMD lineage artifact still in state
+  ``ART_LIVE`` (pipeline run outputs stay consumable until their lineage
+  is retired — the KFP rule that artifact deletion follows run deletion).
+- **Retention** (``keep_last=N``) unbinds all but the newest N versions
+  of each register name *first*; MLMD artifacts that pointed at a
+  pruned-and-now-unreferenced digest are transitioned to ``ART_DELETED``
+  — the lineage row stays readable (who produced it, when, for which
+  run), only the bytes go.
+- **Mark** expands tree manifests, so a checkpoint shard shared between a
+  retained and a pruned version (CAS dedup) is kept by the retained root.
+- **Sweep** deletes unmarked blobs and their ``trees/`` materializations.
+  In-flight writes are protected two ways: staging temp files never look
+  like content addresses (the sweep only touches 64-hex paths), and a
+  **grace window** (``min_age_s``, default 10 min) skips any blob younger
+  than it — a writer that finished ``put_bytes`` but hasn't yet
+  registered/recorded lineage for the digest cannot lose it to a
+  concurrent GC (the same young-object rule every production CAS GC
+  applies; set 0 only in tests or with the platform quiesced).
+
+``dry_run=True`` reports what would be deleted without touching anything
+(including the MLMD state transitions).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import threading
+from typing import Optional
+
+from kubeflow_tpu.pipelines.artifacts import SCHEME, ArtifactStore
+
+_HEX2 = re.compile(r"^[0-9a-f]{2}$")
+_HEX62 = re.compile(r"^[0-9a-f]{62}$")
+
+# One GC at a time per process (the API server is threaded; two concurrent
+# sweeps would race each other's unlinks). Cross-process concurrency is
+# additionally tolerated by treating every vanished path as already-swept.
+_GC_LOCK = threading.Lock()
+
+
+def _iter_blobs(store: ArtifactStore):
+    """Yield every (digest, path) in the CAS. Skips the register
+    (``named/``), materializations (``trees/``), staging (``.tmp``) and
+    anything that isn't shaped like a content address."""
+    for d2 in sorted(os.listdir(store.root)):
+        if not _HEX2.match(d2):
+            continue
+        sub = os.path.join(store.root, d2)
+        if not os.path.isdir(sub):
+            continue
+        for rest in sorted(os.listdir(sub)):
+            if _HEX62.match(rest):
+                yield d2 + rest, os.path.join(sub, rest)
+
+
+def _mark(store: ArtifactStore, digests) -> set[str]:
+    """Transitive closure: tree manifests pull in their file blobs."""
+    live: set[str] = set()
+    for digest in digests:
+        if digest in live:
+            continue
+        live.add(digest)
+        try:
+            manifest = store._manifest_of(SCHEME + digest)
+        except FileNotFoundError:
+            continue               # dangling root: nothing to expand
+        if manifest:
+            live.update(manifest.values())
+    return live
+
+
+def _mlmd_artifacts(metadata) -> list[tuple[int, str, int]]:
+    """Every MLMD artifact as (id, digest, state). Ids are contiguous from
+    1 — MLMD never deletes rows (states change instead), in both the C++
+    and sqlite backends — so a linear probe terminates at the first gap."""
+    out = []
+    aid = 1
+    while True:
+        row = metadata.get_artifact(aid)   # MetadataStore dict surface
+        if row is None:
+            return out
+        uri = row["uri"]
+        if uri.startswith(SCHEME):
+            out.append((aid, uri[len(SCHEME):], row["state"]))
+        aid += 1
+
+
+def collect_garbage(store: ArtifactStore, metadata=None, *,
+                    keep_last: Optional[int] = None,
+                    min_age_s: float = 600.0,
+                    dry_run: bool = False) -> dict:
+    """Run one GC cycle. Returns a report dict (counts, bytes, details).
+
+    ``metadata``: the platform MetadataStore (lineage roots + state
+    transitions); None = register-only GC (no lineage roots — everything
+    unregistered is collectable).
+    ``keep_last``: per-name version retention; None keeps all versions.
+    ``min_age_s``: grace window — blobs younger than this never sweep
+    (protects the put_bytes→register window of concurrent writers).
+    """
+    with _GC_LOCK:
+        return _collect_garbage_locked(store, metadata, keep_last=keep_last,
+                                       min_age_s=min_age_s, dry_run=dry_run)
+
+
+def _collect_garbage_locked(store: ArtifactStore, metadata=None, *,
+                            keep_last: Optional[int] = None,
+                            min_age_s: float = 600.0,
+                            dry_run: bool = False) -> dict:
+    import time
+    from kubeflow_tpu.pipelines.metadata import ART_DELETED, ART_LIVE
+
+    report = {
+        "dry_run": dry_run,
+        "pruned_versions": [],       # ["name@version", ...]
+        "retired_lineage": [],       # MLMD artifact ids -> ART_DELETED
+        "swept_blobs": 0,
+        "swept_bytes": 0,
+        "swept_trees": 0,
+        "live_blobs": 0,
+        "live_bytes": 0,
+    }
+
+    # 1. Retention: unbind all but the newest keep_last versions per name.
+    retained_digests: set[str] = set()
+    pruned_digests: set[str] = set()
+    for name in store.names():
+        versions = store.versions(name)
+        cut = (len(versions) - keep_last) if keep_last is not None else 0
+        for i, version in enumerate(versions):
+            try:
+                digest = store.lookup(name, version)[len(SCHEME):]
+            except FileNotFoundError:
+                continue
+            if i < max(cut, 0):
+                report["pruned_versions"].append(f"{name}@{version}")
+                pruned_digests.add(digest)
+                if not dry_run:
+                    try:
+                        os.unlink(os.path.join(store.root, "named", name,
+                                               version))
+                    except FileNotFoundError:
+                        pass       # concurrent GC already pruned it
+            else:
+                retained_digests.add(digest)
+
+    # 2. Lineage roots + platform-managed retirement of pruned entries.
+    mlmd_live_digests: set[str] = set()
+    if metadata is not None:
+        for aid, digest, state in _mlmd_artifacts(metadata):
+            if state != ART_LIVE:
+                continue
+            if digest in pruned_digests and digest not in retained_digests:
+                # The register retired this content; keep the lineage row
+                # readable but stop it from rooting the bytes.
+                report["retired_lineage"].append(aid)
+                if not dry_run:
+                    metadata.update_artifact(aid, state=ART_DELETED)
+                continue
+            mlmd_live_digests.add(digest)
+
+    # 3-4. Mark + sweep.
+    live = _mark(store, retained_digests | mlmd_live_digests)
+    cutoff = time.time() - max(min_age_s, 0.0)
+    for digest, path in _iter_blobs(store):
+        try:
+            st = os.stat(path)
+        except FileNotFoundError:
+            continue               # concurrent GC / manual prune
+        if digest in live:
+            report["live_blobs"] += 1
+            report["live_bytes"] += st.st_size
+            continue
+        if st.st_mtime > cutoff:
+            report["live_blobs"] += 1      # young: in a writer's window
+            report["live_bytes"] += st.st_size
+            continue
+        report["swept_blobs"] += 1
+        report["swept_bytes"] += st.st_size
+        if not dry_run:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass               # cross-process race: already swept
+
+    trees_dir = os.path.join(store.root, "trees")
+    if os.path.isdir(trees_dir):
+        for digest in sorted(os.listdir(trees_dir)):
+            p = os.path.join(trees_dir, digest)
+            try:
+                mtime = os.path.getmtime(p)
+            except FileNotFoundError:
+                continue
+            if len(digest) == 64 and digest not in live and mtime <= cutoff:
+                report["swept_trees"] += 1
+                if not dry_run:
+                    shutil.rmtree(p, ignore_errors=True)
+    if not dry_run:
+        # Empty shard/name dirs are cosmetic but keep listings honest.
+        for d2 in os.listdir(store.root):
+            sub = os.path.join(store.root, d2)
+            if _HEX2.match(d2) and os.path.isdir(sub) and not os.listdir(sub):
+                os.rmdir(sub)
+        named = os.path.join(store.root, "named")
+        if os.path.isdir(named):
+            for name in os.listdir(named):
+                nd = os.path.join(named, name)
+                if os.path.isdir(nd) and not os.listdir(nd):
+                    os.rmdir(nd)
+    return report

@@ -315,6 +315,8 @@ class ApiServer:
 
     def _post(self, h) -> None:
         parts = [p for p in urlparse(h.path).path.split("/") if p]
+        if parts == ["artifacts", "gc"]:
+            return self._artifacts_gc(h)
         if parts[:1] == ["volumes"] and len(parts) == 3:
             # PVC-create analog: provision an empty volume directory.
             ns, vol = unquote(parts[1]), unquote(parts[2])
@@ -342,6 +344,45 @@ class ApiServer:
             return h._send(403, {"error": "forbidden"})
         applied = self.cp.apply(obj)
         h._send(200, applied.to_manifest())
+
+    def _artifacts_gc(self, h) -> None:
+        """POST /artifacts/gc {keep_last?, min_age_s?, dry_run?} — platform
+        artifact GC (pipelines/gc.py): retention-prune the register, retire
+        matching lineage, mark-and-sweep the CAS. Cluster-scoped and
+        destructive: in multi-user mode only the admin-namespace
+        ("kubeflow" Profile) owner may run it; single-user mode is open
+        (matching the rest of the surface)."""
+        user = h.headers.get("X-Kftpu-User")
+        if user is not None:
+            admin = self.cp.store.try_get(Profile, "kubeflow", "default")
+            if admin is None or user != admin.spec.owner:
+                return h._send(403, {"error": "artifact gc requires the "
+                                              "admin (kubeflow) profile "
+                                              "owner"})
+        length = int(h.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(h.rfile.read(length).decode() or "{}")
+        except ValueError:
+            return h._send(400, {"error": "bad json body"})
+        keep_last = body.get("keep_last")
+        if keep_last is not None and (isinstance(keep_last, bool)
+                                      or not isinstance(keep_last, int)
+                                      or keep_last < 1):
+            # bool-vs-int matters: JSON true would otherwise read as
+            # keep_last=1 and mass-prune every name to one version.
+            return h._send(400, {"error": "keep_last must be a positive "
+                                          "integer"})
+        from kubeflow_tpu.pipelines.gc import collect_garbage
+
+        metadata = getattr(
+            getattr(self.cp, "pipelinerun_reconciler", None), "metadata",
+            None)
+        report = collect_garbage(
+            self.cp.artifact_store, metadata,
+            keep_last=keep_last,
+            min_age_s=float(body.get("min_age_s", 600.0)),
+            dry_run=bool(body.get("dry_run", False)))
+        return h._send(200, report)
 
     def _delete(self, h) -> None:
         parts = [p for p in urlparse(h.path).path.split("/") if p]
