@@ -22,12 +22,70 @@ custom explainers plug in without touching the server.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
 explainer_registry: dict[str, Callable] = {}
+
+
+# Jitted computation per (cfg, mesh): the mesh-mode engine holds TP-sharded
+# (possibly int8-quantized) params — an eager forward would dispatch
+# primitive-by-primitive over sharded operands; under jit GSPMD partitions
+# the whole attribution computation and inserts the per-layer psums exactly
+# as serving dispatches do. lru_cache keys on the hashable (cfg, mesh) so
+# each served configuration compiles once per prompt length.
+
+@functools.lru_cache(maxsize=32)
+def _logits_fn(cfg, mesh):
+    from kubeflow_tpu.models.decoder import decoder_forward
+
+    def f(params, toks):
+        logits, _, _ = decoder_forward(params, toks, cfg, mesh=mesh)
+        return logits
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=32)
+def _embed_fn(cfg, mesh):
+    def f(params, toks):
+        table = params["embed"].astype(cfg.activation_dtype)
+        return table[toks]
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=32)
+def _saliency_fn(cfg, mesh):
+    from kubeflow_tpu.models.decoder import decoder_forward
+
+    def lp_of(params, toks, embeds, target):
+        logits, _, _ = decoder_forward(params, toks, cfg, mesh=mesh,
+                                       inputs_embeds=embeds)
+        return jax.nn.log_softmax(
+            logits[0, -1].astype(jnp.float32))[target]
+
+    def f(params, toks, embeds, target):
+        g = jax.grad(lp_of, argnums=2)(params, toks, embeds, target)
+        return jnp.sum(g.astype(jnp.float32) * embeds.astype(jnp.float32),
+                       axis=-1)[0]
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=32)
+def _loo_fn(cfg, mesh):
+    from kubeflow_tpu.models.decoder import decoder_forward
+
+    def f(params, variants, target):
+        logits, _, _ = decoder_forward(params, variants, cfg, mesh=mesh)
+        return jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
+                                  axis=-1)[:, target]
+
+    return jax.jit(f)
 
 
 def register_explainer(name: str):
@@ -51,35 +109,24 @@ def resolve_explainer(handler: str) -> Callable:
     return getattr(importlib.import_module(module), attr)
 
 
-def _predicted_target(params, cfg, toks: jax.Array) -> tuple[int, float]:
+def _predicted_target(params, cfg, toks: jax.Array,
+                      mesh=None) -> tuple[int, float]:
     """(argmax next token at the last position, its log-probability)."""
-    from kubeflow_tpu.models.decoder import decoder_forward
-
-    logits, _, _ = decoder_forward(params, toks, cfg)
+    logits = _logits_fn(cfg, mesh)(params, toks)
     lp = jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
     target = int(jnp.argmax(lp))
     return target, float(lp[target])
 
 
 @register_explainer("grad_x_input")
-def grad_x_input(tokens: list[int], *, params, cfg, **_) -> dict:
+def grad_x_input(tokens: list[int], *, params, cfg, mesh=None, **_) -> dict:
     """Saliency: score_i = <d logp(target)/d e_i, e_i> for each prompt
     embedding e_i — the first-order effect of removing token i."""
-    from kubeflow_tpu.models.decoder import decoder_forward
-
     toks = jnp.asarray([tokens], jnp.int32)
-    target, lp_target = _predicted_target(params, cfg, toks)
-    dt = cfg.activation_dtype
-    embeds = params["embed"].astype(dt)[toks]        # [1, S, D] (pre-scale)
-
-    def lp_of(e):
-        logits, _, _ = decoder_forward(params, toks, cfg, inputs_embeds=e)
-        return jax.nn.log_softmax(
-            logits[0, -1].astype(jnp.float32))[target]
-
-    g = jax.grad(lp_of)(embeds)
-    scores = jnp.sum(g.astype(jnp.float32) * embeds.astype(jnp.float32),
-                     axis=-1)[0]
+    target, lp_target = _predicted_target(params, cfg, toks, mesh)
+    embeds = _embed_fn(cfg, mesh)(params, toks)      # [1, S, D] (pre-scale)
+    scores = _saliency_fn(cfg, mesh)(params, toks, embeds,
+                                     jnp.int32(target))
     return {
         "method": "grad_x_input",
         "target_token": target,
@@ -89,21 +136,17 @@ def grad_x_input(tokens: list[int], *, params, cfg, **_) -> dict:
 
 
 @register_explainer("leave_one_out")
-def leave_one_out(tokens: list[int], *, params, cfg,
+def leave_one_out(tokens: list[int], *, params, cfg, mesh=None,
                   ablate_token: int = 0, **_) -> dict:
     """Occlusion: score_i = logp(target | prompt) - logp(target | prompt
     with token i replaced by ``ablate_token``). One [S+1, S] forward."""
-    from kubeflow_tpu.models.decoder import decoder_forward
-
     s = len(tokens)
     toks = jnp.asarray([tokens], jnp.int32)
-    target, lp_full = _predicted_target(params, cfg, toks)
+    target, lp_full = _predicted_target(params, cfg, toks, mesh)
     base = jnp.asarray(tokens, jnp.int32)
     variants = jnp.where(jnp.eye(s, dtype=bool), jnp.int32(ablate_token),
                          base[None, :])              # [S, S]
-    logits, _, _ = decoder_forward(params, variants, cfg)
-    lps = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
-                             axis=-1)[:, target]     # [S]
+    lps = _loo_fn(cfg, mesh)(params, variants, jnp.int32(target))
     return {
         "method": "leave_one_out",
         "target_token": target,

@@ -181,7 +181,11 @@ class ModelServer:
                 # the same reason decode defaults to dense in the engine.
                 import dataclasses as _dc
                 cfg = _dc.replace(cfg, moe_impl="dense")
-            out = self.explainer(toks, params=engine.params, cfg=cfg)
+            # mesh: the TP engine's params are sharded (and possibly int8)
+            # — the handlers jit with it so GSPMD partitions attribution
+            # the same way it partitions serving dispatches.
+            out = self.explainer(toks, params=engine.params, cfg=cfg,
+                                 mesh=engine.mesh)
             out["tokens"] = [tokenizer.decode([t]) for t in toks]
             out["predicted_text"] = tokenizer.decode([out["target_token"]])
         return out
