@@ -13,7 +13,7 @@ from __future__ import annotations
 import enum
 from typing import Any, Optional
 
-from pydantic import BaseModel, ConfigDict, Field, model_validator
+from pydantic import BaseModel, ConfigDict, Field, field_validator, model_validator
 
 from kubeflow_tpu.core.object import ApiObject, ConditionMixin
 from kubeflow_tpu.core.registry import register_kind
@@ -47,9 +47,25 @@ class TaskIR(BaseModel):
     # {"all": [{"op": "<", "lhs": <ref>, "rhs": <ref>}, ...]} — AND of
     # comparisons; refs use the same shapes as arguments.
     condition: Optional[dict[str, Any]] = None
-    # {"loop_id": id, "items": <ref>} — task instantiated per item at run time
-    iterate_over: Optional[dict[str, Any]] = None
+    # [{"loop_id": id, "items": <ref>}, ...] outermost→innermost — the task
+    # instantiates per item at run time; nested ParallelFor stacks entries
+    # (the inner items ref may be the outer loop_item, e.g. iterating a
+    # field of each outer element). A bare dict (pre-nesting IR documents)
+    # normalizes to a one-element list.
+    iterate_over: Optional[list[dict[str, Any]]] = None
     exit_handler: bool = False
+
+    @field_validator("iterate_over", mode="before")
+    @classmethod
+    def _coerce_iterate(cls, v):
+        if isinstance(v, dict):
+            return [v]
+        if isinstance(v, (list, tuple)) and len(v) == 0:
+            # [] would be neither concrete nor a registered loop at run
+            # time — the task would silently never run. Unrepresentable.
+            raise ValueError("iterate_over must be None or a non-empty "
+                             "list of loop levels")
+        return v
 
 
 class PipelineIR(BaseModel):

@@ -340,17 +340,20 @@ class _PipelineTrace:
                 loops.append(g)
             # ExitHandler scope adds no per-task IR: only the exit task
             # itself (flagged in ExitHandler.__init__) is special.
-        if len(loops) > 1:
-            raise NotImplementedError("nested ParallelFor is not supported")
+        # Nested ParallelFor stacks loop levels outermost→innermost (the
+        # group-stack order); an inner level's items may reference the
+        # outer loop_item (iterating a field of each outer element) — the
+        # executor substitutes it per outer instance at expansion time.
         iterate = None
         if loops:
-            items_ref = _as_ref(loops[0].items)
-            if isinstance(loops[0].items, (list, tuple)):
-                items_ref = {"constant": list(loops[0].items)}
-            iterate = {"loop_id": loops[0].loop_id, "items": items_ref}
-            src = loops[0].items
-            if isinstance(src, TaskOutput):
-                depends.add(src.task.name)
+            iterate = []
+            for g in loops:
+                items_ref = _as_ref(g.items)
+                if isinstance(g.items, (list, tuple)):
+                    items_ref = {"constant": list(g.items)}
+                iterate.append({"loop_id": g.loop_id, "items": items_ref})
+                if isinstance(g.items, TaskOutput):
+                    depends.add(g.items.task.name)
         task = Task(name, comp, arguments, tuple(self._group_stack))
         self.tasks[name] = {
             "name": name,

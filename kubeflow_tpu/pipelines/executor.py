@@ -109,7 +109,7 @@ class PipelineExecutor:
         # Seed: non-loop tasks are concrete as-is; loop members wait for
         # group expansion.
         for name, t in ir.tasks.items():
-            if t.iterate_over is None:
+            if not t.iterate_over:
                 state.concrete[name] = _Concrete(
                     name=name, ir=t, arguments=dict(t.arguments),
                     depends_on=list(t.depends_on))
@@ -118,7 +118,8 @@ class PipelineExecutor:
         progress = True
         while progress:
             progress = False
-            for loop_id, members in state.loops.items():
+            # Snapshot: expanding a nested member registers new inner loops.
+            for loop_id, members in list(state.loops.items()):
                 if loop_id not in state.expanded and self._loop_ready(state, loop_id):
                     self._expand_loop(state, loop_id, members)
                     progress = True
@@ -158,7 +159,7 @@ class PipelineExecutor:
         """A loop expands when every dependency *outside* the loop is done."""
         members = set(state.loops[loop_id])
         for m in state.loops[loop_id]:
-            for dep in state.ir.tasks[m].depends_on:
+            for dep in state.task_ir(m).depends_on:
                 if dep in members:
                     continue
                 if not state.dep_finished(dep):
@@ -169,9 +170,17 @@ class PipelineExecutor:
 
     def _expand_loop(self, state: "_RunState", loop_id: str,
                      members: list[str]) -> None:
-        first = state.ir.tasks[members[0]]
+        """Instantiate one loop LEVEL. A member still carrying inner loop
+        levels becomes a *virtual* instance: its outer loop_item refs are
+        substituted (including inside the inner items ref — nested
+        ParallelFor iterating a field of each outer element), its inner
+        loop ids are scoped per outer instance (``loop-2#0`` …) so each
+        outer element expands its own inner fan-out, and it registers as a
+        new pending loop instead of a runnable task. Fan-in flattens
+        through the instance tree (``_RunState.flat_instances``)."""
+        first = state.task_ir(members[0])
         try:
-            items = self._resolve_ref(state, first.iterate_over["items"])
+            items = self._resolve_ref(state, first.iterate_over[0]["items"])
         except _Unresolvable:
             state.expanded.add(loop_id)  # upstream skipped: zero items
             items = []
@@ -181,7 +190,7 @@ class PipelineExecutor:
                 f"{type(items).__name__}, need a list")
         member_set = set(members)
         for m in members:
-            t = state.ir.tasks[m]
+            t = state.task_ir(m)
             instances = []
             for i, item in enumerate(items):
                 cname = f"{m}#{i}"
@@ -198,9 +207,35 @@ class PipelineExecutor:
                         for side in ("lhs", "rhs"):
                             comp[side] = self._instance_ref(
                                 comp[side], loop_id, item, i, member_set)
-                cir = t.model_copy(update={"condition": cond})
-                state.concrete[cname] = _Concrete(
-                    name=cname, ir=cir, arguments=args, depends_on=deps)
+                inner = t.iterate_over[1:]
+                if inner:
+                    inner = json.loads(json.dumps(inner))   # deep copy
+                    # Inner loop ids scope per outer instance; loop_item
+                    # refs in args/conditions follow the rename so they
+                    # still match at the inner expansion.
+                    scope = {lv["loop_id"]: f"{lv['loop_id']}#{i}"
+                             for lv in inner}
+                    for level in inner:
+                        level["items"] = self._rescope(self._instance_ref(
+                            level["items"], loop_id, item, i, member_set),
+                            scope)
+                        level["loop_id"] = scope[level["loop_id"]]
+                    args = {k: self._rescope(r, scope)
+                            for k, r in args.items()}
+                    if cond is not None:
+                        for comp in cond["all"]:
+                            for side in ("lhs", "rhs"):
+                                comp[side] = self._rescope(comp[side], scope)
+                    vir = t.model_copy(update={
+                        "name": cname, "arguments": args,
+                        "depends_on": deps, "condition": cond,
+                        "iterate_over": inner})
+                    state.register_virtual(cname, vir)
+                else:
+                    cir = t.model_copy(update={"condition": cond,
+                                               "iterate_over": None})
+                    state.concrete[cname] = _Concrete(
+                        name=cname, ir=cir, arguments=args, depends_on=deps)
                 instances.append(cname)
             state.instances[m] = instances
         state.expanded.add(loop_id)
@@ -217,6 +252,13 @@ class PipelineExecutor:
             src, _, out = ref["task_output"].partition(".")
             if src in members:
                 return {"task_output": f"{src}#{i}.{out}"}
+        return ref
+
+    @staticmethod
+    def _rescope(ref: dict[str, Any], scope: dict[str, str]) -> dict[str, Any]:
+        """Follow an inner-loop id rename in a loop_item reference."""
+        if isinstance(ref, dict) and ref.get("loop_item") in scope:
+            return {**ref, "loop_item": scope[ref["loop_item"]]}
         return ref
 
     def _readiness(self, state: "_RunState", c: _Concrete) -> str:
@@ -351,8 +393,10 @@ class PipelineExecutor:
         if "task_output" in ref:
             src, _, out = ref["task_output"].partition(".")
             if src in state.instances:  # fan-in over loop instances
+                # Nested loops flatten: a consumer outside both levels sees
+                # one list over every (i, j) instance in loop order.
                 vals = []
-                for inst in state.instances[src]:
+                for inst in state.flat_instances(src):
                     st = state.status.get(inst)
                     if st is None or st.skipped or st.phase is not RunPhase.SUCCEEDED:
                         continue
@@ -462,39 +506,65 @@ class _RunState:
         self.status: dict[str, TaskExecutionStatus] = {}
         # (concrete task, output) -> (artifact_id, uri, value)
         self.outputs: dict[tuple[str, str], tuple[int, str, Any]] = {}
-        self.instances: dict[str, list[str]] = {}   # loop member -> concrete
+        self.instances: dict[str, list[str]] = {}   # loop member -> instances
         self.expanded: set[str] = set()
         self.loops: dict[str, list[str]] = {}
+        # Virtual instances: an outer-loop instance still carrying inner
+        # loop levels (nested ParallelFor) — a task record pending its own
+        # expansion, never directly runnable.
+        self.virtual: dict[str, TaskIR] = {}
         for name, t in ir.tasks.items():
-            if t.iterate_over is not None:
-                self.loops.setdefault(t.iterate_over["loop_id"], []).append(name)
+            if t.iterate_over:
+                self.loops.setdefault(
+                    t.iterate_over[0]["loop_id"], []).append(name)
+
+    def task_ir(self, name: str) -> TaskIR:
+        return self.virtual.get(name) or self.ir.tasks[name]
+
+    def register_virtual(self, name: str, tir: TaskIR) -> None:
+        self.virtual[name] = tir
+        self.loops.setdefault(tir.iterate_over[0]["loop_id"], []).append(name)
+
+    def flat_instances(self, name: str) -> list[str]:
+        """Concrete instances under a (possibly nested) loop member, in
+        loop order — the fan-in view."""
+        out = []
+        for i in self.instances.get(name, []):
+            if i in self.instances:
+                out.extend(self.flat_instances(i))
+            else:
+                out.append(i)
+        return out
 
     def dep_finished(self, dep: str) -> bool:
         if dep in self.instances:
-            return all(i in self.status for i in self.instances[dep])
+            return all(self.dep_finished(i) for i in self.instances[dep])
         if any(dep in members for members in self.loops.values()):
-            if dep not in self.instances:
-                return False  # loop not expanded yet
+            return False  # loop not expanded yet
         return dep in self.status
 
     def dep_succeeded(self, dep: str) -> bool:
         """Loop-member deps succeed if expansion happened (instances may be
         individually skipped — fan-in just sees fewer values)."""
         if dep in self.instances:
-            return all(
-                self.status.get(i) is not None
-                and self.status[i].phase is not RunPhase.FAILED
-                for i in self.instances[dep])
+            return all(self._instance_ok(i) for i in self.instances[dep])
         st = self.status.get(dep)
         return (st is not None and st.phase is RunPhase.SUCCEEDED
                 and not st.skipped)
+
+    def _instance_ok(self, name: str) -> bool:
+        if name in self.instances:
+            return all(self._instance_ok(i) for i in self.instances[name])
+        st = self.status.get(name)
+        return st is not None and st.phase is not RunPhase.FAILED
 
     def artifact_for_ref(self, ref: dict[str, Any]) -> list[int]:
         if "task_output" not in ref:
             return []
         src, _, out = ref["task_output"].partition(".")
         if src in self.instances:
-            return [self.outputs[(i, out)][0] for i in self.instances[src]
+            return [self.outputs[(i, out)][0]
+                    for i in self.flat_instances(src)
                     if (i, out) in self.outputs]
         entry = self.outputs.get((src, out))
         return [entry[0]] if entry else []

@@ -129,3 +129,55 @@ class TestValidation:
         # Outside a trace a component is just the function (unit-testable).
         assert ingest(source="s") == ["s"]
         assert train(rows=[1, 2]) == 2.0
+
+
+@dsl.component
+def shard_work(group: str, item: int) -> int:
+    return item
+
+
+@dsl.component
+def collect(items: list) -> int:
+    return len(items)
+
+
+NESTED_GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                             "nested_loops_pipeline.yaml")
+
+
+@dsl.pipeline(name="nested-loops", description="nested ParallelFor demo")
+def nested_loops():
+    groups = [{"name": "a", "xs": [1, 2]}, {"name": "b", "xs": [3]}]
+    with dsl.ParallelFor(groups) as g:
+        with dsl.ParallelFor(g["xs"]) as x:
+            w = shard_work(group=g["name"], item=x)
+    collect(items=w.output)
+
+
+class TestNestedLoopIR:
+    def test_nested_golden_file(self):
+        """Nested ParallelFor compiles to stacked iterate_over levels
+        (outermost→innermost), the inner items referencing the outer
+        loop_item — pinned as a golden snapshot (the KFP compiler-test
+        pattern)."""
+        got = to_yaml(compile_pipeline(nested_loops))
+        if not os.path.exists(NESTED_GOLDEN):  # bootstrap the snapshot
+            os.makedirs(os.path.dirname(NESTED_GOLDEN), exist_ok=True)
+            with open(NESTED_GOLDEN, "w") as f:
+                f.write(got)
+        with open(NESTED_GOLDEN) as f:
+            want = f.read()
+        assert got == want, (
+            "compiled IR drifted from the golden snapshot; if intentional, "
+            f"delete {NESTED_GOLDEN} and rerun")
+
+    def test_nested_ir_structure(self):
+        ir = compile_pipeline(nested_loops)
+        t = ir.tasks["shard_work"]
+        assert len(t.iterate_over) == 2
+        outer, inner = t.iterate_over
+        assert "constant" in outer["items"]
+        assert inner["items"]["loop_item"] == outer["loop_id"]
+        assert inner["items"]["subpath"] == "xs"
+        # Single-level IR stays a one-element list (dict form coerces too).
+        assert from_yaml(to_yaml(ir)) == ir

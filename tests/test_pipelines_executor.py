@@ -221,3 +221,87 @@ class TestArtifacts:
         assert store.put_value({"a": [1, 2]}) == uri   # content-addressed
         obj = {1, 2, 3}  # not JSON-able → pickle codec
         assert store.get_value(store.put_value(obj)) == obj
+
+
+@dsl.component
+def pair_sum(a: int, b: int) -> int:
+    CALLS.append("pair_sum")
+    return a + b
+
+
+class TestNestedParallelFor:
+    """Nested ParallelFor (VERDICT r4 next #10, (U) KFP dsl.ParallelFor
+    nesting): inner loops expand per outer instance with composite
+    instance keys (m#i#j); fan-in outside both levels flattens."""
+
+    def test_static_nested_fanout_and_flat_fanin(self, ex):
+        @dsl.pipeline
+        def p():
+            with dsl.ParallelFor([1, 2]) as outer:
+                with dsl.ParallelFor([10, 20, 30]) as inner:
+                    s = pair_sum(a=outer, b=inner)
+            merge(items=s.output)
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.SUCCEEDED
+        # 2 x 3 instances with composite keys.
+        keys = {n for n in res.tasks if n.startswith("pair_sum#")}
+        assert keys == {f"pair_sum#{i}#{j}" for i in range(2)
+                        for j in range(3)}
+        # (1+10)+(1+20)+(1+30)+(2+10)+(2+20)+(2+30) = 129
+        assert res.tasks["merge"].outputs["output"] == 129
+
+    def test_inner_items_from_outer_element_field(self, ex):
+        """The KFP idiom: iterate a field of each outer element."""
+        @dsl.pipeline
+        def p():
+            groups = [{"base": 100, "xs": [1, 2]},
+                      {"base": 200, "xs": [3]}]
+            with dsl.ParallelFor(groups) as g:
+                with dsl.ParallelFor(g["xs"]) as x:
+                    s = pair_sum(a=g["base"], b=x)
+            merge(items=s.output)
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.SUCCEEDED
+        # Ragged inner lengths: 2 instances under outer#0, 1 under outer#1.
+        assert res.tasks["merge"].outputs["output"] == (101 + 102) + 203
+
+    def test_dynamic_outer_items_and_inner_chain(self, ex):
+        """Outer items from a task output; a dependency chain inside the
+        inner body keys both tasks per (i, j)."""
+        @dsl.pipeline
+        def p(n: int = 2):
+            data = emit(n=n)               # [0, 1]
+            with dsl.ParallelFor(data.output) as i:
+                with dsl.ParallelFor([5, 7]) as j:
+                    s = pair_sum(a=i, b=j)
+                    d = double(x=s.output)
+            merge(items=d.output)
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.SUCCEEDED
+        # 2*((0+5)+(0+7)+(1+5)+(1+7)) = 2*26 = 52
+        assert res.tasks["merge"].outputs["output"] == 52
+        assert "double#0#1" in res.tasks
+        # The inner chain wired instance-to-instance, not cross-product.
+        assert res.tasks["double#1#0"].outputs["output"] == 2 * (1 + 5)
+
+    def test_failure_in_one_inner_instance_skips_fanin(self, ex):
+        @dsl.component
+        def boom_if(x: int) -> int:
+            if x == 7:
+                raise RuntimeError("kaput")
+            return x
+
+        @dsl.pipeline
+        def p():
+            with dsl.ParallelFor([[1, 2], [7]]) as xs:
+                with dsl.ParallelFor(xs) as x:
+                    b = boom_if(x=x)
+            merge(items=b.output)
+
+        res = ex.run(compile_pipeline(p), run_name="r")
+        assert res.phase is RunPhase.FAILED
+        assert res.tasks["boom_if#1#0"].phase is RunPhase.FAILED
+        assert res.tasks["merge"].skipped
