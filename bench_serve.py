@@ -93,6 +93,31 @@ def _summarize(wall, results):
     }
 
 
+def _measure(engine, make_prompts, params, concurrency, requests,
+             warm_prompts):
+    """The shared A/B measurement protocol (every workload uses this — a
+    methodology fix lands once): warm the exact dispatch set, reset the
+    clock, measure two back-to-back segments, report both + spread."""
+    from kubeflow_tpu.serve.engine import EngineMetrics
+
+    engine.start()
+    _drive(engine, warm_prompts, params, concurrency)
+    engine.metrics = EngineMetrics()
+    segs = []
+    for _ in range(2):
+        wall, results = _drive(engine, make_prompts(requests), params,
+                               concurrency)
+        segs.append(_summarize(wall, results))
+    engine.stop()
+    vals = [s["req_s"] for s in segs]
+    return {
+        "value": round(sum(vals) / len(vals), 2),
+        "segments": segs,
+        "spread_pct": round(
+            100 * abs(vals[0] - vals[1]) / max(max(vals), 1e-9), 1),
+    }
+
+
 def _prompts_for(workload, n, cfg, prompt_len, rng, max_new):
     # Generated prompts must leave room for generation: cap at
     # max_seq_len - max_new - 1 (the tiny CPU config's 128 would otherwise
@@ -124,7 +149,7 @@ def run_bench(workload: str, requests: int, concurrency: int,
     import jax
 
     from kubeflow_tpu.models.config import preset
-    from kubeflow_tpu.serve.engine import EngineMetrics, SamplingParams
+    from kubeflow_tpu.serve.engine import SamplingParams
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
@@ -162,41 +187,30 @@ def run_bench(workload: str, requests: int, concurrency: int,
     engine = _mk_engine(cfg, paged=paged, slots=slots, buckets=buckets,
                         max_pages=pool_pages if paged else None,
                         on_tpu=on_tpu)
-    engine.start()
     params = SamplingParams(max_new_tokens=max_new, temperature=0.0)
     rng = np.random.default_rng(0)
 
     # Warm the EXACT dispatch set: one prompt per configured prefill bucket
     # (deterministic — a rare bucket must not compile mid-measurement) plus
-    # 2× slots of the workload's own mix, then reset metrics.
+    # 2× slots of the workload's own mix.
     warm = [rng.integers(1, cfg.vocab_size,
                          size=max(1, min(b - 1, cap))).tolist()
             for b in buckets]
     warm += _prompts_for(workload, 2 * slots, cfg, prompt_len, rng, max_new)
-    _drive(engine, warm, params, concurrency)
-    engine.metrics = EngineMetrics()
-
-    # Two back-to-back measured segments expose run-to-run spread.
-    segs = []
-    for _ in range(2):
-        prompts = _prompts_for(workload, requests, cfg, prompt_len, rng,
-                               max_new)
-        wall, results = _drive(engine, prompts, params, concurrency)
-        segs.append(_summarize(wall, results))
-    engine.stop()
-
-    vals = [s["req_s"] for s in segs]
+    m = _measure(engine,
+                 lambda n: _prompts_for(workload, n, cfg, prompt_len, rng,
+                                        max_new),
+                 params, concurrency, requests, warm)
     return {
         "metric": f"serve_req_per_sec[{model_tag},{workload},"
                   f"gen{max_new},c{concurrency}"
                   f"{',paged' if paged else ''}]",
-        "value": round(sum(vals) / len(vals), 2),
+        "value": m["value"],
         "unit": "req/s",
         "vs_baseline": 1.0,
         "detail": {
-            "segments": segs,
-            "spread_pct": round(
-                100 * abs(vals[0] - vals[1]) / max(vals), 1),
+            "segments": m["segments"],
+            "spread_pct": m["spread_pct"],
             "slots": slots,
             "concurrency": concurrency,
             "pool_pages": pool_pages if paged else None,
@@ -217,7 +231,7 @@ def run_moe_ab(requests: int, concurrency: int, prompt_len: int,
     from kubeflow_tpu.core.serving import BatchingSpec
     from kubeflow_tpu.models.config import preset
     from kubeflow_tpu.serve.engine import (
-        EngineMetrics, LLMEngine, SamplingParams,
+        LLMEngine, SamplingParams,
     )
 
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -252,30 +266,167 @@ def run_moe_ab(requests: int, concurrency: int, prompt_len: int,
             max_batch_size=slots, max_seq_len=cfg.max_seq_len,
             prefill_buckets=[prompt_len],
             weights_dtype="bfloat16" if on_tpu else None, **knobs))
-        engine.start()
-        warm = [rng.integers(1, cfg.vocab_size, size=prompt_len).tolist()
-                for _ in range(2 * slots)]
-        _drive(engine, warm, params, concurrency)
-        engine.metrics = EngineMetrics()
-        segs = []
-        for _ in range(2):
-            prompts = [rng.integers(1, cfg.vocab_size,
-                                    size=prompt_len).tolist()
-                       for _ in range(requests)]
-            wall, results = _drive(engine, prompts, params, concurrency)
-            segs.append(_summarize(wall, results))
-        engine.stop()
-        vals = [s["req_s"] for s in segs]
+        gen = lambda n: [rng.integers(1, cfg.vocab_size,          # noqa: E731
+                                      size=prompt_len).tolist()
+                         for _ in range(n)]
+        m = _measure(engine, gen, params, concurrency, requests,
+                     warm_prompts=gen(2 * slots))
         rows.append({
             "metric": f"serve_moe_req_per_sec[{model_tag},{tag},"
                       f"p{prompt_len},gen{max_new},c{concurrency}]",
-            "value": round(sum(vals) / len(vals), 2),
+            "value": m["value"],
             "unit": "req/s",
             "vs_baseline": 1.0,
-            "detail": {"segments": segs,
-                       "spread_pct": round(
-                           100 * abs(vals[0] - vals[1]) / max(vals), 1),
+            "detail": {"segments": m["segments"],
+                       "spread_pct": m["spread_pct"],
                        "slots": slots,
+                       "requests_per_segment": requests},
+        })
+    return rows
+
+
+def run_quant_ab(requests: int, concurrency: int, prompt_len: int,
+                 max_new: int, only: str = "all") -> list[dict]:
+    """int8 weight-only + int8-KV served A/B (VERDICT r4 #3): bf16 vs
+    quantized weights (contiguous engine — isolates the decode param-read
+    halving) and paged bf16 vs paged int8 KV at the SAME pool page count
+    (isolates the read-traffic change; the density win — 2x resident
+    tokens/byte — is architectural, AOT-proven in BASELINE.md).
+    Decode-heavy workload (short prompts, long generations) so the per-step
+    param/KV read is what the req/s measures."""
+    import jax
+
+    from kubeflow_tpu.core.serving import BatchingSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.serve.engine import (
+        LLMEngine, SamplingParams,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # Bigger than the 0.6b serving config: decode is param-read-bound,
+        # so the thing int8 halves should dominate the step.
+        cfg = preset(
+            "llama3-8b",
+            n_layers=16, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
+            mlp_dim=8192, vocab_size=32000, max_seq_len=2048)
+        model_tag = "llama3-1.2b"
+        # Enforce the decode-heavy shape the metric name claims: short
+        # prompts, long generations (the CLI defaults are prefill-leaning).
+        prompt_len = min(prompt_len, 128)
+        max_new = max(max_new, 128)
+    else:
+        cfg = preset("tiny")
+        model_tag = "tiny"
+        prompt_len = min(prompt_len, 64)
+    cap = cfg.max_seq_len - max_new - 1
+    prompt_len = min(prompt_len, cap)
+    slots = min(16, concurrency)
+    rng = np.random.default_rng(0)
+    params = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+    pool_pages = slots * cfg.max_seq_len // 128
+
+    variants = [
+        ("bf16", {}),
+        ("int8w", {"quantize": "int8"}),
+        ("paged_bf16", {"paged": True, "max_pages": pool_pages,
+                        "paged_attn_impl": "gather"}),
+        ("paged_int8kv", {"paged": True, "max_pages": pool_pages,
+                          "quantize": "int8", "kv_cache_dtype": "int8",
+                          "paged_attn_impl": "gather"}),
+    ]
+    if only != "all":
+        variants = [vk for vk in variants if vk[0] == only]
+    rows = []
+    for tag, knobs in variants:
+        engine = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=slots, max_seq_len=cfg.max_seq_len,
+            prefill_buckets=[prompt_len], chunked_prefill_tokens=512,
+            weights_dtype="bfloat16" if on_tpu else None, **knobs))
+        gen = lambda n: [rng.integers(1, cfg.vocab_size,          # noqa: E731
+                                      size=prompt_len).tolist()
+                         for _ in range(n)]
+        m = _measure(engine, gen, params, concurrency, requests,
+                     warm_prompts=gen(2 * slots))
+        rows.append({
+            "metric": f"serve_quant_req_per_sec[{model_tag},{tag},"
+                      f"p{prompt_len},gen{max_new},c{concurrency}]",
+            "value": m["value"],
+            "unit": "req/s",
+            "vs_baseline": 1.0,
+            "detail": {"segments": m["segments"],
+                       "spread_pct": m["spread_pct"],
+                       "slots": slots,
+                       "requests_per_segment": requests},
+        })
+    return rows
+
+
+def run_longctx_ab(requests: int, concurrency: int, prompt_len: int,
+                   max_new: int, only: str = "all") -> list[dict]:
+    """Long-context serving (VERDICT r4 next #4 — the paged kernel's home
+    turf): S>=4k contexts (long prompts, long decode residency), A/B
+    paged-gather vs the Pallas paged-attention kernel on the SAME pool.
+    This is the measurement behind round-2's 'the saving scales with
+    context length and slot count' claim — at 256-768-token contexts the
+    kernel measured +9.5%; here the per-step gather materializes 4k+ of KV
+    per slot, which the direct-page-read kernel never does."""
+    import jax
+
+    from kubeflow_tpu.core.serving import BatchingSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.serve.engine import (
+        LLMEngine, SamplingParams,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = preset(
+            "llama3-8b",
+            n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
+            mlp_dim=8192, vocab_size=32000, max_seq_len=8192)
+        model_tag = "llama3-0.6b-s8k"
+        prompt_len = max(prompt_len, 4096)
+    else:
+        cfg = preset("tiny")
+        model_tag = "tiny"
+        prompt_len = min(prompt_len, 64)
+    cap = cfg.max_seq_len - max_new - 1
+    prompt_len = min(prompt_len, cap)
+    slots = min(8, concurrency)          # 8 slots x 8k KV ≈ 1 GB at 0.6b
+    pool_pages = slots * cfg.max_seq_len // 128
+    rng = np.random.default_rng(0)
+    params = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+
+    variants = [
+        ("paged_gather", {"paged_attn_impl": "gather"}),
+        ("paged_pallas", {"paged_attn_impl": "pallas"}),
+    ]
+    if only != "all":
+        variants = [vk for vk in variants if vk[0] == only]
+    rows = []
+    for tag, knobs in variants:
+        if tag == "paged_pallas" and not on_tpu:
+            continue                     # Mosaic kernel needs the chip
+        engine = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=slots, max_seq_len=cfg.max_seq_len,
+            paged=True, page_size=128, max_pages=pool_pages,
+            chunked_prefill_tokens=1024, max_concurrent_prefills=2,
+            weights_dtype="bfloat16" if on_tpu else None, **knobs))
+        gen = lambda n: [rng.integers(1, cfg.vocab_size,          # noqa: E731
+                                      size=prompt_len).tolist()
+                         for _ in range(n)]
+        m = _measure(engine, gen, params, concurrency, requests,
+                     warm_prompts=gen(max(4, slots)))
+        rows.append({
+            "metric": f"serve_longctx_req_per_sec[{model_tag},{tag},"
+                      f"p{prompt_len},gen{max_new},c{concurrency}]",
+            "value": m["value"],
+            "unit": "req/s",
+            "vs_baseline": 1.0,
+            "detail": {"segments": m["segments"],
+                       "spread_pct": m["spread_pct"],
+                       "slots": slots, "pool_pages": pool_pages,
                        "requests_per_segment": requests},
         })
     return rows
@@ -284,7 +435,8 @@ def run_moe_ab(requests: int, concurrency: int, prompt_len: int,
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="uniform",
-                    choices=["uniform", "mixed", "prefix", "all", "moe"])
+                    choices=["uniform", "mixed", "prefix", "all", "moe",
+                             "quant", "longctx"])
     ap.add_argument("--requests", type=int, default=48,
                     help="per measured segment (two segments run)")
     ap.add_argument("--concurrency", type=int, default=16)
@@ -299,11 +451,28 @@ if __name__ == "__main__":
                          "tunnel-compile time budgets (cross-process "
                          "comparisons carry session noise — prefer one "
                          "process for the A/B)")
+    ap.add_argument("--variant", default="all",
+                    choices=["all", "dense", "dispatch_prefill",
+                             "dispatch_prefill+zd_decode", "bf16", "int8w",
+                             "paged_bf16", "paged_int8kv", "paged_gather",
+                             "paged_pallas"],
+                    help="moe/quant/longctx workloads: run one variant")
     args = ap.parse_args()
     if args.workload == "moe":
+        only = args.variant if args.variant != "all" else args.moe_variant
         for row in run_moe_ab(args.requests, args.concurrency,
-                              args.prompt_len, args.max_new,
-                              only=args.moe_variant):
+                              args.prompt_len, args.max_new, only=only):
+            print(json.dumps(row), flush=True)
+        raise SystemExit(0)
+    if args.workload in ("quant", "longctx"):
+        fn = run_quant_ab if args.workload == "quant" else run_longctx_ab
+        rows = fn(args.requests, args.concurrency, args.prompt_len,
+                  args.max_new, only=args.variant)
+        if not rows:
+            raise SystemExit(
+                f"no variants ran for --workload {args.workload} "
+                f"--variant {args.variant} on this backend")
+        for row in rows:
             print(json.dumps(row), flush=True)
         raise SystemExit(0)
     wls = (["uniform", "mixed", "prefix"] if args.workload == "all"

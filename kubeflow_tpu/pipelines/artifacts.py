@@ -68,12 +68,29 @@ class ArtifactStore:
         digest = hashlib.sha256(data).hexdigest()
         path = self._path(digest)
         if not os.path.exists(path):
-            os.makedirs(os.path.dirname(path), exist_ok=True)
             # Atomic publish: same-content races converge on the same digest.
-            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+            # The retry covers GC's empty-dir rmdir landing between makedirs
+            # and mkstemp (the dir vanishes; recreate and go again).
+            for attempt in (0, 1):
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                try:
+                    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+                    break
+                except FileNotFoundError:
+                    if attempt:
+                        raise
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
             os.replace(tmp, path)
+        else:
+            # Dedup hit: refresh mtime so the GC grace window protects this
+            # blob through the caller's write→register window even when the
+            # bytes were first stored long ago (a dangling old blob re-used
+            # by a new tree must read as young to a concurrent sweep).
+            try:
+                os.utime(path)
+            except OSError:
+                pass   # concurrent sweep took it; caller's exists checks rule
         return SCHEME + digest
 
     def get_bytes(self, uri: str) -> bytes:

@@ -207,14 +207,22 @@ def _collect_garbage_locked(store: ArtifactStore, metadata=None, *,
                     shutil.rmtree(p, ignore_errors=True)
     if not dry_run:
         # Empty shard/name dirs are cosmetic but keep listings honest.
+        # rmdir races a concurrent writer's makedirs→mkstemp window:
+        # ENOTEMPTY here just means the dir came back to life — leave it.
         for d2 in os.listdir(store.root):
             sub = os.path.join(store.root, d2)
             if _HEX2.match(d2) and os.path.isdir(sub) and not os.listdir(sub):
-                os.rmdir(sub)
+                try:
+                    os.rmdir(sub)
+                except OSError:
+                    pass
         named = os.path.join(store.root, "named")
         if os.path.isdir(named):
             for name in os.listdir(named):
                 nd = os.path.join(named, name)
                 if os.path.isdir(nd) and not os.listdir(nd):
-                    os.rmdir(nd)
+                    try:
+                        os.rmdir(nd)
+                    except OSError:
+                        pass
     return report

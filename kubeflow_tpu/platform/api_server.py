@@ -372,6 +372,13 @@ class ApiServer:
             # keep_last=1 and mass-prune every name to one version.
             return h._send(400, {"error": "keep_last must be a positive "
                                           "integer"})
+        min_age = body.get("min_age_s", 600.0)
+        # NaN would poison the grace-window cutoff (all comparisons False —
+        # young blobs sweep, trees never do); strings would 500 in float().
+        if not isinstance(min_age, (int, float)) or isinstance(min_age, bool) \
+                or min_age != min_age or min_age < 0:
+            return h._send(400, {"error": "min_age_s must be a "
+                                          "non-negative number"})
         from kubeflow_tpu.pipelines.gc import collect_garbage
 
         metadata = getattr(
@@ -380,7 +387,7 @@ class ApiServer:
         report = collect_garbage(
             self.cp.artifact_store, metadata,
             keep_last=keep_last,
-            min_age_s=float(body.get("min_age_s", 600.0)),
+            min_age_s=float(min_age),
             dry_run=bool(body.get("dry_run", False)))
         return h._send(200, report)
 
