@@ -106,7 +106,14 @@ class ElasticPolicy(BaseModel):
       analog: cluster pressure outranks one job's width).
     - ``min_tokens_per_sec_per_chip``: shrink when measured per-chip
       throughput falls below the floor — scaling efficiency collapsed, the
-      extra workers are burning chips for nothing.
+      extra workers are burning chips for nothing. These are deliberately
+      *chips-yielding* semantics: each shrink requires a FRESH reading at
+      the new shape (resizes clear stale metrics), but a job whose
+      per-chip throughput is width-independent (pure DP) and persistently
+      below the floor will step down one cooldown at a time toward
+      ``min_replicas`` — the floor says "this job doesn't deserve this
+      many chips", not "find the width that fixes it". Use it to reclaim
+      chips from degraded jobs, with ``min_replicas`` as the keep-alive.
 
     Auto-resizes respect ``scale_cooldown_seconds`` between moves and stop
     for good once ``max_restarts`` auto-resizes have happened (each resize

