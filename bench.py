@@ -115,24 +115,49 @@ def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
 
 
 
-def probe_chip_tflops(n: int = 8192, reps: int = 5) -> float:
-    """Asymptotic bf16 matmul rate (the chip-health probe from
-    scripts/chip_probe.py, inlined): records the WINDOW's practical MXU
+def probe_chip_tflops(n: int = 8192, k1: int = 32, k2: int = 64):
+    """Asymptotic bf16 matmul rate: records the WINDOW's practical MXU
     peak next to the bench numbers, so a cross-session `vs_baseline` ratio
     can be read against the chip's state at measurement time — the
-    tunneled chip drifts 25-40% between sessions (VERDICT r4 weak #5)."""
+    tunneled chip drifts 25-40% between sessions (VERDICT r4 weak #5).
+
+    Slope method (BASELINE.md round-2 chip-envelope notes): time k1 and k2
+    CHAINED matmuls in single dispatches and divide the extra FLOPs by the
+    extra time — the ~90-105 ms tunnel round-trip cancels out (a
+    single-matmul timing reads ~9 TFLOPs on a healthy chip: all RTT).
+    Historically healthy windows measure ~185-190 (95% of nominal 197)."""
     import jax
     import jax.numpy as jnp
 
     a = jnp.ones((n, n), jnp.bfloat16)
-    b = jnp.ones((n, n), jnp.bfloat16)
-    f = jax.jit(lambda a, b: a @ b)
-    _ = jax.device_get(f(a, b).ravel()[0])   # compile + tunnel fence
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        _ = jax.device_get(f(a, b).ravel()[0])
-    dt = (time.perf_counter() - t0) / reps
-    return round(2 * n**3 / dt / 1e12, 1)
+    inv = jnp.bfloat16(1.0 / n)     # keep the chained values at ~1.0
+
+    def chain(k):
+        def f(x, a):
+            def body(x, _):
+                return (x @ a) * inv, None
+
+            x, _ = jax.lax.scan(body, x, None, length=k)
+            return x
+
+        return jax.jit(f)
+
+    times = {}
+    for k in (k1, k2):
+        f = chain(k)
+        _ = jax.device_get(f(a, a).ravel()[0])   # compile + tunnel fence
+        best = float("inf")
+        for _rep in range(3):        # min-of-3: RTT hiccups inflate, never
+            t0 = time.perf_counter()  # deflate, a timing
+            _ = jax.device_get(f(a, a).ravel()[0])
+            best = min(best, time.perf_counter() - t0)
+        times[k] = best
+    dt = times[k2] - times[k1]
+    if dt <= 0:
+        # A flaky window inverted the slope: report invalid, not a number
+        # pretending to be the chip's peak.
+        return None
+    return round(2 * n**3 * (k2 - k1) / dt / 1e12, 1)
 
 
 def run_bench():
@@ -188,7 +213,7 @@ def run_bench():
             "segments": out["segments"],
             "spread_pct": out["spread_pct"],
             # Chip-health probe measured in THIS window: read vs_baseline
-            # against it (healthy v5e windows measure ~180-200 probe
+            # against it (healthy v5e windows measure ~185-190 asymptotic
             # TFLOPs through this stack; a depressed probe explains a
             # depressed ratio without any code regression).
             "probe_tflops": probe_tflops,

@@ -264,33 +264,44 @@ def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
 
 
 def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
-                  slot: jax.Array, length: jax.Array, cfg: DecoderConfig,
-                  attn_impl: str = "xla", mesh: Optional[Mesh] = None):
-    """Prefill a [1, S_bucket] prompt into slot ``slot``.
+                  slots: jax.Array, lengths: jax.Array,
+                  cfg: DecoderConfig, attn_impl: str = "xla",
+                  mesh: Optional[Mesh] = None):
+    """Prefill N same-bucket prompts in ONE dispatch (tokens [N, bucket],
+    slots/lengths [N]); returns ([N, V] last-real-token logits, cache).
+    N=1 is the classic per-request path — one function serves both, so the
+    scratch-cache layout and impl selection can never diverge.
 
     Runs the training forward with a scratch contiguous cache, scatters the
-    resulting K/V into the slot row, and returns the last-real-token logits
-    [V] (the basis of the first sampled token — TTFT ends when it lands).
-    ``mesh`` (TP serving): the flash path runs per-shard via shard_map."""
+    resulting K/V into the slot rows, and returns the last-real-token
+    logits (the basis of the first sampled tokens — TTFT ends when they
+    land). The per-admission dispatch floor (~16 ms host round-trip on a
+    tunneled chip, plus a [1, bucket] forward that under-fills the MXU at
+    small buckets) amortizes across the group; rows are
+    attention-independent (batched causal attention never crosses rows),
+    so outputs are exactly the sequential path's. NOT used for
+    dispatch-MoE prefill — shared [E, C] capacity buffers would couple
+    co-batched prompts, the batch dependence the per-request path exists
+    to avoid (engine.__init__). ``mesh`` (TP serving): the flash path runs
+    per-shard via shard_map."""
+    n, bucket = tokens.shape
     scratch = {
-        "k": jnp.zeros((cfg.n_layers, 1, tokens.shape[1],
+        "k": jnp.zeros((cfg.n_layers, n, bucket,
                         cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
-        "v": jnp.zeros((cfg.n_layers, 1, tokens.shape[1],
+        "v": jnp.zeros((cfg.n_layers, n, bucket,
                         cfg.n_kv_heads, cfg.head_dim), cfg.activation_dtype),
         "len": jnp.int32(0),
         # Static marker: lets attention_block use the flash kernel (start is
         # statically 0 on this path).
         "prefill": True,
     }
-    logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=scratch,
+    logits, filled, _ = decoder_forward(params, tokens, cfg,
+                                        kv_caches=scratch,
                                         attn_impl=attn_impl, mesh=mesh,
-                                        valid_len=length)
-    bucket = tokens.shape[1]
-    ck = jax.lax.dynamic_update_slice(
-        cache["k"], filled["k"], (0, slot, 0, 0, 0))
-    cv = jax.lax.dynamic_update_slice(
-        cache["v"], filled["v"], (0, slot, 0, 0, 0))
-    last = logits[0, length - 1]
+                                        valid_len=lengths)
+    ck = cache["k"].at[:, slots, :bucket].set(filled["k"])
+    cv = cache["v"].at[:, slots, :bucket].set(filled["v"])
+    last = logits[jnp.arange(n), lengths - 1]
     return last, {"k": ck, "v": cv}
 
 
@@ -575,7 +586,20 @@ class LLMEngine:
                                        mesh=self.mesh)
             return out, self._pin(cache)
 
+        # One jitted program serves every group size (N is a trace dim:
+        # sizes are powers of two up to the cap, so the trace set stays
+        # log-bounded per bucket; N=1 is the classic per-request path).
         self._prefill = jax.jit(_prefill_fn, donate_argnums=(1,))
+        # Group cap for batched prefill; forced off where co-batching would
+        # change outputs (dispatch-MoE prefill couples rows through the
+        # shared expert-capacity buffers). The token budget bounds the
+        # transient HBM a group multiplies (scratch KV + [N, bucket, V]
+        # logits): big buckets batch less, the biggest not at all.
+        self.prefill_batch_max = max(1, int(b.prefill_batch_max))
+        self.prefill_batch_token_budget = max(
+            0, int(b.prefill_batch_token_budget))
+        if cfg.is_moe and cfg_prefill.moe_impl == "dispatch":
+            self.prefill_batch_max = 1
         # Chunked prefill for prompts longer than the chunk size: one chunk
         # per scheduler step per in-flight prompt, decode interleaving
         # between chunks. In paged mode EVERY admission takes this path
@@ -693,8 +717,9 @@ class LLMEngine:
                 return bkt
         return self.max_len
 
-    def _free_slot(self) -> Optional[int]:
-        reserved = {ch.slot for ch in self._chunkings}
+    def _free_slot(self, extra_reserved: frozenset = frozenset()
+                   ) -> Optional[int]:
+        reserved = {ch.slot for ch in self._chunkings} | extra_reserved
         for i, s in enumerate(self.slots):
             if s is None and i not in reserved:
                 return i
@@ -712,7 +737,11 @@ class LLMEngine:
             jnp.asarray([req.params.top_k], jnp.int32),
             jnp.asarray([req.params.top_p], jnp.float32),
             _mode_for([req.params]))
-        tok = int(jax.device_get(first)[0])
+        self._admit_with_token(req, slot_idx, plen,
+                               int(jax.device_get(first)[0]))
+
+    def _admit_with_token(self, req: Request, slot_idx: int, plen: int,
+                          tok: int) -> None:
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
         req.output_tokens.append(tok)
@@ -819,23 +848,29 @@ class LLMEngine:
         return self._backlog.pop(0)
 
     def _admit(self) -> int:
-        """Prefill waiting requests into free slots. Returns admissions."""
+        """Prefill waiting requests into free slots. Returns admissions.
+
+        One-shot admissions accumulate into same-bucket groups and flush as
+        batched prefill dispatches (``prefill_batch_max``) — the chunked
+        and paged paths dispatch per-request as before."""
         n = self._advance_chunked()
+        pending: list[tuple[Request, int, int, int]] = []   # req, slot, plen, bucket
         while True:
             if len(self._chunkings) >= self.max_concurrent_prefills \
                     and self.paged:
-                return n
-            slot_idx = self._free_slot()
+                break
+            slot_idx = self._free_slot(
+                frozenset(p[1] for p in pending))
             if slot_idx is None:
-                return n
+                break
             req = self._next_admissible()
             if req is None:
-                return n
+                break
             plen = len(req.prompt_tokens)
             C = self.chunk_size
             if self.paged:
-                # Paged admission is always chunked; the prefix cache trims
-                # the work to the uncached tail.
+                # Paged admission is always chunked; the prefix cache
+                # trims the work to the uncached tail.
                 hit = self._allocator.match_prefix(req.prompt_tokens)
                 self._release_slot_pages(slot_idx)
                 self._slot_pages[slot_idx] = list(hit)
@@ -848,22 +883,67 @@ class LLMEngine:
             if C and plen > C and -(-plen // C) * C <= self.max_len \
                     and len(self._chunkings) < self.max_concurrent_prefills:
                 # Long prompt: chunked path — _free_slot holds this slot
-                # while chunks stream across scheduler steps. Guard: every
-                # C-wide window must fit inside max_len, else the final
-                # chunk's dynamic_update_slice would clamp and overwrite
-                # earlier KV (fall through to one-shot prefill instead).
+                # while chunks stream across scheduler steps. Guard:
+                # every C-wide window must fit inside max_len, else the
+                # final chunk's dynamic_update_slice would clamp and
+                # overwrite earlier KV (fall through to one-shot
+                # prefill instead).
                 ch = _Chunking(req, slot_idx, 0)
                 self._chunkings.append(ch)
                 n += self._advance_one(ch)
                 continue
-            bucket = self._bucket_for(plen)
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :plen] = req.prompt_tokens
-            last_logits, self.cache = self._prefill(
-                self.params, self.cache, jnp.asarray(toks),
-                jnp.int32(slot_idx), jnp.int32(plen))
-            self._start_first_token(req, slot_idx, plen, last_logits)
-            n += 1
+            pending.append((req, slot_idx,
+                            plen, self._bucket_for(plen)))
+        n += self._flush_prefills(pending)
+        return n
+
+    def _flush_prefills(self, pending) -> int:
+        """Dispatch accumulated one-shot admissions, same-bucket groups in
+        power-of-two sizes (p2 keeps the trace set at log(batch_max) per
+        bucket) capped by ``prefill_batch_max`` AND the transient-HBM token
+        budget (group_size × bucket ≤ budget). First tokens sample in ONE
+        batched sampler dispatch + ONE fetch per group — serializing N
+        sampler round-trips here would hand back the amortization the
+        grouped prefill just bought."""
+        n = 0
+        by_bucket: dict[int, list] = {}
+        for item in pending:
+            by_bucket.setdefault(item[3], []).append(item)
+        for bucket, items in by_bucket.items():
+            cap = self.prefill_batch_max
+            if self.prefill_batch_token_budget:
+                cap = min(cap, max(1,
+                                   self.prefill_batch_token_budget // bucket))
+            i = 0
+            while i < len(items):
+                take = 1
+                while take * 2 <= cap and i + take * 2 <= len(items):
+                    take *= 2
+                group = items[i:i + take]
+                i += take
+                toks = np.zeros((take, bucket), np.int32)
+                slots = np.zeros((take,), np.int32)
+                plens = np.zeros((take,), np.int32)
+                for j, (req, slot_idx, plen, _) in enumerate(group):
+                    toks[j, :plen] = req.prompt_tokens
+                    slots[j] = slot_idx
+                    plens[j] = plen
+                last_logits, self.cache = self._prefill(
+                    self.params, self.cache, jnp.asarray(toks),
+                    jnp.asarray(slots), jnp.asarray(plens))
+                params_list = [g[0].params for g in group]
+                firsts = self._sampler(
+                    last_logits, self._next_key(),
+                    jnp.asarray([p.temperature for p in params_list],
+                                jnp.float32),
+                    jnp.asarray([p.top_k for p in params_list], jnp.int32),
+                    jnp.asarray([p.top_p for p in params_list], jnp.float32),
+                    _mode_for(params_list))
+                vals = jax.device_get(firsts)
+                for j, (req, slot_idx, plen, _) in enumerate(group):
+                    self._admit_with_token(req, slot_idx, plen, int(vals[j]))
+                    n += 1
+        return n
 
     # -- paged bookkeeping -----------------------------------------------------
 
