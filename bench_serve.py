@@ -115,6 +115,10 @@ def _measure(engine, make_prompts, params, concurrency, requests,
         "segments": segs,
         "spread_pct": round(
             100 * abs(vals[0] - vals[1]) / max(max(vals), 1e-9), 1),
+        # Engine-side counters for the measured segments only (the warmup
+        # ran against a throwaway EngineMetrics) — the spec A/B reads
+        # acceptance rate / verified tokens per step from here.
+        "engine_metrics": engine.metrics.snapshot(),
     }
 
 
@@ -432,11 +436,119 @@ def run_longctx_ab(requests: int, concurrency: int, prompt_len: int,
     return rows
 
 
+def run_spec_ab(requests: int, concurrency: int, prompt_len: int,
+                max_new: int, only: str = "all", paged: bool = False,
+                spec_k: int = 6) -> list[dict]:
+    """Speculative decoding served A/B: spec-off vs n-gram-draft spec-on at
+    a DECODE-HEAVY shape (short templated prompts, long generations — the
+    dispatch/HBM-bound regime speculation attacks). The workload's prompts
+    are a repeated template ("templated suffix": extraction, code, JSON —
+    the traffic class lookup drafting targets), so the drafter proposes
+    from the first decode round; greedy continuations additionally
+    self-repeat, which is the same property in the generated stream.
+    Reports decode tok/s per variant + acceptance/verified-tokens-per-step
+    from the engine, and a final speedup row (the headline)."""
+    import jax
+
+    from kubeflow_tpu.core.serving import BatchingSpec, SpeculativeSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.serve.engine import LLMEngine, SamplingParams
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = preset(
+            "llama3-8b",
+            n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
+            mlp_dim=8192, vocab_size=32000, max_seq_len=2048)
+        model_tag = "llama3-0.6b"
+        max_new = max(max_new, 512)          # the decode-heavy gen512 shape
+        prompt_len = min(prompt_len, 256)
+    else:
+        cfg = preset("tiny", max_seq_len=1024)
+        model_tag = "tiny-s1k"
+        prompt_len = min(prompt_len, 64)
+        max_new = min(max(max_new, 256), 512)
+    cap = cfg.max_seq_len - max_new - 1
+    prompt_len = min(prompt_len, cap)
+    slots = min(16, concurrency)
+    rng = np.random.default_rng(0)
+    params = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+
+    unit = rng.integers(1, cfg.vocab_size, size=16).tolist()
+
+    def gen(n):
+        # Templated suffix: a shared repeating unit with a per-request
+        # random head — the n-gram drafter locks onto the repetition, the
+        # unique head keeps requests distinct (no prefix-cache confound).
+        out = []
+        for _ in range(n):
+            head = rng.integers(1, cfg.vocab_size, size=8).tolist()
+            reps = unit * (max(prompt_len - len(head), 1) // len(unit) + 1)
+            out.append((head + reps)[:prompt_len])
+        return out
+
+    variants = [
+        ("spec_off", SpeculativeSpec(mode="off")),
+        ("spec_ngram", SpeculativeSpec(mode="ngram", k=spec_k)),
+    ]
+    if only != "all":
+        variants = [vk for vk in variants if vk[0] == only]
+    rows = []
+    toks = {}
+    for tag, spec in variants:
+        engine = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=slots, max_seq_len=cfg.max_seq_len,
+            prefill_buckets=[max(prompt_len, 16)],
+            paged=paged, page_size=128,
+            weights_dtype="bfloat16" if on_tpu else None,
+            speculative=spec))
+        m = _measure(engine, gen, params, concurrency, requests,
+                     warm_prompts=gen(max(4, slots)))
+        tok_s = [s["decode_tok_s"] for s in m["segments"]]
+        toks[tag] = sum(tok_s) / len(tok_s)
+        em = m["engine_metrics"]
+        rows.append({
+            "metric": f"serve_spec_decode_tok_s[{model_tag},{tag},"
+                      f"p{prompt_len},gen{max_new},c{concurrency},"
+                      f"k{spec_k}{',paged' if paged else ''}]",
+            "value": round(toks[tag], 1),
+            "unit": "tok/s",
+            "vs_baseline": 1.0,
+            "detail": {
+                "segments": m["segments"],
+                "spread_pct": m["spread_pct"],
+                "req_s": m["value"],
+                "slots": slots,
+                "requests_per_segment": requests,
+                "spec_acceptance_rate": round(
+                    em.get("spec_acceptance_rate", 0.0), 4),
+                "spec_tokens_per_step": round(
+                    em.get("spec_tokens_per_step", 0.0), 3),
+                "spec_draft_overhead": round(
+                    em.get("spec_draft_overhead", 0.0), 4),
+                "spec_rounds": em.get("spec_rounds", 0),
+            },
+        })
+    if len(toks) == 2:
+        rows.append({
+            "metric": f"serve_spec_speedup[{model_tag},ngram_vs_off,"
+                      f"p{prompt_len},gen{max_new},c{concurrency},"
+                      f"k{spec_k}{',paged' if paged else ''}]",
+            "value": round(toks["spec_ngram"] / max(toks["spec_off"], 1e-9),
+                           3),
+            "unit": "x decode tok/s",
+            "vs_baseline": 1.0,
+            "detail": {"spec_on_tok_s": round(toks["spec_ngram"], 1),
+                       "spec_off_tok_s": round(toks["spec_off"], 1)},
+        })
+    return rows
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="uniform",
                     choices=["uniform", "mixed", "prefix", "all", "moe",
-                             "quant", "longctx"])
+                             "quant", "longctx", "spec"])
     ap.add_argument("--requests", type=int, default=48,
                     help="per measured segment (two segments run)")
     ap.add_argument("--concurrency", type=int, default=16)
@@ -455,9 +567,18 @@ if __name__ == "__main__":
                     choices=["all", "dense", "dispatch_prefill",
                              "dispatch_prefill+zd_decode", "bf16", "int8w",
                              "paged_bf16", "paged_int8kv", "paged_gather",
-                             "paged_pallas"],
-                    help="moe/quant/longctx workloads: run one variant")
+                             "paged_pallas", "spec_off", "spec_ngram"],
+                    help="moe/quant/longctx/spec workloads: run one variant")
+    ap.add_argument("--spec-k", type=int, default=6,
+                    help="spec workload: draft tokens per round")
     args = ap.parse_args()
+    if args.workload == "spec":
+        rows = run_spec_ab(args.requests, args.concurrency, args.prompt_len,
+                           args.max_new, only=args.variant,
+                           paged=args.paged, spec_k=args.spec_k)
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        raise SystemExit(0)
     if args.workload == "moe":
         only = args.variant if args.variant != "all" else args.moe_variant
         for row in run_moe_ab(args.requests, args.concurrency,

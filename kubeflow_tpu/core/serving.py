@@ -42,6 +42,54 @@ class ModelSpec(BaseModel):
     config: dict[str, Any] = Field(default_factory=dict)  # model arch/config
 
 
+class SpeculativeSpec(BaseModel):
+    """Speculative decoding knobs (≈ vLLM ``speculative_config``).
+
+    Greedy requests draft up to ``k`` tokens per decode round and verify all
+    of them in ONE batched dispatch — multiple verified tokens per dispatch
+    at token-identical output (the decode hot path is dispatch- and
+    HBM-bound, not FLOP-bound, so scoring k+1 positions costs barely more
+    than scoring one). Draft sources:
+
+    - ``ngram``: prompt/self lookup — match the last n-gram against the
+      request's own prompt+generated tokens and propose the continuation
+      that followed it (no extra model; wins on templated/repetitive
+      suffixes: code, JSON, extraction, self-repeating generations).
+    - ``draft_model``: a small decoder (``draft`` = {"preset", "overrides"})
+      sharing the target's tokenizer/vocab runs ahead autoregressively;
+      the target verifies. Wins on natural text where lookup misses.
+
+    Sampling (temperature>0) requests fall back to the normal decode path —
+    greedy verification is exact only for argmax decoding."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    mode: str = "off"                # off | ngram | draft_model
+    k: int = 4                       # draft tokens proposed per round
+    # ngram mode: longest/shortest suffix n-gram to look up (tried in
+    # descending order; longer matches are more specific, shorter ones
+    # match earlier in the stream).
+    ngram_max: int = 3
+    ngram_min: int = 1
+    # draft_model mode: the small decoder — {"preset": str,
+    # "overrides": {...}} exactly like ModelSpec.config. Must share the
+    # target's vocab (drafts are token ids).
+    draft: dict[str, Any] = Field(default_factory=dict)
+
+    @model_validator(mode="after")
+    def _check(self) -> "SpeculativeSpec":
+        if self.mode not in ("off", "ngram", "draft_model"):
+            raise ValueError(
+                f"unknown speculative mode {self.mode!r}; "
+                "one of off|ngram|draft_model")
+        if self.mode != "off" and not (1 <= self.k <= 64):
+            raise ValueError("speculative.k must be in [1, 64]")
+        if self.mode == "ngram" and not (
+                1 <= self.ngram_min <= self.ngram_max):
+            raise ValueError("need 1 <= ngram_min <= ngram_max")
+        return self
+
+
 class BatchingSpec(BaseModel):
     """Continuous-batching engine knobs (≈ vLLM engine args in the HF runtime)."""
 
@@ -125,6 +173,11 @@ class BatchingSpec(BaseModel):
     # remeasurement at other batch sizes.
     moe_prefill_impl: str = "auto"   # auto|dispatch|dense
     moe_decode_impl: str = "auto"    # auto|zero_drop|dense
+    # Speculative decoding (draft + batched verify): greedy requests emit
+    # multiple verified tokens per decode dispatch at token-identical
+    # output. Flows to the engine verbatim; the ISVC controller ships it to
+    # predictor replicas inside the batching config like every other knob.
+    speculative: SpeculativeSpec = Field(default_factory=SpeculativeSpec)
 
 
 class PredictorSpec(BaseModel):

@@ -70,15 +70,18 @@ class ArtifactStore:
         if not os.path.exists(path):
             # Atomic publish: same-content races converge on the same digest.
             # The retry covers GC's empty-dir rmdir landing between makedirs
-            # and mkstemp (the dir vanishes; recreate and go again).
-            for attempt in (0, 1):
+            # and mkstemp (the dir vanishes; recreate and go again). Loop
+            # until the dir holds still: repeated GC cycles can re-race the
+            # window any number of times (ADVICE r5), and each retry is two
+            # cheap syscalls — losing a write to win a cleanup race is the
+            # wrong trade at any retry count.
+            while True:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 try:
                     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
                     break
                 except FileNotFoundError:
-                    if attempt:
-                        raise
+                    continue
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
             os.replace(tmp, path)
@@ -243,6 +246,18 @@ class ArtifactStore:
             raise ValueError("'gc' is a reserved artifact name")
         if not _NAME_OK.match(version):
             raise ValueError(f"bad artifact version {version!r}")
+        # Refresh the blob's mtime BEFORE the exists check: registering a
+        # pre-existing, currently-dangling digest races a concurrent GC in
+        # another process (the in-process GC lock can't see it) — between
+        # its mark and sweep this blob is garbage, and only the grace
+        # window protects it. The utime puts it back inside that window;
+        # doing it first means a sweep can beat the utime (register then
+        # fails loudly below) but can never beat a register that already
+        # returned (ADVICE r5).
+        try:
+            os.utime(self.path_for(self.resolve(uri)))
+        except (OSError, ValueError, FileNotFoundError):
+            pass    # missing/invalid: the exists check below rules
         if not self.exists(uri):
             raise FileNotFoundError(f"register {name}@{version}: {uri} "
                                     "is not in the store")

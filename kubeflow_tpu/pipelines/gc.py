@@ -80,19 +80,40 @@ def _mark(store: ArtifactStore, digests) -> set[str]:
 
 
 def _mlmd_artifacts(metadata) -> list[tuple[int, str, int]]:
-    """Every MLMD artifact as (id, digest, state). Ids are contiguous from
-    1 — MLMD never deletes rows (states change instead), in both the C++
-    and sqlite backends — so a linear probe terminates at the first gap."""
+    """Every MLMD artifact as (id, digest, state).
+
+    Enumeration MUST be a full list/scan (``list_artifact_ids``), never an
+    id probe that stops at the first ``get_artifact(aid) is None`` gap: GC
+    marks roots from this list, so any backend that ever yields an id gap
+    (deletion support, id reuse, an alternate backend) would silently
+    unroot every live artifact past the gap — data loss in a destructive
+    operation with no error signal (ADVICE r5). Stores without the scan
+    API (duck-typed stand-ins) fall back to the probe, hardened with a
+    count cross-check when the store can report one."""
     out = []
-    aid = 1
-    while True:
+    ids = None
+    if hasattr(metadata, "list_artifact_ids"):
+        ids = metadata.list_artifact_ids()
+    else:
+        ids = []
+        aid = 1
+        while metadata.get_artifact(aid) is not None:
+            ids.append(aid)
+            aid += 1
+        count = getattr(metadata, "count_artifacts", None)
+        if callable(count) and count() != len(ids):
+            raise RuntimeError(
+                f"artifact id probe found {len(ids)} rows but the store "
+                f"reports {count()}: id space has gaps — refusing to sweep "
+                "with an incomplete root set")
+    for aid in ids:
         row = metadata.get_artifact(aid)   # MetadataStore dict surface
         if row is None:
-            return out
+            continue                       # raced a concurrent writer
         uri = row["uri"]
         if uri.startswith(SCHEME):
             out.append((aid, uri[len(SCHEME):], row["state"]))
-        aid += 1
+    return out
 
 
 def collect_garbage(store: ArtifactStore, metadata=None, *,

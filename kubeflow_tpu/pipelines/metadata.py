@@ -138,10 +138,24 @@ class _NativeBackend:
         if lib is None:
             raise RuntimeError("native metadata store library unavailable")
         self._lib = lib
+        self._path = path
         err = ctypes.create_string_buffer(256)
         self._h = lib.ms_open(path.encode(), err, len(err))
         if not self._h:
             raise RuntimeError(f"ms_open failed: {err.value.decode()}")
+
+    def list_artifact_ids(self) -> list[int]:
+        """Every artifact id, ascending. The C ABI has no list-all call and
+        the library is frozen, but the native store is the system SQLite
+        underneath — enumerate through a read-only side connection (GC
+        depends on a FULL scan: probing ids until the first gap silently
+        unroots everything past a gap)."""
+        db = _pysqlite.connect(f"file:{self._path}?mode=ro", uri=True)
+        try:
+            return [r[0] for r in
+                    db.execute("SELECT id FROM artifacts ORDER BY id")]
+        finally:
+            db.close()
 
     def close(self) -> None:
         if self._h:
@@ -435,6 +449,10 @@ class _PythonBackend:
                         (aid,))
         return tuple(row) if row else None
 
+    def list_artifact_ids(self):
+        return [r[0] for r in
+                self._all("SELECT id FROM artifacts ORDER BY id")]
+
     def create_execution(self, type_id, state):
         return self._write("INSERT INTO executions(type_id,state) VALUES(?,?)",
                            (type_id, state))
@@ -640,6 +658,12 @@ class MetadataStore:
     def artifacts_of_type(self, type_name: str) -> list[int]:
         tid = self._b.get_type(ARTIFACT, type_name)
         return [] if tid is None else self._b.list_by_type(ARTIFACT, tid)
+
+    def list_artifact_ids(self) -> list[int]:
+        """Every artifact id regardless of type, ascending — the full-scan
+        enumeration destructive consumers (pipelines/gc.py root discovery)
+        must use instead of probing ids until the first gap."""
+        return self._b.list_artifact_ids()
 
     # -- executions ------------------------------------------------------------
 

@@ -367,7 +367,9 @@ def _pin2(out, pin):
 
 class EngineMetrics:
     """Serving metrics the reference never surfaces from its own code:
-    req/s, TTFT and TPOT quantiles, tokens/s (SURVEY.md §5 observability)."""
+    req/s, TTFT and TPOT quantiles, tokens/s (SURVEY.md §5 observability),
+    plus speculative-decoding health (acceptance rate, verified tokens per
+    dispatch, draft overhead share) when the engine runs spec rounds."""
 
     def __init__(self, window: int = 2048):
         self._lock = threading.Lock()
@@ -377,6 +379,13 @@ class EngineMetrics:
         self._ttft: list[float] = []
         self._tpot: list[float] = []
         self._window = window
+        # speculative decoding counters (one "round" = one verify dispatch)
+        self.spec_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_draft_time = 0.0     # seconds proposing drafts
+        self.spec_verify_time = 0.0    # seconds in verify dispatches
 
     def observe(self, req: Request) -> None:
         with self._lock:
@@ -392,6 +401,16 @@ class EngineMetrics:
                 self._tpot.append(tpot)
                 self._tpot = self._tpot[-self._window:]
 
+    def observe_spec_round(self, drafted: int, accepted: int, emitted: int,
+                           draft_s: float, verify_s: float) -> None:
+        with self._lock:
+            self.spec_rounds += 1
+            self.spec_drafted += drafted
+            self.spec_accepted += accepted
+            self.spec_emitted += emitted
+            self.spec_draft_time += draft_s
+            self.spec_verify_time += verify_s
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             elapsed = max(time.monotonic() - self.started, 1e-9)
@@ -406,6 +425,15 @@ class EngineMetrics:
                     arr = np.asarray(xs)
                     out[f"{name}_p50_ms"] = float(np.percentile(arr, 50) * 1e3)
                     out[f"{name}_p99_ms"] = float(np.percentile(arr, 99) * 1e3)
+            if self.spec_rounds:
+                out["spec_rounds"] = self.spec_rounds
+                out["spec_acceptance_rate"] = (
+                    self.spec_accepted / max(self.spec_drafted, 1))
+                out["spec_tokens_per_step"] = (
+                    self.spec_emitted / self.spec_rounds)
+                total = self.spec_draft_time + self.spec_verify_time
+                out["spec_draft_overhead"] = (
+                    self.spec_draft_time / max(total, 1e-9))
             return out
 
 
@@ -414,7 +442,8 @@ class LLMEngine:
 
     def __init__(self, cfg: DecoderConfig, batching: Optional[BatchingSpec] = None,
                  *, params: Optional[Params] = None, seed: int = 0,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 draft_params: Optional[Params] = None):
         self.cfg = cfg
         self.batching = batching or BatchingSpec()
         b = self.batching
@@ -664,6 +693,92 @@ class LLMEngine:
                                 cfg_decode, n, sample_mode=m), self._pin),
             static_argnums=(11, 12), donate_argnums=(1,))
 
+        # Speculative decoding (draft + batched verify; serve/spec_decode.py).
+        # Greedy rounds draft k tokens per slot and verify all k+1 positions
+        # in ONE dispatch — multiple verified tokens per host round-trip at
+        # token-identical output. Sampling traffic falls back to the normal
+        # decode path (greedy verification is exact for argmax only).
+        spec = b.speculative
+        self.spec_mode = spec.mode
+        self.spec_k = int(spec.k)
+        self._spec_ngram_max = int(spec.ngram_max)
+        self._spec_ngram_min = int(spec.ngram_min)
+        self._draft_cfg: Optional[DecoderConfig] = None
+        self._draft_params: Optional[Params] = None
+        if self.spec_mode != "off":
+            if self.mesh is not None:
+                raise ValueError(
+                    "speculative decoding is not supported in mesh "
+                    "(tensor-parallel) mode yet")
+            from kubeflow_tpu.serve.spec_decode import (
+                paged_verify_step, verify_step,
+            )
+
+            if self.paged:
+                self._verify = jax.jit(
+                    lambda p, c, t, l, lv: _pin2(
+                        paged_verify_step(p, c, t, l, lv, cfg_decode),
+                        self._pin),
+                    donate_argnums=(1,))
+            else:
+                self._verify = jax.jit(
+                    lambda p, c, t, l, lv: _pin2(
+                        verify_step(p, c, t, l, lv, cfg_decode), self._pin),
+                    donate_argnums=(1,))
+        if self.spec_mode == "draft_model":
+            from kubeflow_tpu.models.config import preset as _preset
+            from kubeflow_tpu.serve.spec_decode import draft_propose
+
+            dconf = dict(spec.draft or {})
+            dcfg = _preset(dconf.get("preset", "tiny"),
+                           **dconf.get("overrides", {}))
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft model vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size} (drafts are token ids — the two "
+                    "must share the tokenizer)")
+            if dcfg.max_seq_len < self.max_len:
+                dcfg = dataclasses.replace(dcfg, max_seq_len=self.max_len)
+            self._draft_cfg = dcfg
+            self._draft_params = (
+                draft_params if draft_params is not None
+                else init_decoder_params(jax.random.PRNGKey(seed + 2), dcfg))
+            if b.weights_dtype is not None:
+                wdt = jnp.dtype(b.weights_dtype)
+                self._draft_params = jax.tree.map(
+                    lambda x: (x.astype(wdt)
+                               if jnp.issubdtype(x.dtype, jnp.floating)
+                               else x), self._draft_params)
+            # The draft's own KV residency: a dense slot cache (the draft is
+            # small — that's the point — so slots × max_len of its few
+            # kv-heads is cheap even when the target pool is paged).
+            self._draft_cache = {
+                "k": jnp.zeros((dcfg.n_layers, self.num_slots, self.max_len,
+                                dcfg.n_kv_heads, dcfg.head_dim),
+                               dcfg.activation_dtype),
+                "v": jnp.zeros((dcfg.n_layers, self.num_slots, self.max_len,
+                                dcfg.n_kv_heads, dcfg.head_dim),
+                               dcfg.activation_dtype),
+            }
+            # consumed-context pointer per slot: positions [0, pos) of the
+            # TRUE sequence have valid draft KV; reset at (re-)admission
+            self._draft_pos = [0] * self.num_slots
+            self._draft_propose_n = jax.jit(
+                lambda p, c, d, dl, dp, lv, n:
+                draft_propose(p, c, d, dl, dp, lv, dcfg, n),
+                static_argnums=(6,), donate_argnums=(1,))
+            self._draft_chunkfn = jax.jit(
+                lambda p, c, t, s, st, vl:
+                _chunk_prefill_step(p, c, t, s, st, dcfg, vl),
+                donate_argnums=(1,))
+            # Catch-up chunk size: the largest power-of-two <= 128 that
+            # divides max_len, so C-aligned chunk windows never cross the
+            # cache edge (the dynamic_update_slice clamp hazard).
+            c = min(128, self.max_len)
+            while c > 1 and self.max_len % c:
+                c //= 2
+            self._draft_chunk = max(c, 1)
+
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots
         self.waiting: "queue.Queue[Request]" = queue.Queue()
         self.metrics = EngineMetrics()
@@ -752,6 +867,10 @@ class LLMEngine:
                                      last_token=tok,
                                      generated=len(req.output_tokens),
                                      admit_seq=next(self._admit_seq))
+        if self._draft_cfg is not None:
+            # Fresh occupant: the draft model has consumed none of it yet
+            # (the first spec round runs a catch-up prefill).
+            self._draft_pos[slot_idx] = 0
         self._finish_if_done(slot_idx)
 
     def _advance_one(self, ch: "_Chunking") -> int:
@@ -904,11 +1023,19 @@ class LLMEngine:
         budget (group_size × bucket ≤ budget). First tokens sample in ONE
         batched sampler dispatch + ONE fetch per group — serializing N
         sampler round-trips here would hand back the amortization the
-        grouped prefill just bought."""
+        grouped prefill just bought.
+
+        Exception safety (ADVICE r5): the requests here were already popped
+        off the backlog — a mid-flush failure (e.g. OOM on a large group)
+        must not silently drop the rest. The failing group's requests fail
+        loudly (their callers see finish_reason="error"); every not-yet-
+        dispatched request goes back to the FRONT of the backlog in
+        arrival order."""
         n = 0
         by_bucket: dict[int, list] = {}
         for item in pending:
             by_bucket.setdefault(item[3], []).append(item)
+        remaining = {id(item): item for item in pending}
         for bucket, items in by_bucket.items():
             cap = self.prefill_batch_max
             if self.prefill_batch_token_budget:
@@ -928,22 +1055,45 @@ class LLMEngine:
                     toks[j, :plen] = req.prompt_tokens
                     slots[j] = slot_idx
                     plens[j] = plen
-                last_logits, self.cache = self._prefill(
-                    self.params, self.cache, jnp.asarray(toks),
-                    jnp.asarray(slots), jnp.asarray(plens))
-                params_list = [g[0].params for g in group]
-                firsts = self._sampler(
-                    last_logits, self._next_key(),
-                    jnp.asarray([p.temperature for p in params_list],
-                                jnp.float32),
-                    jnp.asarray([p.top_k for p in params_list], jnp.int32),
-                    jnp.asarray([p.top_p for p in params_list], jnp.float32),
-                    _mode_for(params_list))
-                vals = jax.device_get(firsts)
+                try:
+                    last_logits, self.cache = self._prefill(
+                        self.params, self.cache, jnp.asarray(toks),
+                        jnp.asarray(slots), jnp.asarray(plens))
+                    params_list = [g[0].params for g in group]
+                    firsts = self._sampler(
+                        last_logits, self._next_key(),
+                        jnp.asarray([p.temperature for p in params_list],
+                                    jnp.float32),
+                        jnp.asarray([p.top_k for p in params_list],
+                                    jnp.int32),
+                        jnp.asarray([p.top_p for p in params_list],
+                                    jnp.float32),
+                        _mode_for(params_list))
+                    vals = jax.device_get(firsts)
+                except Exception:
+                    for item in group:
+                        remaining.pop(id(item), None)
+                    self._fail_flush(group, list(remaining.values()))
+                    raise
+                for item in group:
+                    remaining.pop(id(item), None)
                 for j, (req, slot_idx, plen, _) in enumerate(group):
                     self._admit_with_token(req, slot_idx, plen, int(vals[j]))
                     n += 1
         return n
+
+    def _fail_flush(self, failed_group, requeue_items) -> None:
+        """Mid-flush failure cleanup: fail the dispatched-but-broken group's
+        requests (their engine-side state is unknown — retrying could
+        double-write KV) and requeue everything never dispatched."""
+        for req, _, _, _ in failed_group:
+            req.finish_reason = "error"
+            req.finish_time = time.monotonic()
+            req.stream.put(None)
+            req.done.set()
+        # FRONT of the backlog, original arrival order: they were admitted
+        # once already — nothing may overtake them now.
+        self._backlog[:0] = [item[0] for item in requeue_items]
 
     # -- paged bookkeeping -----------------------------------------------------
 
@@ -1016,12 +1166,23 @@ class LLMEngine:
         return True
 
     def _decode_once(self) -> int:
-        """Up to ``decode_steps`` decode steps for all active slots in one
-        dispatch (one step while a chunked prefill interleaves, so running
-        streams still tick between chunks). Returns tokens emitted."""
+        """One decode round for all active slots. Routes greedy-only rounds
+        to the speculative path when configured; sampling traffic (and
+        spec-off engines) take the plain multi-step path. Returns tokens
+        emitted."""
         active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return 0
+        if (self.spec_mode != "off"
+                and all(s.request.params.temperature <= 0.0
+                        for _, s in active)):
+            return self._spec_decode_once(active)
+        return self._plain_decode_once(active)
+
+    def _plain_decode_once(self, active) -> int:
+        """Up to ``decode_steps`` decode steps for all active slots in one
+        dispatch (one step while a chunked prefill interleaves, so running
+        streams still tick between chunks). Returns tokens emitted."""
         # While a chunked prefill is in flight, decode still multi-steps —
         # just with a smaller K: hard-capping at 1 let concurrent paged
         # traffic (where EVERY admission chunks) pay a full dispatch
@@ -1103,6 +1264,197 @@ class LLMEngine:
                 emitted += 1
             self._finish_if_done(i)
         return emitted
+
+    # -- speculative decoding --------------------------------------------------
+
+    @staticmethod
+    def _context_tokens(s: "_Slot") -> list[int]:
+        """The slot's TRUE token sequence (prompt + emitted output past any
+        preemption fold-back). Invariant: ctx[-1] == s.last_token and
+        len(ctx) == s.length + 1 (the last token's KV is not yet written)."""
+        req = s.request
+        return list(req.prompt_tokens) + req.output_tokens[req.resumed_from:]
+
+    def _spec_decode_once(self, active) -> int:
+        """One draft + batched-verify round (serve/spec_decode.py).
+
+        Each live slot proposes up to ``spec_k`` draft tokens; ONE dispatch
+        scores all k+1 positions per slot; greedy verification accepts the
+        longest prefix matching the target's own argmax chain plus the
+        correction token from the first mismatched position — so outputs
+        are token-identical to plain greedy decode while each round emits
+        1..k+1 tokens per slot. Rounds where no slot produced a draft fall
+        back to the plain multi-step path (which amortizes the dispatch
+        better than a draft-less verify would)."""
+        t0 = time.monotonic()
+        k = self.spec_k
+        drafts: dict[int, list[int]] = {}
+        if self.spec_mode == "ngram":
+            from kubeflow_tpu.serve.spec_decode import ngram_propose
+
+            for i, s in active:
+                drafts[i] = ngram_propose(self._context_tokens(s), k,
+                                          self._spec_ngram_max,
+                                          self._spec_ngram_min)
+        else:
+            drafts = self._draft_model_propose(active)
+        if not any(drafts.values()):
+            return self._plain_decode_once(active)
+        draft_s = time.monotonic() - t0
+        t1 = time.monotonic()
+        T = k + 1
+        if self.paged:
+            # Pages must cover ALL T verify write positions — a dropped
+            # write would corrupt an accepted token's KV. Under pool
+            # pressure preempt youngest-first; if even that cannot cover a
+            # slot, fall back to plain decode (whose shrink-to-one-step
+            # path handles the sole-survivor case).
+            for i, s in list(active):
+                if self.slots[i] is not s:
+                    continue    # preempted by an earlier slot's allocation
+                upto = min(s.length + T, self.max_len)
+                covered = True
+                while not self._ensure_pages(i, upto):
+                    if not self._preempt_youngest(keep=i):
+                        covered = False
+                        break
+                if not covered:
+                    active = [(j, sl) for j, sl in enumerate(self.slots)
+                              if sl is not None]
+                    return self._plain_decode_once(active) if active else 0
+            active = [(i, s) for i, s in enumerate(self.slots)
+                      if s is not None]
+            if not active:
+                return 0
+        nb = self.num_slots
+        tokens = np.zeros((nb, T), np.int32)
+        lengths = np.zeros((nb,), np.int32)
+        live = np.zeros((nb,), bool)
+        for i, s in active:
+            d = drafts.get(i, [])
+            tokens[i, 0] = s.last_token
+            tokens[i, 1:1 + len(d)] = d
+            lengths[i] = s.length
+            live[i] = True
+        if self.paged:
+            cache_in = {**self.cache, "table": jnp.asarray(self._table)}
+            greedy, cache_out = self._verify(
+                self.params, cache_in, jnp.asarray(tokens),
+                jnp.asarray(lengths), jnp.asarray(live))
+            self.cache = {n: cache_out[n] for n in cache_out if n != "table"}
+        else:
+            greedy, self.cache = self._verify(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(lengths), jnp.asarray(live))
+        greedy = np.asarray(jax.device_get(greedy))
+        verify_s = time.monotonic() - t1
+        emitted = 0
+        for i, s in active:
+            d = drafts.get(i, [])
+            a = 0
+            while a < len(d) and d[a] == int(greedy[i, a]):
+                a += 1
+            # Accepted drafts + the correction/bonus token from the first
+            # position whose match broke (free — its logits were computed
+            # by the same dispatch). Truncation by budget/stop/max_len
+            # always finishes the slot, so the "last emitted token's KV is
+            # already written" state it leaves never escapes.
+            emit = d[:a] + [int(greedy[i, a])]
+            p = s.request.params
+            emit = emit[:max(p.max_new_tokens - s.generated, 0)]
+            emit = emit[:self.max_len - 1 - s.length]
+            if p.stop_token is not None and p.stop_token in emit:
+                emit = emit[:emit.index(p.stop_token) + 1]
+            for tok in emit:
+                s.request.output_tokens.append(tok)
+                s.request.stream.put(tok)
+            s.last_token = emit[-1]
+            s.length += len(emit)
+            s.generated += len(emit)
+            emitted += len(emit)
+            self.metrics.observe_spec_round(
+                drafted=len(d), accepted=min(a, len(emit)),
+                emitted=len(emit),
+                draft_s=draft_s / len(active), verify_s=verify_s / len(active))
+            if self.paged:
+                # Roll back rejected positions: live KV covers exactly
+                # [0, s.length) now — truncate the page table to it so pool
+                # refcounts always account for tokens the slot kept.
+                self._truncate_slot_pages(i, s.length)
+            if self._draft_cfg is not None:
+                # Draft KV is valid for everything but the final (bonus)
+                # token, which the draft never consumed.
+                self._draft_pos[i] = s.length
+            self._finish_if_done(i)
+        return emitted
+
+    def _draft_model_propose(self, active) -> dict[int, list[int]]:
+        """Run the small draft model k steps ahead for every live slot in
+        one dispatch (plus per-slot catch-up chunk prefills for freshly
+        (re-)admitted slots whose context the draft hasn't consumed)."""
+        k = self.spec_k
+        dmax = k + 1
+        ctxs: dict[int, list[int]] = {}
+        for i, s in active:
+            ctx = self._context_tokens(s)
+            ctxs[i] = ctx
+            # Catch-up: consume all but the last context token through the
+            # chunked prefill (C-aligned windows; C divides max_len).
+            if len(ctx) - self._draft_pos[i] > dmax:
+                C = self._draft_chunk
+                target = len(ctx) - 1
+                pos = self._draft_pos[i]
+                while pos < target:
+                    real = min(C - pos % C, target - pos)
+                    chunk = np.zeros((1, C), np.int32)
+                    chunk[0, :real] = ctx[pos:pos + real]
+                    _, self._draft_cache = self._draft_chunkfn(
+                        self._draft_params, self._draft_cache,
+                        jnp.asarray(chunk), jnp.int32(i), jnp.int32(pos),
+                        jnp.int32(real))
+                    pos += real
+                self._draft_pos[i] = target
+        nb = self.num_slots
+        deltas = np.zeros((nb, dmax), np.int32)
+        dlens = np.zeros((nb,), np.int32)
+        dpos = np.zeros((nb,), np.int32)
+        live = np.zeros((nb,), bool)
+        for i, s in active:
+            delta = ctxs[i][self._draft_pos[i]:]
+            deltas[i, :len(delta)] = delta
+            dlens[i] = len(delta)
+            dpos[i] = self._draft_pos[i]
+            live[i] = True
+        steps = dmax + k - 1
+        out, self._draft_cache = self._draft_propose_n(
+            self._draft_params, self._draft_cache, jnp.asarray(deltas),
+            jnp.asarray(dlens), jnp.asarray(dpos), jnp.asarray(live), steps)
+        out = np.asarray(jax.device_get(out))
+        drafts: dict[int, list[int]] = {}
+        for i, s in active:
+            first = int(dlens[i]) - 1    # step that predicts past the ctx
+            drafts[i] = [int(t) for t in out[i, first:first + k]]
+            # The propose dispatch consumed the delta AND fed k-1 of its own
+            # drafts; only the true context counts as consumed — the
+            # accepted suffix advances the pointer after verification.
+            self._draft_pos[i] = len(ctxs[i])
+        return drafts
+
+    def _truncate_slot_pages(self, idx: int, keep_tokens: int) -> None:
+        """Free the pages past the ones covering [0, keep_tokens) — the
+        paged-KV rollback after a speculative rejection. Decode-grown pages
+        are never prefix-registered and keep_tokens never rewinds into the
+        prompt, so registered prefix pages are never dropped here."""
+        if self._allocator is None:
+            return
+        keep = -(-keep_tokens // self.page_size)
+        pages = self._slot_pages[idx]
+        if len(pages) <= keep:
+            return
+        drop = pages[keep:]
+        self._slot_pages[idx] = pages[:keep]
+        self._table[idx, keep:len(pages)] = -1
+        self._allocator.free(drop)
 
     def step(self) -> int:
         """One scheduler iteration: admit then decode. Returns work done."""

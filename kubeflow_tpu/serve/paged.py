@@ -24,7 +24,12 @@ Device side, the paged variants mirror the contiguous ones (engine.py): the
 page table rides into the dispatch as a ``[B, max_pages_per_slot]`` int32
 array; reads gather pages back into the ``[B, S, KV, Dh]`` layout XLA
 already tiles well, writes scatter ``(page, offset)`` with out-of-bounds
-drops for dead rows. Exactness: with the "gather" attention impl the same
+drops for dead rows. The speculative verify dispatch
+(serve/spec_decode.py ``paged_verify_step``) extends the same contract
+with a verify-length axis — k+1 (page, offset) writes per slot per round —
+and rejection rolls the page table back to the accepted length
+(engine._truncate_slot_pages): truncated pages return to the free list,
+so pool refcounts account for exactly the tokens each slot kept. Exactness: with the "gather" attention impl the same
 einsums run over the same values, so the paged engine is bit-compatible
 with the contiguous one (tests pin this); the "pallas" impl
 (ops/paged_attention.py) is mathematically exact blockwise softmax with
@@ -87,6 +92,14 @@ class PageAllocator:
 
     def available(self) -> int:
         return len(self._free) + len(self._reclaimable)
+
+    def in_use(self) -> int:
+        """Pages currently referenced by at least one slot. The speculative
+        rollback invariant (engine._truncate_slot_pages) is audited against
+        this: after every request finishes, in_use() must return to 0 —
+        rejected-draft pages were freed exactly once, accepted ones exactly
+        once at slot release."""
+        return int((self._ref > 0).sum())
 
     def alloc(self, n: int) -> list[int]:
         """n fresh pages (ref=1 each). Evicts cached pages LRU if needed."""

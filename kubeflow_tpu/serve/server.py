@@ -253,7 +253,9 @@ class ModelServer:
             lines.append(f"kftpu_serving_tokens_total{lab} "
                          f"{snap['tokens_generated']}")
             for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
-                      "requests_per_sec", "tokens_per_sec"):
+                      "requests_per_sec", "tokens_per_sec",
+                      "spec_acceptance_rate", "spec_tokens_per_step",
+                      "spec_draft_overhead"):
                 if k in snap:
                     lines.append(f"kftpu_serving_{k}{lab} {snap[k]}")
         return "\n".join(lines) + "\n"

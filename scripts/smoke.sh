@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-command local gate for perf PRs: the tier-1 test suite (the exact
+# command ROADMAP.md pins) followed by a short bench_serve sanity run, so a
+# serving change is exercised end-to-end (engine + scheduler + metrics +
+# bench JSON) before it ships. ~15 min total on an idle CPU host.
+#
+#   scripts/smoke.sh            # tier-1 + 30s-class bench sanity
+#   SMOKE_SKIP_TESTS=1 scripts/smoke.sh   # bench sanity only (iterating)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+if [ -z "${SMOKE_SKIP_TESTS:-}" ]; then
+  echo "== tier-1 tests (ROADMAP.md) =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+  rc=${PIPESTATUS[0]}
+  echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+fi
+
+echo "== bench_serve sanity (spec A/B, small shape) =="
+# Small shapes: this is a does-it-run-and-report gate, not a measurement —
+# the JSON must contain the spec-on/spec-off rows and the speedup line.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python bench_serve.py --workload spec --requests 4 --concurrency 4 \
+  --max-new 64 | tee /tmp/_smoke_bench.json
+bench_rc=${PIPESTATUS[0]}
+grep -q "serve_spec_speedup" /tmp/_smoke_bench.json || bench_rc=1
+
+echo "== smoke: tests rc=$rc bench rc=$bench_rc =="
+[ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ]

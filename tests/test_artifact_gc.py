@@ -105,6 +105,43 @@ class TestRetentionAndSweep:
 
 
 class TestLineageRoots:
+    def test_id_gap_does_not_unroot_later_artifacts(self, store, tmp_path):
+        """Regression (ADVICE r5, gc.py root discovery): enumeration must be
+        a full scan, not an id probe that stops at the first gap — a gap
+        used to silently unmark every later LIVE artifact and sweep its
+        bytes."""
+        from kubeflow_tpu.pipelines.metadata import ART_LIVE, MetadataStore
+
+        md = MetadataStore(str(tmp_path / "md.db"), backend="python")
+        try:
+            first = store.put_bytes(b"early output" * 8)
+            md.create_artifact("Dataset", uri=first, state=ART_LIVE)
+            survivor = store.put_bytes(b"later output" * 8)
+            aid2 = md.create_artifact("Dataset", uri=survivor, state=ART_LIVE)
+            # Simulate a backend with an id gap (deletion support / id
+            # reuse / alternate store): drop the first row outright.
+            md._b._write("DELETE FROM artifacts WHERE id=?", (aid2 - 1,))
+            assert md.get_artifact(aid2 - 1) is None       # the gap is real
+            _age(store)
+            collect_garbage(store, md, min_age_s=0)
+            assert store.exists(survivor)                  # still rooted
+        finally:
+            md.close()
+
+    def test_probe_fallback_refuses_on_count_mismatch(self, store):
+        """Duck-typed stores without the scan API fall back to the id probe,
+        but a store that can report a row count cross-checks it and refuses
+        to sweep with an incomplete root set."""
+        class GappyStore:
+            def get_artifact(self, aid):
+                return {"uri": "", "state": 0} if aid in (1, 3) else None
+
+            def count_artifacts(self):
+                return 2        # probe only reaches id 1
+
+        with pytest.raises(RuntimeError, match="refusing to sweep"):
+            collect_garbage(store, GappyStore(), min_age_s=0)
+
     def test_live_lineage_roots_blobs_and_retirement(self, store, tmp_path):
         from kubeflow_tpu.pipelines.metadata import (
             ART_DELETED, ART_LIVE, MetadataStore,
