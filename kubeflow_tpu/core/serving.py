@@ -178,6 +178,18 @@ class BatchingSpec(BaseModel):
     # output. Flows to the engine verbatim; the ISVC controller ships it to
     # predictor replicas inside the batching config like every other knob.
     speculative: SpeculativeSpec = Field(default_factory=SpeculativeSpec)
+    # Bounded admission (load shedding): submit() rejects with
+    # EngineOverloaded once this many requests wait in the scheduler queue
+    # (mapped to HTTP 429 + Retry-After by the model server). 0 = unbounded
+    # — the pre-hardening behavior, where overload turns into unbounded
+    # queue delay and every client times out instead of a few failing fast.
+    max_queue: int = 0
+    # Queue-delay budget (seconds): a request still waiting for a slot this
+    # long after arrival is shed with finish_reason="shed" rather than
+    # admitted — by then its client has almost certainly timed out, and
+    # prefilling it would only steal capacity from requests that can still
+    # meet their deadlines. None = off.
+    queue_delay_budget: Optional[float] = None
 
 
 class PredictorSpec(BaseModel):
@@ -192,6 +204,10 @@ class PredictorSpec(BaseModel):
     resources: TPUResourceSpec = Field(default_factory=TPUResourceSpec)
     parallelism: ParallelismSpec = Field(default_factory=ParallelismSpec)
     batching: BatchingSpec = Field(default_factory=BatchingSpec)
+    # Graceful drain on scale-down/rollout (≈ pod terminationGracePeriod):
+    # a retired replica stops receiving router traffic immediately, then
+    # gets this long to finish in-flight requests before deletion.
+    drain_deadline_s: float = 30.0
 
     @model_validator(mode="after")
     def _check(self) -> "PredictorSpec":

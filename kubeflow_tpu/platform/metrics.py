@@ -24,6 +24,22 @@ def _line(name: str, value, labels: Optional[dict] = None) -> str:
     return f"{name} {value}"
 
 
+def render_histogram(name: str, buckets, counts, total_sum: float,
+                     count: int, labels: Optional[dict] = None) -> list[str]:
+    """Prometheus histogram lines: cumulative ``_bucket`` series (including
+    the ``+Inf`` tail) plus ``_sum``/``_count``. ``counts`` is per-bucket
+    (len(buckets) + 1 entries); shared by the serving queue-delay histogram
+    and any future platform histogram."""
+    out = [f"# TYPE {name} histogram"]
+    acc = 0
+    for le, c in zip(list(buckets) + ["+Inf"], counts):
+        acc += c
+        out.append(_line(name + "_bucket", acc, {**(labels or {}), "le": le}))
+    out.append(_line(name + "_sum", total_sum, labels))
+    out.append(_line(name + "_count", count, labels))
+    return out
+
+
 def render_metrics(store: ObjectStore,
                    recorder: Optional[EventRecorder] = None,
                    allocator=None) -> str:

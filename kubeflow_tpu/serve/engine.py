@@ -16,9 +16,16 @@ Design, driven by XLA's compilation model rather than CUDA streams:
 - **Prefill reuses the training forward** (models/decoder.py
   decoder_forward) on a [1, bucket] block, then scatters the resulting
   K/V into the slot — one model definition, two execution shapes.
-- **Scheduler in plain Python** between device steps: admit → prefill →
-  decode → emit. The hot loop holds no Python per-token state beyond the
-  slot table; everything tensor-shaped lives on device.
+- **Scheduler in plain Python** between device steps: reap → admit →
+  prefill → decode → emit. The hot loop holds no Python per-token state
+  beyond the slot table; everything tensor-shaped lives on device.
+- **Request lifecycle** (deadlines, cancellation, load shedding): every
+  request may carry a monotonic ``deadline`` and can be ``cancel()``ed from
+  any thread; the scheduler reaps dead requests each step wherever they
+  live (backlog, chunked prefill, live slot), freeing the slot and paged-KV
+  pages refcount-balanced. Admission is bounded (``BatchingSpec.max_queue``
+  → ``EngineOverloaded``, the HTTP-429 signal) and queue time is budgeted
+  (``queue_delay_budget`` → finish_reason="shed").
 - **Tensor-parallel mesh mode** ((U) kserve huggingfaceserver → vLLM
   ``tensor_parallel_size``; SURVEY.md §2.3#27): pass a ``mesh`` and the
   engine shards weights by the same logical rules training uses
@@ -34,6 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import logging
 import queue
 import threading
 import time
@@ -48,6 +56,18 @@ from kubeflow_tpu.core.serving import BatchingSpec
 from kubeflow_tpu.models import layers as L
 from kubeflow_tpu.models.config import DecoderConfig
 from kubeflow_tpu.models.decoder import Params, decoder_forward, init_decoder_params
+
+logger = logging.getLogger("kubeflow_tpu.serve.engine")
+
+
+class EngineOverloaded(Exception):
+    """The admission queue is at ``BatchingSpec.max_queue``: shed at the
+    door, in microseconds, instead of queueing into a guaranteed timeout.
+    The protocol layer maps this to HTTP 429 + ``Retry-After``."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 # -- sampling ------------------------------------------------------------------
@@ -313,6 +333,13 @@ class Request:
     params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     id: str = ""
     arrival: float = dataclasses.field(default_factory=time.monotonic)
+    # Request lifecycle: ``deadline`` is a monotonic timestamp (None = no
+    # deadline) stamped by the caller — the model server derives it from the
+    # client timeout / router deadline header. The scheduler reaps expired
+    # and cancelled requests wherever they live (backlog, chunked prefill,
+    # live slot), freeing the slot and its KV pages instead of decoding
+    # dead work.
+    deadline: Optional[float] = None
     # Recompute-preemption bookkeeping (paged engine): output tokens already
     # folded back into prompt_tokens when the slot was preempted.
     resumed_from: int = 0
@@ -324,12 +351,34 @@ class Request:
     stream: "queue.Queue[Optional[int]]" = dataclasses.field(
         default_factory=queue.Queue)
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    _cancelled: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
 
     @property
     def ttft(self) -> Optional[float]:
         if self.first_token_time is None:
             return None
         return self.first_token_time - self.arrival
+
+    def cancel(self) -> None:
+        """Client abandonment: flag the request for the scheduler, which
+        reaps it at its next step. Safe from any thread, idempotent, and a
+        no-op on an already-finished request."""
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def abandon_reason(self, now: Optional[float] = None) -> Optional[str]:
+        """Why the scheduler should drop this request, or None to keep it.
+        Cancellation wins over expiry (it is the more explicit signal)."""
+        if self._cancelled.is_set():
+            return "cancelled"
+        if self.deadline is not None and \
+                (time.monotonic() if now is None else now) > self.deadline:
+            return "deadline"
+        return None
 
     def result(self, timeout: Optional[float] = None) -> list[int]:
         if not self.done.wait(timeout):
@@ -365,6 +414,11 @@ def _pin2(out, pin):
 
 # -- the engine ----------------------------------------------------------------
 
+#: Queue-delay histogram bucket upper bounds (seconds). Chosen to resolve
+#: both the healthy regime (sub-dispatch waits) and the overload knee.
+QUEUE_DELAY_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 5.0, 30.0)
+
+
 class EngineMetrics:
     """Serving metrics the reference never surfaces from its own code:
     req/s, TTFT and TPOT quantiles, tokens/s (SURVEY.md §5 observability),
@@ -386,6 +440,13 @@ class EngineMetrics:
         self.spec_emitted = 0
         self.spec_draft_time = 0.0     # seconds proposing drafts
         self.spec_verify_time = 0.0    # seconds in verify dispatches
+        # request-lifecycle counters (load shedding + reaping)
+        self.requests_shed = 0         # rejected at admission / queue budget
+        self.requests_cancelled = 0    # client called Request.cancel()
+        self.requests_expired = 0      # reaped past their deadline
+        self._qd_counts = [0] * (len(QUEUE_DELAY_BUCKETS) + 1)  # +Inf tail
+        self._qd_sum = 0.0
+        self._qd_n = 0
 
     def observe(self, req: Request) -> None:
         with self._lock:
@@ -400,6 +461,35 @@ class EngineMetrics:
                         / (len(req.output_tokens) - 1))
                 self._tpot.append(tpot)
                 self._tpot = self._tpot[-self._window:]
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self.requests_shed += 1
+
+    def note_abandoned(self, reason: str) -> None:
+        with self._lock:
+            if reason == "cancelled":
+                self.requests_cancelled += 1
+            else:
+                self.requests_expired += 1
+
+    def observe_queue_delay(self, seconds: float) -> None:
+        with self._lock:
+            i = 0
+            while i < len(QUEUE_DELAY_BUCKETS) \
+                    and seconds > QUEUE_DELAY_BUCKETS[i]:
+                i += 1
+            self._qd_counts[i] += 1
+            self._qd_sum += seconds
+            self._qd_n += 1
+
+    def queue_delay_histogram(self) -> tuple[list[float], list[int],
+                                             float, int]:
+        """(bucket upper bounds, per-bucket counts incl. +Inf tail, sum,
+        count) — the Prometheus-histogram raw material."""
+        with self._lock:
+            return (list(QUEUE_DELAY_BUCKETS), list(self._qd_counts),
+                    self._qd_sum, self._qd_n)
 
     def observe_spec_round(self, drafted: int, accepted: int, emitted: int,
                            draft_s: float, verify_s: float) -> None:
@@ -419,7 +509,12 @@ class EngineMetrics:
                 "tokens_generated": self.tokens_generated,
                 "requests_per_sec": self.requests_completed / elapsed,
                 "tokens_per_sec": self.tokens_generated / elapsed,
+                "requests_shed": self.requests_shed,
+                "requests_cancelled": self.requests_cancelled,
+                "requests_expired": self.requests_expired,
             }
+            if self._qd_n:
+                out["queue_delay_avg_ms"] = self._qd_sum / self._qd_n * 1e3
             for name, xs in (("ttft", self._ttft), ("tpot", self._tpot)):
                 if xs:
                     arr = np.asarray(xs)
@@ -782,10 +877,18 @@ class LLMEngine:
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots
         self.waiting: "queue.Queue[Request]" = queue.Queue()
         self.metrics = EngineMetrics()
+        # Bounded admission + queue-delay budget (load shedding): see
+        # BatchingSpec — 0/None keep the pre-hardening unbounded behavior.
+        self.max_queue = max(0, int(b.max_queue))
+        self.queue_delay_budget = (None if b.queue_delay_budget is None
+                                   else float(b.queue_delay_budget))
         self._id_gen = itertools.count()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
+        # None until stop() runs; False = the scheduler thread outlived its
+        # join timeout and is leaked (it may hold live device buffers).
+        self.stopped_clean: Optional[bool] = None
 
     # -- mesh-mode helpers -----------------------------------------------------
 
@@ -809,17 +912,38 @@ class LLMEngine:
 
     # -- submission ------------------------------------------------------------
 
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (admission queue + scheduler-side
+        backlog). Approximate under concurrency — good enough for both the
+        admission bound and the metrics gauge."""
+        return self.waiting.qsize() + len(self._backlog)
+
+    def kv_pages_in_use(self) -> int:
+        """Referenced paged-KV pages (0 for the contiguous cache). The
+        chaos-suite invariant: quiescent engine -> 0 — every reap/finish
+        path freed exactly what admission allocated."""
+        return 0 if self._allocator is None else self._allocator.in_use()
+
     def submit(self, prompt_tokens: list[int],
                params: Optional[SamplingParams] = None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None, *,
+               deadline: Optional[float] = None) -> Request:
         if not prompt_tokens:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.max_len:
             raise ValueError(
                 f"prompt length {len(prompt_tokens)} >= max_seq_len {self.max_len}")
+        if self.max_queue:
+            depth = self.queue_depth()
+            if depth >= self.max_queue:
+                self.metrics.note_shed()
+                raise EngineOverloaded(
+                    f"admission queue full ({depth} >= "
+                    f"max_queue={self.max_queue})")
         req = Request(prompt_tokens=list(prompt_tokens),
                       params=params or SamplingParams(),
-                      id=request_id or f"req-{next(self._id_gen)}")
+                      id=request_id or f"req-{next(self._id_gen)}",
+                      deadline=deadline)
         self.waiting.put(req)
         self._wake.set()
         return req
@@ -937,19 +1061,83 @@ class LLMEngine:
     def _pages_for(self, tokens: int) -> int:
         return -(-min(tokens, self.max_len) // self.page_size)
 
+    def _drain_waiting(self) -> None:
+        while True:
+            try:
+                self._backlog.append(self.waiting.get_nowait())
+            except queue.Empty:
+                break
+
+    def _fail_request(self, req: Request, reason: str) -> None:
+        """Terminal failure with an explicit reason. The lifecycle
+        invariant every robustness path leans on: a submitted request sets
+        ``done`` exactly once — no caller ever hangs on a reaped request."""
+        if req.done.is_set():
+            return
+        req.finish_reason = reason
+        req.finish_time = time.monotonic()
+        req.stream.put(None)
+        req.done.set()
+        if reason == "shed":
+            self.metrics.note_shed()
+        elif reason in ("cancelled", "deadline"):
+            self.metrics.note_abandoned(reason)
+
+    def _reap_abandoned(self) -> int:
+        """Drop cancelled/expired requests wherever they live — live decode
+        slots, in-flight chunked prefills, the preempted lane, and the
+        backlog — and shed backlog entries past the queue-delay budget.
+        Freed slots and their paged-KV pages return to the pool immediately
+        (refcount-balanced) instead of decoding dead work. Runs once per
+        scheduler step, so reap latency is one step (or the 50 ms idle
+        poll). Returns the number of requests dropped."""
+        self._drain_waiting()
+        now = time.monotonic()
+        n = 0
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            reason = s.request.abandon_reason(now)
+            if reason:
+                self._release_slot_pages(i)
+                self.slots[i] = None
+                self._fail_request(s.request, reason)
+                n += 1
+        for ch in list(self._chunkings):
+            reason = ch.request.abandon_reason(now)
+            if reason:
+                self._chunkings.remove(ch)
+                self._release_slot_pages(ch.slot)
+                self._fail_request(ch.request, reason)
+                n += 1
+        for lane in (self._preempted, self._backlog):
+            for req in list(lane):
+                reason = req.abandon_reason(now)
+                if reason is None and lane is self._backlog \
+                        and self.queue_delay_budget is not None \
+                        and now - req.arrival > self.queue_delay_budget:
+                    reason = "shed"
+                if reason:
+                    lane.remove(req)
+                    self._fail_request(req, reason)
+                    n += 1
+        return n
+
+    def _note_admitted(self, req: Request) -> Request:
+        self.metrics.observe_queue_delay(time.monotonic() - req.arrival)
+        return req
+
     def _next_admissible(self) -> Optional[Request]:
         """Next request the scheduler may start. Paged admission control
         (livelock prevention under pool pressure): a preempted request
         resumes FIRST and only once the pool can hold its entire remaining
         run — and while one waits, nothing else is admitted (backpressure);
         fresh requests need room for their prompt plus one growth page."""
-        while True:
-            try:
-                self._backlog.append(self.waiting.get_nowait())
-            except queue.Empty:
-                break
+        self._drain_waiting()
         if not self.paged:
-            return self._backlog.pop(0) if self._backlog else None
+            if not self._backlog:
+                return None
+            return self._note_admitted(self._backlog.pop(0))
         if self._preempted:
             req = self._preempted[0]
             remaining = max(req.params.max_new_tokens
@@ -964,7 +1152,7 @@ class LLMEngine:
         if self._allocator.available() < self._pages_for(
                 len(req.prompt_tokens)) + 1:
             return None
-        return self._backlog.pop(0)
+        return self._note_admitted(self._backlog.pop(0))
 
     def _admit(self) -> int:
         """Prefill waiting requests into free slots. Returns admissions.
@@ -1457,8 +1645,9 @@ class LLMEngine:
         self._allocator.free(drop)
 
     def step(self) -> int:
-        """One scheduler iteration: admit then decode. Returns work done."""
-        return self._admit() + self._decode_once()
+        """One scheduler iteration: reap dead requests, admit, decode.
+        Returns work done (reaps count — a freed slot is admissible work)."""
+        return self._reap_abandoned() + self._admit() + self._decode_once()
 
     # -- background loop -------------------------------------------------------
 
@@ -1475,21 +1664,40 @@ class LLMEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 10.0) -> bool:
+        """Stop the background scheduler. Returns (and records in
+        ``stopped_clean``) whether the thread actually exited: a join
+        timeout is NOT success — the leaked thread still owns the device
+        buffers, so callers must not silently treat the engine as freed."""
         self._stop.set()
         self._wake.set()
+        self.stopped_clean = True
         if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                self.stopped_clean = False
+                logger.error(
+                    "engine scheduler thread did not stop within %.1fs; "
+                    "leaking a live thread that still holds device buffers",
+                    timeout)
+            else:
+                self._thread = None
+        return self.stopped_clean
 
     # -- convenience -----------------------------------------------------------
 
     def generate(self, prompt_tokens: list[int],
                  params: Optional[SamplingParams] = None,
                  timeout: float = 120.0) -> list[int]:
-        """Blocking single-shot generation (drives steps if no loop runs)."""
+        """Blocking single-shot generation (drives steps if no loop runs).
+        A timeout cancels the request so the engine frees its slot and KV
+        pages instead of decoding for a caller that already gave up."""
         req = self.submit(prompt_tokens, params)
         if self._thread is None:
             while not req.done.is_set():
                 self.step()
-        return req.result(timeout)
+        try:
+            return req.result(timeout)
+        except TimeoutError:
+            req.cancel()
+            raise

@@ -1,0 +1,260 @@
+"""Serving-path fault injection — the serve-side counterpart of
+operator/faults.py.
+
+The control plane grew a first-class FaultInjector because the emulated
+cluster makes failure cheap to rehearse; the serving path gets the same
+treatment here. Scenarios read like incident reports and drive the exact
+robustness machinery this layer ships: router outlier ejection + retries,
+engine deadline reaping, controller crash replacement and graceful drain.
+
+Two layers:
+
+- **Replica-level** (control plane): kill or wedge a predictor replica of a
+  live InferenceService mid-traffic — SIGKILL/SIGSTOP through the worker
+  runtime when processes exist, a phase flip in envtest mode.
+- **Backend-level** (in-process, no control plane needed): ``ChaosProxy``
+  wraps any backend URL and injects 5xx bursts, added latency, wedges
+  (accept, never answer) and hard connection drops — the Envoy-fault-filter
+  analog for router/server tests. ``kill_model_server`` is the in-process
+  SIGKILL analog for a ModelServer: the listener vanishes (new connections
+  refuse — the router sees connect failures and ejects) and the engine
+  scheduler halts where it stands, leaving in-flight requests to the
+  deadline/cancellation machinery — exactly the recovery path under test.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kubeflow_tpu.core.jobs import Worker, WorkerPhase
+
+logger = logging.getLogger("kubeflow_tpu.serve.faults")
+
+
+class ServeFaultInjector:
+    """Replica-level faults against an InferenceService's predictor pool."""
+
+    def __init__(self, cp):
+        self.cp = cp
+
+    def _replica(self, svc_key: str, index: int) -> Optional[Worker]:
+        from kubeflow_tpu.serve.isvc_controller import LABEL_ISVC, LABEL_REPLICA
+
+        namespace, name = svc_key.split("/", 1)
+        ws = self.cp.store.list(Worker, namespace=namespace,
+                                label_selector={LABEL_ISVC: name})
+        for w in sorted(ws, key=lambda w: w.metadata.name):
+            if int(w.metadata.labels.get(LABEL_REPLICA, -1)) == index \
+                    and w.status.phase not in (WorkerPhase.SUCCEEDED,
+                                               WorkerPhase.FAILED):
+                return w
+        return None
+
+    def kill_replica(self, svc_key: str, index: int = 0,
+                     sig: int = signal.SIGKILL) -> bool:
+        """SIGKILL a predictor replica mid-traffic (simulated preemption).
+        The crash replacement + router ejection that follow are the
+        behavior under test. Returns whether a live replica was found."""
+        w = self._replica(svc_key, index)
+        if w is None:
+            return False
+        if self.cp.runtime is None:
+            # envtest mode: no process — flip the Worker phase directly.
+            w.status.phase = WorkerPhase.FAILED
+            w.status.exit_code = 137  # SIGKILL convention
+            w.status.message = "serve fault injection"
+            self.cp.store.update_status(w)
+            return True
+        return self.cp.runtime.procman.signal(
+            f"{w.metadata.namespace}.{w.metadata.name}", sig)
+
+    def wedge_replica(self, svc_key: str, index: int = 0) -> bool:
+        """SIGSTOP a replica: alive but silent — the readiness probe (and
+        router deadline machinery) must handle it, not exit-code paths."""
+        w = self._replica(svc_key, index)
+        if w is None or self.cp.runtime is None:
+            return False
+        return self.cp.runtime.procman.signal(
+            f"{w.metadata.namespace}.{w.metadata.name}", signal.SIGSTOP)
+
+
+def kill_model_server(server) -> None:
+    """In-process SIGKILL analog for a ModelServer (tests/chaos harness).
+
+    After this call: the HTTP listener is gone (new connections are
+    refused, so the router records connect failures, retries elsewhere,
+    and ejects the backend) and the engine's scheduler loop halts without
+    any drain — in-flight requests are stranded exactly as a real process
+    kill strands them, and must be resolved by the caller-side timeout /
+    cancellation machinery, never by luck. The engine object itself stays
+    steppable: a recovery audit can drive ``engine.step()`` to let the
+    reaper release stranded slots/pages and prove refcount balance."""
+    try:
+        server.httpd.shutdown()
+        server.httpd.server_close()
+    except OSError:
+        pass
+    if server.engine is not None:
+        server.engine._stop.set()
+        server.engine._wake.set()
+    logger.info("killed model server %s (port %s)", server.name, server.port)
+
+
+class ChaosProxy:
+    """HTTP fault middleman: register ``proxy.url`` with the Router in
+    place of the real replica URL, then turn fault knobs mid-traffic.
+
+    Knobs (all safe to flip while serving):
+    - ``fail_next(n, code)``: answer the next ``n`` requests with ``code``
+      (5xx burst) without touching the target.
+    - ``latency``: seconds added before every forwarded request.
+    - ``wedge()`` / ``unwedge()``: accept connections but never answer
+      (SIGSTOP analog at the HTTP layer) — held requests are released,
+      with a closed connection, when unwedged or at ``stop()``.
+    - ``drop()`` / ``undrop()``: close every new connection before any
+      response byte — the router-visible shape of a dead process.
+    """
+
+    def __init__(self, target: str, host: str = "127.0.0.1", port: int = 0):
+        self.target = target.rstrip("/")
+        self.latency = 0.0
+        self.fail_code = 503
+        self._fail_remaining = 0
+        self._lock = threading.Lock()
+        self._wedged = threading.Event()
+        self._dropped = threading.Event()
+        self._release = threading.Event()   # set -> wedged requests exit
+        self.stats = {"forwarded": 0, "injected_5xx": 0, "dropped": 0,
+                      "wedged": 0}
+        from kubeflow_tpu.serve.router import quiet_handle_error
+
+        self.httpd = ThreadingHTTPServer((host, port), _chaos_handler(self))
+        self.httpd.daemon_threads = True
+        quiet_handle_error(self.httpd)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def fail_next(self, n: int, code: int = 503) -> None:
+        with self._lock:
+            self._fail_remaining = int(n)
+            self.fail_code = int(code)
+
+    def wedge(self) -> None:
+        self._release.clear()
+        self._wedged.set()
+
+    def unwedge(self) -> None:
+        self._wedged.clear()
+        self._release.set()
+
+    def drop(self) -> None:
+        self._dropped.set()
+
+    def undrop(self) -> None:
+        self._dropped.clear()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="chaos-proxy")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._release.set()      # free any wedged handler threads
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def _chaos_handler(proxy: ChaosProxy):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args) -> None:
+            pass
+
+        def _chaos(self) -> None:
+            if proxy._dropped.is_set():
+                # Zero response bytes: the caller sees a connection-level
+                # failure (the retry-safe class).
+                proxy.stats["dropped"] += 1
+                self.close_connection = True
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return
+            if proxy._wedged.is_set():
+                proxy.stats["wedged"] += 1
+                proxy._release.wait()        # hold until unwedged/stopped
+                self.close_connection = True
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return
+            if proxy.latency > 0:
+                time.sleep(proxy.latency)
+            with proxy._lock:
+                inject = proxy._fail_remaining > 0
+                if inject:
+                    proxy._fail_remaining -= 1
+                code = proxy.fail_code
+            if inject:
+                proxy.stats["injected_5xx"] += 1
+                data = json.dumps({"error": "chaos: injected fault"}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            # forward verbatim (headers that matter: content-type, deadline)
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n) if n else None
+            fwd_headers = {"Content-Type": self.headers.get(
+                "Content-Type", "application/json")}
+            for h in ("X-Kftpu-Deadline-Ms",):
+                if self.headers.get(h):
+                    fwd_headers[h] = self.headers[h]
+            req = urllib.request.Request(
+                proxy.target + self.path, data=body, method=self.command,
+                headers=fwd_headers)
+            try:
+                with urllib.request.urlopen(req, timeout=600) as resp:
+                    data = resp.read()
+                    status, ctype = resp.status, resp.headers.get(
+                        "Content-Type", "application/json")
+            except urllib.error.HTTPError as exc:
+                data = exc.read()
+                status, ctype = exc.code, "application/json"
+            except OSError:
+                self.close_connection = True
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return
+            proxy.stats["forwarded"] += 1
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        do_GET = _chaos
+        do_POST = _chaos
+
+    return Handler

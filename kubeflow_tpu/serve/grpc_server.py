@@ -21,6 +21,7 @@ from typing import Optional
 
 import grpc
 
+from kubeflow_tpu.serve.engine import EngineOverloaded
 from kubeflow_tpu.serve.protos import oip_pb2 as pb
 
 SERVICE = "inference.GRPCInferenceService"
@@ -130,6 +131,12 @@ class GRPCInferenceServer:
             context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
         except ValueError as exc:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        except EngineOverloaded as exc:
+            # Bounded-admission shed: the gRPC analog of HTTP 429.
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+        except TimeoutError as exc:
+            # Deadline reap / cancellation: the analog of HTTP 504.
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
         out_tensor = pb.ModelInferResponse.InferOutputTensor(
             name="text", datatype="BYTES", shape=[len(texts)])
         out_tensor.contents.bytes_contents.extend(texts)

@@ -23,6 +23,11 @@ predictor.go — SURVEY.md §2.3#25), TPU-native shape:
   old-generation replicas are torn down once the new generation is ready.
 - Crash recovery: failed replicas are replaced (fresh Worker object), not
   gang-restarted — serving replicas are independent, unlike SPMD training.
+- Graceful drain ((U) pod terminationGracePeriod + Envoy connection drain):
+  scale-down and rollout retirement first remove a replica from the router
+  rotation (no new traffic), then wait for its in-flight requests to finish
+  — up to ``PredictorSpec.drain_deadline_s`` — before deleting the worker.
+  Crashed or never-started replicas skip the drain and delete immediately.
 """
 
 from __future__ import annotations
@@ -94,6 +99,11 @@ class ISVCController:
         # service another cooldown of life on every flake.
         self._last_active: dict[str, float] = {}
         self._req_totals: dict[str, dict[str, int]] = {}
+        # Graceful drain state: service key -> {worker name -> hard drain
+        # deadline (monotonic)}. A draining replica took its last routed
+        # request the pass it entered here; it is deleted once idle or at
+        # the deadline, whichever comes first.
+        self._draining: dict[str, dict[str, float]] = {}
 
     # -- event routing ---------------------------------------------------------
 
@@ -121,6 +131,7 @@ class ISVCController:
             self._last_scale.pop(key, None)
             self._last_active.pop(key, None)
             self._req_totals.pop(key, None)
+            self._draining.pop(key, None)
             return None
 
         pred = isvc.spec.predictor
@@ -184,7 +195,7 @@ class ISVCController:
                 by[(gen, i)] = self._create_replica(isvc, i, gen)
         for (g, i) in sorted(by):
             if g == gen and i >= n_latest:
-                self._delete_worker(by.pop((g, i)))
+                self._retire_worker(key, router, by.pop((g, i)), isvc)
         pg = prev_gens[-1] if prev_gens else None
         if canary_active:
             # Converge the newest previous generation to its share. A
@@ -210,7 +221,8 @@ class ISVCController:
                             isvc, i, pg, clone_from=sibling)
                 for (g, i) in sorted(by):
                     if g == pg and i >= n_prev:
-                        self._delete_worker(by.pop((g, i)))
+                        self._retire_worker(key, router, by.pop((g, i)),
+                                            isvc)
 
         # Readiness probing, per generation.
         ready_by_gen: dict[int, list[str]] = {}
@@ -252,7 +264,7 @@ class ISVCController:
             # until then via prev_urls below).
             for (g, i) in sorted(by):
                 if g != gen and g != pg:
-                    self._delete_worker(by.pop((g, i)))
+                    self._retire_worker(key, router, by.pop((g, i)), isvc)
                     ready_by_gen.pop(g, None)
         if not canary_active:
             # Rolling update: drop old generations once the new one is ready
@@ -260,7 +272,8 @@ class ISVCController:
             if latest_ready or n_latest == 0:
                 for (g, i) in sorted(by):
                     if g != gen:
-                        self._delete_worker(by.pop((g, i)))
+                        self._retire_worker(key, router, by.pop((g, i)),
+                                            isvc)
                         ready_by_gen.pop(g, None)
 
         # Router backends + traffic split.
@@ -366,6 +379,45 @@ class ISVCController:
         return self.store.list(Worker, namespace=namespace,
                                label_selector={LABEL_ISVC: name})
 
+    @staticmethod
+    def _replica_url(w: Worker) -> str:
+        return f"http://127.0.0.1:{w.spec.template.config['port']}"
+
+    def _retire_worker(self, key: str, router: Router, w: Worker,
+                       isvc: Optional[InferenceService] = None) -> None:
+        """Graceful drain ((U) pod terminationGracePeriod + Envoy drain):
+        a RUNNING replica being scaled away stops receiving traffic this
+        same pass (its url leaves the router rotation AND is marked
+        draining), finishes its in-flight requests, and is deleted once
+        idle — or at the per-service drain deadline. Non-running replicas
+        (crashed, never started) delete immediately. Callers invoke this
+        every reconcile pass; the per-worker state machine converges."""
+        name = w.metadata.name
+        url = self._replica_url(w)
+        st = self._draining.setdefault(key, {})
+        if w.status.phase != WorkerPhase.RUNNING:
+            st.pop(name, None)
+            router.set_draining(url, False)
+            self._delete_worker(w)
+            return
+        now = time.monotonic()
+        if name not in st:
+            grace = 30.0
+            if isvc is not None:
+                grace = isvc.spec.predictor.drain_deadline_s
+            st[name] = now + max(0.0, grace)
+            router.set_draining(url, True)
+            if isvc is not None:
+                self.recorder.normal(
+                    isvc, "Draining",
+                    f"{name}: finishing in-flight requests "
+                    f"(hard deadline {grace:.0f}s)")
+        got = self.probe(url)
+        if got is None or got.get("in_flight", 0) <= 0 or now >= st[name]:
+            st.pop(name, None)
+            router.set_draining(url, False)
+            self._delete_worker(w)
+
     def _create_replica(self, isvc: InferenceService, index: int,
                         generation: int,
                         clone_from: Optional[Worker] = None) -> Worker:
@@ -449,3 +501,4 @@ class ISVCController:
         for router in self._routers.values():
             router.stop()
         self._routers.clear()
+        self._draining.clear()

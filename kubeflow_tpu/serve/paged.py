@@ -101,6 +101,22 @@ class PageAllocator:
         once at slot release."""
         return int((self._ref > 0).sum())
 
+    def leak_report(self) -> dict:
+        """Pages still referenced and their refcounts ({} when quiescent) —
+        the chaos suite's post-scenario audit payload."""
+        held = np.flatnonzero(self._ref > 0)
+        return {int(p): int(self._ref[p]) for p in held}
+
+    def assert_quiescent(self) -> None:
+        """Refcount-balance invariant for the chaos suite: once every
+        request has completed or been reaped, every alloc/incref must have
+        been balanced by exactly one free — no page may stay referenced."""
+        leaked = self.leak_report()
+        if leaked:
+            raise AssertionError(
+                f"KV page leak: {len(leaked)} page(s) still referenced "
+                f"(page -> ref): {dict(list(leaked.items())[:16])}")
+
     def alloc(self, n: int) -> list[int]:
         """n fresh pages (ref=1 each). Evicts cached pages LRU if needed."""
         if self.available() < n:

@@ -10,17 +10,66 @@ Scale-to-zero: with no ready backends a request does NOT 503 — it parks on a
 condition variable and the ``pending`` gauge rises; the ISVC controller
 reads that gauge as the activation signal, spawns a replica, and the next
 ``set_backends`` wakes every parked request (0→1 cold start). 503 only after
-``queue_timeout``."""
+``queue_timeout``.
+
+Request-lifecycle hardening (Envoy-analog, TPU-native):
+
+- **Deadline-aware timeouts.** The client's remaining budget rides in the
+  ``X-Kftpu-Deadline-Ms`` header (default: ``upstream_timeout``); it bounds
+  every upstream socket wait — replacing the old hard-coded 600 s — and the
+  remaining budget is re-stamped onto the forwarded request so the backend
+  engine can reap the request when the client is already gone.
+- **Connect-failure retries.** A backend that refuses the connection (zero
+  response bytes, nothing reached a model OR the client) is retried on a
+  different backend up to ``max_retries`` times.
+- **Outlier ejection.** ``eject_threshold`` consecutive failures (connect
+  failures or 5xx responses) eject a backend for ``eject_period`` seconds;
+  after the window expires the next pick half-opens it — ONE probe request
+  (picking re-arms the window so concurrent traffic keeps avoiding it) and
+  a success fully reinstates it. If every backend is ejected the router
+  panic-routes to the least-recently-ejected one: a suspect backend beats
+  queueing into a guaranteed timeout.
+- **Draining.** ``set_draining(url)`` removes a backend from selection
+  without touching its in-flight requests — the graceful scale-down path
+  the ISVC controller drives.
+"""
 
 from __future__ import annotations
 
 import itertools
 import random
+import sys
 import threading
 import time
+import traceback
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+
+def quiet_handle_error(httpd) -> None:
+    """Replace socketserver's print-a-traceback error hook on ``httpd``:
+    connection breakage (a client hanging up mid-response) is ROUTINE under
+    load shedding and chaos testing, not a bug worth a stderr traceback.
+    Anything else still prints."""
+
+    def handle_error(request, client_address):
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            return
+        traceback.print_exc()
+
+    httpd.handle_error = handle_error
+
+#: Remaining client budget in milliseconds; stamped/decremented hop by hop
+#: (client → router → replica) so every layer — proxy socket timeouts, the
+#: model server's result wait, the engine scheduler's reaper — enforces the
+#: SAME deadline instead of each inventing its own.
+DEADLINE_HEADER = "X-Kftpu-Deadline-Ms"
+
+#: Local (non-proxied) router endpoints.
+ROUTER_METRICS_PATH = "/-/router/metrics"
 
 
 class Router:
@@ -31,7 +80,11 @@ class Router:
     pick a group by weight, then round-robin inside it."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 queue_timeout: float = 120.0):
+                 queue_timeout: float = 120.0, *,
+                 upstream_timeout: float = 600.0,
+                 eject_threshold: int = 3,
+                 eject_period: float = 5.0,
+                 max_retries: int = 2):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._groups: dict[str, list[str]] = {}    # group -> base urls
@@ -41,8 +94,21 @@ class Router:
         self._last_activity = 0.0   # monotonic; stamped per request
         self._closed = False
         self.queue_timeout = queue_timeout
+        self.upstream_timeout = upstream_timeout
+        self.eject_threshold = max(1, int(eject_threshold))
+        self.eject_period = eject_period
+        self.max_retries = max(0, int(max_retries))
+        # outlier-ejection state (all under self._lock)
+        self._fails: dict[str, int] = {}           # consecutive failures
+        self._ejected_until: dict[str, float] = {}
+        self._draining: set[str] = set()
+        self.stats = {"picks": 0, "retries": 0, "connect_failures": 0,
+                      "http_5xx": 0, "ejections": 0, "half_open_probes": 0,
+                      "panic_picks": 0, "queue_timeouts": 0,
+                      "deadline_exhausted": 0}
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.httpd.daemon_threads = True
+        quiet_handle_error(self.httpd)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -55,8 +121,56 @@ class Router:
             else:
                 self._weights = {g: 100 // max(len(self._groups), 1)
                                  for g in self._groups}
+            # Prune per-backend state for urls that left the rotation —
+            # ports get reused, and a recycled port must not inherit its
+            # predecessor's failure history.
+            live = {u for urls in self._groups.values() for u in urls}
+            for d in (self._fails, self._ejected_until):
+                for u in [u for u in d if u not in live]:
+                    d.pop(u)
+            self._draining &= live
             if self._groups:
                 self._cond.notify_all()   # wake cold-start queued requests
+
+    # -- outlier ejection / draining ----------------------------------------
+
+    def note_backend_failure(self, url: str, *, connect: bool = False) -> None:
+        """One failed request against ``url`` (connect failure or 5xx).
+        ``eject_threshold`` consecutive failures eject it for
+        ``eject_period`` seconds."""
+        with self._lock:
+            self._fails[url] = self._fails.get(url, 0) + 1
+            self.stats["connect_failures" if connect else "http_5xx"] += 1
+            if self._fails[url] >= self.eject_threshold:
+                self._ejected_until[url] = time.monotonic() + self.eject_period
+                self.stats["ejections"] += 1
+
+    def note_backend_success(self, url: str) -> None:
+        with self._lock:
+            self._fails.pop(url, None)
+            self._ejected_until.pop(url, None)
+
+    def set_draining(self, url: str, draining: bool = True) -> None:
+        """Mark a backend draining: new requests never pick it; in-flight
+        requests (already connected) finish undisturbed."""
+        with self._lock:
+            if draining:
+                self._draining.add(url)
+            else:
+                self._draining.discard(url)
+
+    def count(self, stat: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[stat] = self.stats.get(stat, 0) + n
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return dict(self.stats, pending=self._pending,
+                        backends=sum(len(u) for u in self._groups.values()),
+                        ejected=sum(1 for t in self._ejected_until.values()
+                                    if t > now),
+                        draining=len(self._draining))
 
     @property
     def pending(self) -> int:
@@ -78,10 +192,34 @@ class Router:
         with self._lock:
             self._last_activity = time.monotonic()
 
-    def _pick_locked(self) -> Optional[str]:
-        groups = [(g, self._weights.get(g, 0)) for g in self._groups]
-        if not groups:
-            return None
+    # -- backend selection ---------------------------------------------------
+
+    def _eligible_locked(self, exclude: frozenset,
+                         now: float) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {}
+        for g, urls in self._groups.items():
+            ok = [u for u in urls
+                  if u not in exclude and u not in self._draining
+                  and self._ejected_until.get(u, 0.0) <= now]
+            if ok:
+                out[g] = ok
+        return out
+
+    def _pick_locked(self, exclude: frozenset = frozenset()) -> Optional[str]:
+        now = time.monotonic()
+        eligible = self._eligible_locked(exclude, now)
+        if not eligible:
+            # Panic routing (Envoy panic-threshold analog): every backend is
+            # ejected — try the least-recently-ejected suspect rather than
+            # park the request into a guaranteed queue timeout.
+            suspects = [u for urls in self._groups.values() for u in urls
+                        if u not in exclude and u not in self._draining]
+            if not suspects:
+                return None
+            self.stats["panic_picks"] += 1
+            return min(suspects,
+                       key=lambda u: self._ejected_until.get(u, 0.0))
+        groups = [(g, self._weights.get(g, 0)) for g in eligible]
         total = sum(w for _, w in groups) or len(groups)
         r = random.uniform(0, total)
         acc = 0.0
@@ -91,21 +229,30 @@ class Router:
             if r <= acc:
                 chosen = g
                 break
-        urls = self._groups[chosen]
-        return urls[next(self._rr) % len(urls)]
+        urls = eligible[chosen]
+        url = urls[next(self._rr) % len(urls)]
+        if url in self._ejected_until:
+            # Expired ejection window: this pick IS the half-open probe.
+            # Re-arm the window so concurrent traffic keeps avoiding the
+            # backend until the probe's verdict (success clears the state,
+            # failure re-ejects).
+            self._ejected_until[url] = now + self.eject_period
+            self.stats["half_open_probes"] += 1
+        return url
 
-    def pick(self) -> Optional[str]:
+    def pick(self, exclude: frozenset = frozenset()) -> Optional[str]:
         with self._lock:
-            return self._pick_locked()
+            return self._pick_locked(exclude)
 
-    def pick_or_wait(self, timeout: Optional[float] = None) -> Optional[str]:
+    def pick_or_wait(self, timeout: Optional[float] = None,
+                     exclude: frozenset = frozenset()) -> Optional[str]:
         """Pick a backend, queueing until one registers (scale-from-zero
         path). Returns None only after ``timeout`` (default: the router's
         queue_timeout) with still no backend."""
         deadline = time.monotonic() + (
             timeout if timeout is not None else self.queue_timeout)
         with self._cond:
-            backend = self._pick_locked()
+            backend = self._pick_locked(exclude)
             if backend is not None:
                 return backend
             self._pending += 1
@@ -115,7 +262,7 @@ class Router:
                     if remaining <= 0:
                         return None
                     self._cond.wait(remaining)
-                    backend = self._pick_locked()
+                    backend = self._pick_locked(exclude)
                     if backend is not None:
                         return backend
                 return None   # router torn down: fail fast, don't hold 120s
@@ -148,7 +295,30 @@ def _make_handler(router: Router):
         def log_message(self, *args) -> None:
             pass
 
+        def _send(self, code: int, data: bytes,
+                  ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _error(self, code: int, message: str) -> None:
+            self._send(code, f'{{"error": "{message}"}}'.encode())
+
+        def _router_metrics(self) -> None:
+            snap = router.snapshot()
+            lines = ["# TYPE kftpu_router gauge"]
+            lines += [f"kftpu_router_{k} {v}" for k, v in sorted(snap.items())]
+            self._send(200, ("\n".join(lines) + "\n").encode(),
+                       ctype="text/plain")
+
         def _proxy(self) -> None:
+            if self.path == ROUTER_METRICS_PATH:
+                # Observability scrape, not traffic: must not feed the
+                # KPA-analog activity clock (a 1 s scrape loop would pin
+                # the service out of scale-to-zero forever).
+                return self._router_metrics()
             router.note_activity()
             try:
                 self._proxy_inner()
@@ -159,57 +329,132 @@ def _make_handler(router: Router):
                 # gets culled the moment in_flight drops back to zero.
                 router.note_activity()
 
+        def _budget_s(self) -> float:
+            """Remaining client budget (seconds): deadline header if the
+            client sent one, capped by the router's upstream timeout."""
+            budget = router.upstream_timeout
+            hdr = self.headers.get(DEADLINE_HEADER)
+            if hdr:
+                try:
+                    budget = min(budget, max(float(hdr) / 1e3, 0.0))
+                except ValueError:
+                    pass
+            return budget
+
         def _proxy_inner(self) -> None:
-            backend = router.pick_or_wait()
-            if backend is None:
-                data = b'{"error": "no ready backends (queue timeout)"}'
-                self.send_response(503)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-                return
+            deadline = time.monotonic() + self._budget_s()
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n) if n else None
-            req = urllib.request.Request(
-                backend + self.path, data=body, method=self.command,
-                headers={"Content-Type":
-                         self.headers.get("Content-Type", "application/json")})
-            try:
-                with urllib.request.urlopen(req, timeout=600) as resp:
-                    self.send_response(resp.status)
-                    ctype = resp.headers.get("Content-Type", "application/json")
-                    self.send_header("Content-Type", ctype)
-                    if "event-stream" in ctype:
-                        self.send_header("Transfer-Encoding", "chunked")
-                        self.end_headers()
-                        while True:
-                            piece = resp.read(512)
-                            if not piece:
-                                break
-                            self.wfile.write(f"{len(piece):x}\r\n".encode()
-                                             + piece + b"\r\n")
-                            self.wfile.flush()
-                        self.wfile.write(b"0\r\n\r\n")
+            tried: set[str] = set()
+            first_attempt = True
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    router.count("deadline_exhausted")
+                    return self._error(504, "deadline exhausted in router")
+                if first_attempt:
+                    # Only the first pick parks (scale-from-zero): a retry
+                    # already had a live-looking rotation moments ago, so a
+                    # blocking wait would just burn the client's budget.
+                    backend = router.pick_or_wait(
+                        timeout=min(remaining, router.queue_timeout),
+                        exclude=frozenset(tried))
+                else:
+                    backend = router.pick(exclude=frozenset(tried))
+                if backend is None:
+                    if tried:
+                        # Retried through the whole rotation: every backend
+                        # refused the connection — a backend-side outage,
+                        # not a routing/queue condition.
+                        return self._error(
+                            502, "backend unreachable: all backends failed")
+                    router.count("queue_timeouts")
+                    return self._error(
+                        503, "no ready backends (queue timeout)")
+                router.count("picks")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    router.count("deadline_exhausted")
+                    return self._error(504, "deadline exhausted in router")
+                req = urllib.request.Request(
+                    backend + self.path, data=body, method=self.command,
+                    headers={
+                        "Content-Type": self.headers.get(
+                            "Content-Type", "application/json"),
+                        # Forward the REMAINING budget: the replica stamps
+                        # the engine-side request deadline from it.
+                        DEADLINE_HEADER: str(int(remaining * 1e3)),
+                    })
+                try:
+                    resp = urllib.request.urlopen(req, timeout=remaining)
+                except urllib.error.HTTPError as exc:
+                    # A response arrived: forward it verbatim. 5xx counts
+                    # toward outlier ejection (the Envoy consecutive-5xx
+                    # rule) but is NOT retried — the backend consumed the
+                    # request, and generation is not idempotent.
+                    if exc.code >= 500:
+                        router.note_backend_failure(backend)
                     else:
-                        data = resp.read()
-                        self.send_header("Content-Length", str(len(data)))
-                        self.end_headers()
-                        self.wfile.write(data)
-            except urllib.error.HTTPError as exc:
-                data = exc.read()
-                self.send_response(exc.code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-            except OSError as exc:
-                data = f'{{"error": "backend unreachable: {exc}"}}'.encode()
-                self.send_response(502)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                        router.note_backend_success(backend)
+                    data = exc.read()
+                    self._send(exc.code, data, ctype=exc.headers.get(
+                        "Content-Type", "application/json"))
+                    return
+                except OSError as exc:
+                    # Connection-level failure before any response byte:
+                    # nothing reached a model, nothing reached the client —
+                    # the ONE case where a retry on a different backend is
+                    # unconditionally safe.
+                    router.note_backend_failure(backend, connect=True)
+                    tried.add(backend)
+                    first_attempt = False
+                    if len(tried) <= router.max_retries:
+                        router.count("retries")
+                        continue
+                    return self._error(502, f"backend unreachable: {exc}")
+                def read_upstream(*args):
+                    # Mid-response read failures are the BACKEND's fault
+                    # (it died streaming) — distinct from a client hang-up
+                    # on the write side, which must not eject a healthy
+                    # backend.
+                    try:
+                        return resp.read(*args)
+                    except OSError:
+                        router.note_backend_failure(backend)
+                        raise
+
+                try:
+                    with resp:
+                        self.send_response(resp.status)
+                        ctype = resp.headers.get("Content-Type",
+                                                 "application/json")
+                        self.send_header("Content-Type", ctype)
+                        if "event-stream" in ctype:
+                            self.send_header("Transfer-Encoding", "chunked")
+                            self.end_headers()
+                            while True:
+                                piece = read_upstream(512)
+                                if not piece:
+                                    break
+                                self.wfile.write(
+                                    f"{len(piece):x}\r\n".encode()
+                                    + piece + b"\r\n")
+                                self.wfile.flush()
+                            self.wfile.write(b"0\r\n\r\n")
+                        else:
+                            data = read_upstream()
+                            self.send_header("Content-Length",
+                                             str(len(data)))
+                            self.end_headers()
+                            self.wfile.write(data)
+                except OSError:
+                    # Response bytes may already be on the wire, so no
+                    # retry — close the connection, which is the explicit
+                    # error a streaming client can detect.
+                    self.close_connection = True
+                    return
+                router.note_backend_success(backend)
+                return
 
         do_GET = _proxy
         do_POST = _proxy

@@ -29,14 +29,35 @@ in-flight request — fine at platform scale, and zero dependencies.
 from __future__ import annotations
 
 import json
+import queue
 import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from kubeflow_tpu.serve.engine import LLMEngine, Request, SamplingParams
+from kubeflow_tpu.platform.metrics import render_histogram
+from kubeflow_tpu.serve.engine import (
+    EngineOverloaded, LLMEngine, Request, SamplingParams,
+)
+from kubeflow_tpu.serve.router import DEADLINE_HEADER, quiet_handle_error
 from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
+
+
+def _raise_for_reaped(req: Request) -> None:
+    """Map an engine-side terminal failure to the exception the protocol
+    layer translates into an explicit HTTP status (504/429/500). A request
+    the scheduler reaped returns normally from ``result()`` — with a
+    failure ``finish_reason`` and possibly zero output tokens — and MUST
+    NOT be served as a successful (empty) completion."""
+    if req.finish_reason in ("deadline", "cancelled"):
+        raise TimeoutError(
+            f"request {req.id} {req.finish_reason} before completion")
+    if req.finish_reason == "shed":
+        raise EngineOverloaded(
+            f"request {req.id} shed: queue delay exceeded budget")
+    if req.finish_reason == "error":
+        raise RuntimeError(f"request {req.id} failed in-engine")
 
 _V1_PREDICT = re.compile(r"^/v1/models/([^/:]+):predict$")
 _V1_EXPLAIN = re.compile(r"^/v1/models/([^/:]+):explain$")
@@ -71,6 +92,7 @@ class ModelServer:
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
+        quiet_handle_error(self.httpd)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
         # v2 protocol over gRPC as well as REST (grpc_port=0 → ephemeral).
@@ -190,17 +212,44 @@ class ModelServer:
             out["predicted_text"] = tokenizer.decode([out["target_token"]])
         return out
 
+    def request_timeout(self, body: dict,
+                        deadline_s: Optional[float] = None) -> float:
+        """Effective per-request budget: the body ``timeout`` capped by the
+        remaining client budget from the router's deadline header."""
+        timeout = float(body.get("timeout", 300))
+        if deadline_s is not None:
+            timeout = min(timeout, max(deadline_s, 0.0))
+        return timeout
+
     def generate_text(self, prompt: str, body: dict, model: Optional[str],
-                      strict: bool = False) -> tuple[str, "Request"]:
+                      strict: bool = False,
+                      deadline_s: Optional[float] = None
+                      ) -> tuple[str, "Request"]:
         """Pre-hop → tokenize → engine → detokenize → post-hop: the one
         generation path every protocol surface (REST v1/v2, OpenAI, gRPC)
-        shares."""
+        shares.
+
+        Lifecycle: the engine-side request carries a deadline equal to the
+        client budget (``deadline_s`` from the router header, capped by the
+        body timeout), so the scheduler reaps it — freeing its slot and KV
+        pages — the moment the client can no longer use the answer. The
+        result wait gets one extra second past that deadline so the normal
+        path is the engine's explicit reap; the TimeoutError fallback (a
+        wedged scheduler) cancels the orphan so a recovering engine drops
+        it instead of decoding dead work."""
         if self.transformer is not None:
             prompt = self.transformer(prompt, "pre")
+        timeout = self.request_timeout(body, deadline_s)
         with self.lease(model, strict=strict) as (engine, tokenizer, _):
             toks = tokenizer.encode(prompt)
-            req = engine.submit(toks, self.sampling_from(body, tokenizer))
-            out = req.result(timeout=float(body.get("timeout", 300)))
+            req = engine.submit(toks, self.sampling_from(body, tokenizer),
+                                deadline=time.monotonic() + timeout)
+            try:
+                out = req.result(timeout=timeout + 1.0)
+            except TimeoutError:
+                req.cancel()
+                raise
+            _raise_for_reaped(req)
             text = tokenizer.decode([t for t in out if t != tokenizer.eos_id])
         if self.transformer is not None:
             text = self.transformer(text, "post")
@@ -245,6 +294,8 @@ class ModelServer:
                 entry = self.repository.peek(item["name"])
                 if entry is not None and entry.engine is not None:
                     engines.append((entry.name, entry.engine))
+        lines.append("# TYPE kftpu_serving_queue_depth gauge")
+        lines.append("# TYPE kftpu_serving_requests_shed_total counter")
         for name, engine in engines:
             snap = engine.metrics.snapshot()
             lab = f'{{model="{name}"}}'
@@ -258,6 +309,20 @@ class ModelServer:
                       "spec_draft_overhead"):
                 if k in snap:
                     lines.append(f"kftpu_serving_{k}{lab} {snap[k]}")
+            # Load-shedding / lifecycle surface: queue depth, shed and reap
+            # counters, and the queue-delay histogram — the dashboards that
+            # show an overload knee BEFORE clients start timing out.
+            lines.append(f"kftpu_serving_queue_depth{lab} "
+                         f"{engine.queue_depth()}")
+            for k, metric in (("requests_shed", "requests_shed_total"),
+                              ("requests_cancelled",
+                               "requests_cancelled_total"),
+                              ("requests_expired", "requests_expired_total")):
+                lines.append(f"kftpu_serving_{metric}{lab} {snap[k]}")
+            buckets, counts, qsum, qn = engine.metrics.queue_delay_histogram()
+            lines.extend(render_histogram(
+                "kftpu_serving_queue_delay_seconds", buckets, counts, qsum,
+                qn, {"model": name}))
         return "\n".join(lines) + "\n"
 
 
@@ -270,13 +335,27 @@ def _make_handler(server: ModelServer):
 
         # -- helpers ----------------------------------------------------------
 
-        def _json(self, code: int, obj: Any) -> None:
+        def _json(self, code: int, obj: Any,
+                  headers: Optional[dict] = None) -> None:
             data = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
+
+        def _deadline_s(self) -> Optional[float]:
+            """Remaining client budget (seconds) from the router's deadline
+            header; None when the request carries no deadline."""
+            hdr = self.headers.get(DEADLINE_HEADER)
+            if not hdr:
+                return None
+            try:
+                return max(float(hdr) / 1e3, 0.0)
+            except ValueError:
+                return None
 
         def _text(self, code: int, text: str, ctype="text/plain") -> None:
             data = text.encode()
@@ -358,6 +437,13 @@ def _make_handler(server: ModelServer):
                 self._json(404, {"error": str(exc)})
             except ValueError as exc:
                 self._json(400, {"error": str(exc)})
+            except EngineOverloaded as exc:
+                # Bounded admission: shed fast with an explicit retry hint
+                # instead of queueing the client into a timeout.
+                self._json(429, {"error": str(exc)}, headers={
+                    "Retry-After": str(max(1, int(exc.retry_after)))})
+            except TimeoutError as exc:
+                self._json(504, {"error": str(exc)})
             except Exception as exc:   # surface, don't hide
                 self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
             finally:
@@ -376,7 +462,8 @@ def _make_handler(server: ModelServer):
         def _generate_text(self, prompt: str, body: dict,
                            model: Optional[str],
                            strict: bool = False) -> tuple[str, Request]:
-            return server.generate_text(prompt, body, model, strict=strict)
+            return server.generate_text(prompt, body, model, strict=strict,
+                                        deadline_s=self._deadline_s())
 
         def _v1_predict(self, body: dict, model: str) -> None:
             instances = body.get("instances")
@@ -448,10 +535,12 @@ def _make_handler(server: ModelServer):
             # transformer limitation, matching kserve's non-streaming scope.
             if server.transformer is not None:
                 prompt = server.transformer(prompt, "pre")
+            timeout = server.request_timeout(body, self._deadline_s())
             with server.lease(model) as (engine, tokenizer, _):
                 toks = tokenizer.encode(prompt)
                 req = engine.submit(toks,
-                                    server.sampling_from(body, tokenizer))
+                                    server.sampling_from(body, tokenizer),
+                                    deadline=time.monotonic() + timeout)
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
@@ -464,22 +553,38 @@ def _make_handler(server: ModelServer):
                                      + payload + b"\r\n")
                     self.wfile.flush()
 
-                while True:
-                    tok = req.stream.get(
-                        timeout=float(body.get("timeout", 300)))
-                    if tok is None:
-                        break
-                    if tok == tokenizer.eos_id:
-                        continue
-                    piece = tokenizer.decode([tok])
-                    if chat:
-                        delta = {"choices": [{"index": 0,
-                                              "delta": {"content": piece}}]}
-                    else:
-                        delta = {"choices": [{"index": 0, "text": piece}]}
-                    chunk(json.dumps({"id": req.id, "object": "chunk",
-                                      "model": model or server.name,
-                                      **delta}))
+                try:
+                    while True:
+                        try:
+                            tok = req.stream.get(timeout=timeout + 1.0)
+                        except queue.Empty:
+                            # Engine never finished within the deadline
+                            # (its own reaper should have; this is the
+                            # wedged-scheduler fallback): cancel so a
+                            # recovering engine drops the orphan.
+                            req.cancel()
+                            break
+                        if tok is None:
+                            break
+                        if tok == tokenizer.eos_id:
+                            continue
+                        piece = tokenizer.decode([tok])
+                        if chat:
+                            delta = {"choices": [
+                                {"index": 0, "delta": {"content": piece}}]}
+                        else:
+                            delta = {"choices": [{"index": 0,
+                                                  "text": piece}]}
+                        chunk(json.dumps({"id": req.id, "object": "chunk",
+                                          "model": model or server.name,
+                                          **delta}))
+                except OSError:
+                    # Client hung up mid-stream: free the slot and its KV
+                    # pages now instead of decoding to completion for a
+                    # reader that is gone.
+                    req.cancel()
+                    self.close_connection = True
+                    return
                 chunk("[DONE]")
                 self.wfile.write(b"0\r\n\r\n")
 

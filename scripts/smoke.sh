@@ -30,5 +30,15 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 bench_rc=${PIPESTATUS[0]}
 grep -q "serve_spec_speedup" /tmp/_smoke_bench.json || bench_rc=1
 
-echo "== smoke: tests rc=$rc bench rc=$bench_rc =="
-[ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ]
+echo "== chaos smoke (replica SIGKILL mid-run through the router) =="
+# Serving-path robustness gate: one replica is killed mid-bench; the run
+# must finish with zero hung requests, every request resolved explicitly,
+# a recovered router, and zero paged-KV page leaks on both engines.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/chaos_smoke.py --requests 24 --concurrency 4 \
+  | tee /tmp/_smoke_chaos.json
+chaos_rc=${PIPESTATUS[0]}
+grep -q '"chaos_smoke": "ok"' /tmp/_smoke_chaos.json || chaos_rc=1
+
+echo "== smoke: tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc =="
+[ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ]

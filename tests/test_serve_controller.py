@@ -35,13 +35,14 @@ def cp(tmp_path):
     plane.isvc_reconciler.shutdown()
 
 
-def mkisvc(name="svc", min_replicas=1, max_replicas=1, scale_target=4):
+def mkisvc(name="svc", min_replicas=1, max_replicas=1, scale_target=4,
+           drain_deadline_s=30.0):
     return InferenceService(
         metadata=ObjectMeta(name=name),
         spec=InferenceServiceSpec(predictor=PredictorSpec(
             model=ModelSpec(config={"preset": "tiny"}),
             min_replicas=min_replicas, max_replicas=max_replicas,
-            scale_target=scale_target)))
+            scale_target=scale_target, drain_deadline_s=drain_deadline_s)))
 
 
 def replicas(cp, name="svc"):
@@ -413,6 +414,66 @@ def test_scale_to_zero_suspends_canary_generations(cp):
     recon()                                  # converge: everything gone
     recon()
     assert replicas(cp) == []
+
+
+# -- graceful drain (scale-down/rollout retire path) --------------------------
+
+def _force_two_replicas(cp, recon, **mk_kw):
+    cp.submit(mkisvc(min_replicas=1, max_replicas=2, **mk_kw))
+    recon()
+    mark_running(cp, replicas(cp))
+    isvc = get_isvc(cp)
+    isvc.status.desired_replicas = 2
+    cp.store.update_status(isvc)
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    assert get_isvc(cp).status.ready_replicas == 2
+
+
+def test_scale_down_drains_busy_replica_before_delete(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    _force_two_replicas(cp, recon)
+    ws = replicas(cp)
+    url1 = f"http://127.0.0.1:{ws[1].spec.template.config['port']}"
+    cp.probe.load[url1] = 3        # replica 1 has requests in flight
+    isvc = get_isvc(cp)
+    isvc.status.desired_replicas = 1
+    cp.store.update_status(isvc)
+    recon()
+    # Still two workers: the trimmed replica is draining, not deleted.
+    assert len(replicas(cp)) == 2
+    events = [e.reason for e in cp.recorder.for_object(get_isvc(cp))]
+    assert "Draining" in events
+    recon()                        # still busy -> still draining
+    assert len(replicas(cp)) == 2
+    cp.probe.load[url1] = 0        # in-flight work finished
+    recon()
+    assert len(replicas(cp)) == 1, "idle draining replica must be deleted"
+
+
+def test_drain_hard_deadline_forces_delete(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    _force_two_replicas(cp, recon, drain_deadline_s=0.0)
+    ws = replicas(cp)
+    url1 = f"http://127.0.0.1:{ws[1].spec.template.config['port']}"
+    cp.probe.load[url1] = 5        # busy forever
+    isvc = get_isvc(cp)
+    isvc.status.desired_replicas = 1
+    cp.store.update_status(isvc)
+    recon()
+    # Deadline 0: the drain window is already over — delete despite load.
+    assert len(replicas(cp)) == 1
+
+
+def test_idle_replica_scale_down_deletes_immediately(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    _force_two_replicas(cp, recon)
+    isvc = get_isvc(cp)
+    isvc.status.desired_replicas = 1
+    cp.store.update_status(isvc)
+    recon()                        # probe reports idle -> no drain wait
+    assert len(replicas(cp)) == 1
 
 
 def test_router_stop_releases_parked_requests(cp):

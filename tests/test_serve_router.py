@@ -1,0 +1,298 @@
+"""Router hardening: connect-failure retries, outlier ejection + half-open
+re-probe, draining, deadline-aware upstream timeouts, and concurrent
+set_backends() swaps (ISSUE 2). Pure-HTTP tests — no JAX, no engine: fake
+backends answer with their own name so routing decisions are observable."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_tpu.serve.faults import ChaosProxy
+from kubeflow_tpu.serve.router import DEADLINE_HEADER, Router
+
+
+class EchoBackend:
+    """Answers every request with {"backend": <name>}."""
+
+    def __init__(self, name: str):
+        self.name = name
+        backend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _do(self):
+                n = int(self.headers.get("Content-Length", 0))
+                if n:
+                    self.rfile.read(n)
+                data = json.dumps({"backend": backend.name}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = _do
+            do_POST = _do
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def dead_url() -> str:
+    """A url that refuses connections (bound once, then closed)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def ask(router_url: str, timeout: float = 10.0,
+        deadline_ms: int = 0) -> tuple[int, dict]:
+    headers = {"Content-Type": "application/json"}
+    if deadline_ms:
+        headers[DEADLINE_HEADER] = str(deadline_ms)
+    req = urllib.request.Request(router_url + "/v1/echo", data=b"{}",
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as exc:
+        body = exc.read()
+        return exc.code, json.loads(body) if body else {}
+
+
+@pytest.fixture()
+def backends():
+    a, b = EchoBackend("a"), EchoBackend("b")
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+@pytest.fixture()
+def router():
+    r = Router(queue_timeout=5.0, eject_threshold=2, eject_period=0.4,
+               max_retries=2, upstream_timeout=30.0)
+    r.start()
+    yield r
+    r.stop()
+
+
+def test_retry_on_connect_failure_uses_other_backend(router, backends):
+    """Satellite: a refused connection must not become a client-visible 502
+    while ready backends exist — retry the pick excluding the failure."""
+    a, _ = backends
+    router.set_backends({"latest": [dead_url(), a.url]})
+    for _ in range(6):
+        status, body = ask(router.url)
+        assert status == 200 and body["backend"] == "a"
+    snap = router.snapshot()
+    assert snap["retries"] >= 1
+    assert snap["connect_failures"] >= 1
+
+
+def test_all_backends_dead_is_explicit_502(router):
+    router.set_backends({"latest": [dead_url(), dead_url()]})
+    status, body = ask(router.url)
+    assert status == 502
+    assert "unreachable" in body["error"]
+
+
+def test_outlier_ejection_and_half_open_recovery(router, backends):
+    a, b = backends
+    proxy = ChaosProxy(a.url)
+    proxy.start()
+    try:
+        router.set_backends({"latest": [proxy.url, b.url]})
+        proxy.drop()                      # a's proxy now refuses everything
+        for _ in range(6):
+            status, body = ask(router.url)
+            assert status == 200 and body["backend"] == "b"
+        assert router.snapshot()["ejections"] >= 1
+        # While ejected, the proxy is never even dialed.
+        dropped_before = proxy.stats["dropped"]
+        for _ in range(4):
+            status, _ = ask(router.url)
+            assert status == 200
+        assert proxy.stats["dropped"] == dropped_before, \
+            "ejected backend still being dialed"
+        # Backend recovers; after the ejection window a half-open probe
+        # reinstates it.
+        proxy.undrop()
+        time.sleep(0.5)
+        for _ in range(8):
+            status, _ = ask(router.url)
+            assert status == 200
+        assert proxy.stats["forwarded"] > 0, "recovered backend never probed"
+        assert router.snapshot()["half_open_probes"] >= 1
+    finally:
+        proxy.stop()
+
+
+def test_draining_backend_stops_receiving_picks(router, backends):
+    a, b = backends
+    router.set_backends({"latest": [a.url, b.url]})
+    router.set_draining(a.url)
+    for _ in range(6):
+        status, body = ask(router.url)
+        assert status == 200 and body["backend"] == "b"
+    router.set_draining(a.url, False)
+    seen = {ask(router.url)[1]["backend"] for _ in range(8)}
+    assert seen == {"a", "b"}
+
+
+def test_deadline_header_bounds_wedged_upstream(router, backends):
+    """The hard-coded 600 s upstream timeout is gone: a wedged backend
+    costs at most the client's remaining budget."""
+    a, _ = backends
+    proxy = ChaosProxy(a.url)
+    proxy.start()
+    try:
+        router.set_backends({"latest": [proxy.url]})
+        proxy.wedge()
+        t0 = time.monotonic()
+        status, body = ask(router.url, timeout=15.0, deadline_ms=400)
+        elapsed = time.monotonic() - t0
+        assert status in (502, 504), body
+        assert elapsed < 5.0, f"wedged backend held the request {elapsed:.1f}s"
+    finally:
+        proxy.stop()
+
+
+def test_router_upstream_timeout_replaces_hardcoded_600s(backends):
+    a, _ = backends
+    r = Router(queue_timeout=2.0, upstream_timeout=0.4, max_retries=1)
+    r.start()
+    proxy = ChaosProxy(a.url)
+    proxy.start()
+    try:
+        r.set_backends({"latest": [proxy.url]})
+        proxy.wedge()
+        t0 = time.monotonic()
+        status, _ = ask(r.url, timeout=15.0)    # no deadline header
+        assert status in (502, 504)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        proxy.stop()
+        r.stop()
+
+
+def test_5xx_is_forwarded_not_retried_but_counts_toward_ejection(
+        router, backends):
+    a, b = backends
+    proxy = ChaosProxy(a.url)
+    proxy.start()
+    try:
+        router.set_backends({"latest": [proxy.url]})
+        proxy.fail_next(2, code=503)
+        codes = [ask(router.url)[0] for _ in range(2)]
+        assert codes == [503, 503], "5xx must reach the client verbatim"
+        assert router.snapshot()["ejections"] >= 1
+        # Post-burst: backend healthy again; half-open probe restores it.
+        time.sleep(0.5)
+        status, _ = ask(router.url)
+        assert status == 200
+    finally:
+        proxy.stop()
+
+
+def test_concurrent_set_backends_swaps_with_requests_in_flight(
+        router, backends):
+    """Satellite: requests racing set_backends() swaps must neither crash
+    nor route to a backend after it has settled out of the rotation."""
+    a, b = backends
+    router.set_backends({"latest": [a.url, b.url]})
+    errors: list = []
+    results: list = []
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                status, body = ask(router.url, timeout=10.0)
+                results.append((status, body.get("backend")))
+                if status not in (200, 502, 503, 504):
+                    errors.append(f"unexpected status {status}")
+            except Exception as exc:   # noqa: BLE001 - any crash is a fail
+                errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    combos = [{"latest": [a.url, b.url]}, {"latest": [b.url]},
+              {"latest": [a.url]}, {"latest": [a.url, b.url]}]
+    for i in range(40):
+        router.set_backends(combos[i % len(combos)])
+        time.sleep(0.005)
+    stop.set()
+    for t in threads:
+        t.join(timeout=15.0)
+        assert not t.is_alive(), "client thread hung through backend swaps"
+    assert not errors, errors
+    assert results, "no requests completed during the swap storm"
+    # Settle on b only: every subsequent request must land on b.
+    router.set_backends({"latest": [b.url]})
+    for _ in range(6):
+        status, body = ask(router.url)
+        assert status == 200 and body["backend"] == "b"
+
+
+def test_pick_or_wait_never_returns_removed_backend(router, backends):
+    a, b = backends
+    router.set_backends({"latest": [a.url, b.url]})
+    picks: list = []
+    stop = threading.Event()
+
+    def picker():
+        while not stop.is_set():
+            p = router.pick_or_wait(timeout=1.0)
+            if p is not None:
+                picks.append((time.monotonic(), p))
+
+    threads = [threading.Thread(target=picker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    router.set_backends({"latest": [b.url]})
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+    assert picks, "no picks happened under the swap"
+    # After the swap has settled, the removed backend is never picked again.
+    assert all(router.pick_or_wait(timeout=1.0) == b.url
+               for _ in range(20))
+
+
+def test_router_metrics_endpoint(router, backends):
+    a, _ = backends
+    router.set_backends({"latest": [a.url]})
+    ask(router.url)
+    with urllib.request.urlopen(router.url + "/-/router/metrics",
+                                timeout=5.0) as r:
+        text = r.read().decode()
+    assert "kftpu_router_picks" in text
+    assert "kftpu_router_ejected" in text

@@ -105,6 +105,76 @@ def test_metrics_endpoint(server):
     assert status == 200
     assert "kftpu_serving_requests_total" in text
     assert "kftpu_serving_ttft_p50_ms" in text
+    # Lifecycle/shedding surface (ISSUE 2): depth gauge, shed/reap
+    # counters, queue-delay histogram.
+    assert "kftpu_serving_queue_depth" in text
+    assert "kftpu_serving_requests_shed_total" in text
+    assert "kftpu_serving_requests_cancelled_total" in text
+    assert "kftpu_serving_queue_delay_seconds_bucket" in text
+    assert 'le="+Inf"' in text
+
+
+def test_expired_deadline_returns_504_and_reaps(server):
+    """A request whose budget is already gone must fail explicitly (504,
+    finish_reason='deadline' engine-side) — never hang, never 200-empty."""
+    req = urllib.request.Request(
+        server.url + "/v1/completions",
+        data=json.dumps({"prompt": "ab", "max_tokens": 8,
+                         "timeout": 0}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "expected 504"
+    except urllib.error.HTTPError as e:
+        assert e.code == 504
+        assert "deadline" in json.loads(e.read())["error"]
+    assert server.engine.metrics.snapshot()["requests_expired"] >= 1
+    # The engine is unharmed: the next request completes normally.
+    out = _post(server.url + "/v1/completions",
+                {"prompt": "cd", "max_tokens": 3})
+    assert out["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+def test_overload_returns_429_with_retry_after():
+    """Bounded admission at the protocol surface: queue full -> immediate
+    429 + Retry-After (the engine never sees the shed request)."""
+    import threading
+    import time as _t
+
+    cfg = preset("tiny", vocab_size=512)
+    params = init_decoder_params(jax.random.PRNGKey(1), cfg)
+    engine = LLMEngine(
+        cfg, BatchingSpec(max_batch_size=1, max_seq_len=64,
+                          prefill_buckets=[32], max_queue=1),
+        params=params)
+    srv = ModelServer("jam", engine, port=0)
+    srv.start()
+    try:
+        engine.stop()          # freeze the scheduler: submissions pile up
+        first = threading.Thread(target=lambda: http(
+            srv, "POST", "/v1/completions",
+            {"prompt": "xy", "max_tokens": 4, "timeout": 2}))
+        first.start()
+        deadline = _t.monotonic() + 5.0
+        while engine.queue_depth() < 1:
+            assert _t.monotonic() < deadline
+            _t.sleep(0.01)
+        req = urllib.request.Request(
+            srv.url + "/v1/completions",
+            data=json.dumps({"prompt": "zz", "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 429"
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert int(e.headers["Retry-After"]) >= 1
+            assert "queue full" in json.loads(e.read())["error"]
+        first.join(timeout=15.0)
+        assert not first.is_alive(), "queued request hung"
+        assert engine.metrics.snapshot()["requests_shed"] >= 1
+    finally:
+        srv.stop()
 
 
 def test_bad_request_400(server):
