@@ -167,6 +167,29 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Pretty-print a trace dump: a file saved from any ``/debug/traces``
+    endpoint (or its ``?chrome=1`` Chrome export), or — with no file — the
+    platform server's live ``/debug/traces``. ``--slowest N`` keeps the N
+    slowest traces by root duration."""
+    from kubeflow_tpu.obs.trace import format_dump, load_dump
+
+    if args.file is not None:
+        doc = load_dump(args.file)
+    else:
+        path = "/debug/traces"
+        if args.slowest is not None:
+            path += f"?slowest={int(args.slowest)}"
+        doc = _req(args.server, "GET", path)
+    if args.slowest is not None and "traces" in doc:
+        traces = [t for t in doc["traces"] if t.get("root")]
+        traces.sort(key=lambda t: t["root"].get("duration_ms") or 0.0,
+                    reverse=True)
+        doc = {"traces": traces[:int(args.slowest)]}
+    print(format_dump(doc))
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     """One aggregated view of the whole platform (centraldashboard analog):
     per-namespace per-kind counts with condition rollups + recent events."""
@@ -379,6 +402,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--tail", type=int, default=50)
     common(sp)
     sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser("trace", help="pretty-print a trace dump "
+                                      "(/debug/traces JSON or Chrome export)")
+    sp.add_argument("file", nargs="?", default=None,
+                    help="dump file; omit to fetch the server's live traces")
+    sp.add_argument("--slowest", type=int, default=None,
+                    help="show only the N slowest traces")
+    common(sp)
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("metrics", help="Prometheus metrics")
     common(sp)

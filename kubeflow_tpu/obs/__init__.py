@@ -1,0 +1,2 @@
+"""Platform observability: the span tracer (obs/trace.py) and the unified
+metrics registry (obs/registry.py) every /metrics endpoint renders through."""

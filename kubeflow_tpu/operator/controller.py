@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Optional, Protocol
 
 from kubeflow_tpu.core.store import ObjectStore, Watch, WatchEvent
+from kubeflow_tpu.obs.trace import get_tracer
 
 logger = logging.getLogger("kubeflow_tpu.operator")
 
@@ -163,8 +164,14 @@ class Controller:
         return n
 
     def _do_reconcile(self, key: str) -> None:
+        # Every reconcile is a (root) trace: a slow or crashing reconciler
+        # shows up in /debug/traces?slowest=N next to slow requests, with
+        # the controller name and key on the span. Concrete reconcilers can
+        # annotate further via get_tracer().current().
         try:
-            res = self.reconciler.reconcile(key)
+            with get_tracer().span("reconcile", controller=self.name,
+                                   key=key):
+                res = self.reconciler.reconcile(key)
         except Exception:
             logger.exception("%s: reconcile(%s) failed; requeueing", self.name, key)
             self.queue.add_after(key, 1.0)

@@ -26,6 +26,7 @@ from typing import Any, Callable, Optional
 from kubeflow_tpu.core.pipeline_specs import (
     PipelineIR, RunPhase, TaskExecutionStatus, TaskIR,
 )
+from kubeflow_tpu.obs.trace import get_tracer
 from kubeflow_tpu.pipelines import metadata as md
 from kubeflow_tpu.pipelines.artifacts import ArtifactStore
 from kubeflow_tpu.pipelines.dsl import Component
@@ -92,6 +93,24 @@ class PipelineExecutor:
 
     def run(self, ir: PipelineIR, parameters: Optional[dict[str, Any]] = None,
             *, run_name: str = "run", cache_enabled: bool = True) -> RunResult:
+        # One trace per pipeline run; each task executes inside a child
+        # span (the executor is single-threaded, so the contextvar carries
+        # the nesting), making "which step ate the run's wall clock" a
+        # /debug/traces?slowest=1 lookup instead of a log dig.
+        with get_tracer().span("pipeline.run", pipeline=ir.name,
+                               run=run_name) as sp:
+            result = self._run_traced(ir, parameters, run_name=run_name,
+                                      cache_enabled=cache_enabled)
+            sp.set_attrs(phase=result.phase.value,
+                         tasks=len(result.tasks))
+            if result.phase is RunPhase.FAILED:
+                sp.status = "error"
+            return result
+
+    def _run_traced(self, ir: PipelineIR,
+                    parameters: Optional[dict[str, Any]] = None,
+                    *, run_name: str = "run",
+                    cache_enabled: bool = True) -> RunResult:
         params = dict(ir.parameters)
         params.update(parameters or {})
         missing = [k for k, v in params.items() if v is None]
@@ -284,6 +303,19 @@ class PipelineExecutor:
 
     def _execute(self, state: "_RunState", c: _Concrete, ctx: int,
                  *, best_effort_inputs: bool = False) -> None:
+        with get_tracer().span("pipeline.task", task=c.name,
+                               component=c.ir.component) as sp:
+            self._execute_inner(state, c, ctx,
+                                best_effort_inputs=best_effort_inputs)
+            st = state.status.get(c.name)
+            if st is not None:
+                sp.set_attrs(cached=st.cached, skipped=st.skipped)
+                if st.phase is RunPhase.FAILED:
+                    sp.set_attrs(error=st.error or "failed")
+                    sp.status = "error"
+
+    def _execute_inner(self, state: "_RunState", c: _Concrete, ctx: int,
+                       *, best_effort_inputs: bool = False) -> None:
         comp = state.ir.components[c.ir.component]
         try:
             inputs = {}

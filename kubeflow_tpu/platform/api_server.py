@@ -43,6 +43,7 @@ from kubeflow_tpu.core.manifest import load_manifest
 from kubeflow_tpu.core.registry import known_kinds
 from kubeflow_tpu.core.store import NotFoundError
 from kubeflow_tpu.core.workspace_specs import Profile
+from kubeflow_tpu.obs.trace import debug_traces_payload
 from kubeflow_tpu.platform.metrics import render_metrics
 
 
@@ -129,6 +130,10 @@ class ApiServer:
             return h._send(200, render_metrics(
                 self.cp.store, self.cp.recorder,
                 getattr(self.cp, "allocator", None)), "text/plain")
+        if url.path == "/debug/traces":
+            # Control-plane trace surface: reconcile spans, pipeline runs,
+            # train windows — whatever this process's tracer holds.
+            return h._send(200, debug_traces_payload(h.path))
         if url.path == "/apis":
             return h._send(200, {"kinds": sorted(known_kinds())})
         if parts[:1] == ["apis"] and len(parts) == 2:

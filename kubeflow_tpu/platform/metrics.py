@@ -5,6 +5,11 @@ The reference exposes controller-runtime metrics on every controller
 state the reference surfaces — object/phase counts, event totals — plus the
 data-plane numbers it never sees: per-job tokens/sec/chip, step, MFU, and
 gang-allocator chip occupancy.
+
+Rendering goes through the unified registry (obs/registry.py): one
+Counter/Gauge/Histogram implementation, one label escaper, one exposition
+path shared with the model server's and the router's /metrics. ``_line``
+and ``render_histogram`` remain as thin compatibility shims over it.
 """
 
 from __future__ import annotations
@@ -15,37 +20,35 @@ from kubeflow_tpu.core.events import EventRecorder
 from kubeflow_tpu.core.jobs import JAXJob, Worker
 from kubeflow_tpu.core.registry import known_kinds
 from kubeflow_tpu.core.store import ObjectStore
+from kubeflow_tpu.obs.registry import MetricsRegistry, format_line
 
 
 def _line(name: str, value, labels: Optional[dict] = None) -> str:
-    if labels:
-        lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-        return f"{name}{{{lab}}} {value}"
-    return f"{name} {value}"
+    """One exposition sample line, with the registry's shared label-value
+    escaping (quotes/backslashes/newlines in object names used to emit
+    invalid exposition text here)."""
+    return format_line(name, value, labels)
 
 
 def render_histogram(name: str, buckets, counts, total_sum: float,
                      count: int, labels: Optional[dict] = None) -> list[str]:
     """Prometheus histogram lines: cumulative ``_bucket`` series (including
     the ``+Inf`` tail) plus ``_sum``/``_count``. ``counts`` is per-bucket
-    (len(buckets) + 1 entries); shared by the serving queue-delay histogram
-    and any future platform histogram."""
-    out = [f"# TYPE {name} histogram"]
-    acc = 0
-    for le, c in zip(list(buckets) + ["+Inf"], counts):
-        acc += c
-        out.append(_line(name + "_bucket", acc, {**(labels or {}), "le": le}))
-    out.append(_line(name + "_sum", total_sum, labels))
-    out.append(_line(name + "_count", count, labels))
-    return out
+    (len(buckets) + 1 entries). Compatibility shim over the registry's
+    Histogram renderer."""
+    reg = MetricsRegistry()
+    h = reg.histogram(name, buckets)
+    h.set_cumulative(list(counts), total_sum, count, **(labels or {}))
+    return h.render()
 
 
-def render_metrics(store: ObjectStore,
+def build_registry(store: ObjectStore,
                    recorder: Optional[EventRecorder] = None,
-                   allocator=None) -> str:
-    out: list[str] = []
+                   allocator=None) -> MetricsRegistry:
+    """Scrape-time registry over the control plane's object store."""
+    reg = MetricsRegistry()
 
-    out.append("# TYPE kftpu_objects gauge")
+    objects = reg.gauge("kftpu_objects")
     for kind, cls in sorted(known_kinds().items()):
         objs = store.list(cls)
         phases: dict[str, int] = {}
@@ -55,44 +58,45 @@ def render_metrics(store: ObjectStore,
             phase = getattr(phase, "value", phase) or "unknown"
             phases[str(phase)] = phases.get(str(phase), 0) + 1
         for phase, n in sorted(phases.items()):
-            out.append(_line("kftpu_objects", n,
-                             {"kind": kind, "phase": phase}))
+            objects.set(n, kind=kind, phase=phase)
 
-    out.append("# TYPE kftpu_job_metric gauge")
+    job_step = reg.gauge("kftpu_job_step")
     for job in store.list(JAXJob):
         m = job.status.metrics
         labels = {"job": job.metadata.name,
                   "namespace": job.metadata.namespace}
-        out.append(_line("kftpu_job_step", m.step, labels))
+        job_step.set(m.step, **labels)
         for field in ("tokens_per_sec_per_chip", "step_time_ms", "mfu", "loss"):
             v = getattr(m, field)
             if v is not None:
-                out.append(_line(f"kftpu_job_{field}", v, labels))
+                reg.gauge(f"kftpu_job_{field}").set(v, **labels)
 
-    out.append("# TYPE kftpu_workers gauge")
+    workers = reg.gauge("kftpu_workers")
     worker_phases: dict[str, int] = {}
     for w in store.list(Worker):
         p = getattr(w.status.phase, "value", str(w.status.phase))
         worker_phases[p] = worker_phases.get(p, 0) + 1
     for phase, n in sorted(worker_phases.items()):
-        out.append(_line("kftpu_workers", n, {"phase": phase}))
+        workers.set(n, phase=phase)
 
     if allocator is not None:
-        total = sum(s.num_chips for s in allocator._cluster.slices)
-        free = sum(allocator.free_chips(s.name)
-                   for s in allocator._cluster.slices)
-        out.append("# TYPE kftpu_chips gauge")
-        out.append(_line("kftpu_chips_total", total))
-        out.append(_line("kftpu_chips_allocated", total - free))
+        total, free = allocator.capacity()
+        reg.gauge("kftpu_chips_total").set(total)
+        reg.gauge("kftpu_chips_allocated").set(total - free)
 
     if recorder is not None:
         counts: dict[tuple[str, str], int] = {}
         for ev in recorder.all():
             key = (ev.type, ev.reason)
             counts[key] = counts.get(key, 0) + ev.count
-        out.append("# TYPE kftpu_events_total counter")
+        events = reg.counter("kftpu_events_total")
         for (etype, reason), n in sorted(counts.items()):
-            out.append(_line("kftpu_events_total", n,
-                             {"type": etype, "reason": reason}))
+            events.inc(n, type=etype, reason=reason)
 
-    return "\n".join(out) + "\n"
+    return reg
+
+
+def render_metrics(store: ObjectStore,
+                   recorder: Optional[EventRecorder] = None,
+                   allocator=None) -> str:
+    return build_registry(store, recorder, allocator).render()

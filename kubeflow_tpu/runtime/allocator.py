@@ -84,6 +84,15 @@ class GangAllocator:
         with self._lock:
             return len(self._free.get(slice_name, ()))
 
+    def capacity(self) -> tuple[int, int]:
+        """(total_chips, free_chips) across every slice, in one consistent
+        snapshot — the public accessor metrics/export surfaces use instead
+        of reaching into ``_cluster`` and locking per slice."""
+        with self._lock:
+            total = sum(s.num_chips for s in self._cluster.slices)
+            free = sum(len(chips) for chips in self._free.values())
+            return total, free
+
     # -- lifecycle -------------------------------------------------------------
 
     def submit(self, req: GangRequest) -> Optional[GangAllocation]:

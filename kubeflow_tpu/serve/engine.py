@@ -56,6 +56,7 @@ from kubeflow_tpu.core.serving import BatchingSpec
 from kubeflow_tpu.models import layers as L
 from kubeflow_tpu.models.config import DecoderConfig
 from kubeflow_tpu.models.decoder import Params, decoder_forward, init_decoder_params
+from kubeflow_tpu.obs.trace import get_tracer
 
 logger = logging.getLogger("kubeflow_tpu.serve.engine")
 
@@ -353,6 +354,14 @@ class Request:
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     _cancelled: threading.Event = dataclasses.field(
         default_factory=threading.Event)
+    # Observability (obs/trace.py): ``trace_parent`` is the submitter's span
+    # context (the model server's request span — contextvars don't cross
+    # into the scheduler thread, so it rides on the request); ``span`` is
+    # the currently-open engine child span (queued → prefill → decode),
+    # owned exclusively by the scheduler. None on both = untraced request,
+    # and every tracing hook is a no-op.
+    trace_parent: Optional[Any] = None
+    span: Optional[Any] = None
 
     @property
     def ttft(self) -> Optional[float]:
@@ -384,6 +393,21 @@ class Request:
         if not self.done.wait(timeout):
             raise TimeoutError(f"request {self.id} not finished")
         return self.output_tokens
+
+
+def _span_close(req: Request, status: str = "ok", **attrs: Any) -> None:
+    """End the request's open engine span (no-op for untraced requests)."""
+    if req.span is not None:
+        if attrs:
+            req.span.set_attrs(**attrs)
+        req.span.end(status)
+        req.span = None
+
+
+def _span_open(req: Request, name: str, **attrs: Any) -> None:
+    if req.trace_parent is not None:
+        req.span = get_tracer().start_span(name, parent=req.trace_parent,
+                                           request=req.id, **attrs)
 
 
 @dataclasses.dataclass
@@ -927,7 +951,8 @@ class LLMEngine:
     def submit(self, prompt_tokens: list[int],
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None, *,
-               deadline: Optional[float] = None) -> Request:
+               deadline: Optional[float] = None,
+               trace_parent=None) -> Request:
         if not prompt_tokens:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.max_len:
@@ -943,7 +968,8 @@ class LLMEngine:
         req = Request(prompt_tokens=list(prompt_tokens),
                       params=params or SamplingParams(),
                       id=request_id or f"req-{next(self._id_gen)}",
-                      deadline=deadline)
+                      deadline=deadline, trace_parent=trace_parent)
+        _span_open(req, "engine.queued", prompt_tokens=len(prompt_tokens))
         self.waiting.put(req)
         self._wake.set()
         return req
@@ -981,6 +1007,10 @@ class LLMEngine:
 
     def _admit_with_token(self, req: Request, slot_idx: int, plen: int,
                           tok: int) -> None:
+        if req.trace_parent is not None:
+            # prefill → decode: the first token is out.
+            _span_close(req, prompt_tokens=plen)
+            _span_open(req, "engine.decode", slot=slot_idx)
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
         req.output_tokens.append(tok)
@@ -1076,6 +1106,11 @@ class LLMEngine:
             return
         req.finish_reason = reason
         req.finish_time = time.monotonic()
+        # A reaped request's span closes with an explicit failure status —
+        # cancelled client, blown deadline, shed, or in-engine error — so
+        # the ring buffer never accumulates open spans for dead requests.
+        _span_close(req, "cancelled" if reason == "cancelled" else "error",
+                    finish_reason=reason, tokens=len(req.output_tokens))
         req.stream.put(None)
         req.done.set()
         if reason == "shed":
@@ -1173,6 +1208,11 @@ class LLMEngine:
             req = self._next_admissible()
             if req is None:
                 break
+            if req.trace_parent is not None:
+                # queued → prefill (covers both fresh admissions and
+                # preempted-lane resumes, which skip _note_admitted).
+                _span_close(req)
+                _span_open(req, "engine.prefill")
             plen = len(req.prompt_tokens)
             C = self.chunk_size
             if self.paged:
@@ -1275,10 +1315,7 @@ class LLMEngine:
         requests (their engine-side state is unknown — retrying could
         double-write KV) and requeue everything never dispatched."""
         for req, _, _, _ in failed_group:
-            req.finish_reason = "error"
-            req.finish_time = time.monotonic()
-            req.stream.put(None)
-            req.done.set()
+            self._fail_request(req, "error")
         # FRONT of the backlog, original arrival order: they were admitted
         # once already — nothing may overtake them now.
         self._backlog[:0] = [item[0] for item in requeue_items]
@@ -1313,6 +1350,12 @@ class LLMEngine:
         recomputes (prefix cache permitting) and generation resumes."""
         s = self.slots[idx]
         req = s.request
+        if req.trace_parent is not None:
+            # decode → queued again: the re-admission recompute shows up
+            # as a fresh prefill span on the same trace.
+            _span_close(req, preempted=True,
+                        tokens=len(req.output_tokens))
+            _span_open(req, "engine.queued", requeued=True)
         req.prompt_tokens = list(req.prompt_tokens) \
             + req.output_tokens[req.resumed_from:]
         req.resumed_from = len(req.output_tokens)
@@ -1345,6 +1388,8 @@ class LLMEngine:
         req = s.request
         req.finish_reason = reason
         req.finish_time = time.monotonic()
+        _span_close(req, finish_reason=reason,
+                    tokens=len(req.output_tokens))
         req.stream.put(None)
         req.done.set()
         self.metrics.observe(req)
@@ -1440,6 +1485,7 @@ class LLMEngine:
         out = np.asarray(jax.device_get(out))
         emitted = 0
         for i, s in active:
+            n_emit = 0
             for t in out[i]:
                 if t < 0:
                     break               # -1 = emitted nothing further
@@ -1449,7 +1495,14 @@ class LLMEngine:
                 s.last_token = tok
                 s.length += 1
                 s.generated += 1
-                emitted += 1
+                n_emit += 1
+            emitted += n_emit
+            if s.request.span is not None and n_emit:
+                # Round annotation as a span EVENT: one decode round is one
+                # device dispatch shared by every slot — a span per round
+                # per request would out-cost what it measures.
+                s.request.span.add_event("decode_round", tokens=n_emit,
+                                         steps=k_steps)
             self._finish_if_done(i)
         return emitted
 
@@ -1556,6 +1609,9 @@ class LLMEngine:
             for tok in emit:
                 s.request.output_tokens.append(tok)
                 s.request.stream.put(tok)
+            if s.request.span is not None and emit:
+                s.request.span.add_event("decode_round", spec=True,
+                                         drafted=len(d), tokens=len(emit))
             s.last_token = emit[-1]
             s.length += len(emit)
             s.generated += len(emit)

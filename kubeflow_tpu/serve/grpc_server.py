@@ -21,6 +21,7 @@ from typing import Optional
 
 import grpc
 
+from kubeflow_tpu.obs.trace import TRACE_HEADER, get_tracer
 from kubeflow_tpu.serve.engine import EngineOverloaded
 from kubeflow_tpu.serve.protos import oip_pb2 as pb
 
@@ -115,6 +116,18 @@ class GRPCInferenceServer:
 
     def _model_infer(self, request, context):
         body = {k: _param_value(v) for k, v in request.parameters.items()}
+        # Trace join over gRPC: the propagation header arrives as lowercase
+        # invocation metadata; the span set here parents the engine-side
+        # spans through generate_text's contextvar lookup — one trace id
+        # whichever protocol family carried the request.
+        tracer = get_tracer()
+        md = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+        with tracer.span("grpc.model_infer",
+                         parent=tracer.extract(md.get(TRACE_HEADER.lower())),
+                         model=request.model_name):
+            return self._model_infer_traced(request, context, body)
+
+    def _model_infer_traced(self, request, context, body):
         texts = []
         try:
             for inp in request.inputs:

@@ -45,6 +45,7 @@ from kubeflow_tpu.core.serving import InferenceService
 from kubeflow_tpu.core.store import (
     AlreadyExistsError, NotFoundError, ObjectStore, WatchEvent,
 )
+from kubeflow_tpu.obs.trace import get_tracer
 from kubeflow_tpu.operator.controller import ReconcileResult
 from kubeflow_tpu.runtime.bootstrap import free_port
 from kubeflow_tpu.serve.router import Router
@@ -297,6 +298,13 @@ class ISVCController:
         isvc.status.desired_replicas = desired
         isvc.status.ready_replicas = len(ready_urls)
         isvc.status.traffic = traffic
+        sp = get_tracer().current()
+        if sp is not None:
+            # Annotate the Controller-owned reconcile span: what this pass
+            # converged to (the numbers a slow-reconcile trace needs to be
+            # diagnosable without re-running it).
+            sp.set_attrs(desired=desired, ready=len(ready_urls),
+                         pending=pending, canary=bool(canary_active))
         if latest_ready:
             isvc.status.latest_ready_generation = gen
         if ready_urls:

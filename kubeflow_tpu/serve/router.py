@@ -37,6 +37,7 @@ Request-lifecycle hardening (Envoy-analog, TPU-native):
 from __future__ import annotations
 
 import itertools
+import json
 import random
 import sys
 import threading
@@ -46,6 +47,11 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+from kubeflow_tpu.obs.registry import MetricsRegistry
+from kubeflow_tpu.obs.trace import (
+    TRACE_HEADER, debug_traces_payload, get_tracer,
+)
 
 
 def quiet_handle_error(httpd) -> None:
@@ -70,6 +76,7 @@ DEADLINE_HEADER = "X-Kftpu-Deadline-Ms"
 
 #: Local (non-proxied) router endpoints.
 ROUTER_METRICS_PATH = "/-/router/metrics"
+ROUTER_TRACES_PATH = "/-/router/debug/traces"
 
 
 class Router:
@@ -308,10 +315,10 @@ def _make_handler(router: Router):
 
         def _router_metrics(self) -> None:
             snap = router.snapshot()
-            lines = ["# TYPE kftpu_router gauge"]
-            lines += [f"kftpu_router_{k} {v}" for k, v in sorted(snap.items())]
-            self._send(200, ("\n".join(lines) + "\n").encode(),
-                       ctype="text/plain")
+            reg = MetricsRegistry()
+            for k, v in sorted(snap.items()):
+                reg.gauge(f"kftpu_router_{k}").set(v)
+            self._send(200, reg.render().encode(), ctype="text/plain")
 
         def _proxy(self) -> None:
             if self.path == ROUTER_METRICS_PATH:
@@ -319,6 +326,10 @@ def _make_handler(router: Router):
                 # KPA-analog activity clock (a 1 s scrape loop would pin
                 # the service out of scale-to-zero forever).
                 return self._router_metrics()
+            if self.path.split("?", 1)[0] == ROUTER_TRACES_PATH:
+                return self._send(
+                    200, json.dumps(debug_traces_payload(self.path),
+                                    default=str).encode())
             router.note_activity()
             try:
                 self._proxy_inner()
@@ -342,6 +353,19 @@ def _make_handler(router: Router):
             return budget
 
         def _proxy_inner(self) -> None:
+            # Trace root (or join, when the client already carries a
+            # context): every hop below — backend pick, upstream request,
+            # response relay — is annotated on this span, and the context
+            # rides the X-Kftpu-Trace header so the model server and the
+            # engine scheduler continue the SAME trace id.
+            tracer = get_tracer()
+            with tracer.span(
+                    "router.request",
+                    parent=tracer.extract(self.headers.get(TRACE_HEADER)),
+                    path=self.path) as sp:
+                self._proxy_upstream(sp)
+
+        def _proxy_upstream(self, sp) -> None:
             deadline = time.monotonic() + self._budget_s()
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n) if n else None
@@ -351,6 +375,7 @@ def _make_handler(router: Router):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     router.count("deadline_exhausted")
+                    sp.set_attrs(code=504)
                     return self._error(504, "deadline exhausted in router")
                 if first_attempt:
                     # Only the first pick parks (scale-from-zero): a retry
@@ -366,25 +391,33 @@ def _make_handler(router: Router):
                         # Retried through the whole rotation: every backend
                         # refused the connection — a backend-side outage,
                         # not a routing/queue condition.
+                        sp.set_attrs(code=502)
                         return self._error(
                             502, "backend unreachable: all backends failed")
                     router.count("queue_timeouts")
+                    sp.set_attrs(code=503)
                     return self._error(
                         503, "no ready backends (queue timeout)")
                 router.count("picks")
+                sp.set_attrs(backend=backend)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     router.count("deadline_exhausted")
+                    sp.set_attrs(code=504)
                     return self._error(504, "deadline exhausted in router")
+                fwd_headers = {
+                    "Content-Type": self.headers.get(
+                        "Content-Type", "application/json"),
+                    # Forward the REMAINING budget: the replica stamps
+                    # the engine-side request deadline from it.
+                    DEADLINE_HEADER: str(int(remaining * 1e3)),
+                }
+                trace_hdr = get_tracer().inject(sp)
+                if trace_hdr:
+                    fwd_headers[TRACE_HEADER] = trace_hdr
                 req = urllib.request.Request(
                     backend + self.path, data=body, method=self.command,
-                    headers={
-                        "Content-Type": self.headers.get(
-                            "Content-Type", "application/json"),
-                        # Forward the REMAINING budget: the replica stamps
-                        # the engine-side request deadline from it.
-                        DEADLINE_HEADER: str(int(remaining * 1e3)),
-                    })
+                    headers=fwd_headers)
                 try:
                     resp = urllib.request.urlopen(req, timeout=remaining)
                 except urllib.error.HTTPError as exc:
@@ -396,6 +429,7 @@ def _make_handler(router: Router):
                         router.note_backend_failure(backend)
                     else:
                         router.note_backend_success(backend)
+                    sp.set_attrs(code=exc.code)
                     data = exc.read()
                     self._send(exc.code, data, ctype=exc.headers.get(
                         "Content-Type", "application/json"))
@@ -406,11 +440,13 @@ def _make_handler(router: Router):
                     # the ONE case where a retry on a different backend is
                     # unconditionally safe.
                     router.note_backend_failure(backend, connect=True)
+                    sp.add_event("connect_failure", backend=backend)
                     tried.add(backend)
                     first_attempt = False
                     if len(tried) <= router.max_retries:
                         router.count("retries")
                         continue
+                    sp.set_attrs(code=502)
                     return self._error(502, f"backend unreachable: {exc}")
                 def read_upstream(*args):
                     # Mid-response read failures are the BACKEND's fault
@@ -423,6 +459,7 @@ def _make_handler(router: Router):
                         router.note_backend_failure(backend)
                         raise
 
+                sp.set_attrs(code=resp.status)
                 try:
                     with resp:
                         self.send_response(resp.status)

@@ -36,9 +36,12 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from kubeflow_tpu.platform.metrics import render_histogram
+from kubeflow_tpu.obs.registry import MetricsRegistry
+from kubeflow_tpu.obs.trace import (
+    TRACE_HEADER, debug_traces_payload, get_tracer,
+)
 from kubeflow_tpu.serve.engine import (
-    EngineOverloaded, LLMEngine, Request, SamplingParams,
+    EngineOverloaded, LLMEngine, QUEUE_DELAY_BUCKETS, Request, SamplingParams,
 )
 from kubeflow_tpu.serve.router import DEADLINE_HEADER, quiet_handle_error
 from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
@@ -240,17 +243,21 @@ class ModelServer:
         if self.transformer is not None:
             prompt = self.transformer(prompt, "pre")
         timeout = self.request_timeout(body, deadline_s)
+        tracer = get_tracer()
         with self.lease(model, strict=strict) as (engine, tokenizer, _):
             toks = tokenizer.encode(prompt)
             req = engine.submit(toks, self.sampling_from(body, tokenizer),
-                                deadline=time.monotonic() + timeout)
+                                deadline=time.monotonic() + timeout,
+                                trace_parent=tracer.current())
             try:
                 out = req.result(timeout=timeout + 1.0)
             except TimeoutError:
                 req.cancel()
                 raise
             _raise_for_reaped(req)
-            text = tokenizer.decode([t for t in out if t != tokenizer.eos_id])
+            with tracer.span("server.detokenize", tokens=len(out)):
+                text = tokenizer.decode(
+                    [t for t in out if t != tokenizer.eos_id])
         if self.transformer is not None:
             text = self.transformer(text, "post")
         return text, req
@@ -277,13 +284,14 @@ class ModelServer:
             stop_token=tokenizer.eos_id,
         )
 
-    def metrics_text(self) -> str:
-        lines = [
-            "# TYPE kftpu_serving_requests_total counter",
-            "# TYPE kftpu_serving_tokens_total counter",
-            "# TYPE kftpu_serving_in_flight gauge",
-            f"kftpu_serving_in_flight {self.in_flight}",
-        ]
+    def metrics_registry(self) -> MetricsRegistry:
+        """Scrape-time registry over the live engine counters — the model
+        server's half of the platform's single exposition path
+        (obs/registry.py)."""
+        reg = MetricsRegistry()
+        requests_total = reg.counter("kftpu_serving_requests_total")
+        tokens_total = reg.counter("kftpu_serving_tokens_total")
+        reg.gauge("kftpu_serving_in_flight").set(self.in_flight)
         engines: list[tuple[str, LLMEngine]] = []
         if self.engine is not None:
             engines.append((self.name, self.engine))
@@ -294,36 +302,35 @@ class ModelServer:
                 entry = self.repository.peek(item["name"])
                 if entry is not None and entry.engine is not None:
                     engines.append((entry.name, entry.engine))
-        lines.append("# TYPE kftpu_serving_queue_depth gauge")
-        lines.append("# TYPE kftpu_serving_requests_shed_total counter")
+        queue_depth = reg.gauge("kftpu_serving_queue_depth")
+        shed = reg.counter("kftpu_serving_requests_shed_total")
+        cancelled = reg.counter("kftpu_serving_requests_cancelled_total")
+        expired = reg.counter("kftpu_serving_requests_expired_total")
+        qdelay = reg.histogram("kftpu_serving_queue_delay_seconds",
+                               QUEUE_DELAY_BUCKETS)
         for name, engine in engines:
             snap = engine.metrics.snapshot()
-            lab = f'{{model="{name}"}}'
-            lines.append(f"kftpu_serving_requests_total{lab} "
-                         f"{snap['requests_completed']}")
-            lines.append(f"kftpu_serving_tokens_total{lab} "
-                         f"{snap['tokens_generated']}")
+            requests_total.inc(snap["requests_completed"], model=name)
+            tokens_total.inc(snap["tokens_generated"], model=name)
             for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
                       "requests_per_sec", "tokens_per_sec",
                       "spec_acceptance_rate", "spec_tokens_per_step",
                       "spec_draft_overhead"):
                 if k in snap:
-                    lines.append(f"kftpu_serving_{k}{lab} {snap[k]}")
+                    reg.gauge(f"kftpu_serving_{k}").set(snap[k], model=name)
             # Load-shedding / lifecycle surface: queue depth, shed and reap
             # counters, and the queue-delay histogram — the dashboards that
             # show an overload knee BEFORE clients start timing out.
-            lines.append(f"kftpu_serving_queue_depth{lab} "
-                         f"{engine.queue_depth()}")
-            for k, metric in (("requests_shed", "requests_shed_total"),
-                              ("requests_cancelled",
-                               "requests_cancelled_total"),
-                              ("requests_expired", "requests_expired_total")):
-                lines.append(f"kftpu_serving_{metric}{lab} {snap[k]}")
-            buckets, counts, qsum, qn = engine.metrics.queue_delay_histogram()
-            lines.extend(render_histogram(
-                "kftpu_serving_queue_delay_seconds", buckets, counts, qsum,
-                qn, {"model": name}))
-        return "\n".join(lines) + "\n"
+            queue_depth.set(engine.queue_depth(), model=name)
+            shed.inc(snap["requests_shed"], model=name)
+            cancelled.inc(snap["requests_cancelled"], model=name)
+            expired.inc(snap["requests_expired"], model=name)
+            _, counts, qsum, qn = engine.metrics.queue_delay_histogram()
+            qdelay.set_cumulative(counts, qsum, qn, model=name)
+        return reg
+
+    def metrics_text(self) -> str:
+        return self.metrics_registry().render()
 
 
 def _make_handler(server: ModelServer):
@@ -378,6 +385,8 @@ def _make_handler(server: ModelServer):
             if self.path == "/metrics":
                 self._text(200, server.metrics_text())
                 return
+            if self.path.startswith("/debug/traces"):
+                return self._json(200, debug_traces_payload(self.path))
             if self.path == "/v1/models":
                 self._json(200, {"models": server.model_names()})
                 return
@@ -411,28 +420,38 @@ def _make_handler(server: ModelServer):
 
         def do_POST(self) -> None:
             server.track(1)
+            tracer = get_tracer()
             try:
-                # Always drain the body first: HTTP/1.1 keep-alive breaks if
-                # unread bytes remain on the connection.
-                body = self._body()
-                repo = _REPO_ACTION.match(self.path)
-                if repo:
-                    return self._repository_action(repo.group(1),
-                                                   repo.group(2))
-                m = _V1_PREDICT.match(self.path)
-                if m:
-                    return self._v1_predict(body, m.group(1))
-                m = _V1_EXPLAIN.match(self.path)
-                if m:
-                    return self._v1_explain(body, m.group(1))
-                m = _V2_INFER.match(self.path)
-                if m:
-                    return self._v2_infer(body, m.group(1))
-                if self.path == "/v1/completions":
-                    return self._completions(body, chat=False)
-                if self.path == "/v1/chat/completions":
-                    return self._completions(body, chat=True)
-                self._json(404, {"error": f"not found: {self.path}"})
+                # Joins the router's trace via X-Kftpu-Trace (or roots a new
+                # one for direct-to-replica requests); every generation path
+                # below parents its engine-side spans on this span through
+                # the contextvar.
+                with tracer.span(
+                        "server.request",
+                        parent=tracer.extract(
+                            self.headers.get(TRACE_HEADER)),
+                        path=self.path, server=server.name):
+                    # Always drain the body first: HTTP/1.1 keep-alive
+                    # breaks if unread bytes remain on the connection.
+                    body = self._body()
+                    repo = _REPO_ACTION.match(self.path)
+                    if repo:
+                        return self._repository_action(repo.group(1),
+                                                       repo.group(2))
+                    m = _V1_PREDICT.match(self.path)
+                    if m:
+                        return self._v1_predict(body, m.group(1))
+                    m = _V1_EXPLAIN.match(self.path)
+                    if m:
+                        return self._v1_explain(body, m.group(1))
+                    m = _V2_INFER.match(self.path)
+                    if m:
+                        return self._v2_infer(body, m.group(1))
+                    if self.path == "/v1/completions":
+                        return self._completions(body, chat=False)
+                    if self.path == "/v1/chat/completions":
+                        return self._completions(body, chat=True)
+                    self._json(404, {"error": f"not found: {self.path}"})
             except KeyError as exc:
                 self._json(404, {"error": str(exc)})
             except ValueError as exc:
@@ -540,7 +559,8 @@ def _make_handler(server: ModelServer):
                 toks = tokenizer.encode(prompt)
                 req = engine.submit(toks,
                                     server.sampling_from(body, tokenizer),
-                                    deadline=time.monotonic() + timeout)
+                                    deadline=time.monotonic() + timeout,
+                                    trace_parent=get_tracer().current())
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")

@@ -12,12 +12,14 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
 from kubeflow_tpu.models.config import DecoderConfig, preset
+from kubeflow_tpu.obs.trace import get_tracer
 from kubeflow_tpu.train.checkpoint import CheckpointManager
 from kubeflow_tpu.train.data import DataConfig, make_data_source
 from kubeflow_tpu.train.metrics import MetricsEmitter, Throughput
@@ -174,6 +176,8 @@ class Trainer:
         last_tick_step = start
         prof = self.cfg.profile_start_step
         tracing = False
+        tracer = get_tracer()
+        window_start = time.time()
         for step in range(start, self.cfg.steps):
             if prof is not None and self.process_id == 0:
                 # `tracing` guards both ends: a resume that lands inside or
@@ -196,6 +200,22 @@ class Trainer:
                     committed = self.ckpt.latest_committed_step()
                     if committed is not None:
                         metrics["last_checkpoint_step"] = committed
+                # One completed span per logged window (obs/trace.py): the
+                # train loop's slice of the platform trace surface. Spans
+                # are retrospective (explicit start) so the hot loop pays
+                # nothing between log points; ``profiling=True`` marks
+                # windows that overlapped a jax.profiler trace, tying the
+                # span to the on-device timeline it summarizes.
+                sp = tracer.start_span(
+                    "train.window", start=window_start,
+                    steps=f"{last_tick_step}-{step + 1}")
+                for k in ("loss", "step_time_ms", "tokens_per_sec", "mfu"):
+                    if k in metrics:
+                        sp.set_attrs(**{k: round(float(metrics[k]), 6)})
+                if tracing:
+                    sp.set_attrs(profiling=True)
+                sp.end()
+                window_start = time.time()
                 last_tick_step = step + 1
                 last_metrics = metrics
                 if self.process_id == 0:

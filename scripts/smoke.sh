@@ -40,5 +40,16 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 chaos_rc=${PIPESTATUS[0]}
 grep -q '"chaos_smoke": "ok"' /tmp/_smoke_chaos.json || chaos_rc=1
 
-echo "== smoke: tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc =="
-[ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ]
+echo "== obs smoke (trace propagation + /metrics exposition grammar) =="
+# Observability gate: traffic through router→server→engine must yield one
+# unified trace id with closed spans, every /metrics endpoint must parse
+# under the exposition grammar, and every series name must be kftpu_-
+# prefixed (the metric-name lint).
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/obs_smoke.py --requests 8 --concurrency 4 \
+  | tee /tmp/_smoke_obs.json
+obs_rc=${PIPESTATUS[0]}
+grep -q '"obs_smoke": "ok"' /tmp/_smoke_obs.json || obs_rc=1
+
+echo "== smoke: tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc =="
+[ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ]
