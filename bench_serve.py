@@ -544,11 +544,108 @@ def run_spec_ab(requests: int, concurrency: int, prompt_len: int,
     return rows
 
 
+def run_hotloop_ab(requests: int, concurrency: int, prompt_len: int,
+                   max_new: int, only: str = "all",
+                   paged: bool = False) -> list[dict]:
+    """Decode hot-loop host-overhead A/B (ISSUE 4 tentpole): pipelined
+    dispatch + device-resident scheduler state ON vs the synchronous
+    dispatch-then-consume loop, same engine shape, same process, warmed
+    two-segment methodology. Decode-heavy greedy workload (short prompts,
+    long generations) so per-round host overhead is what the tok/s
+    measures. Reports decode tok/s per variant, host-gap p50/p99 and
+    dispatch depth from the engine's own counters, and a speedup row.
+    Steady-state rounds upload zero full scheduler-state arrays either
+    way (the device-resident half is unconditional — the A/B isolates
+    the pipelining half)."""
+    import jax
+
+    from kubeflow_tpu.core.serving import BatchingSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.serve.engine import LLMEngine, SamplingParams
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = preset(
+            "llama3-8b",
+            n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
+            mlp_dim=8192, vocab_size=32000, max_seq_len=2048)
+        model_tag = "llama3-0.6b"
+        max_new = max(max_new, 256)          # decode-heavy
+        prompt_len = min(prompt_len, 128)
+    else:
+        cfg = preset("tiny", max_seq_len=1024)
+        model_tag = "tiny-s1k"
+        prompt_len = min(prompt_len, 64)
+        max_new = min(max(max_new, 128), 512)
+    cap = cfg.max_seq_len - max_new - 1
+    prompt_len = min(prompt_len, cap)
+    slots = min(16, concurrency)
+    rng = np.random.default_rng(0)
+    params = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+
+    def gen(n):
+        return [rng.integers(1, cfg.vocab_size, size=prompt_len).tolist()
+                for _ in range(n)]
+
+    variants = [("pipelined_off", False), ("pipelined_on", True)]
+    if only != "all":
+        variants = [vk for vk in variants if vk[0] == only]
+    rows = []
+    toks = {}
+    for tag, pipelined in variants:
+        engine = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=slots, max_seq_len=cfg.max_seq_len,
+            prefill_buckets=[max(prompt_len, 16)],
+            paged=paged, page_size=128,
+            weights_dtype="bfloat16" if on_tpu else None,
+            pipelined_decode=pipelined))
+        m = _measure(engine, gen, params, concurrency, requests,
+                     warm_prompts=gen(max(4, slots)))
+        tok_s = [s["decode_tok_s"] for s in m["segments"]]
+        toks[tag] = sum(tok_s) / len(tok_s)
+        em = m["engine_metrics"]
+        rows.append({
+            "metric": f"serve_hotloop_decode_tok_s[{model_tag},{tag},"
+                      f"p{prompt_len},gen{max_new},c{concurrency}"
+                      f"{',paged' if paged else ''}]",
+            "value": round(toks[tag], 1),
+            "unit": "tok/s",
+            "vs_baseline": 1.0,
+            "detail": {
+                "segments": m["segments"],
+                "spread_pct": m["spread_pct"],
+                "req_s": m["value"],
+                "slots": slots,
+                "requests_per_segment": requests,
+                "host_gap_p50_ms": round(em.get("host_gap_p50_ms", 0.0), 3),
+                "host_gap_p99_ms": round(em.get("host_gap_p99_ms", 0.0), 3),
+                "host_gap_total_s": round(em.get("host_gap_seconds", 0.0),
+                                          3),
+                "dispatch_depth": em.get("dispatch_depth", 0),
+                "state_uploads": dict(engine._dstate.stats),
+                "decode_rounds": engine.decode_rounds,
+            },
+        })
+    if len(toks) == 2:
+        rows.append({
+            "metric": f"serve_hotloop_speedup[{model_tag},pipelined_vs_off,"
+                      f"p{prompt_len},gen{max_new},c{concurrency}"
+                      f"{',paged' if paged else ''}]",
+            "value": round(
+                toks["pipelined_on"] / max(toks["pipelined_off"], 1e-9), 3),
+            "unit": "x decode tok/s",
+            "vs_baseline": 1.0,
+            "detail": {"on_tok_s": round(toks["pipelined_on"], 1),
+                       "off_tok_s": round(toks["pipelined_off"], 1)},
+        })
+    return rows
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="uniform",
                     choices=["uniform", "mixed", "prefix", "all", "moe",
-                             "quant", "longctx", "spec"])
+                             "quant", "longctx", "spec", "hotloop"])
     ap.add_argument("--requests", type=int, default=48,
                     help="per measured segment (two segments run)")
     ap.add_argument("--concurrency", type=int, default=16)
@@ -567,11 +664,20 @@ if __name__ == "__main__":
                     choices=["all", "dense", "dispatch_prefill",
                              "dispatch_prefill+zd_decode", "bf16", "int8w",
                              "paged_bf16", "paged_int8kv", "paged_gather",
-                             "paged_pallas", "spec_off", "spec_ngram"],
-                    help="moe/quant/longctx/spec workloads: run one variant")
+                             "paged_pallas", "spec_off", "spec_ngram",
+                             "pipelined_off", "pipelined_on"],
+                    help="moe/quant/longctx/spec/hotloop workloads: run "
+                         "one variant")
     ap.add_argument("--spec-k", type=int, default=6,
                     help="spec workload: draft tokens per round")
     args = ap.parse_args()
+    if args.workload == "hotloop":
+        rows = run_hotloop_ab(args.requests, args.concurrency,
+                              args.prompt_len, args.max_new,
+                              only=args.variant, paged=args.paged)
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        raise SystemExit(0)
     if args.workload == "spec":
         rows = run_spec_ab(args.requests, args.concurrency, args.prompt_len,
                            args.max_new, only=args.variant,

@@ -138,6 +138,18 @@ class BatchingSpec(BaseModel):
     # bound for running streams vs dispatch amortization; 1 = the old
     # strict interleave, which costs concurrent paged traffic ~40% req/s).
     prefill_interleave_steps: int = 8
+    # Pipelined decode dispatch (hot-loop host-overhead elimination):
+    # dispatch round N+1 before consuming round N's tokens, so
+    # detokenization, stream callbacks, reaping and admission overlap
+    # device compute instead of serializing behind a blocking device_get.
+    # The scheduler's view is ONE ROUND STALE, bounded: admissions and
+    # cancellations decided while a round is in flight take effect the
+    # next round, and a cancelled slot's in-flight results are masked
+    # before emission (output streams never contain post-cancel tokens).
+    # Greedy outputs are token-identical on/off (regression-tested);
+    # False restores the synchronous dispatch-then-consume loop (the
+    # bench_serve --workload hotloop A/B baseline).
+    pipelined_decode: bool = True
     # Cast model weights once at engine load (e.g. "bfloat16" — halves the
     # per-step HBM param read, the decode bottleneck; standard for serving).
     # None keeps the checkpoint dtype.

@@ -348,6 +348,19 @@ class Tracer:
                     "args": {**s["attrs"], "trace_id": s["trace_id"],
                              "status": s["status"]},
                 })
+                # Span events as thread-scoped instants on the same lane
+                # (e.g. per-round "decode_round" markers with their
+                # host_gap_ms) — Perfetto shows them as ticks inside the
+                # span's slice.
+                for ev in s.get("events", []):
+                    events.append({
+                        "name": ev["name"], "cat": "kftpu", "ph": "i",
+                        "ts": ev["ts"] * 1e6, "s": "t",
+                        "pid": os.getpid(),
+                        "tid": int(s["span_id"][:6], 16),
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("name", "ts")},
+                    })
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -395,6 +408,16 @@ def format_trace_tree(spans: list[dict]) -> str:
             lines.append("  " * depth
                          + f"{s['name']} {dur}{mark}"
                          + (f" ({attrs})" if attrs else ""))
+            # Span events (e.g. per-round decode_round markers with
+            # host_gap_ms) print as bullet children so `kftpu trace` shows
+            # the hot-loop health without a Perfetto round-trip.
+            for ev in s.get("events", []):
+                ev_attrs = " ".join(
+                    f"{k}={v}" for k, v in sorted(ev.items())
+                    if k not in ("name", "ts"))
+                lines.append("  " * (depth + 1)
+                             + f"· {ev['name']}"
+                             + (f" ({ev_attrs})" if ev_attrs else ""))
             walk(s["span_id"], depth + 1)
 
     walk(None, 0)

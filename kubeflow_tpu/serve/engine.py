@@ -19,6 +19,20 @@ Design, driven by XLA's compilation model rather than CUDA streams:
 - **Scheduler in plain Python** between device steps: reap → admit →
   prefill → decode → emit. The hot loop holds no Python per-token state
   beyond the slot table; everything tensor-shaped lives on device.
+- **Device-resident decode state + pipelined dispatch** (the hot-loop
+  host-overhead elimination): the per-slot scheduler arrays
+  (tokens/lengths/live/sampling params/budgets) and the paged page table
+  are persistent device arrays (serve/device_state.py) — admissions,
+  reaps, preemptions and page-table growth apply per-slot DELTAS through
+  small donated scatters, and steady-state rounds upload nothing. With
+  ``BatchingSpec.pipelined_decode`` (default on) the scheduler dispatches
+  round N+1 before consuming round N's tokens, so detokenization, stream
+  callbacks, reaping and admission overlap device compute. The staleness
+  contract is one round deep and bounded: a cancellation or admission
+  decided while a round is in flight takes effect the NEXT round, and a
+  cancelled slot's in-flight results are masked before emission — output
+  streams never contain post-cancel tokens. Greedy outputs are
+  token-identical with pipelining on and off (regression-tested).
 - **Request lifecycle** (deadlines, cancellation, load shedding): every
   request may carry a monotonic ``deadline`` and can be ``cancel()``ed from
   any thread; the scheduler reaps dead requests each step wherever they
@@ -53,6 +67,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kubeflow_tpu.core.serving import BatchingSpec
+from kubeflow_tpu.serve.device_state import DEAD_SLOT, DecodeState
 from kubeflow_tpu.models import layers as L
 from kubeflow_tpu.models.config import DecoderConfig
 from kubeflow_tpu.models.decoder import Params, decoder_forward, init_decoder_params
@@ -228,7 +243,10 @@ def _decode_multi(params: Params, cache: dict, tokens: jax.Array,
     sampled tokens are discarded via the ``live`` mask. Emitted tokens
     surface as ``out`` [B, num_steps] with -1 in never-emitted cells.
 
-    Returns (out, cache, lengths, live, budgets)."""
+    Returns (out, cache, tokens, lengths, live, budgets) — the advanced
+    carry IS the next round's input, which is what lets the engine keep
+    the whole scheduler state device-resident (serve/device_state.py) and
+    dispatch round N+1 before round N's tokens ever reach the host."""
     b = tokens.shape[0]
     max_len = cache["k"].shape[2]
     out0 = jnp.full((b, num_steps), -1, jnp.int32)
@@ -254,10 +272,10 @@ def _decode_multi(params: Params, cache: dict, tokens: jax.Array,
             & (lengths + 1 < max_len)
         return i + 1, cache, tokens, lengths, live, budgets, key, out
 
-    _, cache, _, lengths, live, budgets, _, out = jax.lax.while_loop(
+    _, cache, tokens, lengths, live, budgets, _, out = jax.lax.while_loop(
         cond, body,
         (jnp.int32(0), cache, tokens, lengths, live, budgets, key, out0))
-    return out, cache, lengths, live, budgets
+    return out, cache, tokens, lengths, live, budgets
 
 
 def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
@@ -429,6 +447,19 @@ class _Chunking:
     stalls: int = 0       # consecutive page-starved attempts (paged mode)
 
 
+@dataclasses.dataclass
+class _InflightRound:
+    """A dispatched-but-unconsumed decode round. Pipelined dispatch keeps
+    at most one in flight while the host detokenizes/streams/reaps/admits;
+    ``active`` snapshots the dispatch-time slot occupants so consumption
+    can mask slots that were reaped, preempted, or re-admitted while the
+    round ran (the one-round staleness contract)."""
+    out: jax.Array                      # [B, k_steps] device token buffer
+    active: list[tuple[int, "_Slot"]]
+    k_steps: int
+    gap_ms: Optional[float]             # host gap preceding this dispatch
+
+
 def _pin2(out, pin):
     """Apply the cache-sharding pin to a dispatch's returned cache (always
     the second tuple element) — keeps donated in/out layouts identical so
@@ -441,6 +472,14 @@ def _pin2(out, pin):
 #: Queue-delay histogram bucket upper bounds (seconds). Chosen to resolve
 #: both the healthy regime (sub-dispatch waits) and the overload knee.
 QUEUE_DELAY_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 5.0, 30.0)
+
+#: Host-gap histogram bucket upper bounds (seconds): the per-round wall
+#: time between the previous decode round's results landing on host and
+#: the next round entering the device queue (0 when the next round was
+#: already in flight — the pipelined steady state). Buckets resolve both
+#: the pipelined regime (sub-ms) and the unpipelined host-bound tail.
+HOST_GAP_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                    0.1, 0.5)
 
 
 class EngineMetrics:
@@ -471,6 +510,14 @@ class EngineMetrics:
         self._qd_counts = [0] * (len(QUEUE_DELAY_BUCKETS) + 1)  # +Inf tail
         self._qd_sum = 0.0
         self._qd_n = 0
+        # decode hot-loop health: host gap per round + dispatch depth
+        # (0 = every round waits on the host; 1 = one round in flight
+        # while the host works — the pipelined steady state).
+        self.dispatch_depth = 0
+        self._hg: list[float] = []
+        self._hg_counts = [0] * (len(HOST_GAP_BUCKETS) + 1)  # +Inf tail
+        self._hg_sum = 0.0
+        self._hg_n = 0
 
     def observe(self, req: Request) -> None:
         with self._lock:
@@ -515,6 +562,30 @@ class EngineMetrics:
             return (list(QUEUE_DELAY_BUCKETS), list(self._qd_counts),
                     self._qd_sum, self._qd_n)
 
+    def observe_host_gap(self, seconds: float) -> None:
+        with self._lock:
+            i = 0
+            while i < len(HOST_GAP_BUCKETS) \
+                    and seconds > HOST_GAP_BUCKETS[i]:
+                i += 1
+            self._hg_counts[i] += 1
+            self._hg_sum += seconds
+            self._hg_n += 1
+            self._hg.append(seconds)
+            self._hg = self._hg[-self._window:]
+
+    def note_dispatch_depth(self, depth: int) -> None:
+        with self._lock:
+            self.dispatch_depth = depth
+
+    def host_gap_histogram(self) -> tuple[list[float], list[int],
+                                          float, int]:
+        """(bucket upper bounds, per-bucket counts incl. +Inf tail, sum,
+        count) for ``kftpu_engine_host_gap_seconds``."""
+        with self._lock:
+            return (list(HOST_GAP_BUCKETS), list(self._hg_counts),
+                    self._hg_sum, self._hg_n)
+
     def observe_spec_round(self, drafted: int, accepted: int, emitted: int,
                            draft_s: float, verify_s: float) -> None:
         with self._lock:
@@ -539,6 +610,12 @@ class EngineMetrics:
             }
             if self._qd_n:
                 out["queue_delay_avg_ms"] = self._qd_sum / self._qd_n * 1e3
+            out["dispatch_depth"] = self.dispatch_depth
+            if self._hg_n:
+                out["host_gap_seconds"] = self._hg_sum
+                arr = np.asarray(self._hg)
+                out["host_gap_p50_ms"] = float(np.percentile(arr, 50) * 1e3)
+                out["host_gap_p99_ms"] = float(np.percentile(arr, 99) * 1e3)
             for name, xs in (("ttft", self._ttft), ("tpot", self._tpot)):
                 if xs:
                     arr = np.asarray(xs)
@@ -789,13 +866,26 @@ class LLMEngine:
                     p, c, t, tr, st, cp, cfg_prefill, context_pages=ncp,
                     valid_len=vl), self._pin),
                 static_argnums=(7,), donate_argnums=(1,))
+
+            def _paged_decode_fn(p, c, st, tbl, key, n, m, _impl=pattn):
+                # The device-resident state dict + page table ride in as
+                # donated buffers and return advanced — the scheduler never
+                # re-uploads them (serve/device_state.py).
+                cache_in = {**c, "table": tbl}
+                out, cache, tokens, lengths, live, budgets = \
+                    paged_decode_multi(
+                        p, cache_in, st["tokens"], st["lengths"],
+                        st["live"], st["temps"], st["top_k"], st["top_p"],
+                        st["stops"], st["budgets"], key, cfg_decode, n,
+                        sample_mode=m, attn_impl=_impl)
+                table = cache.pop("table")
+                st = {**st, "tokens": tokens, "lengths": lengths,
+                      "live": live, "budgets": budgets}
+                return out, self._pin(cache), st, table
+
             self._paged_decode_n = jax.jit(
-                lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m,
-                _impl=pattn:
-                _pin2(paged_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd,
-                                         k, cfg_decode, n, sample_mode=m,
-                                         attn_impl=_impl), self._pin),
-                static_argnums=(11, 12), donate_argnums=(1,))
+                _paged_decode_fn, static_argnums=(5, 6),
+                donate_argnums=(1, 2, 3))
         self._preempted: list[Request] = []
         self._backlog: list[Request] = []   # scheduler-side admission queue
         self._admit_seq = itertools.count()
@@ -806,11 +896,18 @@ class LLMEngine:
         # of traces (K/1 × greedy/plain/full) cover all traffic.
         self.decode_steps = max(1, int(b.decode_steps))
         self.prefill_interleave_steps = max(1, int(b.prefill_interleave_steps))
-        self._decode_n = jax.jit(
-            lambda p, c, t, l, lv, tp, tk, tpp, st, bd, k, n, m:
-            _pin2(_decode_multi(p, c, t, l, lv, tp, tk, tpp, st, bd, k,
-                                cfg_decode, n, sample_mode=m), self._pin),
-            static_argnums=(11, 12), donate_argnums=(1,))
+
+        def _decode_fn(p, c, st, key, n, m):
+            out, cache, tokens, lengths, live, budgets = _decode_multi(
+                p, c, st["tokens"], st["lengths"], st["live"], st["temps"],
+                st["top_k"], st["top_p"], st["stops"], st["budgets"], key,
+                cfg_decode, n, sample_mode=m)
+            st = {**st, "tokens": tokens, "lengths": lengths, "live": live,
+                  "budgets": budgets}
+            return out, self._pin(cache), st
+
+        self._decode_n = jax.jit(_decode_fn, static_argnums=(4, 5),
+                                 donate_argnums=(1, 2))
 
         # Speculative decoding (draft + batched verify; serve/spec_decode.py).
         # Greedy rounds draft k tokens per slot and verify all k+1 positions
@@ -899,6 +996,27 @@ class LLMEngine:
             self._draft_chunk = max(c, 1)
 
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots
+        # Device-resident scheduler state (serve/device_state.py): the
+        # decode dispatch's [B] carries and the paged page table live on
+        # device for the engine's lifetime; host scheduler events sync as
+        # per-slot donated scatters, so steady-state rounds upload nothing
+        # (the stats counters prove it).
+        self._dstate = DecodeState(
+            self.num_slots, mpp=self._mpp if self.paged else None)
+        # Pipelined dispatch (double buffering): dispatch round N+1 before
+        # consuming round N, keeping at most ONE unconsumed round in flight
+        # while the host detokenizes/streams/reaps/admits. Staleness is one
+        # round deep: reaps/admissions decided mid-flight take effect next
+        # round, and consumption masks slots whose occupant changed.
+        self.pipelined = bool(b.pipelined_decode)
+        self._rounds: list[_InflightRound] = []
+        # First-token sampling batched per admit round: chunked-prefill
+        # completions park here and one sampler dispatch + ONE host fetch
+        # serves them all (_sample_first_batch).
+        self._pending_first: list[tuple[Request, int, int, jax.Array]] = []
+        self._last_ready_t: Optional[float] = None
+        self.decode_rounds = 0
+        self.first_token_fetches = 0
         self.waiting: "queue.Queue[Request]" = queue.Queue()
         self.metrics = EngineMetrics()
         # Bounded admission + queue-delay budget (load shedding): see
@@ -984,7 +1102,8 @@ class LLMEngine:
 
     def _free_slot(self, extra_reserved: frozenset = frozenset()
                    ) -> Optional[int]:
-        reserved = {ch.slot for ch in self._chunkings} | extra_reserved
+        reserved = {ch.slot for ch in self._chunkings} | extra_reserved \
+            | {slot for _, slot, _, _ in self._pending_first}
         for i, s in enumerate(self.slots):
             if s is None and i not in reserved:
                 return i
@@ -996,14 +1115,49 @@ class LLMEngine:
 
     def _start_first_token(self, req: Request, slot_idx: int, plen: int,
                            last_logits: jax.Array) -> None:
-        first = self._sampler(
-            last_logits[None, :], self._next_key(),
-            jnp.asarray([req.params.temperature], jnp.float32),
-            jnp.asarray([req.params.top_k], jnp.int32),
-            jnp.asarray([req.params.top_p], jnp.float32),
-            _mode_for([req.params]))
-        self._admit_with_token(req, slot_idx, plen,
-                               int(jax.device_get(first)[0]))
+        """Park a finished prefill's first-token sampling until the end of
+        the admit pass: one stalled per-request ``device_get`` here used to
+        serialize every admission behind it — now every admission in the
+        round shares ONE sampler dispatch + ONE fetch
+        (``_flush_first_tokens``). The slot stays reserved via
+        ``_pending_first`` until the flush admits into it."""
+        self._pending_first.append((req, slot_idx, plen, last_logits))
+
+    def _flush_first_tokens(self) -> int:
+        """Sample + fetch every pending first token in one batch."""
+        if not self._pending_first:
+            return 0
+        items, self._pending_first = self._pending_first, []
+        self._sample_first_batch(items)
+        return len(items)
+
+    def _sample_first_batch(self, items,
+                            stacked: Optional[jax.Array] = None) -> None:
+        """ONE sampler dispatch + ONE host fetch for a batch of first
+        tokens, then admit each request into its slot. ``stacked`` is a
+        pre-batched [N, V] logits block (the grouped-prefill path);
+        otherwise individual rows stack here, padded to the next power of
+        two so the sampler trace set stays log-bounded."""
+        n = len(items)
+        if stacked is None:
+            width = 1
+            while width < n:
+                width *= 2
+            stacked = jnp.stack(
+                [it[3] for it in items] + [items[-1][3]] * (width - n))
+        width = stacked.shape[0]
+        params_list = [it[0].params for it in items]
+        padded = params_list + [SamplingParams()] * (width - n)
+        firsts = self._sampler(
+            stacked, self._next_key(),
+            jnp.asarray([p.temperature for p in padded], jnp.float32),
+            jnp.asarray([p.top_k for p in padded], jnp.int32),
+            jnp.asarray([p.top_p for p in padded], jnp.float32),
+            _mode_for(params_list))
+        vals = jax.device_get(firsts)
+        self.first_token_fetches += 1
+        for j, (req, slot_idx, plen, _) in enumerate(items):
+            self._admit_with_token(req, slot_idx, plen, int(vals[j]))
 
     def _admit_with_token(self, req: Request, slot_idx: int, plen: int,
                           tok: int) -> None:
@@ -1021,6 +1175,10 @@ class LLMEngine:
                                      last_token=tok,
                                      generated=len(req.output_tokens),
                                      admit_seq=next(self._admit_seq))
+        # New occupant: its device-resident decode state (and, in paged
+        # mode, its page-table row) sync as deltas at the next dispatch.
+        self._dstate.mark_slot(slot_idx)
+        self._dstate.mark_row(slot_idx)
         if self._draft_cfg is not None:
             # Fresh occupant: the draft model has consumed none of it yet
             # (the first spec round runs a catch-up prefill).
@@ -1136,6 +1294,10 @@ class LLMEngine:
             if reason:
                 self._release_slot_pages(i)
                 self.slots[i] = None
+                # Host-only decision (cancel/deadline): the device still
+                # thinks the row is live — sync live=False next dispatch;
+                # any round already in flight is masked at consume time.
+                self._dstate.mark_slot(i)
                 self._fail_request(s.request, reason)
                 n += 1
         for ch in list(self._chunkings):
@@ -1223,6 +1385,7 @@ class LLMEngine:
                 self._slot_pages[slot_idx] = list(hit)
                 self._table[slot_idx, :] = -1
                 self._table[slot_idx, :len(hit)] = hit
+                self._dstate.mark_row(slot_idx)
                 ch = _Chunking(req, slot_idx, len(hit) * self.page_size)
                 self._chunkings.append(ch)
                 n += self._advance_one(ch)
@@ -1242,6 +1405,13 @@ class LLMEngine:
             pending.append((req, slot_idx,
                             plen, self._bucket_for(plen)))
         n += self._flush_prefills(pending)
+        # Chunked-prefill completions parked by _start_first_token: one
+        # batched sampler dispatch + one fetch for the whole admit round.
+        self._flush_first_tokens()
+        if n:
+            # The device just ran prefill work — the next decode round's
+            # host-gap sample would measure admission, not the hot loop.
+            self._last_ready_t = None
         return n
 
     def _flush_prefills(self, pending) -> int:
@@ -1287,17 +1457,10 @@ class LLMEngine:
                     last_logits, self.cache = self._prefill(
                         self.params, self.cache, jnp.asarray(toks),
                         jnp.asarray(slots), jnp.asarray(plens))
-                    params_list = [g[0].params for g in group]
-                    firsts = self._sampler(
-                        last_logits, self._next_key(),
-                        jnp.asarray([p.temperature for p in params_list],
-                                    jnp.float32),
-                        jnp.asarray([p.top_k for p in params_list],
-                                    jnp.int32),
-                        jnp.asarray([p.top_p for p in params_list],
-                                    jnp.float32),
-                        _mode_for(params_list))
-                    vals = jax.device_get(firsts)
+                    self._sample_first_batch(
+                        [(req, slot_idx, plen, None)
+                         for req, slot_idx, plen, _ in group],
+                        stacked=last_logits)
                 except Exception:
                     for item in group:
                         remaining.pop(id(item), None)
@@ -1305,9 +1468,7 @@ class LLMEngine:
                     raise
                 for item in group:
                     remaining.pop(id(item), None)
-                for j, (req, slot_idx, plen, _) in enumerate(group):
-                    self._admit_with_token(req, slot_idx, plen, int(vals[j]))
-                    n += 1
+                n += len(group)
         return n
 
     def _fail_flush(self, failed_group, requeue_items) -> None:
@@ -1336,6 +1497,7 @@ class LLMEngine:
             return False
         self._table[slot_idx, have:need] = new
         self._slot_pages[slot_idx].extend(new)
+        self._dstate.mark_row(slot_idx)
         return True
 
     def _release_slot_pages(self, idx: int) -> None:
@@ -1343,6 +1505,7 @@ class LLMEngine:
             self._allocator.free(self._slot_pages[idx])
             self._slot_pages[idx] = []
             self._table[idx, :] = -1
+            self._dstate.mark_row(idx)
 
     def _preempt_slot(self, idx: int) -> None:
         """Recompute preemption (vLLM analog): release the slot's pages and
@@ -1361,6 +1524,7 @@ class LLMEngine:
         req.resumed_from = len(req.output_tokens)
         self._release_slot_pages(idx)
         self.slots[idx] = None
+        self._dstate.mark_slot(idx)
         self._preempted.append(req)
 
     def _preempt_youngest(self, keep: int) -> bool:
@@ -1399,23 +1563,61 @@ class LLMEngine:
         return True
 
     def _decode_once(self) -> int:
-        """One decode round for all active slots. Routes greedy-only rounds
-        to the speculative path when configured; sampling traffic (and
-        spec-off engines) take the plain multi-step path. Returns tokens
-        emitted."""
+        """One decode scheduler pass. Routes greedy-only rounds to the
+        speculative path when configured; sampling traffic (and spec-off
+        engines) take the pipelined plain path: dispatch round N+1 FIRST,
+        then consume round N — so the host's emit/stream work (and the
+        reap/admit of the next ``step()``) overlaps device compute.
+        Returns work done (tokens emitted + dispatches)."""
         active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
-        if not active:
-            return 0
-        if (self.spec_mode != "off"
+        if (self.spec_mode != "off" and active
                 and all(s.request.params.temperature <= 0.0
                         for _, s in active)):
-            return self._spec_decode_once(active)
-        return self._plain_decode_once(active)
+            # Spec rounds verify on host between dispatches — drain the
+            # plain pipeline first so host mirrors are current.
+            emitted = self._consume_rounds()
+            active = [(i, s) for i, s in enumerate(self.slots)
+                      if s is not None]
+            if not active:
+                return emitted
+            return emitted + self._spec_decode_once(active)
+        dispatched = False
+        if active:
+            dispatched = self._dispatch_round(active)
+        # Pipelined: leave the just-dispatched round in flight and consume
+        # only the previous one; unpipelined (and trailing) rounds drain.
+        keep = 1 if (self.pipelined and dispatched) else 0
+        emitted = 1 if dispatched else 0
+        while len(self._rounds) > keep:
+            emitted += self._consume_round()
+        return emitted
 
-    def _plain_decode_once(self, active) -> int:
-        """Up to ``decode_steps`` decode steps for all active slots in one
-        dispatch (one step while a chunked prefill interleaves, so running
-        streams still tick between chunks). Returns tokens emitted."""
+    def _slot_state_values(self, idx: int) -> tuple:
+        """Current host-side truth for one slot, in device-state scatter
+        order (serve/device_state.py STATE_FIELDS)."""
+        s = self.slots[idx]
+        if s is None:
+            return DEAD_SLOT
+        p = s.request.params
+        budget = max(p.max_new_tokens - s.generated, 0)
+        return (s.last_token, s.length, budget > 0, p.temperature, p.top_k,
+                p.top_p, -1 if p.stop_token is None else p.stop_token,
+                budget)
+
+    def _sync_decode_state(self) -> None:
+        """Flush host scheduler deltas (admissions, reaps, preemptions,
+        spec advances, page-table growth) to the device-resident state as
+        per-index donated scatters. Steady-state rounds have nothing dirty
+        and sync nothing — the zero-upload invariant."""
+        if self._dstate.dirty_slots:
+            self._dstate.sync_slots(self._slot_state_values)
+        if self.paged and self._dstate.dirty_rows:
+            self._dstate.sync_rows(lambda i: self._table[i])
+
+    def _dispatch_round(self, active) -> bool:
+        """Enqueue one multi-step decode dispatch over the device-resident
+        state (no host blocking — JAX async dispatch). Returns False when
+        paged pool pressure preempted every candidate slot."""
         # While a chunked prefill is in flight, decode still multi-steps —
         # just with a smaller K: hard-capping at 1 let concurrent paged
         # traffic (where EVERY admission chunks) pay a full dispatch
@@ -1423,6 +1625,10 @@ class LLMEngine:
         # waiting chunk's TPOT spike to K steps instead of the full K=16.
         k_steps = (min(self.decode_steps, self.prefill_interleave_steps)
                    if self._chunkings else self.decode_steps)
+        # With rounds in flight the device may already be this many steps
+        # past the host's slot lengths — page pre-allocation must cover
+        # the stale window too or a mid-dispatch write lands unmapped.
+        slack = sum(r.k_steps for r in self._rounds)
         if self.paged:
             # Pre-allocate pages covering every live slot's next k_steps
             # write positions (mid-dispatch page crossings must land on
@@ -1430,7 +1636,7 @@ class LLMEngine:
             for i, s in list(active):
                 if self.slots[i] is not s:
                     continue    # preempted by an earlier slot's allocation
-                upto = min(s.length + k_steps, self.max_len)
+                upto = min(s.length + slack + k_steps, self.max_len)
                 while not self._ensure_pages(i, upto):
                     if self._preempt_youngest(keep=i):
                         continue
@@ -1438,53 +1644,54 @@ class LLMEngine:
                     # guarantees one max-length sequence always fits, but
                     # guard the next write position anyway.
                     k_steps = 1
-                    if not self._ensure_pages(i, min(s.length + 1,
+                    if not self._ensure_pages(i, min(s.length + slack + 1,
                                                      self.max_len)):
                         self._preempt_slot(i)
                     break
             active = [(i, s) for i, s in enumerate(self.slots)
                       if s is not None]
             if not active:
-                return 0
-        nb = self.num_slots
-        tokens = np.zeros((nb,), np.int32)
-        lengths = np.zeros((nb,), np.int32)
-        live = np.zeros((nb,), bool)
-        temps = np.zeros((nb,), np.float32)
-        top_k = np.zeros((nb,), np.int32)
-        top_p = np.ones((nb,), np.float32)
-        stops = np.full((nb,), -1, np.int32)
-        budgets = np.zeros((nb,), np.int32)
-        for i, s in active:
-            p = s.request.params
-            tokens[i] = s.last_token
-            lengths[i] = s.length       # write position of last_token's KV
-            budget = max(p.max_new_tokens - s.generated, 0)
-            live[i] = budget > 0
-            temps[i] = p.temperature
-            top_k[i] = p.top_k
-            top_p[i] = p.top_p
-            stops[i] = -1 if p.stop_token is None else p.stop_token
-            budgets[i] = budget
+                return False
         mode = _mode_for([s.request.params for _, s in active])
+        self._sync_decode_state()
+        now = time.monotonic()
+        gap = None
+        if self._last_ready_t is not None:
+            # Host gap: wall time the device spent waiting on the host
+            # between rounds. 0 by construction when the next round was
+            # already queued before the previous one's results landed.
+            gap = 0.0 if self._rounds else max(0.0, now - self._last_ready_t)
+            self.metrics.observe_host_gap(gap)
+        self.metrics.note_dispatch_depth(len(self._rounds))
+        key = self._next_key()
         if self.paged:
-            cache_in = {**self.cache, "table": jnp.asarray(self._table)}
-            out, cache_out, _, _, _ = self._paged_decode_n(
-                self.params, cache_in, jnp.asarray(tokens),
-                jnp.asarray(lengths), jnp.asarray(live), jnp.asarray(temps),
-                jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(stops),
-                jnp.asarray(budgets), self._next_key(), k_steps, mode)
-            self.cache = {n: cache_out[n] for n in cache_out
-                          if n != "table"}
+            out, self.cache, st, tbl = self._paged_decode_n(
+                self.params, self.cache, self._dstate.arrays,
+                self._dstate.table, key, k_steps, mode)
+            self._dstate.adopt(st, tbl)
         else:
-            out, self.cache, _, _, _ = self._decode_n(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(lengths), jnp.asarray(live), jnp.asarray(temps),
-                jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(stops),
-                jnp.asarray(budgets), self._next_key(), k_steps, mode)
-        out = np.asarray(jax.device_get(out))
+            out, self.cache, st = self._decode_n(
+                self.params, self.cache, self._dstate.arrays, key, k_steps,
+                mode)
+            self._dstate.adopt(st)
+        self.decode_rounds += 1
+        self._rounds.append(_InflightRound(
+            out=out, active=list(active), k_steps=k_steps,
+            gap_ms=None if gap is None else gap * 1e3))
+        return True
+
+    def _consume_round(self) -> int:
+        """Fetch and emit the oldest in-flight round's tokens. Slots whose
+        occupant changed while the round ran (reaped, preempted,
+        re-admitted) are MASKED — a cancelled request's output stream never
+        contains post-cancel tokens. Returns tokens emitted."""
+        rnd = self._rounds.pop(0)
+        out = np.asarray(jax.device_get(rnd.out))
+        self._last_ready_t = time.monotonic()
         emitted = 0
-        for i, s in active:
+        for i, s in rnd.active:
+            if self.slots[i] is not s or s.request.done.is_set():
+                continue
             n_emit = 0
             for t in out[i]:
                 if t < 0:
@@ -1501,10 +1708,31 @@ class LLMEngine:
                 # Round annotation as a span EVENT: one decode round is one
                 # device dispatch shared by every slot — a span per round
                 # per request would out-cost what it measures.
-                s.request.span.add_event("decode_round", tokens=n_emit,
-                                         steps=k_steps)
+                if rnd.gap_ms is None:
+                    s.request.span.add_event("decode_round", tokens=n_emit,
+                                             steps=rnd.k_steps)
+                else:
+                    s.request.span.add_event("decode_round", tokens=n_emit,
+                                             steps=rnd.k_steps,
+                                             host_gap_ms=round(rnd.gap_ms,
+                                                               3))
             self._finish_if_done(i)
         return emitted
+
+    def _consume_rounds(self) -> int:
+        """Drain every in-flight round (the pipeline barrier the spec path
+        and quiescence paths use)."""
+        emitted = 0
+        while self._rounds:
+            emitted += self._consume_round()
+        return emitted
+
+    def _plain_decode_once(self, active) -> int:
+        """Dispatch + consume one plain round synchronously — the
+        speculative path's fallback lane (spec rounds are host-verified,
+        so there is never a pipeline to overlap with here)."""
+        self._dispatch_round(active)
+        return self._consume_rounds()
 
     # -- speculative decoding --------------------------------------------------
 
@@ -1578,11 +1806,18 @@ class LLMEngine:
             lengths[i] = s.length
             live[i] = True
         if self.paged:
-            cache_in = {**self.cache, "table": jnp.asarray(self._table)}
+            # The verify dispatch shares the device-resident page table
+            # with the plain path: dirty rows sync as deltas, the table
+            # itself is donated through and adopted back — never a full
+            # host upload. (The [B, T] token matrix is inherently host
+            # data — the drafts were proposed there.)
+            self._sync_decode_state()
+            cache_in = {**self.cache, "table": self._dstate.table}
             greedy, cache_out = self._verify(
                 self.params, cache_in, jnp.asarray(tokens),
                 jnp.asarray(lengths), jnp.asarray(live))
             self.cache = {n: cache_out[n] for n in cache_out if n != "table"}
+            self._dstate.adopt(self._dstate.arrays, cache_out["table"])
         else:
             greedy, self.cache = self._verify(
                 self.params, self.cache, jnp.asarray(tokens),
@@ -1616,6 +1851,9 @@ class LLMEngine:
             s.length += len(emit)
             s.generated += len(emit)
             emitted += len(emit)
+            # Spec rounds advance the slot host-side only — the device
+            # decode state is stale until the next plain-path sync.
+            self._dstate.mark_slot(i)
             self.metrics.observe_spec_round(
                 drafted=len(d), accepted=min(a, len(emit)),
                 emitted=len(emit),
@@ -1698,12 +1936,20 @@ class LLMEngine:
         drop = pages[keep:]
         self._slot_pages[idx] = pages[:keep]
         self._table[idx, keep:len(pages)] = -1
+        self._dstate.mark_row(idx)
         self._allocator.free(drop)
 
     def step(self) -> int:
         """One scheduler iteration: reap dead requests, admit, decode.
-        Returns work done (reaps count — a freed slot is admissible work)."""
-        return self._reap_abandoned() + self._admit() + self._decode_once()
+        Returns work done (reaps count — a freed slot is admissible work;
+        a dispatched round counts too, so the loop never idles with a
+        round in flight)."""
+        n = self._reap_abandoned() + self._admit() + self._decode_once()
+        if n == 0:
+            # Idle: the next round's host-gap sample would span the idle
+            # wait, not the hot loop.
+            self._last_ready_t = None
+        return n
 
     # -- background loop -------------------------------------------------------
 

@@ -338,7 +338,10 @@ def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,
     """Up to ``num_steps`` decode+sample steps in ONE dispatch over the page
     pool (≈ engine._decode_multi; the host pre-allocates pages covering
     ``lengths + num_steps`` so mid-dispatch page-boundary crossings always
-    land on mapped pages)."""
+    land on mapped pages — with pipelined dispatch the engine adds one
+    in-flight round of slack on top). Returns (out, cache, tokens, lengths,
+    live, budgets): the advanced carry is the next round's input, kept
+    device-resident by the engine (serve/device_state.py)."""
     from kubeflow_tpu.serve.engine import _sample_batch
 
     b = tokens.shape[0]
@@ -366,10 +369,10 @@ def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,
             & (lengths + 1 < max_len)
         return i + 1, cache, tokens, lengths, live, budgets, key, out
 
-    _, cache, _, lengths, live, budgets, _, out = jax.lax.while_loop(
+    _, cache, tokens, lengths, live, budgets, _, out = jax.lax.while_loop(
         cond, body,
         (jnp.int32(0), cache, tokens, lengths, live, budgets, key, out0))
-    return out, cache, lengths, live, budgets
+    return out, cache, tokens, lengths, live, budgets
 
 
 def context_bucket(pos: int, chunk: int, page_size: int, mpp: int) -> int:

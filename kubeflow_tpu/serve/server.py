@@ -41,7 +41,8 @@ from kubeflow_tpu.obs.trace import (
     TRACE_HEADER, debug_traces_payload, get_tracer,
 )
 from kubeflow_tpu.serve.engine import (
-    EngineOverloaded, LLMEngine, QUEUE_DELAY_BUCKETS, Request, SamplingParams,
+    EngineOverloaded, HOST_GAP_BUCKETS, LLMEngine, QUEUE_DELAY_BUCKETS,
+    Request, SamplingParams,
 )
 from kubeflow_tpu.serve.router import DEADLINE_HEADER, quiet_handle_error
 from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
@@ -308,6 +309,13 @@ class ModelServer:
         expired = reg.counter("kftpu_serving_requests_expired_total")
         qdelay = reg.histogram("kftpu_serving_queue_delay_seconds",
                                QUEUE_DELAY_BUCKETS)
+        # Decode hot-loop health (pipelined dispatch): per-round host gap
+        # + how many rounds ride in flight. A pipelined engine shows
+        # near-zero gaps and depth 1; gaps growing toward the round time
+        # mean the host (detokenize/stream/admit) is the bottleneck again.
+        host_gap = reg.histogram("kftpu_engine_host_gap_seconds",
+                                 HOST_GAP_BUCKETS)
+        depth = reg.gauge("kftpu_engine_dispatch_depth")
         for name, engine in engines:
             snap = engine.metrics.snapshot()
             requests_total.inc(snap["requests_completed"], model=name)
@@ -315,7 +323,8 @@ class ModelServer:
             for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
                       "requests_per_sec", "tokens_per_sec",
                       "spec_acceptance_rate", "spec_tokens_per_step",
-                      "spec_draft_overhead"):
+                      "spec_draft_overhead", "host_gap_p50_ms",
+                      "host_gap_p99_ms"):
                 if k in snap:
                     reg.gauge(f"kftpu_serving_{k}").set(snap[k], model=name)
             # Load-shedding / lifecycle surface: queue depth, shed and reap
@@ -327,6 +336,9 @@ class ModelServer:
             expired.inc(snap["requests_expired"], model=name)
             _, counts, qsum, qn = engine.metrics.queue_delay_histogram()
             qdelay.set_cumulative(counts, qsum, qn, model=name)
+            _, hcounts, hsum, hn = engine.metrics.host_gap_histogram()
+            host_gap.set_cumulative(hcounts, hsum, hn, model=name)
+            depth.set(snap.get("dispatch_depth", 0), model=name)
         return reg
 
     def metrics_text(self) -> str:

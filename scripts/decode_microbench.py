@@ -27,29 +27,35 @@ def main():
                                       prefill_buckets=[512]))
     nb = eng.num_slots
 
-    tokens = jnp.zeros((nb,), jnp.int32)
-    lengths = jnp.full((nb,), 600, jnp.int32)
-    live = jnp.ones((nb,), bool)
-    temps = jnp.zeros((nb,), jnp.float32)
-    tk = jnp.zeros((nb,), jnp.int32)
-    tp = jnp.ones((nb,), jnp.float32)
-    stops = jnp.full((nb,), -1, jnp.int32)
     key = jax.random.PRNGKey(0)
 
+    def fresh_state():
+        # The engine's device-resident state shape (serve/device_state.py):
+        # the dispatch donates and returns it, so the loop below re-feeds
+        # the advanced carry exactly like the hot loop does.
+        return {
+            "tokens": jnp.zeros((nb,), jnp.int32),
+            "lengths": jnp.full((nb,), 600, jnp.int32),
+            "live": jnp.ones((nb,), bool),
+            "temps": jnp.zeros((nb,), jnp.float32),
+            "top_k": jnp.zeros((nb,), jnp.int32),
+            "top_p": jnp.ones((nb,), jnp.float32),
+            "stops": jnp.full((nb,), -1, jnp.int32),
+            "budgets": jnp.full((nb,), 10**6, jnp.int32),
+        }
+
     for k_steps in (1, 8, 16, 32):
-        budgets = jnp.full((nb,), 10**6, jnp.int32)
+        state = fresh_state()
         # compile
-        out, eng.cache, _, _, _ = eng._decode_n(
-            eng.params, eng.cache, tokens, lengths, live, temps, tk, tp,
-            stops, budgets, key, k_steps, "greedy")
+        out, eng.cache, state = eng._decode_n(
+            eng.params, eng.cache, state, key, k_steps, "greedy")
         _ = out.block_until_ready()
         _ = int(jax.device_get(out)[0, 0])  # fence
         reps = 6
         t0 = time.perf_counter()
         for _ in range(reps):
-            out, eng.cache, _, _, _ = eng._decode_n(
-                eng.params, eng.cache, tokens, lengths, live, temps, tk, tp,
-                stops, budgets, key, k_steps, "greedy")
+            out, eng.cache, state = eng._decode_n(
+                eng.params, eng.cache, state, key, k_steps, "greedy")
             _ = int(jax.device_get(out)[0, 0])  # fence via host fetch
         dt = (time.perf_counter() - t0) / reps
         print(json.dumps({

@@ -147,9 +147,15 @@ def main() -> int:
         evs = chrome.get("traceEvents", [])
         if not evs:
             problems.append("chrome export is empty")
-        if any(not {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
-               for e in evs):
-            problems.append("chrome export has malformed events")
+        # Complete ("X") events carry a duration; instant ("i") events —
+        # span events such as per-round decode_round markers — carry a
+        # scope instead (Chrome trace-event format).
+        for e in evs:
+            need = {"name", "ph", "ts", "pid", "tid"}
+            need |= {"dur"} if e.get("ph") == "X" else {"s"}
+            if not need <= set(e):
+                problems.append("chrome export has malformed events")
+                break
 
         open_spans = get_tracer().open_spans()
         verdict["open_spans"] = open_spans

@@ -51,5 +51,15 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 obs_rc=${PIPESTATUS[0]}
 grep -q '"obs_smoke": "ok"' /tmp/_smoke_obs.json || obs_rc=1
 
-echo "== smoke: tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc =="
-[ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ]
+echo "== hotloop smoke (pipelined dispatch on/off A/B, CPU) =="
+# Hot-loop gate: greedy token identity with pipelining on/off (dense +
+# paged), zero full scheduler-state uploads past engine construction, a
+# well-formed host_gap_ms attribute on decode spans, and the hot-loop
+# /metrics series. Correctness + plumbing only — no perf assertion on CPU.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/hotloop_smoke.py | tee /tmp/_smoke_hotloop.json
+hotloop_rc=${PIPESTATUS[0]}
+grep -q '"hotloop_smoke": "ok"' /tmp/_smoke_hotloop.json || hotloop_rc=1
+
+echo "== smoke: tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc =="
+[ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ]

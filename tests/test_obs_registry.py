@@ -132,6 +132,12 @@ SEED_SERVING_SERIES = {
     "kftpu_serving_queue_delay_seconds_bucket",
     "kftpu_serving_queue_delay_seconds_sum",
     "kftpu_serving_queue_delay_seconds_count",
+    # Decode hot-loop health (ISSUE 4): per-round host gap + pipeline
+    # depth, exposed per engine through the same registry path.
+    "kftpu_engine_host_gap_seconds_bucket",
+    "kftpu_engine_host_gap_seconds_sum",
+    "kftpu_engine_host_gap_seconds_count",
+    "kftpu_engine_dispatch_depth",
 }
 
 

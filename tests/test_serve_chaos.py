@@ -22,7 +22,7 @@ import jax
 from kubeflow_tpu.core.serving import BatchingSpec
 from kubeflow_tpu.models.config import preset
 from kubeflow_tpu.models.decoder import init_decoder_params
-from kubeflow_tpu.serve.engine import LLMEngine
+from kubeflow_tpu.serve.engine import LLMEngine, SamplingParams
 from kubeflow_tpu.serve.faults import ChaosProxy, kill_model_server
 from kubeflow_tpu.serve.router import DEADLINE_HEADER, Router
 from kubeflow_tpu.serve.server import ModelServer
@@ -40,7 +40,11 @@ def stack():
             cfg,
             BatchingSpec(max_batch_size=2, max_seq_len=96,
                          prefill_buckets=[32], paged=True, page_size=16,
-                         chunked_prefill_tokens=16, decode_steps=4),
+                         chunked_prefill_tokens=16, decode_steps=4,
+                         # Explicit: every scenario here runs with a decode
+                         # round potentially in flight (ISSUE 4) — the
+                         # quiescence audits below must hold regardless.
+                         pipelined_decode=True),
             params=params)
         srv = ModelServer(name, eng, port=0)
         srv.start()
@@ -131,6 +135,11 @@ def audit_quiescent(*servers, deadline_s: float = 20.0) -> None:
             assert time.monotonic() < deadline, \
                 f"{srv.name}: KV pages leaked after scenario"
         eng._allocator.assert_quiescent()
+        # Pipelined dispatch: the reap path must also have drained any
+        # decode round left in flight by the scenario.
+        while eng._rounds:
+            eng.step()
+        assert not eng._rounds, f"{srv.name}: in-flight round stranded"
 
 
 def test_chaos_5xx_burst_ejects_then_recovers(stack):
@@ -208,6 +217,41 @@ def test_chaos_scale_down_under_load_drains_cleanly(stack):
     assert all(s == 200 for s in fire(router.url, 4, timeout_s=10.0))
     router.set_backends({"latest": [a.url, b.url]})
     audit_quiescent(a, b)
+
+
+def test_chaos_halt_with_round_in_flight_reaps_clean():
+    """ISSUE 4: the scheduler halting between dispatch and consume — the
+    worst spot a SIGKILL can land with pipelined dispatch — must leave a
+    state the recovery audit can still balance: the stranded in-flight
+    round drains, cancelled requests mask their late tokens, and every
+    paged-KV refcount returns to zero."""
+    cfg = preset("tiny", vocab_size=512)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+    eng = LLMEngine(
+        cfg,
+        BatchingSpec(max_batch_size=2, max_seq_len=96, prefill_buckets=[32],
+                     paged=True, page_size=16, chunked_prefill_tokens=16,
+                     decode_steps=4, pipelined_decode=True),
+        params=params)
+    reqs = [eng.submit([i + 1] * 20, SamplingParams(max_new_tokens=60))
+            for i in range(2)]
+    for _ in range(3):
+        eng.step()
+    assert eng._rounds, "pipelining should have a round in flight here"
+    emitted_at_halt = [len(r.output_tokens) for r in reqs]
+    # SIGKILL analog: the loop never consumes that round. Recovery cancels
+    # the stranded requests and drives step() like a supervisor would.
+    for r in reqs:
+        r.cancel()
+    deadline = time.monotonic() + 20.0
+    while eng.kv_pages_in_use() > 0 or eng._rounds:
+        eng.step()
+        assert time.monotonic() < deadline, "recovery did not quiesce"
+    eng._allocator.assert_quiescent()
+    assert all(r.done.is_set() and r.finish_reason == "cancelled"
+               for r in reqs)
+    # The stranded round's results never leaked into cancelled streams.
+    assert [len(r.output_tokens) for r in reqs] == emitted_at_halt
 
 
 def test_chaos_zz_replica_kill_mid_traffic(stack):

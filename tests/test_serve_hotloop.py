@@ -1,0 +1,331 @@
+"""Decode hot-loop host-overhead elimination (ISSUE 4): device-resident
+scheduler state + pipelined double-buffered dispatch.
+
+Contracts pinned here:
+- greedy outputs are TOKEN-IDENTICAL with pipelining on and off, across
+  dense, paged, and speculative engines (the pipeline must be invisible to
+  outputs — only latency moves);
+- steady-state decode rounds perform ZERO full-array host→device uploads
+  of scheduler state (counter-asserted: the device_state stats stay at
+  their construction values while rounds accumulate, and per-slot syncs
+  stay flat across decode-only rounds);
+- the one-round staleness contract is bounded: a cancellation decided
+  while a round is in flight masks that round's results — output streams
+  never contain post-cancel tokens — and paged-KV refcounts balance;
+- first-token sampling batches per admit round (one fetch for N
+  admissions, chunked and grouped alike);
+- EngineMetrics surfaces host_gap/dispatch_depth and the model server
+  exposes them on /metrics.
+"""
+
+import time
+
+import pytest
+import jax
+
+from kubeflow_tpu.core.serving import BatchingSpec, SpeculativeSpec
+from kubeflow_tpu.models.config import preset
+from kubeflow_tpu.models.decoder import init_decoder_params
+from kubeflow_tpu.serve.engine import LLMEngine, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return preset("tiny", vocab_size=512)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_decoder_params(jax.random.PRNGKey(0), cfg)
+
+
+PROMPTS = [[5, 17, 3, 99, 42], list(range(1, 50)), [7] * 20,
+           [9, 8, 7, 6, 5, 4]]
+
+
+def make_engine(cfg, params, *, pipelined, paged=False, spec=None,
+                chunk=32, decode_steps=4, slots=4):
+    return LLMEngine(cfg, BatchingSpec(
+        max_batch_size=slots, max_seq_len=128, prefill_buckets=[16, 64],
+        chunked_prefill_tokens=chunk, paged=paged, page_size=16,
+        decode_steps=decode_steps, pipelined_decode=pipelined,
+        speculative=spec or SpeculativeSpec()), params=params)
+
+
+def run_all(eng, reqs, max_steps=1200):
+    for _ in range(max_steps):
+        eng.step()
+        if all(r.done.is_set() for r in reqs):
+            return
+    raise AssertionError("requests did not finish")
+
+
+def gen_all(eng, prompts, max_new=12):
+    sp = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+    reqs = [eng.submit(list(p), sp) for p in prompts]
+    run_all(eng, reqs)
+    return [list(r.output_tokens) for r in reqs]
+
+
+class TestTokenIdentity:
+    """Pipelining on vs off must be invisible to greedy outputs on every
+    engine flavor (the acceptance-criteria core)."""
+
+    @pytest.fixture(scope="class")
+    def want(self, cfg, params):
+        return gen_all(make_engine(cfg, params, pipelined=False), PROMPTS)
+
+    def test_dense(self, cfg, params, want):
+        eng = make_engine(cfg, params, pipelined=True)
+        assert gen_all(eng, PROMPTS) == want
+        assert eng.decode_rounds > 0
+
+    def test_paged(self, cfg, params, want):
+        off = make_engine(cfg, params, pipelined=False, paged=True)
+        on = make_engine(cfg, params, pipelined=True, paged=True)
+        assert gen_all(off, PROMPTS) == want
+        assert gen_all(on, PROMPTS) == want
+        assert on.kv_pages_in_use() == 0
+
+    def test_spec_ngram(self, cfg, params, want):
+        spec = SpeculativeSpec(mode="ngram", k=4)
+        off = make_engine(cfg, params, pipelined=False, spec=spec)
+        on = make_engine(cfg, params, pipelined=True, spec=spec)
+        assert gen_all(off, PROMPTS) == want
+        assert gen_all(on, PROMPTS) == want
+
+    def test_spec_paged(self, cfg, params, want):
+        spec = SpeculativeSpec(mode="ngram", k=4)
+        eng = make_engine(cfg, params, pipelined=True, paged=True,
+                          spec=spec)
+        assert gen_all(eng, PROMPTS) == want
+        assert eng.kv_pages_in_use() == 0
+
+    def test_staggered_admissions(self, cfg, params):
+        """Requests joining while rounds are in flight (the one-round-late
+        admission path) still decode exactly."""
+        def staggered(eng):
+            sp = SamplingParams(max_new_tokens=10, temperature=0.0)
+            reqs = [eng.submit(list(PROMPTS[0]), sp),
+                    eng.submit(list(PROMPTS[1]), sp)]
+            for _ in range(2):
+                eng.step()
+            reqs += [eng.submit(list(PROMPTS[2]), sp),
+                     eng.submit(list(PROMPTS[3]), sp)]
+            run_all(eng, reqs)
+            return [list(r.output_tokens) for r in reqs]
+
+        out_off = staggered(make_engine(cfg, params, pipelined=False))
+        out_on = staggered(make_engine(cfg, params, pipelined=True))
+        assert out_on == out_off
+
+
+class TestDeviceResidentState:
+    """Tentpole (a): the scheduler state uploads ONCE, at construction;
+    everything after is per-slot deltas — and decode-only rounds sync
+    nothing at all."""
+
+    def test_full_uploads_stay_at_construction(self, cfg, params):
+        for paged in (False, True):
+            eng = make_engine(cfg, params, pipelined=True, paged=paged)
+            gen_all(eng, PROMPTS)
+            rounds1 = eng.decode_rounds
+            stats1 = dict(eng._dstate.stats)
+            assert rounds1 > 0
+            assert stats1["full_state_uploads"] == 1
+            assert stats1["full_table_uploads"] == (1 if paged else 0)
+            gen_all(eng, PROMPTS)
+            stats2 = eng._dstate.stats
+            assert eng.decode_rounds > rounds1
+            assert stats2["full_state_uploads"] == 1
+            assert stats2["full_table_uploads"] == (1 if paged else 0)
+
+    def test_steady_state_rounds_sync_nothing(self, cfg, params):
+        """Mid-generation decode rounds (no admissions, no reaps) must not
+        scatter any slot state — the device carry is authoritative."""
+        eng = make_engine(cfg, params, pipelined=True, decode_steps=2)
+        req = eng.submit([3, 1, 4], SamplingParams(max_new_tokens=40))
+        for _ in range(4):
+            eng.step()          # admit + enter steady decode
+        assert not req.done.is_set()
+        syncs_before = eng._dstate.stats["slot_syncs"]
+        rounds_before = eng.decode_rounds
+        for _ in range(5):
+            eng.step()
+        assert not req.done.is_set()
+        assert eng.decode_rounds > rounds_before
+        assert eng._dstate.stats["slot_syncs"] == syncs_before
+        run_all(eng, [req])
+
+    def test_paged_growth_is_row_deltas(self, cfg, params):
+        """Page-table growth mid-decode costs row scatters, never a full
+        table upload."""
+        eng = make_engine(cfg, params, pipelined=True, paged=True,
+                          decode_steps=4)
+        gen_all(eng, [[2, 3, 4]], max_new=60)   # grows across pages
+        stats = eng._dstate.stats
+        assert stats["full_table_uploads"] == 1
+        assert stats["table_row_syncs"] > 0
+
+
+class TestPipelinedCancellation:
+    """The staleness contract's hard edge: results of a round dispatched
+    before the cancel must never reach the stream."""
+
+    def _drain_stream(self, req):
+        toks = []
+        while True:
+            t = req.stream.get(timeout=5)
+            if t is None:
+                return toks
+            toks.append(t)
+
+    @pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+    def test_cancel_mid_flight_emits_nothing_after(self, cfg, params,
+                                                   paged):
+        eng = make_engine(cfg, params, pipelined=True, paged=paged,
+                          decode_steps=4)
+        req = eng.submit([4, 5, 6, 7], SamplingParams(max_new_tokens=100))
+        for _ in range(3):
+            eng.step()          # a round is now in flight past the cancel
+        assert not req.done.is_set()
+        assert eng._rounds, "pipelining should keep a round in flight"
+        emitted_at_cancel = len(req.output_tokens)
+        req.cancel()
+        for _ in range(6):
+            eng.step()
+        assert req.done.is_set()
+        assert req.finish_reason == "cancelled"
+        assert len(req.output_tokens) == emitted_at_cancel, \
+            "post-cancel tokens leaked into the output"
+        streamed = self._drain_stream(req)
+        assert streamed == req.output_tokens
+        if paged:
+            assert eng.kv_pages_in_use() == 0
+            eng._allocator.assert_quiescent()
+
+    def test_deadline_mid_flight_frees_pages(self, cfg, params):
+        eng = make_engine(cfg, params, pipelined=True, paged=True,
+                          decode_steps=4)
+        req = eng.submit([9, 9, 9], SamplingParams(max_new_tokens=100),
+                         deadline=time.monotonic() + 0.03)
+        eng.step()
+        time.sleep(0.05)
+        for _ in range(8):
+            eng.step()
+        assert req.done.is_set() and req.finish_reason == "deadline"
+        assert eng.kv_pages_in_use() == 0
+        eng._allocator.assert_quiescent()
+
+    def test_slot_reuse_after_mid_flight_cancel_is_clean(self, cfg, params):
+        """A slot freed by a mid-flight cancel and immediately re-admitted
+        must serve the newcomer untainted (its in-flight garbage KV is
+        overwritten before ever being attended)."""
+        want = gen_all(make_engine(cfg, params, pipelined=False),
+                       [[11, 12, 13]], max_new=10)[0]
+        eng = make_engine(cfg, params, pipelined=True, slots=1,
+                          decode_steps=4)
+        victim = eng.submit([4, 5, 6, 7], SamplingParams(max_new_tokens=80))
+        for _ in range(3):
+            eng.step()
+        victim.cancel()
+        fresh = eng.submit([11, 12, 13], SamplingParams(max_new_tokens=10))
+        run_all(eng, [victim, fresh])
+        assert victim.finish_reason == "cancelled"
+        assert list(fresh.output_tokens) == want
+
+
+class TestFirstTokenBatching:
+    """Satellite: first-token fetches batch per admit round — one sampler
+    dispatch + one device_get for every admission in the pass."""
+
+    def test_chunked_completions_share_one_fetch(self, cfg, params):
+        eng = make_engine(cfg, params, pipelined=True, chunk=16, slots=4)
+        eng.max_concurrent_prefills = 3
+        sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+        # Three same-length long prompts chunk in lockstep and complete in
+        # the same admit pass.
+        reqs = [eng.submit([i + 1] * 33, sp) for i in range(3)]
+        before = eng.first_token_fetches
+        while not all(r.first_token_time is not None for r in reqs):
+            eng.step()
+        assert eng.first_token_fetches == before + 1
+        run_all(eng, reqs)
+
+    def test_grouped_prefill_shares_one_fetch(self, cfg, params):
+        eng = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=8, max_seq_len=64, prefill_buckets=[8],
+            prefill_batch_max=4, decode_steps=4), params=params)
+        sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+        reqs = [eng.submit([i + 1, i + 2, i + 3], sp) for i in range(4)]
+        before = eng.first_token_fetches
+        eng.step()
+        assert all(r.first_token_time is not None for r in reqs)
+        assert eng.first_token_fetches == before + 1
+        run_all(eng, reqs)
+
+    def test_batched_first_tokens_match_reference(self, cfg, params):
+        """The batched sampler path must not perturb greedy outputs."""
+        want = gen_all(make_engine(cfg, params, pipelined=False),
+                       PROMPTS, max_new=6)
+        eng = make_engine(cfg, params, pipelined=True, chunk=16)
+        assert gen_all(eng, PROMPTS, max_new=6) == want
+
+
+class TestHotLoopMetrics:
+    """Satellite: host_gap + dispatch_depth in EngineMetrics.snapshot()
+    and on /metrics through the PR 3 registry."""
+
+    def test_snapshot_has_host_gap_and_depth(self, cfg, params):
+        for pipelined, want_depth in ((False, 0), (True, 1)):
+            eng = make_engine(cfg, params, pipelined=pipelined)
+            gen_all(eng, [[2] * 6], max_new=30)
+            snap = eng.metrics.snapshot()
+            assert snap["dispatch_depth"] == want_depth
+            assert "host_gap_seconds" in snap
+            assert snap["host_gap_p50_ms"] >= 0.0
+            assert snap["host_gap_p99_ms"] >= snap["host_gap_p50_ms"]
+            buckets, counts, total, n = eng.metrics.host_gap_histogram()
+            assert n > 0 and sum(counts) == n
+            assert total >= 0.0
+            if pipelined:
+                # Steady-state pipelined rounds have zero host gap by
+                # construction — the distribution must reflect it.
+                assert snap["host_gap_p50_ms"] == 0.0
+
+    def test_metrics_endpoint_series(self, cfg, params):
+        from kubeflow_tpu.obs.registry import parse_exposition
+        from kubeflow_tpu.serve.server import ModelServer
+
+        eng = make_engine(cfg, params, pipelined=True)
+        gen_all(eng, [[2] * 6], max_new=20)
+        srv = ModelServer("hotloop", eng, port=0)
+        try:
+            names = {n for n, _, _ in parse_exposition(srv.metrics_text())}
+        finally:
+            srv.httpd.server_close()
+        for need in ("kftpu_engine_host_gap_seconds_bucket",
+                     "kftpu_engine_host_gap_seconds_sum",
+                     "kftpu_engine_host_gap_seconds_count",
+                     "kftpu_engine_dispatch_depth"):
+            assert need in names, f"missing {need}"
+
+    def test_decode_span_host_gap_attribute(self, cfg, params):
+        from kubeflow_tpu.obs.trace import get_tracer
+
+        tracer = get_tracer()
+        eng = make_engine(cfg, params, pipelined=True)
+        sp = SamplingParams(max_new_tokens=40, temperature=0.0)
+        with tracer.span("test.root") as root:
+            req = eng.submit([3, 1, 4], sp, trace_parent=root)
+            run_all(eng, [req])
+        tr = tracer.trace(root.trace_id)
+        gaps = []
+        for s in tr["spans"]:
+            if s["name"] != "engine.decode":
+                continue
+            for ev in s.get("events", []):
+                if ev["name"] == "decode_round" and "host_gap_ms" in ev:
+                    gaps.append(ev["host_gap_ms"])
+        assert gaps, "no decode_round event carried host_gap_ms"
+        assert all(isinstance(g, float) and g >= 0.0 for g in gaps)

@@ -204,9 +204,13 @@ def test_generate_timeout_cancels_orphan(engine):
     mid-engine — cancel() lets the scheduler free its slot and pages."""
     engine.start()
     try:
+        # 2 ms: far below even a fully-warmed engine's 48-token run (the
+        # pipelined hot loop finishes 48 tokens in ~17 ms on CPU — the old
+        # 20 ms bound stopped timing out once decode stopped blocking on
+        # per-round host fetches).
         with pytest.raises(TimeoutError):
             engine.generate([2] * 8, SamplingParams(max_new_tokens=48),
-                            timeout=0.02)
+                            timeout=0.002)
         deadline = time.monotonic() + 10
         while engine.kv_pages_in_use() > 0:
             assert time.monotonic() < deadline, \
