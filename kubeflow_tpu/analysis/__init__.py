@@ -1,0 +1,12 @@
+"""``kubeflow_tpu.analysis`` — the ``kftpu lint`` static analyzer.
+
+See ``core.py`` for the framework (walker, annotation grammar, baseline),
+``rules_device.py`` for the device-hygiene family (D1xx),
+``rules_concurrency.py`` for the lock-discipline family (C3xx), and
+``rules_metrics.py`` for the metric-name rules (M2xx).
+"""
+
+from kubeflow_tpu.analysis.core import (  # noqa: F401
+    Baseline, Finding, LintResult, Module, Rule, all_rules, find_baseline,
+    lint_source, main, run_lint,
+)

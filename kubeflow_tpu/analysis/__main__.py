@@ -1,0 +1,8 @@
+"""``python -m kubeflow_tpu.analysis`` — same as ``kftpu lint``."""
+
+import sys
+
+from kubeflow_tpu.analysis.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

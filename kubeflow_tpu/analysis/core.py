@@ -1,0 +1,475 @@
+"""``kftpu lint`` — codebase-aware static analysis for the platform.
+
+The last three PRs each burned a debugging session on a defect class a
+machine can catch from the AST alone: an implicit per-round host→device
+upload hiding in the decode hot loop (the PR-4 ``jnp.asarray(self._table)``
+bug), control-plane state mutated cross-thread without its lock (chaos
+tests catch that only probabilistically), and metric-name hygiene enforced
+only at render time. This package is the machine: an AST walker, a rule
+registry, and two rule families tuned to how THIS codebase is written —
+device hygiene over the serving/ops/parallel hot paths, lock discipline
+over the threaded control plane — plus the metric-name rules ported from
+``obs/registry.lint()`` to definition sites.
+
+Annotation grammar (comments; same line as the construct or the line
+directly above):
+
+- ``# guarded_by: <lock_attr>`` — on an attribute's ``__init__``
+  assignment: every mutation of the attribute outside ``__init__`` must
+  hold ``self.<lock_attr>`` (lexically under ``with self.<lock_attr>`` /
+  a Condition built from it, or in a method that declares the lock held).
+- ``# lockfree: <reason>`` — on an attribute's ``__init__`` assignment:
+  deliberately unsynchronized (thread-confined, delegated, GIL-atomic);
+  the reason is required and shows up in ``--list-annotations`` audits.
+- ``# requires_lock: <lock_attr>`` — on a ``def``: callers hold the lock;
+  the body counts as guarded. Methods named ``*_locked`` get this
+  implicitly (the codebase's existing convention).
+- ``# hot-loop`` — on a ``def``: the function is on the decode/dispatch
+  hot path; blocking host syncs and full-buffer uploads are findings.
+- ``# traced`` — on a ``def``: the body is compiled under ``jax.jit``
+  (used where the jit wrapping happens in another module); host syncs
+  inside are findings.
+- ``# sync-point: <reason>`` — on a line inside a hot-loop function: this
+  host sync is the designed one (e.g. the pipelined consume fetch).
+- ``# lint: disable=D101[,C301...]`` — suppress specific rules on this
+  line.
+
+Baseline: a checked-in JSON file (default ``.kftpu-lint-baseline.json``,
+discovered upward from the scanned paths) holding fingerprints of known
+pre-existing findings with a one-line justification each, so legacy debt
+does not block CI while new findings still fail it. Fingerprints are
+line-number-free (rule | path | enclosing symbol | message), so unrelated
+edits don't invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from collections import Counter
+from typing import Iterable, Optional
+
+# -- findings ------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # e.g. "D103"
+    name: str          # e.g. "full-buffer-reupload"
+    path: str          # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    symbol: str = ""   # enclosing Class.method qualname (baseline key part)
+
+    @property
+    def fingerprint(self) -> str:
+        # Deliberately line-free: the baseline must survive unrelated edits.
+        return f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.name}] {self.message}")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# -- annotations ---------------------------------------------------------------
+
+_ANNOT_RES = {
+    "guarded_by": re.compile(r"#\s*guarded_by:\s*([A-Za-z_]\w*)"),
+    "lockfree": re.compile(r"#\s*lockfree:\s*(\S.*)"),
+    "requires_lock": re.compile(r"#\s*requires_lock:\s*([A-Za-z_]\w*)"),
+    "hot_loop": re.compile(r"#\s*hot-loop\b"),
+    "traced": re.compile(r"#\s*traced\b"),
+    "sync_point": re.compile(r"#\s*sync-point:\s*(\S.*)"),
+}
+_DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)")
+
+
+class Module:
+    """One parsed source file: AST with parent links, comment map, import
+    aliases, and the annotation lookups every rule shares."""
+
+    def __init__(self, relpath: str, text: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.tree = ast.parse(text)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._parent = node  # type: ignore[attr-defined]
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+        self.aliases = self._build_aliases()
+
+    # -- imports / names ---------------------------------------------------
+
+    def _build_aliases(self) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Dotted, alias-expanded name of a Name/Attribute chain
+        (``np.asarray`` → ``numpy.asarray``), or None for anything
+        dynamic."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            base = self.aliases.get(node.id, node.id)
+            return ".".join([base] + list(reversed(parts)))
+        return None
+
+    # -- annotations -------------------------------------------------------
+
+    def _lines_for(self, node: ast.AST) -> Iterable[int]:
+        line = getattr(node, "lineno", None)
+        if line is None:
+            return ()
+        end = getattr(node, "end_lineno", line) or line
+        return range(line - 1, end + 1)
+
+    def annotation(self, node: ast.AST, name: str) -> Optional[str]:
+        """Value of annotation ``name`` attached to ``node`` (its line
+        span or the line directly above), else None. Marker annotations
+        (hot-loop/traced) return "" when present."""
+        regex = _ANNOT_RES[name]
+        for ln in self._lines_for(node):
+            m = regex.search(self.comments.get(ln, ""))
+            if m:
+                return m.group(1).strip() if m.groups() else ""
+        return None
+
+    def line_annotation(self, line: int, name: str) -> Optional[str]:
+        m = _ANNOT_RES[name].search(self.comments.get(line, ""))
+        if m:
+            return m.group(1).strip() if m.groups() else ""
+        return None
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for ln in (line, line - 1):
+            m = _DISABLE_RE.search(self.comments.get(ln, ""))
+            if m and rule in {r.strip() for r in m.group(1).split(",")}:
+                return True
+        return False
+
+    # -- structure ---------------------------------------------------------
+
+    def symbol_for(self, node: ast.AST) -> str:
+        parts: list[str] = []
+        cur = getattr(node, "_parent", None)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            parts.append(node.name)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = getattr(cur, "_parent", None)
+        return ".".join(reversed(parts))
+
+    def enclosing_function(self, node: ast.AST):
+        cur = getattr(node, "_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = getattr(cur, "_parent", None)
+        return None
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str,
+                symbol: Optional[str] = None) -> Finding:
+        return Finding(rule=rule.id, name=rule.name, path=self.relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message,
+                       symbol=symbol if symbol is not None
+                       else self.symbol_for(node))
+
+
+# -- rule registry -------------------------------------------------------------
+
+
+class Rule:
+    id: str = ""
+    name: str = ""
+    doc: str = ""
+
+    def check(self, mod: Module) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_RULES: list[Rule] = []
+
+
+def register(cls: type) -> type:
+    _RULES.append(cls())
+    return cls
+
+
+def all_rules() -> list[Rule]:
+    _load_rules()
+    return list(_RULES)
+
+
+_loaded = False
+
+
+def _load_rules() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from kubeflow_tpu.analysis import (  # noqa: F401  (registration import)
+        rules_concurrency, rules_device, rules_metrics,
+    )
+
+
+# -- baseline ------------------------------------------------------------------
+
+
+class Baseline:
+    """Checked-in known-findings file: each entry a line-free fingerprint
+    plus a one-line justification. Matching is multiset-aware (the same
+    fingerprint may legitimately occur N times)."""
+
+    def __init__(self, entries: Optional[list[dict]] = None,
+                 path: Optional[str] = None):
+        self.path = path
+        self.entries = entries or []
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            doc = json.load(f)
+        return cls(doc.get("entries", []), path=path)
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding],
+                      reason: str = "baselined pre-existing debt"
+                      ) -> "Baseline":
+        return cls([{"fingerprint": f.fingerprint, "reason": reason}
+                    for f in findings])
+
+    def save(self, path: str) -> None:
+        doc = {"version": 1,
+               "entries": sorted(self.entries,
+                                 key=lambda e: e["fingerprint"])}
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def split(self, findings: list[Finding]
+              ) -> tuple[list[Finding], list[Finding]]:
+        """(new, baselined)."""
+        budget = Counter(e["fingerprint"] for e in self.entries)
+        new, matched = [], []
+        for f in findings:
+            if budget.get(f.fingerprint, 0) > 0:
+                budget[f.fingerprint] -= 1
+                matched.append(f)
+            else:
+                new.append(f)
+        return new, matched
+
+
+BASELINE_FILENAME = ".kftpu-lint-baseline.json"
+
+
+def find_baseline(paths: list[str]) -> Optional[str]:
+    """Walk upward from the scanned paths (then the cwd) looking for the
+    checked-in baseline file."""
+    starts = [os.path.abspath(p) for p in paths] + [os.getcwd()]
+    for start in starts:
+        cur = start if os.path.isdir(start) else os.path.dirname(start)
+        while True:
+            cand = os.path.join(cur, BASELINE_FILENAME)
+            if os.path.isfile(cand):
+                return cand
+            parent = os.path.dirname(cur)
+            if parent == cur:
+                break
+            cur = parent
+    return None
+
+
+# -- running -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    new: list[Finding]
+    baselined: list[Finding]
+    errors: list[Finding]
+    files_scanned: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.errors
+
+
+def iter_py_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__" and not d.startswith("."))
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return out
+
+
+def lint_module(mod: Module, rules: Optional[list[Rule]] = None
+                ) -> list[Finding]:
+    """All non-suppressed findings for one parsed module."""
+    findings: list[Finding] = []
+    for rule in rules if rules is not None else all_rules():
+        for f in rule.check(mod):
+            if not mod.suppressed(f.line, f.rule):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_source(text: str, relpath: str = "<memory>.py",
+                rules: Optional[list[Rule]] = None) -> list[Finding]:
+    """Test/embedding entry point: lint one source string."""
+    return lint_module(Module(relpath, text), rules=rules)
+
+
+class _ParseError(Rule):
+    id = "E000"
+    name = "parse-error"
+
+
+_PARSE_ERROR = _ParseError()
+
+
+def run_lint(paths: list[str], baseline: Optional[Baseline] = None,
+             root: Optional[str] = None) -> LintResult:
+    """Lint every .py under ``paths``. Finding paths are relative to
+    ``root`` (default: cwd), matching how the baseline was recorded."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: list[Finding] = []
+    errors: list[Finding] = []
+    files = iter_py_files(paths)
+    for path in files:
+        rel = os.path.relpath(os.path.abspath(path), root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            mod = Module(rel, text)
+        except (SyntaxError, ValueError, UnicodeDecodeError) as exc:
+            errors.append(Finding(
+                rule="E000", name="parse-error",
+                path=rel.replace(os.sep, "/"),
+                line=getattr(exc, "lineno", 0) or 0, col=1,
+                message=f"cannot parse: {exc}"))
+            continue
+        findings.extend(lint_module(mod))
+    if baseline is not None:
+        new, matched = baseline.split(findings)
+    else:
+        new, matched = findings, []
+    return LintResult(new=new, baselined=matched, errors=errors,
+                      files_scanned=len(files))
+
+
+# -- CLI -----------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kftpu lint",
+        description="codebase-aware static analysis (device hygiene + "
+                    "lock discipline + metric naming)")
+    p.add_argument("paths", nargs="*", default=["kubeflow_tpu"],
+                   help="files or directories to scan")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: nearest "
+                        f"{BASELINE_FILENAME})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to the current findings "
+                        "(each entry still needs a hand-written reason)")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings matched by the baseline")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in sorted(all_rules(), key=lambda r: r.id):
+            print(f"{rule.id}  {rule.name:28} {rule.doc}")
+        return 0
+    paths = args.paths or ["kubeflow_tpu"]
+    baseline: Optional[Baseline] = None
+    baseline_path = args.baseline
+    if not args.no_baseline and not args.update_baseline:
+        if baseline_path is None:
+            baseline_path = find_baseline(paths)
+        if baseline_path is not None and os.path.isfile(baseline_path):
+            baseline = Baseline.load(baseline_path)
+    result = run_lint(paths, baseline=baseline)
+    if args.update_baseline:
+        target = args.baseline or find_baseline(paths) or BASELINE_FILENAME
+        Baseline.from_findings(result.new,
+                               reason="baselined by --update-baseline; "
+                                      "replace with a real justification"
+                               ).save(target)
+        print(f"wrote {len(result.new)} entries to {target}")
+        return 0
+    if args.as_json:
+        print(json.dumps({
+            "files_scanned": result.files_scanned,
+            "findings": [f.to_json() for f in result.new],
+            "baselined": [f.to_json() for f in result.baselined],
+            "errors": [f.to_json() for f in result.errors],
+            "ok": result.ok,
+        }, indent=2))
+    else:
+        for f in result.errors + result.new:
+            print(f.render())
+        if args.show_baselined:
+            for f in result.baselined:
+                print(f"{f.render()}  (baselined)")
+        tail = (f"{result.files_scanned} files, "
+                f"{len(result.new)} finding(s), "
+                f"{len(result.baselined)} baselined")
+        if baseline is not None and baseline.path:
+            tail += f" ({os.path.basename(baseline.path)})"
+        print(tail)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

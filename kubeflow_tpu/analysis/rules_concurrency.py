@@ -1,0 +1,458 @@
+"""Family B — lock-discipline / concurrency rules (TSan-style, static).
+
+The platform's control plane is threads all the way down: the engine
+scheduler thread, the router's per-request handler threads, controller
+event/worker pairs, the ISVC autoscaler. PR 2's chaos harness catches
+unlocked shared mutation only probabilistically; these rules catch it from
+the AST:
+
+- C301 ``unlocked-shared-mutation``: per class, infer the lock attributes
+  (``threading.Lock``/``RLock``/``Condition`` assigned in ``__init__``)
+  and the thread entry points (``Thread(target=self.m)``, executor
+  ``submit(self.m)``); flag attributes mutated without a lock held from a
+  thread-reachable method while also being accessed from the public
+  surface. The ``# guarded_by: <lock>`` annotation turns an attribute
+  into a checked contract (every mutation must hold that lock);
+  ``# lockfree: <reason>`` documents deliberate confinement and closes
+  the false positive. Methods named ``*_locked`` or annotated
+  ``# requires_lock: <lock>`` count as holding the lock (callers do).
+- C302 ``blocking-call-under-lock``: ``time.sleep``, socket/HTTP I/O,
+  ``subprocess``, ``Thread.join`` or ``Event.wait`` while a lock is held
+  (``Condition.wait`` is exempt — it releases the lock).
+- C303 ``swallowed-exception``: a bare/broad ``except`` whose body
+  neither re-raises nor calls anything (no logging, no status update) —
+  the controller-killing silent failure.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional
+
+from kubeflow_tpu.analysis.core import Finding, Module, Rule, register
+
+_LOCK_TYPES = {"threading.Lock", "threading.RLock"}
+_COND_TYPES = {"threading.Condition"}
+_EXEMPT_TYPES = {
+    # objects that own their synchronization (or are immutable-ish)
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.local", "queue.Queue", "queue.SimpleQueue",
+    "queue.LifoQueue", "queue.PriorityQueue", "itertools.count",
+    "contextvars.ContextVar", "collections.OrderedDict",
+}
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "popitem",
+    "clear", "add", "discard", "update", "setdefault", "sort", "reverse",
+}
+_BLOCKING_CALLS = {
+    "time.sleep", "urllib.request.urlopen", "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "http.client.HTTPConnection", "requests.get", "requests.post",
+    "requests.request",
+}
+
+
+def _self_attr_name(node: ast.AST) -> Optional[str]:
+    """'X' for a plain ``self.X`` expression."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    method: str
+    node: ast.AST
+    write: bool
+    locks_held: frozenset  # lock attr names lexically held at the site
+
+
+class _ClassModel:
+    """Everything C301/C302 need to know about one class."""
+
+    def __init__(self, mod: Module, cls: ast.ClassDef):
+        self.mod = mod
+        self.cls = cls
+        self.methods: dict[str, ast.FunctionDef] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[stmt.name] = stmt
+        self.lock_attrs: set[str] = set()
+        self.cond_to_lock: dict[str, str] = {}
+        self.exempt_attrs: set[str] = set()
+        self.container_attrs: set[str] = set()
+        self.attr_guarded_by: dict[str, str] = {}
+        self.attr_lockfree: set[str] = set()
+        self.attr_init_node: dict[str, ast.AST] = {}
+        self._scan_init()
+        self.thread_entries = self._find_thread_entries()
+        self.calls = {name: self._self_calls(fn)
+                      for name, fn in self.methods.items()}
+        self.thread_reachable = self._closure(self.thread_entries)
+        public = {n for n in self.methods
+                  if not n.startswith("_") and n != "__init__"}
+        self.public_reachable = self._closure(public)
+        self.accesses: list[_Access] = []
+        for name, fn in self.methods.items():
+            if name == "__init__":
+                continue
+            self._collect_accesses(name, fn)
+
+    # -- __init__ scan -----------------------------------------------------
+
+    def _scan_init(self) -> None:
+        init = self.methods.get("__init__")
+        if init is None:
+            return
+        for stmt in ast.walk(init):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            for t in targets:
+                attr = _self_attr_name(t)
+                if attr is None:
+                    continue
+                self.attr_init_node.setdefault(attr, stmt)
+                gb = self.mod.annotation(stmt, "guarded_by")
+                if gb:
+                    self.attr_guarded_by[attr] = gb
+                if self.mod.annotation(stmt, "lockfree") is not None:
+                    self.attr_lockfree.add(attr)
+                if isinstance(value, ast.Call):
+                    qn = self.mod.qualname(value.func)
+                    if qn in _LOCK_TYPES:
+                        self.lock_attrs.add(attr)
+                    elif qn in _COND_TYPES:
+                        self.lock_attrs.add(attr)
+                        if value.args:
+                            inner = _self_attr_name(value.args[0])
+                            if inner:
+                                self.cond_to_lock[attr] = inner
+                    elif qn in _EXEMPT_TYPES:
+                        self.exempt_attrs.add(attr)
+                    elif qn in ("list", "dict", "set", "collections.deque"):
+                        self.container_attrs.add(attr)
+                elif isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                        ast.ListComp, ast.DictComp,
+                                        ast.SetComp)):
+                    self.container_attrs.add(attr)
+
+    # -- thread entries / call graph ---------------------------------------
+
+    def _find_thread_entries(self) -> set[str]:
+        entries: set[str] = set()
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                qn = self.mod.qualname(node.func)
+                if qn in ("threading.Thread", "threading.Timer"):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            m = _self_attr_name(kw.value)
+                            if m and m in self.methods:
+                                entries.add(m)
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "submit" and node.args:
+                    m = _self_attr_name(node.args[0])
+                    if m and m in self.methods:
+                        entries.add(m)
+        return entries
+
+    def _self_calls(self, fn: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                m = _self_attr_name(node.func)
+                if m and m in self.methods:
+                    out.add(m)
+            elif isinstance(node, ast.Attribute):
+                # bound-method references (callbacks) count as calls
+                m = _self_attr_name(node)
+                if m and m in self.methods:
+                    out.add(m)
+        return out
+
+    def _closure(self, roots: set[str]) -> set[str]:
+        seen = set()
+        stack = [r for r in roots if r in self.methods]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.calls.get(cur, ()))
+        return seen
+
+    # -- lock-held tracking ------------------------------------------------
+
+    def _canonical_lock(self, attr: str) -> str:
+        return self.cond_to_lock.get(attr, attr)
+
+    def _method_locks(self, name: str, fn: ast.AST) -> frozenset:
+        """Locks the method body holds throughout (caller-held)."""
+        held: set[str] = set()
+        ann = self.mod.annotation(fn, "requires_lock")
+        if ann:
+            held.add(self._canonical_lock(ann))
+        elif name.endswith("_locked") and self.lock_attrs:
+            # codebase convention: *_locked methods run under the class's
+            # (sole) lock; with several locks the annotation is required
+            held.update(self._canonical_lock(a) for a in self.lock_attrs)
+        return frozenset(held)
+
+    def _collect_accesses(self, method: str, fn: ast.FunctionDef) -> None:
+        base = self._method_locks(method, fn)
+
+        def visit(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, ast.With):
+                extra = set()
+                for item in node.items:
+                    # Liberal here (vs C302): ANY `with self.X:` counts as
+                    # acquiring X — the lock may be inherited from a base
+                    # class this module model cannot see (e.g. Metric's
+                    # _lock under Histogram), and presuming a guard only
+                    # ever silences C301, never invents a finding.
+                    a = _self_attr_name(item.context_expr)
+                    if a:
+                        extra.add(self._canonical_lock(a))
+                inner = frozenset(held | extra)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return      # nested defs analyzed separately (if methods)
+            self._record(node, method, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, base)
+
+    def _record(self, node: ast.AST, method: str, held: frozenset) -> None:
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr, sub = self._target_attr(t)
+                if attr:
+                    self.accesses.append(
+                        _Access(attr, method, node, True, held))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr, _ = self._target_attr(t)
+                if attr:
+                    self.accesses.append(
+                        _Access(attr, method, node, True, held))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS:
+            attr = _self_attr_name(node.func.value)
+            if attr and attr in self.container_attrs:
+                self.accesses.append(
+                    _Access(attr, method, node, True, held))
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load):
+            attr = _self_attr_name(node)
+            if attr:
+                self.accesses.append(
+                    _Access(attr, method, node, False, held))
+
+    @staticmethod
+    def _target_attr(t: ast.AST) -> tuple[Optional[str], bool]:
+        """('X', is_subscript) for targets ``self.X`` / ``self.X[...]``;
+        tuple targets are handled by the caller walking elements."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                attr, sub = _ClassModel._target_attr(e)
+                if attr:
+                    return attr, sub
+            return None, False
+        if isinstance(t, ast.Subscript):
+            return _self_attr_name(t.value), True
+        a = _self_attr_name(t)
+        return a, False
+
+
+def class_models(mod: Module) -> list[_ClassModel]:
+    return [_ClassModel(mod, node) for node in ast.walk(mod.tree)
+            if isinstance(node, ast.ClassDef)]
+
+
+@register
+class UnlockedSharedMutation(Rule):
+    id = "C301"
+    name = "unlocked-shared-mutation"
+    doc = ("class attribute mutated without its lock while shared across "
+           "threads; annotate '# guarded_by: <lock>' or "
+           "'# lockfree: <reason>' on the __init__ assignment")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for cm in class_models(mod):
+            yield from self._check_class(mod, cm)
+
+    def _check_class(self, mod: Module, cm: _ClassModel
+                     ) -> Iterable[Finding]:
+        cls = cm.cls.name
+        by_attr: dict[str, list[_Access]] = {}
+        for acc in cm.accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+        for attr, accs in sorted(by_attr.items()):
+            if attr in cm.lock_attrs or attr in cm.exempt_attrs:
+                continue
+            if attr in cm.attr_lockfree:
+                continue
+            writes = [a for a in accs if a.write]
+            if not writes:
+                continue
+            guard = cm.attr_guarded_by.get(attr)
+            if guard is not None:
+                lock = cm.cond_to_lock.get(guard, guard)
+                for a in writes:
+                    if lock not in a.locks_held:
+                        yield mod.finding(
+                            self, a.node,
+                            f"'{cls}.{attr}' is declared "
+                            f"'# guarded_by: {guard}' but is mutated in "
+                            f"'{a.method}' without holding "
+                            f"'self.{guard}'",
+                            symbol=f"{cls}.{attr}")
+                continue
+            # inference mode: needs real threads + cross-surface sharing
+            if not cm.thread_entries:
+                continue
+            t_writes = [a for a in writes
+                        if a.method in cm.thread_reachable
+                        and not a.locks_held]
+            if not t_writes:
+                continue
+            p_access = [a for a in accs
+                        if a.method in cm.public_reachable
+                        and not a.locks_held]
+            if not p_access:
+                continue
+            a = t_writes[0]
+            other = next((x.method for x in p_access
+                          if x.method != a.method), p_access[0].method)
+            yield mod.finding(
+                self, a.node,
+                f"'{cls}.{attr}' is mutated in thread-reachable "
+                f"'{a.method}' without a lock and also accessed from "
+                f"the public surface ('{other}'); lock it or annotate "
+                "'# guarded_by:'/'# lockfree:' on its __init__ "
+                "assignment",
+                symbol=f"{cls}.{attr}")
+
+
+@register
+class BlockingCallUnderLock(Rule):
+    id = "C302"
+    name = "blocking-call-under-lock"
+    doc = ("sleep / network / subprocess / join / Event.wait while "
+           "holding a lock")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for cm in class_models(mod):
+            if not cm.lock_attrs:
+                continue
+            for name, fn in cm.methods.items():
+                yield from self._check_method(mod, cm, name, fn)
+
+    def _check_method(self, mod: Module, cm: _ClassModel, name: str,
+                      fn: ast.FunctionDef) -> Iterable[Finding]:
+        base = cm._method_locks(name, fn)
+
+        def visit(node: ast.AST, held: frozenset) -> Iterable[Finding]:
+            if isinstance(node, ast.With):
+                extra = set()
+                for item in node.items:
+                    a = _self_attr_name(item.context_expr)
+                    if a and a in cm.lock_attrs:
+                        extra.add(cm._canonical_lock(a))
+                inner = frozenset(held | extra)
+                for child in node.body:
+                    yield from visit(child, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return
+            if held and isinstance(node, ast.Call):
+                hit = self._blocking(mod, cm, node)
+                if hit:
+                    yield mod.finding(
+                        self, node,
+                        f"{hit} while holding "
+                        f"{sorted('self.' + h for h in held)}; blocking "
+                        "under a lock stalls every other thread on it")
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+        for stmt in fn.body:
+            yield from visit(stmt, base)
+
+    @staticmethod
+    def _blocking(mod: Module, cm: _ClassModel,
+                  node: ast.Call) -> Optional[str]:
+        qn = mod.qualname(node.func)
+        if qn in _BLOCKING_CALLS:
+            return f"'{qn}'"
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            recv = _self_attr_name(node.func.value)
+            if meth == "join" and recv is not None \
+                    and ("thread" in recv.lower() or "proc" in recv.lower()):
+                return f"'self.{recv}.join()'"
+            if meth == "wait" and recv is not None \
+                    and recv in cm.exempt_attrs \
+                    and recv not in cm.cond_to_lock \
+                    and recv not in cm.lock_attrs:
+                # Event/Semaphore wait (Condition.wait releases the lock
+                # and lives in lock_attrs, so it never reaches here)
+                return f"'self.{recv}.wait()'"
+        return None
+
+
+@register
+class SwallowedException(Rule):
+    id = "C303"
+    name = "swallowed-exception"
+    doc = ("bare/broad except whose body neither re-raises nor calls "
+           "anything (no logging, no status update)")
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(mod, node):
+                continue
+            has_raise = any(isinstance(n, ast.Raise)
+                            for n in ast.walk(node))
+            has_call = any(isinstance(n, ast.Call)
+                           for n in ast.walk(node))
+            if has_raise or has_call:
+                continue
+            label = "bare 'except:'" if node.type is None else \
+                f"'except {ast.unparse(node.type)}:'"
+            yield mod.finding(
+                self, node,
+                f"{label} silently swallows the error (no re-raise, no "
+                "log, no status update); narrow it or log before "
+                "continuing")
+
+    def _is_broad(self, mod: Module, node: ast.ExceptHandler) -> bool:
+        if node.type is None:
+            return True
+        types = node.type.elts if isinstance(node.type, ast.Tuple) \
+            else [node.type]
+        for t in types:
+            qn = mod.qualname(t) or ""
+            if qn.split(".")[-1] in self._BROAD:
+                return True
+        return False

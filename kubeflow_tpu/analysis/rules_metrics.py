@@ -1,0 +1,110 @@
+"""Metric-definition-site rules — ``obs/registry.lint()`` made static.
+
+The runtime registry already refuses duplicate families and the obs smoke
+stage lints the ``kftpu_`` prefix at render time; these rules move both
+checks to the definition site so a bad metric name fails ``kftpu lint``
+instead of the first scrape:
+
+- M201 ``metric-name``: a literal name passed to ``.counter()`` /
+  ``.gauge()`` / ``.histogram()`` (or a ``Counter``/``Gauge``/
+  ``Histogram`` constructor imported from ``obs.registry``) must carry
+  the ``kftpu_`` prefix and match the exposition grammar. f-strings are
+  checked on their literal head.
+- M202 ``duplicate-metric``: the same literal name registered twice in
+  one function (two families with one name — the registry would raise at
+  runtime; the lint catches it before).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from kubeflow_tpu.analysis.core import Finding, Module, Rule, register
+
+_PREFIX = "kftpu_"
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_REG_CLASSES = {
+    "kubeflow_tpu.obs.registry.Counter",
+    "kubeflow_tpu.obs.registry.Gauge",
+    "kubeflow_tpu.obs.registry.Histogram",
+}
+
+
+def _literal_name(node: ast.AST) -> tuple[Optional[str], bool]:
+    """(name, exact): the literal metric name, and whether it is complete
+    (False for f-strings, where only the head is known)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value, False
+        return None, False
+    return None, True
+
+
+def _definition_sites(mod: Module) -> Iterable[tuple[ast.Call, str, bool]]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        is_site = False
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _REG_METHODS:
+            is_site = True
+        elif mod.qualname(node.func) in _REG_CLASSES:
+            is_site = True
+        if not is_site:
+            continue
+        name, exact = _literal_name(node.args[0])
+        if name is None:
+            continue
+        yield node, name, exact
+
+
+@register
+class MetricName(Rule):
+    id = "M201"
+    name = "metric-name"
+    doc = (f"metric family name must carry the '{_PREFIX}' prefix and "
+           "match the exposition grammar (obs/registry.lint(), static)")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for node, name, exact in _definition_sites(mod):
+            if not name.startswith(_PREFIX):
+                yield mod.finding(
+                    self, node,
+                    f"metric name {name!r} is missing the platform "
+                    f"prefix {_PREFIX!r}")
+            elif exact and not _NAME_RE.match(name):
+                yield mod.finding(
+                    self, node,
+                    f"metric name {name!r} is not a valid exposition "
+                    "metric name")
+
+
+@register
+class DuplicateMetric(Rule):
+    id = "M202"
+    name = "duplicate-metric"
+    doc = ("the same literal metric name registered twice in one "
+           "function (duplicate family — the registry raises at scrape "
+           "time; fail at lint time instead)")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        per_fn: dict[int, dict[str, ast.Call]] = {}
+        for node, name, exact in _definition_sites(mod):
+            if not exact:
+                continue
+            fn = mod.enclosing_function(node)
+            key = id(fn) if fn is not None else 0
+            seen = per_fn.setdefault(key, {})
+            if name in seen:
+                yield mod.finding(
+                    self, node,
+                    f"metric name {name!r} registered twice in the same "
+                    "function; two families cannot share a name")
+            else:
+                seen[name] = node

@@ -9,7 +9,7 @@ reporting, not a web app). Two modes:
   wait for the workloads to finish, print the outcome. No server needed.
 
 Commands: server, apply, get, describe, delete, logs, events, metrics,
-run, exec (run a cell in a Notebook session).
+run, exec (run a cell in a Notebook session), lint (static analysis).
 """
 
 from __future__ import annotations
@@ -449,6 +449,14 @@ def build_parser() -> argparse.ArgumentParser:
     common(sp)
     sp.set_defaults(fn=cmd_exec)
 
+    # NOTE: "lint" is dispatched in main() before this parser runs (its
+    # flags are the analyzer's own); listed here only so --help shows it.
+    sub.add_parser(
+        "lint",
+        help="static analysis: device-hygiene + lock-discipline + "
+             "metric-name rules (kubeflow_tpu/analysis; see "
+             "'kftpu lint --help')")
+
     sp = sub.add_parser("run", help="one-shot: apply manifests and wait")
     sp.add_argument("-f", "--file", required=True)
     sp.add_argument("--timeout", type=float, default=600.0)
@@ -462,6 +470,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[list[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv[:1] == ["lint"]:
+        # The analyzer owns its flag set (paths, --json, --baseline, ...);
+        # forwarding through argparse REMAINDER mangles leading options.
+        from kubeflow_tpu.analysis.core import main as lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -75,7 +75,7 @@ class Metric:
         self.name = name
         self.help = help
         self._lock = threading.Lock()
-        self._samples: dict[tuple, float] = {}
+        self._samples: dict[tuple, float] = {}  # guarded_by: _lock
 
     @staticmethod
     def _key(labels: dict) -> tuple:
@@ -130,9 +130,9 @@ class Histogram(Metric):
         if self.buckets != sorted(self.buckets):
             raise ValueError(f"{name}: buckets must be sorted")
         # label key -> {"counts": [per-bucket + +Inf], "sum": s, "n": n}
-        self._hists: dict[tuple, dict] = {}
+        self._hists: dict[tuple, dict] = {}     # guarded_by: _lock
 
-    def _hist(self, labels: dict) -> dict:
+    def _hist(self, labels: dict) -> dict:  # requires_lock: _lock
         key = self._key(labels)
         h = self._hists.get(key)
         if h is None:
@@ -184,7 +184,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict[str, Metric] = {}
+        self._metrics: dict[str, Metric] = {}   # guarded_by: _lock
 
     def register(self, metric: Metric) -> Metric:
         with self._lock:

@@ -56,8 +56,8 @@ class _WorkQueue:
     def __init__(self):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._pending: dict[str, None] = {}       # ordered set of ready keys
-        self._delayed: list[tuple[float, int, str]] = []  # (due, seq, key) heap
+        self._pending: dict[str, None] = {}       # guarded_by: _cv
+        self._delayed: list[tuple[float, int, str]] = []  # guarded_by: _cv
         self._seq = itertools.count()
 
     def add(self, key: str) -> None:
@@ -126,6 +126,10 @@ class Controller:
         self.reconciler = reconciler
         self.name = name or type(reconciler).__name__
         self.queue = _WorkQueue()
+        # Only the event loop replaces the watch; stop() sets _stop first
+        # and Watch.close() is idempotent, so its cross-thread close is
+        # safe by construction.
+        # lockfree: event-loop owned; stop's close is idempotent
         self._watch: Watch = store.watch(kinds=list(reconciler.kinds),
                                          namespace=namespace)
         self._namespace = namespace

@@ -10,10 +10,13 @@ and detects the local (sim or real) environment as a one-slice cluster.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Optional
 
 from pydantic import BaseModel, ConfigDict, Field
+
+logger = logging.getLogger("kubeflow_tpu.runtime")
 
 
 class ChipGeneration(BaseModel):
@@ -100,6 +103,11 @@ def detect_local_cluster(num_chips: Optional[int] = None, generation: Optional[s
             plat = jax.devices()[0].platform
             generation = generation or ("cpu" if plat == "cpu" else "sim")
         except Exception:
+            # Backend probe failure must not kill cluster detection, but a
+            # silent 1-chip fallback turned out impossible to diagnose —
+            # log what happened before degrading.
+            logger.exception(
+                "jax backend probe failed; assuming a 1-chip sim cluster")
             num_chips = 1
             generation = generation or "sim"
     generation = generation or "sim"

@@ -127,20 +127,29 @@ class DecodeState:
 
     # -- delta sync (immediately before a dispatch that reads the state) ---
 
-    def sync_slots(self, values_for: Callable[[int], tuple]) -> None:
+    def sync_slots(self, values_for: Callable[[int], tuple]) -> None:  # hot-loop
         """Scatter every dirty slot's current host-side values.
         ``values_for(idx)`` returns the STATE_FIELDS tuple (DEAD_SLOT for a
-        freed slot)."""
+        freed slot). Scalars upload via EXPLICIT ``jax.device_put`` so the
+        sync stays legal under ``jax.transfer_guard("disallow")`` (the
+        KFTPU_SANITIZE runtime guard, and the steady-state guard the
+        hot-loop tests apply): every intended transfer is explicit and
+        accounted; an implicit one anywhere is a regression. (In this
+        jax, ``jnp.asarray`` of a *scalar* still counts as implicit —
+        only ``device_put`` is unconditionally explicit.)"""
+        put = jax.device_put
         for idx in sorted(self.dirty_slots):
             tok, length, live, temp, tk, tp, stop, budget = values_for(idx)
             self.arrays = self._scatter(
-                self.arrays, np.int32(idx), np.int32(tok), np.int32(length),
-                np.bool_(live), np.float32(temp), np.int32(tk),
-                np.float32(tp), np.int32(stop), np.int32(budget))
+                self.arrays, put(np.int32(idx)),
+                put(np.int32(tok)), put(np.int32(length)),
+                put(np.bool_(live)), put(np.float32(temp)),
+                put(np.int32(tk)), put(np.float32(tp)),
+                put(np.int32(stop)), put(np.int32(budget)))
             self.stats["slot_syncs"] += 1
         self.dirty_slots.clear()
 
-    def sync_rows(self, row_for: Callable[[int], np.ndarray]) -> None:
+    def sync_rows(self, row_for: Callable[[int], np.ndarray]) -> None:  # hot-loop
         """Scatter every dirty page-table row (one ``[mpp]`` upload each —
         page-table GROWTH costs one row, never the full table)."""
         if self.table is None:
@@ -148,8 +157,9 @@ class DecodeState:
             return
         for idx in sorted(self.dirty_rows):
             self.table = self._row_set(
-                self.table, np.int32(idx),
-                np.ascontiguousarray(row_for(idx), np.int32))
+                self.table, jax.device_put(np.int32(idx)),
+                jax.device_put(np.ascontiguousarray(row_for(idx),
+                                                    np.int32)))
             self.stats["table_row_syncs"] += 1
         self.dirty_rows.clear()
 

@@ -53,9 +53,11 @@ Design, driven by XLA's compilation model rather than CUDA streams:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import logging
+import os
 import queue
 import threading
 import time
@@ -107,7 +109,7 @@ def _mode_for(params_list) -> str:
     return "full"
 
 
-def _sample_batch(logits: jax.Array, key: jax.Array, temps: jax.Array,
+def _sample_batch(logits: jax.Array, key: jax.Array, temps: jax.Array,  # traced
                   top_k: jax.Array, top_p: jax.Array,
                   mode: str = "full") -> jax.Array:
     """[B, V] logits -> [B] token ids with PER-SLOT sampling params.
@@ -149,7 +151,7 @@ def _sample_batch(logits: jax.Array, key: jax.Array, temps: jax.Array,
 
 # -- device-side steps ---------------------------------------------------------
 
-def _decode_attention(q, ck, cv, lengths, cfg: DecoderConfig):
+def _decode_attention(q, ck, cv, lengths, cfg: DecoderConfig):  # traced
     """One-token attention over slot caches.
 
     q [B,1,H,Dh]; ck/cv [B,Smax,KV,Dh]; lengths [B] = position of the token
@@ -169,7 +171,7 @@ def _decode_attention(q, ck, cv, lengths, cfg: DecoderConfig):
     return out.reshape(b, 1, cfg.n_heads, cfg.head_dim)
 
 
-def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg):
+def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg):  # traced
     """One transformer block for a [B,1] decode step against slot caches.
     Returns (x, new_k_cache, new_v_cache)."""
     dt = cfg.activation_dtype
@@ -197,7 +199,7 @@ def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg):
     return x + mlp_out, ck, cv
 
 
-def _decode_step(params: Params, cache: dict, tokens: jax.Array,
+def _decode_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                  lengths: jax.Array, live: jax.Array, cfg: DecoderConfig):
     """tokens [B] (last sampled), lengths [B] (their positions), live [B]
     (rows whose KV write is real). Returns (logits [B,V] fp32, new cache)."""
@@ -224,7 +226,7 @@ def _decode_step(params: Params, cache: dict, tokens: jax.Array,
     return logits, {"k": nk, "v": nv}
 
 
-def _decode_multi(params: Params, cache: dict, tokens: jax.Array,
+def _decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
                   lengths: jax.Array, live: jax.Array, temps: jax.Array,
                   top_k: jax.Array, top_p: jax.Array, stop_tokens: jax.Array,
                   budgets: jax.Array, key: jax.Array, cfg: DecoderConfig,
@@ -278,7 +280,7 @@ def _decode_multi(params: Params, cache: dict, tokens: jax.Array,
     return out, cache, tokens, lengths, live, budgets
 
 
-def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
+def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                         slot: jax.Array, start: jax.Array,
                         cfg: DecoderConfig,
                         valid_len: Optional[jax.Array] = None):
@@ -302,7 +304,7 @@ def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,
     return logits[0], {"k": nk, "v": nv}
 
 
-def _prefill_step(params: Params, cache: dict, tokens: jax.Array,
+def _prefill_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                   slots: jax.Array, lengths: jax.Array,
                   cfg: DecoderConfig, attn_impl: str = "xla",
                   mesh: Optional[Mesh] = None):
@@ -490,34 +492,34 @@ class EngineMetrics:
 
     def __init__(self, window: int = 2048):
         self._lock = threading.Lock()
-        self.requests_completed = 0
-        self.tokens_generated = 0
+        self.requests_completed = 0     # guarded_by: _lock
+        self.tokens_generated = 0       # guarded_by: _lock
         self.started = time.monotonic()
-        self._ttft: list[float] = []
-        self._tpot: list[float] = []
+        self._ttft: list[float] = []    # guarded_by: _lock
+        self._tpot: list[float] = []    # guarded_by: _lock
         self._window = window
         # speculative decoding counters (one "round" = one verify dispatch)
-        self.spec_rounds = 0
-        self.spec_drafted = 0
-        self.spec_accepted = 0
-        self.spec_emitted = 0
-        self.spec_draft_time = 0.0     # seconds proposing drafts
-        self.spec_verify_time = 0.0    # seconds in verify dispatches
+        self.spec_rounds = 0            # guarded_by: _lock
+        self.spec_drafted = 0           # guarded_by: _lock
+        self.spec_accepted = 0          # guarded_by: _lock
+        self.spec_emitted = 0           # guarded_by: _lock
+        self.spec_draft_time = 0.0      # guarded_by: _lock
+        self.spec_verify_time = 0.0     # guarded_by: _lock
         # request-lifecycle counters (load shedding + reaping)
-        self.requests_shed = 0         # rejected at admission / queue budget
-        self.requests_cancelled = 0    # client called Request.cancel()
-        self.requests_expired = 0      # reaped past their deadline
-        self._qd_counts = [0] * (len(QUEUE_DELAY_BUCKETS) + 1)  # +Inf tail
-        self._qd_sum = 0.0
-        self._qd_n = 0
+        self.requests_shed = 0          # guarded_by: _lock
+        self.requests_cancelled = 0     # guarded_by: _lock
+        self.requests_expired = 0       # guarded_by: _lock
+        self._qd_counts = [0] * (len(QUEUE_DELAY_BUCKETS) + 1)  # guarded_by: _lock
+        self._qd_sum = 0.0              # guarded_by: _lock
+        self._qd_n = 0                  # guarded_by: _lock
         # decode hot-loop health: host gap per round + dispatch depth
         # (0 = every round waits on the host; 1 = one round in flight
         # while the host works — the pipelined steady state).
-        self.dispatch_depth = 0
-        self._hg: list[float] = []
-        self._hg_counts = [0] * (len(HOST_GAP_BUCKETS) + 1)  # +Inf tail
-        self._hg_sum = 0.0
-        self._hg_n = 0
+        self.dispatch_depth = 0         # guarded_by: _lock
+        self._hg: list[float] = []      # guarded_by: _lock
+        self._hg_counts = [0] * (len(HOST_GAP_BUCKETS) + 1)  # guarded_by: _lock
+        self._hg_sum = 0.0              # guarded_by: _lock
+        self._hg_n = 0                  # guarded_by: _lock
 
     def observe(self, req: Request) -> None:
         with self._lock:
@@ -741,7 +743,7 @@ class LLMEngine:
                 scale_ps = PartitionSpec()
             self._cache_sh = NamedSharding(self.mesh, kv_ps)
             self._cache_scale_sh = NamedSharding(self.mesh, scale_ps)
-        self._rng = jax.random.PRNGKey(seed + 1)
+        self._rng = jax.random.PRNGKey(seed + 1)  # lockfree: scheduler-confined
 
         self.paged = bool(b.paged)
         self.page_size = int(b.page_size)
@@ -765,11 +767,12 @@ class LLMEngine:
             self._allocator = PageAllocator(
                 self._num_pages, pg,
                 enable_prefix_caching=b.enable_prefix_caching)
+            # lockfree: scheduler-confined (host page-table mirror)
             self._table = np.full((self.num_slots, self._mpp), -1, np.int32)
-            self._slot_pages: list[list[int]] = [
+            self._slot_pages: list[list[int]] = [  # lockfree: scheduler-confined
                 [] for _ in range(self.num_slots)]
             kv_dt = jnp.int8 if self.kv_quant else cfg.activation_dtype
-            self.cache = {
+            self.cache = {  # lockfree: scheduler-confined (donated KV)
                 "k": self._zeros((cfg.n_layers, self._num_pages, pg,
                                   cfg.n_kv_heads, cfg.head_dim), kv_dt),
                 "v": self._zeros((cfg.n_layers, self._num_pages, pg,
@@ -783,7 +786,7 @@ class LLMEngine:
                         (cfg.n_layers, self._num_pages, pg, cfg.n_kv_heads),
                         jnp.float32, scale=True)
         else:
-            self.cache = {
+            self.cache = {  # lockfree: scheduler-confined (donated KV)
                 "k": self._zeros((cfg.n_layers, self.num_slots, self.max_len,
                                   cfg.n_kv_heads, cfg.head_dim),
                                  cfg.activation_dtype),
@@ -839,7 +842,7 @@ class LLMEngine:
                 _chunk_prefill_step(p, c, t, s, st, cfg_prefill, vl),
                 self._pin),
             donate_argnums=(1,))
-        self._chunkings: list[_Chunking] = []
+        self._chunkings: list[_Chunking] = []   # lockfree: scheduler-confined
         self.max_concurrent_prefills = max(1, int(b.max_concurrent_prefills))
         if self.paged:
             from kubeflow_tpu.serve.paged import (
@@ -886,8 +889,15 @@ class LLMEngine:
             self._paged_decode_n = jax.jit(
                 _paged_decode_fn, static_argnums=(5, 6),
                 donate_argnums=(1, 2, 3))
-        self._preempted: list[Request] = []
-        self._backlog: list[Request] = []   # scheduler-side admission queue
+        # Scheduler-confined state (the whole block below): mutated ONLY
+        # on the scheduler thread (or by step() when no loop runs — the
+        # unthreaded mode never coexists with start()). Cross-thread
+        # signals ride `waiting` (a Queue) and the `_stop`/`_wake`
+        # Events; everything else is single-owner by construction, which
+        # is what the `# lockfree:` contracts below assert for the
+        # C301 lock-discipline rule.
+        self._preempted: list[Request] = []     # lockfree: scheduler-confined
+        self._backlog: list[Request] = []       # lockfree: scheduler-confined
         self._admit_seq = itertools.count()
         self._sampler = jax.jit(_sample_batch, static_argnums=(5,))
         # K decode steps per dispatch amortizes host round-trip latency
@@ -968,7 +978,7 @@ class LLMEngine:
             # The draft's own KV residency: a dense slot cache (the draft is
             # small — that's the point — so slots × max_len of its few
             # kv-heads is cheap even when the target pool is paged).
-            self._draft_cache = {
+            self._draft_cache = {  # lockfree: scheduler-confined
                 "k": jnp.zeros((dcfg.n_layers, self.num_slots, self.max_len,
                                 dcfg.n_kv_heads, dcfg.head_dim),
                                dcfg.activation_dtype),
@@ -978,7 +988,7 @@ class LLMEngine:
             }
             # consumed-context pointer per slot: positions [0, pos) of the
             # TRUE sequence have valid draft KV; reset at (re-)admission
-            self._draft_pos = [0] * self.num_slots
+            self._draft_pos = [0] * self.num_slots  # lockfree: scheduler-confined
             self._draft_propose_n = jax.jit(
                 lambda p, c, d, dl, dp, lv, n:
                 draft_propose(p, c, d, dl, dp, lv, dcfg, n),
@@ -995,7 +1005,7 @@ class LLMEngine:
                 c //= 2
             self._draft_chunk = max(c, 1)
 
-        self.slots: list[Optional[_Slot]] = [None] * self.num_slots
+        self.slots: list[Optional[_Slot]] = [None] * self.num_slots  # lockfree: scheduler-confined
         # Device-resident scheduler state (serve/device_state.py): the
         # decode dispatch's [B] carries and the paged page table live on
         # device for the engine's lifetime; host scheduler events sync as
@@ -1009,14 +1019,15 @@ class LLMEngine:
         # round deep: reaps/admissions decided mid-flight take effect next
         # round, and consumption masks slots whose occupant changed.
         self.pipelined = bool(b.pipelined_decode)
-        self._rounds: list[_InflightRound] = []
+        self._rounds: list[_InflightRound] = []  # lockfree: scheduler-confined
         # First-token sampling batched per admit round: chunked-prefill
         # completions park here and one sampler dispatch + ONE host fetch
         # serves them all (_sample_first_batch).
+        # lockfree: scheduler-confined
         self._pending_first: list[tuple[Request, int, int, jax.Array]] = []
-        self._last_ready_t: Optional[float] = None
-        self.decode_rounds = 0
-        self.first_token_fetches = 0
+        self._last_ready_t: Optional[float] = None  # lockfree: scheduler-confined
+        self.decode_rounds = 0          # lockfree: scheduler-confined counter
+        self.first_token_fetches = 0    # lockfree: scheduler-confined counter
         self.waiting: "queue.Queue[Request]" = queue.Queue()
         self.metrics = EngineMetrics()
         # Bounded admission + queue-delay budget (load shedding): see
@@ -1025,6 +1036,15 @@ class LLMEngine:
         self.queue_delay_budget = (None if b.queue_delay_budget is None
                                    else float(b.queue_delay_budget))
         self._id_gen = itertools.count()
+        # Runtime sanitizer (KFTPU_SANITIZE=1): run every scheduler step
+        # under ``jax.transfer_guard("disallow")``. The engine's transfer
+        # contract is that every host↔device move is EXPLICIT
+        # (``jnp.asarray`` at admission/sync sites, ``jax.device_get`` at
+        # the designed fetch points) — an implicit transfer anywhere in
+        # the step is a regression of exactly the class the static
+        # device-hygiene rules (kftpu lint, D1xx) catch, so the two
+        # cross-check each other.
+        self.sanitize = os.environ.get("KFTPU_SANITIZE", "") not in ("", "0")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
@@ -1562,7 +1582,7 @@ class LLMEngine:
         self.slots[idx] = None
         return True
 
-    def _decode_once(self) -> int:
+    def _decode_once(self) -> int:  # hot-loop
         """One decode scheduler pass. Routes greedy-only rounds to the
         speculative path when configured; sampling traffic (and spec-off
         engines) take the pipelined plain path: dispatch round N+1 FIRST,
@@ -1604,7 +1624,7 @@ class LLMEngine:
                 p.top_p, -1 if p.stop_token is None else p.stop_token,
                 budget)
 
-    def _sync_decode_state(self) -> None:
+    def _sync_decode_state(self) -> None:  # hot-loop
         """Flush host scheduler deltas (admissions, reaps, preemptions,
         spec advances, page-table growth) to the device-resident state as
         per-index donated scatters. Steady-state rounds have nothing dirty
@@ -1614,7 +1634,7 @@ class LLMEngine:
         if self.paged and self._dstate.dirty_rows:
             self._dstate.sync_rows(lambda i: self._table[i])
 
-    def _dispatch_round(self, active) -> bool:
+    def _dispatch_round(self, active) -> bool:  # hot-loop
         """Enqueue one multi-step decode dispatch over the device-resident
         state (no host blocking — JAX async dispatch). Returns False when
         paged pool pressure preempted every candidate slot."""
@@ -1680,13 +1700,13 @@ class LLMEngine:
             gap_ms=None if gap is None else gap * 1e3))
         return True
 
-    def _consume_round(self) -> int:
+    def _consume_round(self) -> int:  # hot-loop
         """Fetch and emit the oldest in-flight round's tokens. Slots whose
         occupant changed while the round ran (reaped, preempted,
         re-admitted) are MASKED — a cancelled request's output stream never
         contains post-cancel tokens. Returns tokens emitted."""
         rnd = self._rounds.pop(0)
-        out = np.asarray(jax.device_get(rnd.out))
+        out = np.asarray(jax.device_get(rnd.out))  # sync-point: the pipeline's one designed fetch per round
         self._last_ready_t = time.monotonic()
         emitted = 0
         for i, s in rnd.active:
@@ -1727,7 +1747,7 @@ class LLMEngine:
             emitted += self._consume_round()
         return emitted
 
-    def _plain_decode_once(self, active) -> int:
+    def _plain_decode_once(self, active) -> int:  # hot-loop
         """Dispatch + consume one plain round synchronously — the
         speculative path's fallback lane (spec rounds are host-verified,
         so there is never a pipeline to overlap with here)."""
@@ -1744,7 +1764,7 @@ class LLMEngine:
         req = s.request
         return list(req.prompt_tokens) + req.output_tokens[req.resumed_from:]
 
-    def _spec_decode_once(self, active) -> int:
+    def _spec_decode_once(self, active) -> int:  # hot-loop
         """One draft + batched-verify round (serve/spec_decode.py).
 
         Each live slot proposes up to ``spec_k`` draft tokens; ONE dispatch
@@ -1822,7 +1842,7 @@ class LLMEngine:
             greedy, self.cache = self._verify(
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.asarray(lengths), jnp.asarray(live))
-        greedy = np.asarray(jax.device_get(greedy))
+        greedy = np.asarray(jax.device_get(greedy))  # sync-point: greedy verification happens host-side
         verify_s = time.monotonic() - t1
         emitted = 0
         for i, s in active:
@@ -1870,7 +1890,7 @@ class LLMEngine:
             self._finish_if_done(i)
         return emitted
 
-    def _draft_model_propose(self, active) -> dict[int, list[int]]:
+    def _draft_model_propose(self, active) -> dict[int, list[int]]:  # hot-loop
         """Run the small draft model k steps ahead for every live slot in
         one dispatch (plus per-slot catch-up chunk prefills for freshly
         (re-)admitted slots whose context the draft hasn't consumed)."""
@@ -1911,7 +1931,7 @@ class LLMEngine:
         out, self._draft_cache = self._draft_propose_n(
             self._draft_params, self._draft_cache, jnp.asarray(deltas),
             jnp.asarray(dlens), jnp.asarray(dpos), jnp.asarray(live), steps)
-        out = np.asarray(jax.device_get(out))
+        out = np.asarray(jax.device_get(out))  # sync-point: drafts are proposed host-side
         drafts: dict[int, list[int]] = {}
         for i, s in active:
             first = int(dlens[i]) - 1    # step that predicts past the ctx
@@ -1939,12 +1959,28 @@ class LLMEngine:
         self._dstate.mark_row(idx)
         self._allocator.free(drop)
 
+    def _transfer_guard(self):
+        """``jax.transfer_guard("disallow")`` in sanitize mode: implicit
+        transfers (a stray numpy array riding into a dispatch — the PR-4
+        bug class) raise immediately; explicit ``device_put``/
+        ``device_get`` at the designed sites stay legal. Scoped to the
+        decode path: admission legitimately uploads prompt chunks and
+        scalar positions (``jnp.asarray``/``jnp.int32``, which this jax
+        still classes as implicit for scalars)."""
+        if not self.sanitize:
+            return contextlib.nullcontext()
+        return jax.transfer_guard("disallow")
+
     def step(self) -> int:
         """One scheduler iteration: reap dead requests, admit, decode.
         Returns work done (reaps count — a freed slot is admissible work;
         a dispatched round counts too, so the loop never idles with a
-        round in flight)."""
-        n = self._reap_abandoned() + self._admit() + self._decode_once()
+        round in flight). Under ``KFTPU_SANITIZE=1`` the decode pass runs
+        with implicit transfers disallowed — the runtime half of the
+        static device-hygiene rules."""
+        n = self._reap_abandoned() + self._admit()
+        with self._transfer_guard():
+            n += self._decode_once()
         if n == 0:
             # Idle: the next round's host-gap sample would span the idle
             # wait, not the hot loop.

@@ -205,14 +205,14 @@ class PageAllocator:
 # (reads are length-masked, writes aimed out of bounds and dropped).
 
 
-def paged_gather(pool: jax.Array, table: jax.Array) -> jax.Array:
+def paged_gather(pool: jax.Array, table: jax.Array) -> jax.Array:  # traced
     """[P,pg,K,D] pool + [B,mpp] table -> [B, mpp*pg, K, D] per-slot view."""
     b, mpp = table.shape
     pages = pool[jnp.clip(table, 0, pool.shape[0] - 1)]   # [B,mpp,pg,K,D]
     return pages.reshape(b, mpp * pool.shape[1], *pool.shape[2:])
 
 
-def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,
+def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,  # traced
                         table, cfg: DecoderConfig, attn_impl: str = "gather",
                         pool_ks=None, pool_vs=None):
     """One transformer block for a [B,1] decode step against the page pool.
@@ -283,7 +283,7 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,
     return x + mlp_out, nk, nv, nks, nvs
 
 
-def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,
+def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                        lengths: jax.Array, live: jax.Array,
                        cfg: DecoderConfig, attn_impl: str = "gather"):
     """One [B,1] decode step over the page pool (≈ engine._decode_step)."""
@@ -329,7 +329,7 @@ def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,
     return logits, out
 
 
-def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,
+def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
                        lengths: jax.Array, live: jax.Array, temps: jax.Array,
                        top_k: jax.Array, top_p: jax.Array,
                        stop_tokens: jax.Array, budgets: jax.Array,
@@ -388,7 +388,7 @@ def context_bucket(pos: int, chunk: int, page_size: int, mpp: int) -> int:
     return min(ctx, mpp)
 
 
-def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,
+def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,  # traced
                         table_row: jax.Array, start: jax.Array,
                         chunk_pages: jax.Array, cfg: DecoderConfig,
                         attn_impl: str = "xla",

@@ -94,22 +94,23 @@ class Router:
                  max_retries: int = 2):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._groups: dict[str, list[str]] = {}    # group -> base urls
-        self._weights: dict[str, int] = {}         # group -> percent
-        self._rr = itertools.count()
-        self._pending = 0
-        self._last_activity = 0.0   # monotonic; stamped per request
-        self._closed = False
+        self._groups: dict[str, list[str]] = {}    # guarded_by: _lock
+        self._weights: dict[str, int] = {}         # guarded_by: _lock
+        self._rr = itertools.count()    # lockfree: next() is GIL-atomic
+        self._pending = 0               # guarded_by: _lock
+        self._last_activity = 0.0   # guarded_by: _lock (monotonic stamp)
+        self._closed = False            # guarded_by: _lock
         self.queue_timeout = queue_timeout
         self.upstream_timeout = upstream_timeout
         self.eject_threshold = max(1, int(eject_threshold))
         self.eject_period = eject_period
         self.max_retries = max(0, int(max_retries))
         # outlier-ejection state (all under self._lock)
-        self._fails: dict[str, int] = {}           # consecutive failures
-        self._ejected_until: dict[str, float] = {}
-        self._draining: set[str] = set()
-        self.stats = {"picks": 0, "retries": 0, "connect_failures": 0,
+        self._fails: dict[str, int] = {}           # guarded_by: _lock
+        self._ejected_until: dict[str, float] = {}  # guarded_by: _lock
+        self._draining: set[str] = set()            # guarded_by: _lock
+        self.stats = {"picks": 0, "retries": 0,    # guarded_by: _lock
+                      "connect_failures": 0,
                       "http_5xx": 0, "ejections": 0, "half_open_probes": 0,
                       "panic_picks": 0, "queue_timeouts": 0,
                       "deadline_exhausted": 0}

@@ -91,7 +91,7 @@ class ModelServer:
         # explainer(tokens, params=..., cfg=...) -> attribution dict,
         # served on the v1 :explain route (serve/explain.py).
         self.explainer = explainer
-        self._in_flight = 0
+        self._in_flight = 0             # guarded_by: _in_flight_lock
         self._in_flight_lock = threading.Lock()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)

@@ -84,7 +84,7 @@ def ngram_propose(ctx: Sequence[int], k: int, ngram_max: int,
 
 # -- batched verify (dense slot cache) -----------------------------------------
 
-def _spec_attention(q, ck, cv, lengths, cfg: DecoderConfig):
+def _spec_attention(q, ck, cv, lengths, cfg: DecoderConfig):  # traced
     """T-query attention over slot caches (the verify-length generalization
     of engine._decode_attention). q [B,T,H,Dh]; ck/cv [B,Smax,KV,Dh];
     query t sits at position lengths[b]+t and attends kpos <= that."""
@@ -104,7 +104,7 @@ def _spec_attention(q, ck, cv, lengths, cfg: DecoderConfig):
     return out.reshape(b, t, cfg.n_heads, cfg.head_dim)
 
 
-def _spec_block(bp, x, positions, lengths, live, cache_k, cache_v,
+def _spec_block(bp, x, positions, lengths, live, cache_k, cache_v,  # traced
                 cfg: DecoderConfig):
     """One transformer block for a [B,T] verify step against slot caches
     (engine._decode_block with a verify-length axis). Writes the K/V of all
@@ -132,7 +132,7 @@ def _spec_block(bp, x, positions, lengths, live, cache_k, cache_v,
     return x + mlp_out, ck, cv
 
 
-def verify_step(params: Params, cache: dict, tokens: jax.Array,
+def verify_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                 lengths: jax.Array, live: jax.Array, cfg: DecoderConfig):
     """ONE dispatch scoring T = k+1 positions per slot over the dense slot
     cache. tokens [B,T] = [last_token, draft_1..draft_k] (pad columns are
@@ -168,7 +168,7 @@ def verify_step(params: Params, cache: dict, tokens: jax.Array,
 
 # -- batched verify (paged pool) -----------------------------------------------
 
-def _paged_spec_block(bp, x, positions, lengths, live, pool_k, pool_v,
+def _paged_spec_block(bp, x, positions, lengths, live, pool_k, pool_v,  # traced
                       table, cfg: DecoderConfig, pool_ks=None, pool_vs=None):
     """Verify block against the page pool (paged._paged_decode_block with a
     verify-length axis; always the gather attention impl — the Pallas
@@ -222,7 +222,7 @@ def _paged_spec_block(bp, x, positions, lengths, live, pool_k, pool_v,
     return x + mlp_out, nk, nv, nks, nvs
 
 
-def paged_verify_step(params: Params, cache: dict, tokens: jax.Array,
+def paged_verify_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                       lengths: jax.Array, live: jax.Array,
                       cfg: DecoderConfig):
     """verify_step over the page pool (cache carries "table"; the host
@@ -271,7 +271,7 @@ def paged_verify_step(params: Params, cache: dict, tokens: jax.Array,
 
 # -- draft-model proposal ------------------------------------------------------
 
-def draft_propose(params: Params, cache: dict, deltas: jax.Array,
+def draft_propose(params: Params, cache: dict, deltas: jax.Array,  # traced
                   delta_lens: jax.Array, draft_pos: jax.Array,
                   live: jax.Array, cfg: DecoderConfig, num_steps: int):
     """Catch-up + autoregressive drafting for the small model in ONE

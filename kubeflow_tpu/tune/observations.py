@@ -46,13 +46,13 @@ class ObservationLog:
     def __init__(self, store: MetadataStore):
         self.store = store
         self._lock = threading.Lock()
-        self._ctx_cache: dict[str, int] = {}
-        self._trial_cache: dict[str, int] = {}
+        self._ctx_cache: dict[str, int] = {}    # guarded_by: _lock
+        self._trial_cache: dict[str, int] = {}  # guarded_by: _lock
         # Highest step already written per (trial, metric): collectors
         # re-report the full history every poll, and re-upserting O(points)
         # properties twice a second would grow quadratically. A restart
         # clears this map → one full (idempotent) re-upsert, then deltas.
-        self._reported: dict[tuple[str, str], int] = {}
+        self._reported: dict[tuple[str, str], int] = {}  # guarded_by: _lock
 
     # -- registration ------------------------------------------------------
 

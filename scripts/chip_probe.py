@@ -32,10 +32,10 @@ def main():
     results = {}
 
     # 1. Pure matmul peak, bf16 (8k^3 = 1.1 TFLOP per op)
+    f = jax.jit(lambda a, b: a @ b)   # one wrapper; each shape traces once
     for n in (4096, 8192):
         a = jnp.ones((n, n), jnp.bfloat16)
         bmat = jnp.ones((n, n), jnp.bfloat16)
-        f = jax.jit(lambda a, b: a @ b)
         dt = timeit(f, a, bmat)
         results[f"matmul{n}_tflops"] = round(2 * n**3 / dt / 1e12, 1)
 

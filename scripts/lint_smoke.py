@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Static-analysis gate for smoke.sh: ``kftpu lint`` over the whole tree.
+
+Fails on ANY finding not matched by the checked-in baseline
+(.kftpu-lint-baseline.json) — pre-existing debt is baselined with a
+justification, new findings block. Also self-checks the analyzer the way
+the acceptance criteria demand: each rule family must still catch its
+seeded regression (the PR-4 per-round ``jnp.asarray(self._table)`` upload
+and a dropped router lock acquisition), so a rule that silently stops
+firing fails the gate too, not just the test suite.
+
+Prints one JSON object; ``"lint_smoke": "ok"`` is the pass marker
+smoke.sh greps for. Findings render as ``file:line:col`` so they are
+clickable in CI logs.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kubeflow_tpu.analysis import Baseline, find_baseline, lint_source, run_lint  # noqa: E402
+
+SCAN = ["kubeflow_tpu", "scripts", "bench.py", "bench_serve.py"]
+
+
+def _seeded_regressions() -> list[str]:
+    """Mutate engine/router source in memory and check each rule family
+    still fires exactly once. Returns a list of failure descriptions."""
+    fails: list[str] = []
+
+    def new_findings(path: str, old: str, new: str, rule: str,
+                     needle: str) -> None:
+        with open(os.path.join(REPO, path)) as f:
+            src = f.read()
+        mut = src.replace(old, new, 1)
+        if mut == src:
+            fails.append(f"{rule}: mutation anchor not found in {path}")
+            return
+        before = {f.fingerprint for f in lint_source(src, path)}
+        fresh = [f for f in lint_source(mut, path)
+                 if f.fingerprint not in before]
+        if len(fresh) != 1 or fresh[0].rule != rule \
+                or needle not in fresh[0].message:
+            fails.append(
+                f"{rule}: seeded regression in {path} produced "
+                f"{[f.render() for f in fresh]!r}, expected exactly one "
+                f"{rule} mentioning {needle!r}")
+
+    # Family A: the PR-4 bug — full page-table re-upload per decode round.
+    new_findings(
+        "kubeflow_tpu/serve/engine.py",
+        "        self._sync_decode_state()\n",
+        "        self._sync_decode_state()\n"
+        "        table = jnp.asarray(self._table)\n",
+        "D103", "self._table")
+    # Family B: drop one router lock acquisition.
+    new_findings(
+        "kubeflow_tpu/serve/router.py",
+        "    def note_activity(self) -> None:\n        with self._lock:\n",
+        "    def note_activity(self) -> None:\n        if True:\n",
+        "C301", "_last_activity")
+    return fails
+
+
+def main() -> int:
+    os.chdir(REPO)
+    baseline_path = find_baseline(SCAN)
+    baseline = Baseline.load(baseline_path) if baseline_path else None
+    result = run_lint(SCAN, baseline=baseline, root=REPO)
+    seeded = _seeded_regressions()
+    ok = result.ok and not seeded
+    print(json.dumps({
+        "lint_smoke": "ok" if ok else "FAIL",
+        "files_scanned": result.files_scanned,
+        "findings": [f.render() for f in result.errors + result.new],
+        "baselined": len(result.baselined),
+        "baseline": (os.path.relpath(baseline_path, REPO)
+                     if baseline_path else None),
+        "seeded_regression_failures": seeded,
+    }, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

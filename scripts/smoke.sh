@@ -9,6 +9,14 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
+echo "== kftpu lint (static analysis vs committed baseline) =="
+# Cheapest gate first: device-hygiene + lock-discipline + metric-name
+# rules over the whole tree; any finding not in .kftpu-lint-baseline.json
+# fails, and each rule family must still catch its seeded regression.
+timeout -k 10 120 python scripts/lint_smoke.py | tee /tmp/_smoke_lint.json
+lint_rc=${PIPESTATUS[0]}
+grep -q '"lint_smoke": "ok"' /tmp/_smoke_lint.json || lint_rc=1
+
 rc=0
 if [ -z "${SMOKE_SKIP_TESTS:-}" ]; then
   echo "== tier-1 tests (ROADMAP.md) =="
@@ -61,5 +69,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 hotloop_rc=${PIPESTATUS[0]}
 grep -q '"hotloop_smoke": "ok"' /tmp/_smoke_hotloop.json || hotloop_rc=1
 
-echo "== smoke: tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc =="
-[ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ]
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ]

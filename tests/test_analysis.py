@@ -1,0 +1,634 @@
+"""``kftpu lint`` — the static analyzer itself (ISSUE 5).
+
+Contracts pinned here:
+- every rule fires on its minimal positive fixture and stays silent on
+  the matching negative (annotations close the false positives they are
+  documented to close);
+- ``# lint: disable=`` suppression and the baseline round-trip work, and
+  baseline fingerprints survive unrelated line shifts;
+- the two seeded regressions from the acceptance criteria: re-introducing
+  the PR-4 per-round ``jnp.asarray(self._table)`` upload into the REAL
+  engine and removing one REAL router lock acquisition each produce
+  exactly the expected finding — the rules are tuned to this codebase,
+  not just to fixtures;
+- the repo itself scans clean against the committed baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from kubeflow_tpu.analysis import (
+    Baseline, all_rules, find_baseline, lint_source, run_lint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src: str, path: str = "kubeflow_tpu/serve/fixture.py"):
+    return [f.rule for f in lint_source(src, path)]
+
+
+# -- Family A: device hygiene --------------------------------------------------
+
+
+class TestHostSyncInJit:
+    def test_np_asarray_in_jitted_fn(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return np.asarray(x) + 1\n")
+        assert rules_of(src) == ["D101"]
+
+    def test_item_and_float_on_traced_param(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(x, y):\n"
+            "    return x.item() + float(y)\n")
+        assert rules_of(src) == ["D101", "D101"]
+
+    def test_partial_jit_decorator_and_traced_annotation(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=(1,))\n"
+            "def a(x, n):\n"
+            "    x.block_until_ready()\n"
+            "    return x\n"
+            "def b(x):  # traced\n"
+            "    return jax.device_get(x)\n")
+        assert rules_of(src) == ["D101", "D101"]
+
+    def test_jit_wrapped_local_fn(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "def build():\n"
+            "    def inner(x):\n"
+            "        return np.asarray(x)\n"
+            "    return jax.jit(inner)\n")
+        assert rules_of(src) == ["D101"]
+
+    def test_same_calls_outside_jit_are_clean(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "def host_side(x):\n"
+            "    return np.asarray(jax.device_get(x)).item()\n")
+        assert rules_of(src) == []
+
+
+class TestHostSyncInHotLoop:
+    def test_device_get_in_hot_loop(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def consume(self):  # hot-loop\n"
+            "        return jax.device_get(self.buf)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["D102"]
+        assert "consume" in fs[0].message
+
+    def test_sync_point_annotation_is_the_designed_fetch(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def consume(self):  # hot-loop\n"
+            "        return jax.device_get(self.buf)"
+            "  # sync-point: the one designed fetch\n")
+        assert rules_of(src) == []
+
+    def test_sleep_in_hot_loop(self):
+        src = (
+            "import time\n"
+            "def spin():  # hot-loop\n"
+            "    time.sleep(0.01)\n")
+        assert rules_of(src) == ["D102"]
+
+    def test_unannotated_function_is_clean(self):
+        src = (
+            "import jax\n"
+            "def consume(buf):\n"
+            "    return jax.device_get(buf)\n")
+        assert rules_of(src) == []
+
+
+class TestFullBufferReupload:
+    POSITIVE = (
+        "import jax.numpy as jnp\n"
+        "class E:\n"
+        "    def dispatch(self):  # hot-loop\n"
+        "        return jnp.asarray(self._table)\n")
+
+    def test_persistent_self_buffer_uploaded_per_round(self):
+        fs = lint_source(self.POSITIVE)
+        assert [f.rule for f in fs] == ["D103"]
+        assert "self._table" in fs[0].message
+
+    def test_device_put_of_self_buffer_also_fires(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def dispatch(self):  # hot-loop\n"
+            "        return jax.device_put(self._state.arrays)\n")
+        assert rules_of(src) == ["D103"]
+
+    def test_local_array_upload_is_clean(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "class E:\n"
+            "    def dispatch(self, row):  # hot-loop\n"
+            "        return jnp.asarray(row)\n")
+        assert rules_of(src) == []
+
+    def test_lint_disable_suppresses(self):
+        src = self.POSITIVE.replace(
+            "return jnp.asarray(self._table)",
+            "return jnp.asarray(self._table)  # lint: disable=D103")
+        assert rules_of(src) == []
+
+
+class TestDonatedBufferReuse:
+    def test_read_after_donating_dispatch(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self):\n"
+            "        out = self._fn(self.cache)\n"
+            "        return self.cache\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["D104"]
+        assert "self.cache" in fs[0].message
+
+    def test_rebind_then_read_is_clean(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self):\n"
+            "        self.cache = self._fn(self.cache)\n"
+            "        return self.cache\n")
+        assert rules_of(src) == []
+
+    def test_donation_in_one_branch_not_read_in_sibling(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self, paged):\n"
+            "        if paged:\n"
+            "            self.cache = self._fn(self.cache)\n"
+            "        else:\n"
+            "            out = self.cache\n"
+            "        return out\n")
+        assert rules_of(src) == []
+
+
+class TestJitInLoop:
+    def test_jit_constructed_per_iteration(self):
+        src = (
+            "import jax\n"
+            "def run(xs):\n"
+            "    for x in xs:\n"
+            "        f = jax.jit(lambda v: v)\n"
+            "        f(x)\n")
+        assert rules_of(src) == ["D105"]
+
+    def test_jit_in_hot_loop_function(self):
+        src = (
+            "import jax\n"
+            "def dispatch(x):  # hot-loop\n"
+            "    return jax.jit(lambda v: v)(x)\n")
+        assert rules_of(src) == ["D105"]
+
+    def test_jit_at_init_is_clean(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda v: v)\n")
+        assert rules_of(src) == []
+
+
+# -- Family B: lock discipline -------------------------------------------------
+
+
+class TestUnlockedSharedMutation:
+    def test_inferred_cross_thread_mutation(self):
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        self._items.append(1)\n"
+            "    def results(self):\n"
+            "        return list(self._items)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["C301"]
+        assert "Worker._items" in fs[0].message
+
+    def test_lock_held_everywhere_is_clean(self):
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self._items.append(1)\n"
+            "    def results(self):\n"
+            "        with self._lock:\n"
+            "            return list(self._items)\n")
+        assert rules_of(src) == []
+
+    def test_guarded_by_contract_checked_without_threads(self):
+        # guarded_by turns the attribute into a contract even when the
+        # class spawns no threads this module can see.
+        src = (
+            "import threading\n"
+            "class G:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded_by: _lock\n"
+            "    def bump(self):\n"
+            "        self._n += 1\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["C301"]
+        assert "guarded_by" in fs[0].message and "bump" in fs[0].message
+
+    def test_guarded_by_satisfied_under_lock(self):
+        src = (
+            "import threading\n"
+            "class G:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded_by: _lock\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n")
+        assert rules_of(src) == []
+
+    def test_locked_suffix_counts_as_holding(self):
+        src = (
+            "import threading\n"
+            "class G:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded_by: _lock\n"
+            "    def _bump_locked(self):\n"
+            "        self._n += 1\n")
+        assert rules_of(src) == []
+
+    def test_requires_lock_annotation(self):
+        src = (
+            "import threading\n"
+            "class G:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded_by: _lock\n"
+            "    def _bump(self):  # requires_lock: _lock\n"
+            "        self._n += 1\n")
+        assert rules_of(src) == []
+
+    def test_lockfree_annotation_closes_inference(self):
+        src = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []  # lockfree: scheduler-confined\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        self._items.append(1)\n"
+            "    def results(self):\n"
+            "        return list(self._items)\n")
+        assert rules_of(src) == []
+
+    def test_condition_guard_counts_as_its_lock(self):
+        src = (
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._lock)\n"
+            "        self._pending = {}  # guarded_by: _cv\n"
+            "    def add(self, k):\n"
+            "        with self._cv:\n"
+            "            self._pending[k] = None\n")
+        assert rules_of(src) == []
+
+
+class TestBlockingCallUnderLock:
+    def test_sleep_under_lock(self):
+        src = (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def poll(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(0.1)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["C302"]
+        assert "time.sleep" in fs[0].message
+
+    def test_thread_join_under_lock(self):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def stop(self):\n"
+            "        with self._lock:\n"
+            "            self._thread.join()\n")
+        assert rules_of(src) == ["C302"]
+
+    def test_sleep_outside_lock_is_clean(self):
+        src = (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def poll(self):\n"
+            "        with self._lock:\n"
+            "            n = 1\n"
+            "        time.sleep(0.1)\n")
+        assert rules_of(src) == []
+
+    def test_condition_wait_is_exempt(self):
+        # Condition.wait releases the lock — the whole point of a CV.
+        src = (
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._lock)\n"
+            "    def pop(self):\n"
+            "        with self._cv:\n"
+            "            self._cv.wait(1.0)\n")
+        assert rules_of(src) == []
+
+
+class TestSwallowedException:
+    def test_bare_except_pass(self):
+        src = (
+            "def reconcile(work):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        pass\n")
+        assert rules_of(src) == ["C303"]
+
+    def test_logged_broad_except_is_clean(self):
+        src = (
+            "import logging\n"
+            "def reconcile(work):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        logging.exception('reconcile failed')\n")
+        assert rules_of(src) == []
+
+    def test_narrow_except_pass_is_clean(self):
+        src = (
+            "def probe(work):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except ValueError:\n"
+            "        pass\n")
+        assert rules_of(src) == []
+
+    def test_reraise_is_clean(self):
+        src = (
+            "def run(work):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except BaseException:\n"
+            "        raise\n")
+        assert rules_of(src) == []
+
+
+# -- metric-name rules ---------------------------------------------------------
+
+
+class TestMetricRules:
+    def test_missing_prefix(self):
+        src = "def setup(reg):\n    reg.counter('queue_depth', 'help')\n"
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["M201"]
+        assert "kftpu_" in fs[0].message
+
+    def test_bad_grammar(self):
+        src = "def setup(reg):\n    reg.gauge('kftpu_bad-name', 'help')\n"
+        assert rules_of(src) == ["M201"]
+
+    def test_fstring_head_checked(self):
+        src = (
+            "def setup(reg, kind):\n"
+            "    reg.gauge(f'queue_{kind}_depth', 'help')\n"
+            "    reg.gauge(f'kftpu_{kind}_depth', 'help')\n")
+        assert rules_of(src) == ["M201"]
+
+    def test_duplicate_family_in_one_function(self):
+        src = (
+            "def setup(reg):\n"
+            "    reg.counter('kftpu_reqs_total', 'a')\n"
+            "    reg.counter('kftpu_reqs_total', 'b')\n")
+        assert rules_of(src) == ["M202"]
+
+    def test_good_names_clean(self):
+        src = (
+            "def setup(reg):\n"
+            "    reg.counter('kftpu_reqs_total', 'a')\n"
+            "    reg.histogram('kftpu_latency_seconds', 'b')\n")
+        assert rules_of(src) == []
+
+
+# -- core machinery ------------------------------------------------------------
+
+
+class TestBaseline:
+    SRC = TestFullBufferReupload.POSITIVE
+
+    def test_round_trip(self, tmp_path):
+        findings = lint_source(self.SRC, "pkg/mod.py")
+        assert findings
+        path = str(tmp_path / "baseline.json")
+        Baseline.from_findings(findings, reason="seed fixture").save(path)
+        loaded = Baseline.load(path)
+        new, matched = loaded.split(lint_source(self.SRC, "pkg/mod.py"))
+        assert new == [] and len(matched) == len(findings)
+        # the file is valid JSON with a reason per entry
+        doc = json.loads(open(path).read())
+        assert all(e["reason"] for e in doc["entries"])
+
+    def test_fingerprints_survive_line_shifts(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        Baseline.from_findings(
+            lint_source(self.SRC, "pkg/mod.py")).save(path)
+        shifted = "# a new header comment\n\n" + self.SRC
+        new, matched = Baseline.load(path).split(
+            lint_source(shifted, "pkg/mod.py"))
+        assert new == [] and matched
+
+    def test_second_occurrence_is_new(self, tmp_path):
+        # The baseline budget is a multiset: one entry forgives ONE
+        # occurrence, a second identical defect is still a finding.
+        path = str(tmp_path / "baseline.json")
+        Baseline.from_findings(
+            lint_source(self.SRC, "pkg/mod.py")).save(path)
+        doubled = self.SRC + (
+            "    def dispatch2(self):  # hot-loop\n"
+            "        return jnp.asarray(self._table)\n")
+        new, matched = Baseline.load(path).split(
+            lint_source(doubled, "pkg/mod.py"))
+        assert len(matched) == 1 and len(new) == 1
+
+    def test_committed_baseline_exists(self):
+        path = find_baseline([os.path.join(REPO, "kubeflow_tpu")])
+        assert path is not None
+        assert os.path.basename(path) == ".kftpu-lint-baseline.json"
+        assert os.path.dirname(path) == REPO
+
+
+class TestRegistry:
+    def test_all_families_registered(self):
+        ids = {r.id for r in all_rules()}
+        assert {"D101", "D102", "D103", "D104", "D105",
+                "C301", "C302", "C303", "M201", "M202"} <= ids
+
+    def test_parse_error_is_reported_not_raised(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        result = run_lint([str(bad)], root=str(tmp_path))
+        assert not result.ok
+        assert [e.rule for e in result.errors] == ["E000"]
+
+
+# -- seeded regressions against the REAL codebase (acceptance criteria) --------
+
+
+def _new_findings(relpath: str, old: str, new: str):
+    with open(os.path.join(REPO, relpath)) as f:
+        src = f.read()
+    mutated = src.replace(old, new, 1)
+    assert mutated != src, f"mutation anchor vanished from {relpath}"
+    before = {f.fingerprint for f in lint_source(src, relpath)}
+    return [f for f in lint_source(mutated, relpath)
+            if f.fingerprint not in before]
+
+
+class TestSeededRegressions:
+    def test_pr4_full_table_reupload_is_caught(self):
+        """Re-introducing the PR-4 bug — a per-round full page-table
+        upload in the dispatch hot loop — produces exactly one D103."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/engine.py",
+            "        self._sync_decode_state()\n",
+            "        self._sync_decode_state()\n"
+            "        table = jnp.asarray(self._table)\n")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "D103" and "self._table" in f.message
+        assert "_dispatch_round" in f.message
+
+    def test_removed_router_lock_is_caught(self):
+        """Dropping one router lock acquisition produces exactly one C301
+        naming the attribute and the offending method."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/router.py",
+            "    def note_activity(self) -> None:\n"
+            "        with self._lock:\n",
+            "    def note_activity(self) -> None:\n"
+            "        if True:\n")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "C301"
+        assert "_last_activity" in f.message
+        assert "note_activity" in f.message
+
+    def test_bad_metric_name_is_caught(self):
+        """A metric family registered without the kftpu_ prefix fails at
+        lint time (obs/registry.lint() made static)."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/server.py",
+            'reg.gauge("kftpu_serving_queue_depth")',
+            'reg.gauge("serving_queue_depth")')
+        assert [f.rule for f in fresh] == ["M201"]
+
+
+# -- self-scan + CLI -----------------------------------------------------------
+
+
+class TestSelfScan:
+    def test_repo_is_clean_against_committed_baseline(self):
+        baseline_path = find_baseline([os.path.join(REPO, "kubeflow_tpu")])
+        baseline = Baseline.load(baseline_path) if baseline_path else None
+        result = run_lint(
+            [os.path.join(REPO, p) for p in
+             ("kubeflow_tpu", "scripts", "bench.py", "bench_serve.py")],
+            baseline=baseline, root=REPO)
+        assert result.files_scanned > 50
+        assert result.errors == []
+        assert result.new == [], "\n".join(
+            f.render() for f in result.new)
+
+
+class TestCli:
+    def test_kftpu_lint_exit_codes(self, tmp_path):
+        from kubeflow_tpu.cli import main as cli_main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(TestFullBufferReupload.POSITIVE)
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert cli_main(["lint", "--no-baseline", str(clean)]) == 0
+        assert cli_main(["lint", "--no-baseline", str(dirty)]) == 1
+
+    def test_json_output_has_clickable_locations(self, tmp_path):
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(TestFullBufferReupload.POSITIVE)
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", "--json",
+             "--no-baseline", str(dirty)],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert doc["ok"] is False and len(doc["findings"]) == 1
+        f = doc["findings"][0]
+        assert f["rule"] == "D103" and f["line"] == 4 and f["col"] >= 1
+
+    def test_update_baseline_then_clean(self, tmp_path):
+        from kubeflow_tpu.cli import main as cli_main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(TestFullBufferReupload.POSITIVE)
+        bl = tmp_path / "bl.json"
+        assert cli_main(["lint", "--update-baseline",
+                         "--baseline", str(bl), str(dirty)]) == 0
+        assert cli_main(["lint", "--baseline", str(bl), str(dirty)]) == 0
+        assert cli_main(["lint", "--no-baseline", str(dirty)]) == 1
+
+    def test_list_rules(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        for rid in ("D103", "C301", "M201"):
+            assert rid in proc.stdout

@@ -272,6 +272,61 @@ class TestFirstTokenBatching:
         assert gen_all(eng, PROMPTS, max_new=6) == want
 
 
+class TestTransferGuard:
+    """Runtime half of the static device-hygiene rules (ISSUE 5): the
+    engine's transfer contract is that every steady-state host<->device
+    move is EXPLICIT (device_put at the sync sites, device_get at the
+    designed fetch points). Proven by running mid-generation decode
+    rounds under ``jax.transfer_guard("disallow")`` — an implicit
+    transfer anywhere raises — on all three engine flavors, and by the
+    ``KFTPU_SANITIZE=1`` mode that wires the same guard inside step()."""
+
+    def _steady_state_under_guard(self, eng, warmup=6, guarded=5):
+        sp = SamplingParams(max_new_tokens=60, temperature=0.0)
+        req = eng.submit([3, 1, 4, 1, 5], sp)
+        for _ in range(warmup):
+            eng.step()          # admit + first token + enter steady decode
+        assert not req.done.is_set()
+        rounds_before = eng.decode_rounds
+        with jax.transfer_guard("disallow"):
+            for _ in range(guarded):
+                eng.step()
+        assert eng.decode_rounds > rounds_before
+        run_all(eng, [req])
+        return req
+
+    def test_dense_steady_state(self, cfg, params):
+        self._steady_state_under_guard(
+            make_engine(cfg, params, pipelined=True))
+
+    def test_paged_steady_state(self, cfg, params):
+        eng = make_engine(cfg, params, pipelined=True, paged=True)
+        self._steady_state_under_guard(eng)
+        assert eng.kv_pages_in_use() == 0
+
+    def test_spec_steady_state(self, cfg, params):
+        spec = SpeculativeSpec(mode="ngram", k=4)
+        self._steady_state_under_guard(
+            make_engine(cfg, params, pipelined=True, spec=spec))
+
+    def test_sanitize_mode_token_identity(self, cfg, params, monkeypatch):
+        """KFTPU_SANITIZE=1 engines guard every decode pass themselves and
+        still produce reference greedy outputs on every flavor."""
+        want = gen_all(make_engine(cfg, params, pipelined=False), PROMPTS)
+        monkeypatch.setenv("KFTPU_SANITIZE", "1")
+        for kw in ({}, {"paged": True},
+                   {"spec": SpeculativeSpec(mode="ngram", k=4)}):
+            eng = make_engine(cfg, params, pipelined=True, **kw)
+            assert eng.sanitize
+            assert gen_all(eng, PROMPTS) == want
+
+    def test_sanitize_mode_off_by_default(self, cfg, params, monkeypatch):
+        monkeypatch.delenv("KFTPU_SANITIZE", raising=False)
+        assert not make_engine(cfg, params, pipelined=True).sanitize
+        monkeypatch.setenv("KFTPU_SANITIZE", "0")
+        assert not make_engine(cfg, params, pipelined=True).sanitize
+
+
 class TestHotLoopMetrics:
     """Satellite: host_gap + dispatch_depth in EngineMetrics.snapshot()
     and on /metrics through the PR 3 registry."""
