@@ -90,6 +90,71 @@ class SpeculativeSpec(BaseModel):
         return self
 
 
+#: Multi-tenant QoS classes, highest priority first. The order IS the
+#: policy: admission dequeues strictly by it, overload sheds from the
+#: BACK of it (batch 429s before interactive ever does), and cross-class
+#: preemption only ever evicts a strictly lower class.
+QOS_CLASSES = ("interactive", "standard", "batch")
+
+#: class name -> priority rank (lower = more urgent).
+QOS_PRIORITY = {c: i for i, c in enumerate(QOS_CLASSES)}
+
+#: Default class for requests that declare none (absent X-Kftpu-Qos
+#: header / body field): the middle tier, so both "more urgent" and
+#: "more sheddable" exist relative to it.
+QOS_DEFAULT = "standard"
+
+
+class QoSClassPolicy(BaseModel):
+    """Per-class admission knobs. Unset fields inherit the engine-wide
+    ``BatchingSpec.max_queue`` / ``queue_delay_budget`` behavior."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # Per-class admission quota: submit() sheds THIS class with 429 once
+    # this many of its requests wait for a slot (0 = no class quota —
+    # only the engine-wide bound applies). Lets a batch tenant's burst
+    # hit its own ceiling long before it can crowd the shared queue.
+    max_queue: int = 0
+    # Per-class queue-delay budget (seconds): a request of this class
+    # still waiting for a slot this long after arrival is shed
+    # (finish_reason="shed"). None = the engine-wide budget.
+    queue_delay_budget: Optional[float] = None
+
+    @model_validator(mode="after")
+    def _check(self) -> "QoSClassPolicy":
+        if self.max_queue < 0:
+            raise ValueError("max_queue must be >= 0")
+        if self.queue_delay_budget is not None and self.queue_delay_budget <= 0:
+            raise ValueError("queue_delay_budget must be positive")
+        return self
+
+
+class QoSSpec(BaseModel):
+    """Multi-tenant scheduling policy for the engine: per-class admission
+    quotas/budgets plus cross-class recompute preemption. Class priority
+    itself is fixed (``QOS_CLASSES`` order) — the spec tunes how hard each
+    tier is protected, not who outranks whom."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    classes: dict[str, QoSClassPolicy] = Field(default_factory=dict)
+    # Cross-class preemption: an arriving higher-class request may
+    # recompute-preempt the youngest slot of the lowest running class
+    # (vLLM-style recompute via the engine's preempted lane). False
+    # limits preemption to the existing page-pressure path.
+    preemption: bool = True
+
+    @model_validator(mode="after")
+    def _check(self) -> "QoSSpec":
+        unknown = set(self.classes) - set(QOS_CLASSES)
+        if unknown:
+            raise ValueError(
+                f"unknown QoS classes {sorted(unknown)}; "
+                f"known: {list(QOS_CLASSES)}")
+        return self
+
+
 class BatchingSpec(BaseModel):
     """Continuous-batching engine knobs (≈ vLLM engine args in the HF runtime)."""
 
@@ -202,6 +267,64 @@ class BatchingSpec(BaseModel):
     # prefilling it would only steal capacity from requests that can still
     # meet their deadlines. None = off.
     queue_delay_budget: Optional[float] = None
+    # Multi-tenant QoS: per-class admission quotas/queue-delay budgets,
+    # strict-priority dequeue, shed-lowest-first under overload, and
+    # cross-class recompute preemption. The defaults keep single-class
+    # traffic byte-for-byte on the pre-QoS behavior (everything is
+    # "standard" unless a request declares otherwise).
+    qos: QoSSpec = Field(default_factory=QoSSpec)
+
+
+class SLOPolicy(BaseModel):
+    """Signal-driven autoscaling targets ((U) Knative KPA, but the signal
+    is the ENGINE's own latency histograms rather than opaque concurrency):
+    the ISVC autoscaler scrapes each replica's queue-delay p95 and TTFT p95
+    off /metrics, forms a utilization ratio against these targets, and
+    resizes within ``min_replicas..max_replicas`` with hysteresis and a
+    cooldown. Missing or stale signals HOLD the current count — an
+    autoscaler must never flap on blindness."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # Latency targets (milliseconds). At least one must be set; when both
+    # are, the binding (worse) ratio drives scaling.
+    target_ttft_ms: Optional[float] = None
+    target_queue_delay_ms: Optional[float] = None
+    # Per-class weights for the pooled ratio when replicas expose
+    # per-class p95s: interactive SLO misses count fully, batch barely —
+    # batch backlog alone must not buy replicas an interactive tenant
+    # doesn't need. Classes absent here default to weight 0.
+    class_weights: dict[str, float] = Field(default_factory=lambda: {
+        "interactive": 1.0, "standard": 0.5, "batch": 0.1})
+    # Hysteresis dead band: scale up when the pooled ratio exceeds
+    # ``scale_up_ratio``, down when it falls below ``scale_down_ratio``;
+    # inside the band the count holds. up > down keeps the two decisions
+    # from chasing each other.
+    scale_up_ratio: float = 1.1
+    scale_down_ratio: float = 0.5
+    # Minimum quiet time between ANY two resize decisions (seconds).
+    cooldown_s: float = 10.0
+
+    @model_validator(mode="after")
+    def _check(self) -> "SLOPolicy":
+        if self.target_ttft_ms is None and self.target_queue_delay_ms is None:
+            raise ValueError(
+                "SLOPolicy needs target_ttft_ms and/or target_queue_delay_ms")
+        for f in ("target_ttft_ms", "target_queue_delay_ms"):
+            v = getattr(self, f)
+            if v is not None and v <= 0:
+                raise ValueError(f"{f} must be positive")
+        if not (0 < self.scale_down_ratio < self.scale_up_ratio):
+            raise ValueError("need 0 < scale_down_ratio < scale_up_ratio")
+        unknown = set(self.class_weights) - set(QOS_CLASSES)
+        if unknown:
+            raise ValueError(
+                f"unknown QoS classes in class_weights {sorted(unknown)}")
+        if any(w < 0 for w in self.class_weights.values()):
+            raise ValueError("class_weights must be >= 0")
+        if self.cooldown_s < 0:
+            raise ValueError("cooldown_s must be >= 0")
+        return self
 
 
 class PredictorSpec(BaseModel):
@@ -212,6 +335,10 @@ class PredictorSpec(BaseModel):
     max_replicas: int = 1
     scale_target: int = 4            # target in-flight requests per replica (≈ KPA concurrency)
     scale_metric: str = "concurrency"
+    # Signal-driven autoscaling: when set, replica count is driven by the
+    # engine's own queue-delay/TTFT p95s against these targets instead of
+    # the concurrency heuristic above (which remains the default).
+    slo: Optional[SLOPolicy] = None
     canary_traffic_percent: Optional[int] = None
     resources: TPUResourceSpec = Field(default_factory=TPUResourceSpec)
     parallelism: ParallelismSpec = Field(default_factory=ParallelismSpec)

@@ -68,7 +68,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from kubeflow_tpu.core.serving import BatchingSpec
+from kubeflow_tpu.core.serving import (
+    BatchingSpec, QOS_DEFAULT, QOS_PRIORITY,
+)
 from kubeflow_tpu.serve.device_state import DEAD_SLOT, DecodeState
 from kubeflow_tpu.models import layers as L
 from kubeflow_tpu.models.config import DecoderConfig
@@ -83,9 +85,11 @@ class EngineOverloaded(Exception):
     door, in microseconds, instead of queueing into a guaranteed timeout.
     The protocol layer maps this to HTTP 429 + ``Retry-After``."""
 
-    def __init__(self, message: str, retry_after: float = 1.0):
+    def __init__(self, message: str, retry_after: float = 1.0,
+                 qos: str = QOS_DEFAULT):
         super().__init__(message)
         self.retry_after = retry_after
+        self.qos = qos
 
 
 # -- sampling ------------------------------------------------------------------
@@ -361,6 +365,10 @@ class Request:
     # live slot), freeing the slot and its KV pages instead of decoding
     # dead work.
     deadline: Optional[float] = None
+    # Multi-tenant QoS class (core/serving.QOS_CLASSES): drives admission
+    # quotas, strict-priority dequeue, shed order under overload, and
+    # cross-class preemption. Rides end-to-end on the X-Kftpu-Qos header.
+    qos: str = QOS_DEFAULT
     # Recompute-preemption bookkeeping (paged engine): output tokens already
     # folded back into prompt_tokens when the slot was preempted.
     resumed_from: int = 0
@@ -509,9 +517,17 @@ class EngineMetrics:
         self.requests_shed = 0          # guarded_by: _lock
         self.requests_cancelled = 0     # guarded_by: _lock
         self.requests_expired = 0       # guarded_by: _lock
+        self.preemptions = 0            # guarded_by: _lock
         self._qd_counts = [0] * (len(QUEUE_DELAY_BUCKETS) + 1)  # guarded_by: _lock
         self._qd_sum = 0.0              # guarded_by: _lock
         self._qd_n = 0                  # guarded_by: _lock
+        self._qd: list[float] = []      # guarded_by: _lock (p95 window)
+        # Per-QoS-class health (multi-tenant SLO attainment): shed /
+        # preemption / completion counters plus TTFT and queue-delay
+        # windows + histogram counts, keyed by class. Lazily created, so
+        # a single-class engine carries exactly one entry and the
+        # pre-QoS snapshot shape is unchanged.
+        self._qos: dict[str, dict] = {}  # guarded_by: _lock
         # decode hot-loop health: host gap per round + dispatch depth
         # (0 = every round waits on the host; 1 = one round in flight
         # while the host works — the pipelined steady state).
@@ -521,13 +537,28 @@ class EngineMetrics:
         self._hg_sum = 0.0              # guarded_by: _lock
         self._hg_n = 0                  # guarded_by: _lock
 
+    def _qos_entry(self, qos: str) -> dict:  # requires_lock: _lock
+        e = self._qos.get(qos)
+        if e is None:
+            e = self._qos[qos] = {
+                "completed": 0, "shed": 0, "preempted": 0,
+                "ttft": [], "qd": [],
+                "qd_counts": [0] * (len(QUEUE_DELAY_BUCKETS) + 1),
+                "qd_sum": 0.0, "qd_n": 0,
+            }
+        return e
+
     def observe(self, req: Request) -> None:
         with self._lock:
             self.requests_completed += 1
             self.tokens_generated += len(req.output_tokens)
+            e = self._qos_entry(req.qos)
+            e["completed"] += 1
             if req.ttft is not None:
                 self._ttft.append(req.ttft)
                 self._ttft = self._ttft[-self._window:]
+                e["ttft"].append(req.ttft)
+                e["ttft"] = e["ttft"][-self._window:]
             if (req.finish_time is not None and req.first_token_time is not None
                     and len(req.output_tokens) > 1):
                 tpot = ((req.finish_time - req.first_token_time)
@@ -535,9 +566,17 @@ class EngineMetrics:
                 self._tpot.append(tpot)
                 self._tpot = self._tpot[-self._window:]
 
-    def note_shed(self) -> None:
+    def note_shed(self, qos: str = QOS_DEFAULT) -> None:
         with self._lock:
             self.requests_shed += 1
+            self._qos_entry(qos)["shed"] += 1
+
+    def note_preempted(self, qos: str = QOS_DEFAULT) -> None:
+        """One recompute preemption, labeled by the VICTIM's class —
+        the series that shows batch absorbing interactive's bursts."""
+        with self._lock:
+            self.preemptions += 1
+            self._qos_entry(qos)["preempted"] += 1
 
     def note_abandoned(self, reason: str) -> None:
         with self._lock:
@@ -546,7 +585,8 @@ class EngineMetrics:
             else:
                 self.requests_expired += 1
 
-    def observe_queue_delay(self, seconds: float) -> None:
+    def observe_queue_delay(self, seconds: float,
+                            qos: str = QOS_DEFAULT) -> None:
         with self._lock:
             i = 0
             while i < len(QUEUE_DELAY_BUCKETS) \
@@ -555,14 +595,33 @@ class EngineMetrics:
             self._qd_counts[i] += 1
             self._qd_sum += seconds
             self._qd_n += 1
+            self._qd.append(seconds)
+            self._qd = self._qd[-self._window:]
+            e = self._qos_entry(qos)
+            e["qd_counts"][i] += 1
+            e["qd_sum"] += seconds
+            e["qd_n"] += 1
+            e["qd"].append(seconds)
+            e["qd"] = e["qd"][-self._window:]
 
-    def queue_delay_histogram(self) -> tuple[list[float], list[int],
-                                             float, int]:
+    def queue_delay_histogram(self, qos: Optional[str] = None
+                              ) -> tuple[list[float], list[int], float, int]:
         """(bucket upper bounds, per-bucket counts incl. +Inf tail, sum,
-        count) — the Prometheus-histogram raw material."""
+        count) — the Prometheus-histogram raw material. ``qos`` selects one
+        class's histogram (all-zero for a class never seen)."""
         with self._lock:
-            return (list(QUEUE_DELAY_BUCKETS), list(self._qd_counts),
-                    self._qd_sum, self._qd_n)
+            if qos is None:
+                return (list(QUEUE_DELAY_BUCKETS), list(self._qd_counts),
+                        self._qd_sum, self._qd_n)
+            e = self._qos_entry(qos)
+            return (list(QUEUE_DELAY_BUCKETS), list(e["qd_counts"]),
+                    e["qd_sum"], e["qd_n"])
+
+    def qos_classes(self) -> list[str]:
+        """Classes this engine has observed (metrics exposition drives
+        one labeled series set per entry)."""
+        with self._lock:
+            return sorted(self._qos)
 
     def observe_host_gap(self, seconds: float) -> None:
         with self._lock:
@@ -609,9 +668,32 @@ class EngineMetrics:
                 "requests_shed": self.requests_shed,
                 "requests_cancelled": self.requests_cancelled,
                 "requests_expired": self.requests_expired,
+                "preemptions": self.preemptions,
             }
             if self._qd_n:
                 out["queue_delay_avg_ms"] = self._qd_sum / self._qd_n * 1e3
+            if self._qd:
+                arr = np.asarray(self._qd)
+                out["queue_delay_p95_ms"] = float(
+                    np.percentile(arr, 95) * 1e3)
+            # Per-class SLO attainment: the series the signal-driven
+            # autoscaler and the overload dashboards read.
+            qos_out: dict[str, dict[str, Any]] = {}
+            for cls, e in self._qos.items():
+                c: dict[str, Any] = {"completed": e["completed"],
+                                     "shed": e["shed"],
+                                     "preempted": e["preempted"]}
+                if e["ttft"]:
+                    arr = np.asarray(e["ttft"])
+                    c["ttft_p50_ms"] = float(np.percentile(arr, 50) * 1e3)
+                    c["ttft_p95_ms"] = float(np.percentile(arr, 95) * 1e3)
+                if e["qd"]:
+                    arr = np.asarray(e["qd"])
+                    c["queue_delay_p95_ms"] = float(
+                        np.percentile(arr, 95) * 1e3)
+                qos_out[cls] = c
+            if qos_out:
+                out["qos"] = qos_out
             out["dispatch_depth"] = self.dispatch_depth
             if self._hg_n:
                 out["host_gap_seconds"] = self._hg_sum
@@ -622,6 +704,7 @@ class EngineMetrics:
                 if xs:
                     arr = np.asarray(xs)
                     out[f"{name}_p50_ms"] = float(np.percentile(arr, 50) * 1e3)
+                    out[f"{name}_p95_ms"] = float(np.percentile(arr, 95) * 1e3)
                     out[f"{name}_p99_ms"] = float(np.percentile(arr, 99) * 1e3)
             if self.spec_rounds:
                 out["spec_rounds"] = self.spec_rounds
@@ -1035,6 +1118,13 @@ class LLMEngine:
         self.max_queue = max(0, int(b.max_queue))
         self.queue_delay_budget = (None if b.queue_delay_budget is None
                                    else float(b.queue_delay_budget))
+        # Multi-tenant QoS (BatchingSpec.qos): per-class admission quotas
+        # and queue-delay budgets; the priority order itself is fixed
+        # (core/serving.QOS_PRIORITY). ``qos_preemption`` enables
+        # cross-class recompute preemption on top of the page-pressure
+        # preemption that always exists.
+        self.qos_policies = dict(b.qos.classes)
+        self.qos_preemption = bool(b.qos.preemption)
         self._id_gen = itertools.count()
         # Runtime sanitizer (KFTPU_SANITIZE=1): run every scheduler step
         # under ``jax.transfer_guard("disallow")``. The engine's transfer
@@ -1080,6 +1170,21 @@ class LLMEngine:
         admission bound and the metrics gauge."""
         return self.waiting.qsize() + len(self._backlog)
 
+    def class_queue_depth(self, qos: str) -> int:
+        """Waiting requests of ONE class (admission queue + backlog) — the
+        per-class admission quota's input. Approximate under concurrency,
+        exactly like ``queue_depth``."""
+        return (sum(1 for r in list(self.waiting.queue) if r.qos == qos)
+                + sum(1 for r in list(self._backlog) if r.qos == qos))
+
+    def _lower_class_waiting(self, qos: str) -> bool:
+        """Any waiting request of a STRICTLY lower class than ``qos``?
+        (The shed-lowest-first question: a full queue 429s the arrival
+        only when nothing more sheddable is already waiting.)"""
+        p = QOS_PRIORITY[qos]
+        return any(QOS_PRIORITY.get(r.qos, p) > p
+                   for r in list(self.waiting.queue) + list(self._backlog))
+
     def kv_pages_in_use(self) -> int:
         """Referenced paged-KV pages (0 for the contiguous cache). The
         chaos-suite invariant: quiescent engine -> 0 — every reap/finish
@@ -1090,24 +1195,43 @@ class LLMEngine:
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None, *,
                deadline: Optional[float] = None,
-               trace_parent=None) -> Request:
+               trace_parent=None, qos: str = QOS_DEFAULT) -> Request:
         if not prompt_tokens:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.max_len:
             raise ValueError(
                 f"prompt length {len(prompt_tokens)} >= max_seq_len {self.max_len}")
+        if qos not in QOS_PRIORITY:
+            raise ValueError(
+                f"unknown QoS class {qos!r}; known: {sorted(QOS_PRIORITY)}")
+        pol = self.qos_policies.get(qos)
+        if pol is not None and pol.max_queue \
+                and self.class_queue_depth(qos) >= pol.max_queue:
+            # Per-class quota: one tenant tier's burst hits its own
+            # ceiling without ever crowding the shared queue.
+            self.metrics.note_shed(qos)
+            raise EngineOverloaded(
+                f"{qos} admission quota full "
+                f"(max_queue={pol.max_queue})", qos=qos)
         if self.max_queue:
             depth = self.queue_depth()
-            if depth >= self.max_queue:
-                self.metrics.note_shed()
+            if depth >= self.max_queue and not self._lower_class_waiting(qos):
+                # Shed-lowest-first: the arrival is itself the most
+                # sheddable class present, so IT takes the 429. When a
+                # strictly lower class waits, over-admit instead — the
+                # scheduler sheds that lower entry at its next step
+                # (_enforce_queue_bound), so batch always 429s before
+                # interactive ever does.
+                self.metrics.note_shed(qos)
                 raise EngineOverloaded(
                     f"admission queue full ({depth} >= "
-                    f"max_queue={self.max_queue})")
+                    f"max_queue={self.max_queue})", qos=qos)
         req = Request(prompt_tokens=list(prompt_tokens),
                       params=params or SamplingParams(),
                       id=request_id or f"req-{next(self._id_gen)}",
-                      deadline=deadline, trace_parent=trace_parent)
-        _span_open(req, "engine.queued", prompt_tokens=len(prompt_tokens))
+                      deadline=deadline, trace_parent=trace_parent, qos=qos)
+        _span_open(req, "engine.queued", prompt_tokens=len(prompt_tokens),
+                   qos=qos)
         self.waiting.put(req)
         self._wake.set()
         return req
@@ -1227,6 +1351,7 @@ class LLMEngine:
                     self._chunkings.remove(ch)
                     self._release_slot_pages(slot_idx)
                     self._preempted.append(req)
+                    self.metrics.note_preempted(req.qos)
                 return 0    # otherwise retry next scheduler step
             ch.stalls = 0
             pg = self.page_size
@@ -1292,7 +1417,7 @@ class LLMEngine:
         req.stream.put(None)
         req.done.set()
         if reason == "shed":
-            self.metrics.note_shed()
+            self.metrics.note_shed(req.qos)
         elif reason in ("cancelled", "deadline"):
             self.metrics.note_abandoned(reason)
 
@@ -1330,46 +1455,82 @@ class LLMEngine:
         for lane in (self._preempted, self._backlog):
             for req in list(lane):
                 reason = req.abandon_reason(now)
-                if reason is None and lane is self._backlog \
-                        and self.queue_delay_budget is not None \
-                        and now - req.arrival > self.queue_delay_budget:
-                    reason = "shed"
+                if reason is None and lane is self._backlog:
+                    # Queue-delay budget: the request's class budget when
+                    # one is declared, else the engine-wide budget — an
+                    # interactive tier can shed stale work aggressively
+                    # while batch waits out long queues.
+                    budget = self.queue_delay_budget
+                    pol = self.qos_policies.get(req.qos)
+                    if pol is not None \
+                            and pol.queue_delay_budget is not None:
+                        budget = pol.queue_delay_budget
+                    if budget is not None and now - req.arrival > budget:
+                        reason = "shed"
                 if reason:
                     lane.remove(req)
                     self._fail_request(req, reason)
                     n += 1
         return n
 
+    def _enforce_queue_bound(self) -> int:
+        """Restore the global admission bound by shedding from the BACK of
+        the priority order: when a higher-class arrival over-admitted past
+        a full queue (submit's shed-lowest-first contract), the lowest-
+        class, youngest waiting request pays for it — batch is shed before
+        interactive ever is. Returns requests shed."""
+        if not self.max_queue:
+            return 0
+        self._drain_waiting()
+        n = 0
+        while len(self._backlog) > self.max_queue:
+            victim = max(self._backlog,
+                         key=lambda r: (QOS_PRIORITY.get(r.qos, 1),
+                                        r.arrival))
+            self._backlog.remove(victim)
+            self._fail_request(victim, "shed")
+            n += 1
+        return n
+
     def _note_admitted(self, req: Request) -> Request:
-        self.metrics.observe_queue_delay(time.monotonic() - req.arrival)
+        self.metrics.observe_queue_delay(time.monotonic() - req.arrival,
+                                         qos=req.qos)
         return req
 
     def _next_admissible(self) -> Optional[Request]:
-        """Next request the scheduler may start. Paged admission control
-        (livelock prevention under pool pressure): a preempted request
-        resumes FIRST and only once the pool can hold its entire remaining
-        run — and while one waits, nothing else is admitted (backpressure);
-        fresh requests need room for their prompt plus one growth page."""
+        """Next request the scheduler may start: STRICT PRIORITY across QoS
+        classes (QOS_PRIORITY order), FIFO within a class.
+
+        Within each class the preempted lane resumes first, and — paged —
+        only once the pool can hold its entire remaining run; while one
+        waits, nothing at its class or below is admitted (the livelock
+        backpressure, scoped per class so a higher-class arrival can still
+        jump a starved batch resume). Fresh paged requests need room for
+        their prompt plus one growth page. Single-class traffic reduces to
+        the pre-QoS behavior exactly."""
         self._drain_waiting()
-        if not self.paged:
-            if not self._backlog:
-                return None
-            return self._note_admitted(self._backlog.pop(0))
-        if self._preempted:
-            req = self._preempted[0]
-            remaining = max(req.params.max_new_tokens
-                            - len(req.output_tokens), 0)
-            if self._allocator.available() < self._pages_for(
-                    len(req.prompt_tokens) + remaining):
-                return None
-            return self._preempted.pop(0)
-        if not self._backlog:
-            return None
-        req = self._backlog[0]
-        if self._allocator.available() < self._pages_for(
-                len(req.prompt_tokens)) + 1:
-            return None
-        return self._note_admitted(self._backlog.pop(0))
+        for cls in sorted(QOS_PRIORITY, key=QOS_PRIORITY.get):
+            pre = next((r for r in self._preempted if r.qos == cls), None)
+            if pre is not None:
+                if not self.paged:
+                    self._preempted.remove(pre)
+                    return pre
+                remaining = max(pre.params.max_new_tokens
+                                - len(pre.output_tokens), 0)
+                if self._allocator.available() >= self._pages_for(
+                        len(pre.prompt_tokens) + remaining):
+                    self._preempted.remove(pre)
+                    return pre
+                return None          # backpressure: this class and below wait
+            req = next((r for r in self._backlog if r.qos == cls), None)
+            if req is None:
+                continue
+            if self.paged and self._allocator.available() < self._pages_for(
+                    len(req.prompt_tokens)) + 1:
+                return None          # head-of-line within the priority order
+            self._backlog.remove(req)
+            return self._note_admitted(req)
+        return None
 
     def _admit(self) -> int:
         """Prefill waiting requests into free slots. Returns admissions.
@@ -1386,6 +1547,11 @@ class LLMEngine:
             slot_idx = self._free_slot(
                 frozenset(p[1] for p in pending))
             if slot_idx is None:
+                # Slots exhausted: a strictly higher-class arrival may
+                # recompute-preempt the lowest running class's youngest
+                # slot (cross-class preemption) and take its place.
+                if self._maybe_preempt_for_priority():
+                    continue
                 break
             req = self._next_admissible()
             if req is None:
@@ -1546,14 +1712,49 @@ class LLMEngine:
         self.slots[idx] = None
         self._dstate.mark_slot(idx)
         self._preempted.append(req)
+        self.metrics.note_preempted(req.qos)
 
     def _preempt_youngest(self, keep: int) -> bool:
-        candidates = [(s.admit_seq, i) for i, s in enumerate(self.slots)
+        """Page-pressure preemption victim: the youngest slot of the
+        LOWEST-priority running class (all-default traffic reduces to
+        plain youngest-first, the pre-QoS behavior)."""
+        candidates = [(QOS_PRIORITY.get(s.request.qos, 1), s.admit_seq, i)
+                      for i, s in enumerate(self.slots)
                       if s is not None and i != keep]
         if not candidates:
             return False
-        _, idx = max(candidates)
+        _, _, idx = max(candidates)
         self._preempt_slot(idx)
+        return True
+
+    def _waiting_priority(self) -> Optional[int]:
+        """Best (numerically lowest) QoS rank waiting for admission."""
+        self._drain_waiting()
+        ranks = [QOS_PRIORITY.get(r.qos, 1)
+                 for r in self._backlog + self._preempted]
+        return min(ranks) if ranks else None
+
+    def _maybe_preempt_for_priority(self) -> bool:
+        """Cross-class recompute preemption: every slot is busy and a
+        STRICTLY higher class waits → evict the youngest slot of the
+        lowest running class through the existing preempted lane
+        (refcount-balanced: ``_preempt_slot`` frees the pages; the victim
+        recomputes on re-admission and strict-priority dequeue keeps it
+        behind everything more urgent). Never evicts the waiting class's
+        own tier — preemption changes WHO degrades, not whether."""
+        if not self.qos_preemption:
+            return False
+        waiting = self._waiting_priority()
+        if waiting is None:
+            return False
+        victims = [(QOS_PRIORITY.get(s.request.qos, 1), s.admit_seq, i)
+                   for i, s in enumerate(self.slots) if s is not None]
+        if not victims:
+            return False
+        vrank, _, vidx = max(victims)
+        if vrank <= waiting:
+            return False
+        self._preempt_slot(vidx)
         return True
 
     def _finish_if_done(self, idx: int) -> bool:
@@ -1978,7 +2179,8 @@ class LLMEngine:
         round in flight). Under ``KFTPU_SANITIZE=1`` the decode pass runs
         with implicit transfers disallowed — the runtime half of the
         static device-hygiene rules."""
-        n = self._reap_abandoned() + self._admit()
+        n = self._reap_abandoned() + self._enforce_queue_bound() \
+            + self._admit()
         with self._transfer_guard():
             n += self._decode_once()
         if n == 0:

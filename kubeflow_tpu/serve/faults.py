@@ -226,7 +226,7 @@ def _chaos_handler(proxy: ChaosProxy):
             body = self.rfile.read(n) if n else None
             fwd_headers = {"Content-Type": self.headers.get(
                 "Content-Type", "application/json")}
-            for h in ("X-Kftpu-Deadline-Ms",):
+            for h in ("X-Kftpu-Deadline-Ms", "X-Kftpu-Qos"):
                 if self.headers.get(h):
                     fwd_headers[h] = self.headers[h]
             req = urllib.request.Request(

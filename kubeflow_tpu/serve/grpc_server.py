@@ -21,9 +21,11 @@ from typing import Optional
 
 import grpc
 
+from kubeflow_tpu.core.serving import QOS_DEFAULT
 from kubeflow_tpu.obs.trace import TRACE_HEADER, get_tracer
 from kubeflow_tpu.serve.engine import EngineOverloaded
 from kubeflow_tpu.serve.protos import oip_pb2 as pb
+from kubeflow_tpu.serve.router import QOS_HEADER
 
 SERVICE = "inference.GRPCInferenceService"
 
@@ -122,12 +124,17 @@ class GRPCInferenceServer:
         # whichever protocol family carried the request.
         tracer = get_tracer()
         md = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+        # QoS rides gRPC invocation metadata under the same (lowercased)
+        # key the HTTP header uses — one propagation convention for both
+        # protocol families.
+        qos = str(md.get(QOS_HEADER.lower(), QOS_DEFAULT)).strip().lower()
         with tracer.span("grpc.model_infer",
                          parent=tracer.extract(md.get(TRACE_HEADER.lower())),
                          model=request.model_name):
-            return self._model_infer_traced(request, context, body)
+            return self._model_infer_traced(request, context, body, qos)
 
-    def _model_infer_traced(self, request, context, body):
+    def _model_infer_traced(self, request, context, body,
+                            qos: str = QOS_DEFAULT):
         texts = []
         try:
             for inp in request.inputs:
@@ -138,7 +145,7 @@ class GRPCInferenceServer:
                 for datum in inp.contents.bytes_contents:
                     out, _ = self.model_server.generate_text(
                         datum.decode("utf-8"), body, request.model_name,
-                        strict=True)
+                        strict=True, qos=qos)
                     texts.append(out.encode("utf-8"))
         except KeyError as exc:
             context.abort(grpc.StatusCode.NOT_FOUND, str(exc))

@@ -41,7 +41,8 @@ from kubeflow_tpu.core.jobs import (
     RestartPolicy, Worker, WorkerPhase, WorkerSpec, WorkerStatus, WorkloadSpec,
 )
 from kubeflow_tpu.core.object import ObjectMeta
-from kubeflow_tpu.core.serving import InferenceService
+from kubeflow_tpu.core.serving import InferenceService, SLOPolicy
+from kubeflow_tpu.obs.registry import parse_exposition
 from kubeflow_tpu.core.store import (
     AlreadyExistsError, NotFoundError, ObjectStore, WatchEvent,
 )
@@ -60,18 +61,43 @@ _SCALE_TO_ZERO_COOLDOWN = 10.0
 
 
 def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
-    """GET /healthz + scrape in-flight from /metrics. None = not ready."""
+    """GET /healthz + scrape autoscaling signals from /metrics. None = not
+    ready. Beyond the concurrency gauges, the probe carries the engine's
+    own latency signals — aggregate and per-QoS-class TTFT/queue-delay
+    p95s — which the SLO autoscaler weighs against ``SLOPolicy`` targets.
+    Signal keys are None/empty when the replica has no traffic history
+    yet: the autoscaler reads "no signal + no load" as idle and "no
+    signal + load" as blindness (hold, don't flap)."""
     try:
         with urllib.request.urlopen(url + "/healthz", timeout=timeout) as r:
             if r.status != 200:
                 return None
-        out = {"ready": True, "in_flight": 0, "requests_total": 0}
+        out = {"ready": True, "in_flight": 0, "requests_total": 0,
+               "ttft_p95_ms": None, "queue_delay_p95_ms": None,
+               "qos_ttft_p95_ms": {}, "qos_queue_delay_p95_ms": {}}
         with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
-            for line in r.read().decode().splitlines():
-                if line.startswith("kftpu_serving_in_flight"):
-                    out["in_flight"] = int(float(line.split()[-1]))
-                elif line.startswith("kftpu_serving_requests_total{"):
-                    out["requests_total"] += int(float(line.split()[-1]))
+            text = r.read().decode()
+        try:
+            samples = parse_exposition(text)
+        except ValueError:
+            return out     # unparseable exposition: ready, but blind
+        for name, labels, value in samples:
+            if name == "kftpu_serving_in_flight":
+                out["in_flight"] = int(value)
+            elif name == "kftpu_serving_requests_total":
+                out["requests_total"] += int(value)
+            elif name == "kftpu_serving_ttft_p95_ms":
+                out["ttft_p95_ms"] = max(out["ttft_p95_ms"] or 0.0, value)
+            elif name == "kftpu_serving_queue_delay_p95_ms":
+                out["queue_delay_p95_ms"] = max(
+                    out["queue_delay_p95_ms"] or 0.0, value)
+            elif name in ("kftpu_serving_qos_ttft_p95_ms",
+                          "kftpu_serving_qos_queue_delay_p95_ms"):
+                cls = labels.get("qos")
+                if cls:
+                    key = ("qos_ttft_p95_ms" if name.endswith("ttft_p95_ms")
+                           else "qos_queue_delay_p95_ms")
+                    out[key][cls] = max(out[key].get(cls, 0.0), value)
         return out
     except OSError:
         return None
@@ -225,10 +251,15 @@ class ISVCController:
                         self._retire_worker(key, router, by.pop((g, i)),
                                             isvc)
 
-        # Readiness probing, per generation.
+        # Readiness probing, per generation. ``signals`` collects each
+        # probed replica's latency scrape for the SLO autoscaler;
+        # ``probes_failed`` counts RUNNING replicas that did not answer —
+        # the "missing/stale signal" condition that HOLDS scaling.
         ready_by_gen: dict[int, list[str]] = {}
         in_flight = 0
         req_counts: dict[str, int] = {}      # replica name -> counter seen
+        signals: list[dict] = []
+        probes_failed = 0
         for (g, i), w in sorted(by.items()):
             if w.status.phase != WorkerPhase.RUNNING:
                 continue
@@ -238,6 +269,9 @@ class ISVCController:
                 ready_by_gen.setdefault(g, []).append(url)
                 in_flight += got.get("in_flight", 0)
                 req_counts[w.metadata.name] = got.get("requests_total", 0)
+                signals.append(got)
+            else:
+                probes_failed += 1
 
         # Activity clock: any traffic signal resets idleness. A replica's
         # counter counts as activity only against ITS OWN last reading
@@ -321,15 +355,20 @@ class ISVCController:
             isvc.status.set_condition("Ready", status=False,
                                       reason="NoReadyReplicas")
 
-        self._autoscale(isvc, key, in_flight, pending)
+        self._autoscale(isvc, key, in_flight, pending,
+                        signals=signals, probes_failed=probes_failed)
         self._update_status(isvc)
         return ReconcileResult(requeue_after=_RESYNC)
 
     # -- autoscaler (KPA analog) -----------------------------------------------
 
     def _autoscale(self, isvc: InferenceService, key: str, in_flight: int,
-                   pending: int) -> None:
+                   pending: int, signals: Optional[list[dict]] = None,
+                   probes_failed: int = 0) -> None:
         pred = isvc.spec.predictor
+        if pred.slo is not None:
+            return self._autoscale_slo(isvc, key, in_flight, pending,
+                                       list(signals or ()), probes_failed)
         ready = isvc.status.ready_replicas
         desired = isvc.status.desired_replicas
         if ready == 0:
@@ -379,6 +418,104 @@ class ISVCController:
                     isvc, "ScaledToZero" if to_zero else "ScaledDown",
                     f"concurrency {per_replica:.1f} < half target: "
                     f"{desired} -> {desired - 1}")
+
+    # -- SLO-driven autoscaler (the closed loop: ISSUE 6 tentpole) -------------
+
+    def _autoscale_slo(self, isvc: InferenceService, key: str,
+                       in_flight: int, pending: int, signals: list[dict],
+                       probes_failed: int) -> None:
+        """Signal-driven replica sizing: the KPA loop re-pointed at the
+        engine's OWN latency signals. Each ready replica's queue-delay/
+        TTFT p95s (per-class-weighted when exposed) form a utilization
+        ratio against the ``SLOPolicy`` targets; the pool mean scales the
+        service up past ``scale_up_ratio``, down below
+        ``scale_down_ratio``, and HOLDS inside the hysteresis band, after
+        any failed probe (blind — don't flap), and within ``cooldown_s``
+        of the previous resize. Scale-down goes through the normal retire
+        path, so a draining replica always finishes its in-flight work
+        before teardown; 1→0 additionally requires a fully idle service
+        (the scale-to-zero traffic-silence rule)."""
+        pred = isvc.spec.predictor
+        slo = pred.slo
+        ready = isvc.status.ready_replicas
+        desired = isvc.status.desired_replicas
+        if ready == 0 or not desired:
+            return     # 0→1 activation is reconcile's parked-request path
+        now = time.monotonic()
+        self._last_scale.setdefault(key, now)  # first sight starts the clock
+        if probes_failed or len(signals) < desired:
+            # Missing/stale signals: a RUNNING replica did not answer its
+            # scrape (wedged, or SIGKILLed between scrape and resize), or
+            # fewer replicas report than the service is supposed to have
+            # (a crash replacement or scale-up still starting). Resizing
+            # on partial vision is how autoscalers flap — hold until the
+            # fleet is whole and every member reports.
+            return
+        ratios = [self._slo_ratio(slo, s) for s in signals]
+        if not ratios or any(r is None for r in ratios):
+            return     # a loaded replica exposes no latency signal: hold
+        ratio = sum(ratios) / len(ratios)
+        if now - self._last_scale[key] < slo.cooldown_s:
+            return     # cooldown: no back-to-back resizes (flap guard)
+        if ratio > slo.scale_up_ratio and desired < pred.max_replicas:
+            isvc.status.desired_replicas = desired + 1
+            self._last_scale[key] = now
+            self.recorder.normal(
+                isvc, "ScaledUp",
+                f"SLO ratio {ratio:.2f} > {slo.scale_up_ratio}: "
+                f"{desired} -> {desired + 1}")
+        elif ratio < slo.scale_down_ratio and desired > pred.min_replicas:
+            to_zero = desired == 1
+            if to_zero:
+                # Dropping the LAST replica needs a fully idle service
+                # and traffic silence, same as the concurrency path.
+                if in_flight > 0 or pending > 0:
+                    return
+                idle_since = max(self._last_scale[key],
+                                 self._last_active.get(key, 0.0))
+                if now - idle_since < _SCALE_TO_ZERO_COOLDOWN:
+                    return
+            isvc.status.desired_replicas = desired - 1
+            self._last_scale[key] = now
+            self.recorder.normal(
+                isvc, "ScaledToZero" if to_zero else "ScaledDown",
+                f"SLO ratio {ratio:.2f} < {slo.scale_down_ratio}: "
+                f"{desired} -> {desired - 1}")
+
+    @staticmethod
+    def _slo_ratio(slo: SLOPolicy, sig: dict) -> Optional[float]:
+        """One replica's utilization against the SLO targets (1.0 = at
+        target). Per-class p95s are weighted by ``slo.class_weights``
+        when the replica exposes them (interactive misses dominate the
+        decision; batch backlog barely registers); otherwise the
+        aggregate p95s apply, taking the worse of the TTFT and
+        queue-delay ratios. None = the replica carries traffic but
+        exposes no latency signal — blind, so the caller holds."""
+        def _ratios(ttft_ms, qd_ms):
+            rs = []
+            if slo.target_ttft_ms is not None and ttft_ms is not None:
+                rs.append(ttft_ms / slo.target_ttft_ms)
+            if slo.target_queue_delay_ms is not None and qd_ms is not None:
+                rs.append(qd_ms / slo.target_queue_delay_ms)
+            return rs
+
+        qos_t = sig.get("qos_ttft_p95_ms") or {}
+        qos_q = sig.get("qos_queue_delay_p95_ms") or {}
+        num = den = 0.0
+        for cls in set(qos_t) | set(qos_q):
+            w = slo.class_weights.get(cls, 0.0)
+            rs = _ratios(qos_t.get(cls), qos_q.get(cls))
+            if w > 0 and rs:
+                num += w * max(rs)
+                den += w
+        if den > 0:
+            return num / den
+        rs = _ratios(sig.get("ttft_p95_ms"), sig.get("queue_delay_p95_ms"))
+        if rs:
+            return max(rs)
+        # No latency signal at all: an idle replica reads as ratio 0
+        # (scale-down-eligible); a loaded one is blind — hold.
+        return None if sig.get("in_flight", 0) > 0 else 0.0
 
     # -- children --------------------------------------------------------------
 

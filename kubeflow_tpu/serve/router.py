@@ -74,6 +74,12 @@ def quiet_handle_error(httpd) -> None:
 #: SAME deadline instead of each inventing its own.
 DEADLINE_HEADER = "X-Kftpu-Deadline-Ms"
 
+#: Multi-tenant QoS class (core/serving.QOS_CLASSES), carried end-to-end:
+#: client → router → model server → engine scheduler. The router forwards
+#: it verbatim — class policy (quotas, priority, shedding, preemption)
+#: lives in the engine, where the queue actually is.
+QOS_HEADER = "X-Kftpu-Qos"
+
 #: Local (non-proxied) router endpoints.
 ROUTER_METRICS_PATH = "/-/router/metrics"
 ROUTER_TRACES_PATH = "/-/router/debug/traces"
@@ -109,10 +115,15 @@ class Router:
         self._fails: dict[str, int] = {}           # guarded_by: _lock
         self._ejected_until: dict[str, float] = {}  # guarded_by: _lock
         self._draining: set[str] = set()            # guarded_by: _lock
+        # ``panic_total``/``probe_total`` mirror panic_picks/
+        # half_open_probes under the stable metric names the autoscaler
+        # post-mortems key on (kftpu_router_panic_total distinguishes
+        # "backends ejected" from "backends slow" — see ISSUE 6).
         self.stats = {"picks": 0, "retries": 0,    # guarded_by: _lock
                       "connect_failures": 0,
                       "http_5xx": 0, "ejections": 0, "half_open_probes": 0,
-                      "panic_picks": 0, "queue_timeouts": 0,
+                      "panic_picks": 0, "panic_total": 0, "probe_total": 0,
+                      "queue_timeouts": 0,
                       "deadline_exhausted": 0}
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.httpd.daemon_threads = True
@@ -225,6 +236,7 @@ class Router:
             if not suspects:
                 return None
             self.stats["panic_picks"] += 1
+            self.stats["panic_total"] += 1
             return min(suspects,
                        key=lambda u: self._ejected_until.get(u, 0.0))
         groups = [(g, self._weights.get(g, 0)) for g in eligible]
@@ -246,6 +258,7 @@ class Router:
             # failure re-ejects).
             self._ejected_until[url] = now + self.eject_period
             self.stats["half_open_probes"] += 1
+            self.stats["probe_total"] += 1
         return url
 
     def pick(self, exclude: frozenset = frozenset()) -> Optional[str]:
@@ -413,6 +426,10 @@ def _make_handler(router: Router):
                     # the engine-side request deadline from it.
                     DEADLINE_HEADER: str(int(remaining * 1e3)),
                 }
+                if self.headers.get(QOS_HEADER):
+                    # QoS class rides to the replica verbatim — the
+                    # engine scheduler enforces the class policy.
+                    fwd_headers[QOS_HEADER] = self.headers[QOS_HEADER]
                 trace_hdr = get_tracer().inject(sp)
                 if trace_hdr:
                     fwd_headers[TRACE_HEADER] = trace_hdr

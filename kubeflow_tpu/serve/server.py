@@ -40,11 +40,14 @@ from kubeflow_tpu.obs.registry import MetricsRegistry
 from kubeflow_tpu.obs.trace import (
     TRACE_HEADER, debug_traces_payload, get_tracer,
 )
+from kubeflow_tpu.core.serving import QOS_DEFAULT
 from kubeflow_tpu.serve.engine import (
     EngineOverloaded, HOST_GAP_BUCKETS, LLMEngine, QUEUE_DELAY_BUCKETS,
     Request, SamplingParams,
 )
-from kubeflow_tpu.serve.router import DEADLINE_HEADER, quiet_handle_error
+from kubeflow_tpu.serve.router import (
+    DEADLINE_HEADER, QOS_HEADER, quiet_handle_error,
+)
 from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
 
 
@@ -227,8 +230,8 @@ class ModelServer:
 
     def generate_text(self, prompt: str, body: dict, model: Optional[str],
                       strict: bool = False,
-                      deadline_s: Optional[float] = None
-                      ) -> tuple[str, "Request"]:
+                      deadline_s: Optional[float] = None,
+                      qos: str = QOS_DEFAULT) -> tuple[str, "Request"]:
         """Pre-hop → tokenize → engine → detokenize → post-hop: the one
         generation path every protocol surface (REST v1/v2, OpenAI, gRPC)
         shares.
@@ -249,7 +252,7 @@ class ModelServer:
             toks = tokenizer.encode(prompt)
             req = engine.submit(toks, self.sampling_from(body, tokenizer),
                                 deadline=time.monotonic() + timeout,
-                                trace_parent=tracer.current())
+                                trace_parent=tracer.current(), qos=qos)
             try:
                 out = req.result(timeout=timeout + 1.0)
             except TimeoutError:
@@ -309,6 +312,16 @@ class ModelServer:
         expired = reg.counter("kftpu_serving_requests_expired_total")
         qdelay = reg.histogram("kftpu_serving_queue_delay_seconds",
                                QUEUE_DELAY_BUCKETS)
+        # Multi-tenant QoS: per-class SLO attainment (the series the
+        # signal-driven autoscaler weighs) + shed/preemption attribution.
+        preempt = reg.counter("kftpu_serving_preemptions_total")
+        qos_requests = reg.counter("kftpu_serving_qos_requests_total")
+        qos_shed = reg.counter("kftpu_serving_qos_requests_shed_total")
+        qos_preempt = reg.counter("kftpu_serving_qos_preemptions_total")
+        qos_ttft = reg.gauge("kftpu_serving_qos_ttft_p95_ms")
+        qos_qd = reg.gauge("kftpu_serving_qos_queue_delay_p95_ms")
+        qos_qdelay = reg.histogram("kftpu_serving_qos_queue_delay_seconds",
+                                   QUEUE_DELAY_BUCKETS)
         # Decode hot-loop health (pipelined dispatch): per-round host gap
         # + how many rounds ride in flight. A pipelined engine shows
         # near-zero gaps and depth 1; gaps growing toward the round time
@@ -320,7 +333,8 @@ class ModelServer:
             snap = engine.metrics.snapshot()
             requests_total.inc(snap["requests_completed"], model=name)
             tokens_total.inc(snap["tokens_generated"], model=name)
-            for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+            for k in ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+                      "tpot_p50_ms", "queue_delay_p95_ms",
                       "requests_per_sec", "tokens_per_sec",
                       "spec_acceptance_rate", "spec_tokens_per_step",
                       "spec_draft_overhead", "host_gap_p50_ms",
@@ -336,6 +350,19 @@ class ModelServer:
             expired.inc(snap["requests_expired"], model=name)
             _, counts, qsum, qn = engine.metrics.queue_delay_histogram()
             qdelay.set_cumulative(counts, qsum, qn, model=name)
+            preempt.inc(snap.get("preemptions", 0), model=name)
+            for cls, c in snap.get("qos", {}).items():
+                qos_requests.inc(c["completed"], model=name, qos=cls)
+                qos_shed.inc(c["shed"], model=name, qos=cls)
+                qos_preempt.inc(c["preempted"], model=name, qos=cls)
+                if "ttft_p95_ms" in c:
+                    qos_ttft.set(c["ttft_p95_ms"], model=name, qos=cls)
+                if "queue_delay_p95_ms" in c:
+                    qos_qd.set(c["queue_delay_p95_ms"], model=name, qos=cls)
+                _, ccounts, csum, cn = \
+                    engine.metrics.queue_delay_histogram(cls)
+                qos_qdelay.set_cumulative(ccounts, csum, cn,
+                                          model=name, qos=cls)
             _, hcounts, hsum, hn = engine.metrics.host_gap_histogram()
             host_gap.set_cumulative(hcounts, hsum, hn, model=name)
             depth.set(snap.get("dispatch_depth", 0), model=name)
@@ -490,11 +517,21 @@ def _make_handler(server: ModelServer):
             self._json(200, {"name": name, "state": "READY"
                              if action == "load" else "UNLOADED"})
 
+        def _qos(self, body: dict) -> str:
+            """QoS class from the ``X-Kftpu-Qos`` header (body ``qos``
+            field as the headerless fallback). Unknown classes fail loudly
+            (engine.submit raises → HTTP 400) rather than silently
+            demoting a tenant to the default tier."""
+            raw = self.headers.get(QOS_HEADER) or body.get("qos") \
+                or QOS_DEFAULT
+            return str(raw).strip().lower()
+
         def _generate_text(self, prompt: str, body: dict,
                            model: Optional[str],
                            strict: bool = False) -> tuple[str, Request]:
             return server.generate_text(prompt, body, model, strict=strict,
-                                        deadline_s=self._deadline_s())
+                                        deadline_s=self._deadline_s(),
+                                        qos=self._qos(body))
 
         def _v1_predict(self, body: dict, model: str) -> None:
             instances = body.get("instances")
@@ -572,7 +609,8 @@ def _make_handler(server: ModelServer):
                 req = engine.submit(toks,
                                     server.sampling_from(body, tokenizer),
                                     deadline=time.monotonic() + timeout,
-                                    trace_parent=get_tracer().current())
+                                    trace_parent=get_tracer().current(),
+                                    qos=self._qos(body))
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")

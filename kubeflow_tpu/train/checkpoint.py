@@ -51,8 +51,6 @@ class CheckpointManager:
         teardown mid-write leaves nothing restorable. Consumers that gate
         destructive moves on "a checkpoint exists" (the elastic autoscaler)
         must use this, not latest_step()."""
-        import orbax.checkpoint as ocp
-
         steps = ocp.utils.checkpoint_steps(self.directory)
         return max(steps) if steps else None
 

@@ -69,5 +69,17 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 hotloop_rc=${PIPESTATUS[0]}
 grep -q '"hotloop_smoke": "ok"' /tmp/_smoke_hotloop.json || hotloop_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ]
+echo "== autoscale smoke (QoS shed ordering + SLO autoscaler loop, CPU) =="
+# Closed-loop gate for the SLO-aware serving loop: a 2-class burst must
+# shed batch-first (interactive all-200), the signal-driven autoscaler
+# must make exactly one scale-up decision off the replica's real
+# /metrics, scale-down must complete drain before teardown, and the new
+# QoS/router series must pass the M2xx metric-name lint + exposition
+# grammar.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/autoscale_smoke.py | tee /tmp/_smoke_autoscale.json
+autoscale_rc=${PIPESTATUS[0]}
+grep -q '"autoscale_smoke": "ok"' /tmp/_smoke_autoscale.json || autoscale_rc=1
+
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc autoscale rc=$autoscale_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ]

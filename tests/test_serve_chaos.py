@@ -65,13 +65,15 @@ def stack():
 
 
 def completion(url: str, *, timeout_s: float = 10.0, max_tokens: int = 8,
-               prompt: str = "chaos") -> int:
+               prompt: str = "chaos", qos: str = "") -> int:
     body = json.dumps({"prompt": prompt, "max_tokens": max_tokens,
                        "timeout": timeout_s}).encode()
+    headers = {"Content-Type": "application/json",
+               DEADLINE_HEADER: str(int(timeout_s * 1e3))}
+    if qos:
+        headers["X-Kftpu-Qos"] = qos
     req = urllib.request.Request(
-        url + "/v1/completions", data=body,
-        headers={"Content-Type": "application/json",
-                 DEADLINE_HEADER: str(int(timeout_s * 1e3))})
+        url + "/v1/completions", data=body, headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s + 5) as r:
             return r.status
@@ -252,6 +254,68 @@ def test_chaos_halt_with_round_in_flight_reaps_clean():
                for r in reqs)
     # The stranded round's results never leaked into cancelled streams.
     assert [len(r.output_tokens) for r in reqs] == emitted_at_halt
+
+
+def test_chaos_qos_overload_sheds_batch_first(stack):
+    """ISSUE 6 acceptance (``qos_overload``): ~2x sustained overload with
+    mixed interactive+batch classes through the router. Invariants:
+
+    - batch absorbs ALL shedding (429 at the door + queue sheds);
+      interactive is never shed;
+    - interactive queue-delay p95 stays within its declared budget —
+      delivered by strict-priority dequeue + cross-class preemption, not
+      by shedding (its shed count is zero);
+    - after the storm (preemptions included), every engine drains to
+      zero pages and ``assert_quiescent`` holds."""
+    from kubeflow_tpu.core.serving import QoSClassPolicy
+
+    a, b, router = stack
+    I_BUDGET_S = 5.0
+    engines = [a.engine, b.engine]
+    for eng in engines:
+        eng.max_queue = 4
+        eng.qos_policies = {
+            "batch": QoSClassPolicy(max_queue=1),
+            "interactive": QoSClassPolicy(queue_delay_budget=I_BUDGET_S)}
+    try:
+        results: dict[str, list[int]] = {"interactive": [], "batch": []}
+        threads = []
+        for cls, nclients in (("interactive", 3), ("batch", 3)):
+            def pool(cls=cls):
+                got = fire(router.url, 9, concurrency=3, timeout_s=10.0,
+                           max_tokens=6, qos=cls)
+                results[cls].extend(got)
+            t = threading.Thread(target=pool)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+            assert not t.is_alive(), "client pool hung under qos overload"
+        for cls in results:
+            assert set(results[cls]) <= EXPLICIT_STATUSES, results[cls]
+        # Graceful, prioritized degradation: interactive all served.
+        assert all(s == 200 for s in results["interactive"]), \
+            results["interactive"]
+        shed = {"interactive": 0, "batch": 0}
+        qd_p95 = []
+        for eng in engines:
+            snap = eng.metrics.snapshot()
+            for cls in shed:
+                shed[cls] += snap.get("qos", {}).get(cls, {}).get("shed", 0)
+            qcls = snap.get("qos", {}).get("interactive", {})
+            if "queue_delay_p95_ms" in qcls:
+                qd_p95.append(qcls["queue_delay_p95_ms"])
+        assert shed["interactive"] == 0, "interactive was shed under overload"
+        if 429 in results["batch"]:
+            assert shed["batch"] > 0
+        assert qd_p95, "no interactive queue-delay signal recorded"
+        assert max(qd_p95) <= I_BUDGET_S * 1e3, \
+            f"interactive queue-delay p95 {max(qd_p95):.0f}ms over budget"
+    finally:
+        for eng in engines:
+            eng.max_queue = 0
+            eng.qos_policies = {}
+    audit_quiescent(a, b)
 
 
 def test_chaos_zz_replica_kill_mid_traffic(stack):

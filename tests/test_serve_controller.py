@@ -12,16 +12,22 @@ from kubeflow_tpu.operator.control_plane import ControlPlane, ControlPlaneConfig
 
 
 class FakeProbe:
-    """url -> {"ready", "in_flight"}; tests mutate `ready` and `load`."""
+    """url -> {"ready", "in_flight"}; tests mutate `ready` and `load`.
+    ``signals[url]`` merges extra scrape keys (the SLO autoscaler's
+    latency p95s); ``fail`` makes individual urls unprobeable (the
+    stale/missing-signal condition)."""
 
     def __init__(self):
         self.ready = True
         self.load = {}          # url -> in_flight
+        self.signals = {}       # url -> extra signal dict
+        self.fail = set()       # urls whose probe fails outright
 
     def __call__(self, url):
-        if not self.ready:
+        if not self.ready or url in self.fail:
             return None
-        return {"ready": True, "in_flight": self.load.get(url, 0)}
+        return {"ready": True, "in_flight": self.load.get(url, 0),
+                **self.signals.get(url, {})}
 
 
 @pytest.fixture()
@@ -494,3 +500,189 @@ def test_router_stop_releases_parked_requests(cp):
     t.join(timeout=5.0)
     assert got.get("done") and got["x"] is None
     assert _t.monotonic() - start < 5.0      # fail fast, not queue_timeout
+
+
+# -- SLO-driven autoscaler (ISSUE 6: the signal-driven closed loop) -----------
+
+def mkisvc_slo(name="svc", min_replicas=1, max_replicas=3, *,
+               target_ttft_ms=100.0, cooldown_s=10.0, **slo_kw):
+    from kubeflow_tpu.core.serving import SLOPolicy
+
+    return InferenceService(
+        metadata=ObjectMeta(name=name),
+        spec=InferenceServiceSpec(predictor=PredictorSpec(
+            model=ModelSpec(config={"preset": "tiny"}),
+            min_replicas=min_replicas, max_replicas=max_replicas,
+            slo=SLOPolicy(target_ttft_ms=target_ttft_ms,
+                          cooldown_s=cooldown_s, **slo_kw))))
+
+
+def _urls(cp, name="svc"):
+    return [f"http://127.0.0.1:{w.spec.template.config['port']}"
+            for w in replicas(cp, name)]
+
+
+def test_slo_scale_up_on_ttft_signal(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_slo())
+    recon()
+    mark_running(cp, replicas(cp))
+    url, = _urls(cp)
+    cp.probe.signals[url] = {"ttft_p95_ms": 300.0}    # 3x over target
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 2
+    events = [e.reason for e in cp.recorder.for_object(get_isvc(cp))]
+    assert "ScaledUp" in events
+
+
+def test_slo_per_class_weights_drive_the_decision(cp):
+    """A screaming batch p95 with near-zero weight must not buy replicas;
+    the same p95 on interactive must."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_slo(class_weights={"interactive": 1.0, "batch": 0.0}))
+    recon()
+    mark_running(cp, replicas(cp))
+    url, = _urls(cp)
+    cp.probe.signals[url] = {
+        "qos_ttft_p95_ms": {"batch": 5000.0, "interactive": 50.0}}
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 1, \
+        "zero-weight batch latency bought a replica"
+    cp.probe.signals[url] = {
+        "qos_ttft_p95_ms": {"batch": 5.0, "interactive": 400.0}}
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 2
+
+
+def test_slo_hold_inside_hysteresis_band_no_oscillation(cp):
+    """A signal inside (scale_down_ratio, scale_up_ratio) must never move
+    the count — repeated reconciles with elapsed cooldowns stay put."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_slo())
+    recon()
+    mark_running(cp, replicas(cp))
+    url, = _urls(cp)
+    cp.probe.signals[url] = {"ttft_p95_ms": 80.0}     # ratio 0.8: in band
+    for _ in range(5):
+        _backdate(cp)
+        recon()
+        assert get_isvc(cp).status.desired_replicas == 1, "autoscaler flapped"
+
+
+def test_slo_missing_signal_holds_replica_count(cp):
+    """ISSUE 6 satellite: stale/missing metrics from ONE replica hold the
+    count — even while the other replica screams for a scale-up."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_slo())
+    recon()
+    mark_running(cp, replicas(cp))
+    isvc = get_isvc(cp)
+    isvc.status.desired_replicas = 2
+    cp.store.update_status(isvc)
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    u0, u1 = _urls(cp)
+    cp.probe.signals[u0] = {"ttft_p95_ms": 900.0}
+    cp.probe.fail.add(u1)                 # stale: probe fails outright
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 2, \
+        "resized on partial signals"
+    cp.probe.fail.discard(u1)             # signal restored → decision resumes
+    cp.probe.signals[u1] = {"ttft_p95_ms": 900.0}
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 3
+
+
+def test_slo_cooldown_suppresses_back_to_back_resizes(cp):
+    """ISSUE 6 satellite: a hot signal right after a resize must wait out
+    the cooldown before the next resize."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_slo())
+    recon()
+    mark_running(cp, replicas(cp))
+    url, = _urls(cp)
+    cp.probe.signals[url] = {"ttft_p95_ms": 500.0}
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 2
+    recon()                                # converge: create replica 2
+    mark_running(cp, replicas(cp))
+    for u in _urls(cp):
+        cp.probe.signals[u] = {"ttft_p95_ms": 500.0}
+    recon()                                # cooldown fresh from the resize
+    assert get_isvc(cp).status.desired_replicas == 2, \
+        "back-to-back resize inside the cooldown"
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 3
+
+
+def test_slo_sigkill_between_scrape_and_resize_holds(cp):
+    """ISSUE 6 satellite chaos: a replica SIGKILLed between scrape and
+    resize leaves the fleet partial — the autoscaler holds until the
+    replacement reports, then resumes deciding."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_slo())
+    recon()
+    mark_running(cp, replicas(cp))
+    isvc = get_isvc(cp)
+    isvc.status.desired_replicas = 2
+    cp.store.update_status(isvc)
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    for u in _urls(cp):
+        cp.probe.signals[u] = {"ttft_p95_ms": 700.0}
+    # SIGKILL one replica (envtest: phase flip, exit 137).
+    w = replicas(cp)[1]
+    w = cp.store.get(Worker, w.metadata.name)
+    w.status.phase = WorkerPhase.FAILED
+    w.status.exit_code = 137
+    cp.store.update_status(w)
+    _backdate(cp)
+    recon()   # replacement spawns but is not RUNNING: fleet partial → hold
+    assert get_isvc(cp).status.desired_replicas == 2, \
+        "resized while a killed replica's replacement was still starting"
+    mark_running(cp, replicas(cp))
+    for u in _urls(cp):
+        cp.probe.signals[u] = {"ttft_p95_ms": 700.0}
+    _backdate(cp)
+    recon()   # fleet whole again, still hot → scale-up resumes
+    assert get_isvc(cp).status.desired_replicas == 3
+
+
+def test_slo_scale_down_goes_through_drain(cp):
+    """An SLO scale-down retires the trimmed replica through the graceful
+    drain path — never an early kill of a busy replica."""
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_slo())
+    recon()
+    mark_running(cp, replicas(cp))
+    isvc = get_isvc(cp)
+    isvc.status.desired_replicas = 2
+    cp.store.update_status(isvc)
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    for u in _urls(cp):
+        cp.probe.signals[u] = {"ttft_p95_ms": 10.0}   # far under target
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_replicas == 1
+    # The trimmed replica is busy: it must drain, not die.
+    ws = replicas(cp)
+    url1 = f"http://127.0.0.1:{ws[1].spec.template.config['port']}"
+    cp.probe.load[url1] = 2
+    recon()
+    assert len(replicas(cp)) == 2, "busy replica killed before drain"
+    events = [e.reason for e in cp.recorder.for_object(get_isvc(cp))]
+    assert "Draining" in events
+    cp.probe.load[url1] = 0
+    recon()
+    assert len(replicas(cp)) == 1, "drained replica not torn down"
