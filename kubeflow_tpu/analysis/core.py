@@ -31,8 +31,18 @@ directly above):
   inside are findings.
 - ``# sync-point: <reason>`` — on a line inside a hot-loop function: this
   host sync is the designed one (e.g. the pipelined consume fetch).
+- ``# mesh-context: <reason>`` — on a ``def``: the function runs under a
+  mesh / ``shard_map`` context established by a caller this module cannot
+  see; collectives with literal axis names inside are bound there (S405).
 - ``# lint: disable=D101[,C301...]`` — suppress specific rules on this
   line.
+
+Interprocedural core (ISSUE 7): every module gets a call graph with
+ONE-level call-following (``Module.callgraph``) so dataflow rules — jit
+region scanning, donation tracking, lock-held regions, resource pairing —
+see through same-module helper calls without whole-program analysis, plus
+a shared resource-pairing primitive (``leaky_allocs``) for the
+alloc/free-on-exception-path rule family.
 
 Baseline: a checked-in JSON file (default ``.kftpu-lint-baseline.json``,
 discovered upward from the scanned paths) holding fingerprints of known
@@ -91,6 +101,7 @@ _ANNOT_RES = {
     "hot_loop": re.compile(r"#\s*hot-loop\b"),
     "traced": re.compile(r"#\s*traced\b"),
     "sync_point": re.compile(r"#\s*sync-point:\s*(\S.*)"),
+    "mesh_context": re.compile(r"#\s*mesh-context:\s*(\S.*)"),
 }
 _DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)")
 
@@ -114,6 +125,13 @@ class Module:
         except tokenize.TokenError:
             pass
         self.aliases = self._build_aliases()
+        self._callgraph: Optional["CallGraph"] = None
+
+    @property
+    def callgraph(self) -> "CallGraph":
+        if self._callgraph is None:
+            self._callgraph = CallGraph(self)
+        return self._callgraph
 
     # -- imports / names ---------------------------------------------------
 
@@ -208,6 +226,218 @@ class Module:
                        else self.symbol_for(node))
 
 
+# -- interprocedural core ------------------------------------------------------
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class CallGraph:
+    """Module-level call graph with ONE-level call-following.
+
+    Resolution is deliberately modest — exactly what same-module helper
+    calls need and no more: a bare ``name(...)`` resolves to a
+    module-level ``def name``; ``self.m(...)`` inside a method resolves to
+    that class's method ``m``. Anything dynamic stays unresolved. Rules use
+    ``callees`` to peek one level into helpers (donation reads, jit-region
+    host syncs, lock acquisitions) and ``callers`` to stay conservative
+    (skip a helper that is also reachable from a context the rule does not
+    model)."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.module_fns: dict[str, ast.AST] = {}
+        self.class_methods: dict[str, dict[str, ast.AST]] = {}
+        # attr name -> same-module class name, from `self.X = Cls(...)`
+        self.attr_class: dict[tuple[str, str], str] = {}
+        for stmt in mod.tree.body:
+            if isinstance(stmt, _FUNC_NODES):
+                self.module_fns[stmt.name] = stmt
+            elif isinstance(stmt, ast.ClassDef):
+                methods = {}
+                for s in stmt.body:
+                    if isinstance(s, _FUNC_NODES):
+                        methods[s.name] = s
+                self.class_methods[stmt.name] = methods
+        for cname, methods in self.class_methods.items():
+            init = methods.get("__init__")
+            if init is None:
+                continue
+            for node in ast.walk(init):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                callee = node.value.func
+                tgt_cls = callee.id if isinstance(callee, ast.Name) else None
+                if tgt_cls not in self.class_methods:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        self.attr_class[(cname, t.attr)] = tgt_cls
+        self._callers: Optional[dict[int, set[int]]] = None
+
+    def enclosing_class(self, fn: ast.AST) -> Optional[str]:
+        cur = getattr(fn, "_parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = getattr(cur, "_parent", None)
+        return None
+
+    def resolve_call(self, call: ast.Call, fn: ast.AST) -> Optional[ast.AST]:
+        """The same-module FunctionDef a call site targets, or None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.module_fns.get(func.id)
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            cls = self.enclosing_class(fn)
+            if func.value.id == "self" and cls is not None:
+                return self.class_methods.get(cls, {}).get(func.attr)
+        # self.<attr>.m() where __init__ bound attr to a same-module class
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Attribute) \
+                and isinstance(func.value.value, ast.Name) \
+                and func.value.value.id == "self":
+            cls = self.enclosing_class(fn)
+            tgt = self.attr_class.get((cls or "", func.value.attr))
+            if tgt is not None:
+                return self.class_methods.get(tgt, {}).get(func.attr)
+        return None
+
+    def callees(self, fn: ast.AST) -> list[ast.AST]:
+        out, seen = [], {id(fn)}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = self.resolve_call(node, fn)
+                if target is not None and id(target) not in seen:
+                    seen.add(id(target))
+                    out.append(target)
+        return out
+
+    def callers_of(self, fn: ast.AST) -> list[ast.AST]:
+        if self._callers is None:
+            self._callers = {}
+            all_fns = list(self.module_fns.values()) + [
+                m for ms in self.class_methods.values()
+                for m in ms.values()]
+            self._by_id = {id(f): f for f in all_fns}
+            for f in all_fns:
+                for callee in self.callees(f):
+                    self._callers.setdefault(id(callee), set()).add(id(f))
+        return [self._by_id[i] for i in self._callers.get(id(fn), ())]
+
+
+def leaky_allocs(fn: ast.AST, is_alloc, releases_var):
+    """Shared resource-pairing dataflow: yield ``(alloc_call, var,
+    risky_stmt)`` for every ``var = <alloc>`` whose resource can leak on an
+    exception path.
+
+    ``is_alloc(call)`` classifies allocation calls; ``releases_var(stmt,
+    var)`` says whether a statement releases/consumes ownership of ``var``
+    (stores it into an owning structure, frees it, returns it, or passes it
+    on). A statement between the alloc and the consumption that contains
+    any call can raise — at which point nothing owns the resource — unless
+    the alloc is inside a ``try`` whose handler or ``finally`` releases
+    the var."""
+    protected: set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        cleanup = [s for h in node.handlers for s in h.body]
+        cleanup += list(node.finalbody)
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and is_alloc(sub):
+                    var = _alloc_target(sub)
+                    if var and any(releases_var(c, var) for c in cleanup):
+                        protected.add(id(sub))
+
+    def scan(stmts, cont):
+        """``cont`` is the statement continuation after this block (the
+        rest of every enclosing block, in execution order) — ownership is
+        routinely taken a block boundary later (alloc inside ``try``,
+        recorded after it)."""
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+                continue
+            rest = stmts[i + 1:] + cont
+            alloc = None
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    is_alloc(stmt.value) and id(stmt.value) not in protected:
+                alloc = stmt.value
+                var = _alloc_target(alloc)
+            if alloc is not None and var:
+                consumed = False
+                for later in rest:
+                    if releases_var(later, var):
+                        consumed = True
+                        break
+                    if any(isinstance(n, ast.Call)
+                           for n in ast.walk(later)):
+                        yield alloc, var, later
+                        consumed = True   # reported once; stop tracking
+                        break
+                if not consumed:
+                    yield alloc, var, stmt
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    yield from scan(sub, rest)
+            for h in getattr(stmt, "handlers", []) or []:
+                yield from scan(h.body, rest)
+
+    yield from scan(list(getattr(fn, "body", [])), [])
+
+
+def _alloc_target(call: ast.Call) -> Optional[str]:
+    stmt = getattr(call, "_parent", None)
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+            and isinstance(stmt.targets[0], ast.Name):
+        return stmt.targets[0].id
+    return None
+
+
+_CANONICAL_AXES_FALLBACK = (
+    "dcn", "pipeline", "data", "fsdp", "expert", "seq", "model",
+)
+_canonical_axes_cache: Optional[tuple[str, ...]] = None
+
+
+def canonical_mesh_axes() -> tuple[str, ...]:
+    """The platform's canonical mesh-axis names, read from
+    ``runtime/mesh.py``'s ``MESH_AXES`` assignment BY AST (the analyzer
+    stays import-light: no jax). Falls back to the baked-in tuple when the
+    source moves."""
+    global _canonical_axes_cache
+    if _canonical_axes_cache is not None:
+        return _canonical_axes_cache
+    axes = _CANONICAL_AXES_FALLBACK
+    mesh_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "runtime", "mesh.py")
+    try:
+        with open(mesh_py, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for stmt in tree.body:
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target] if isinstance(stmt, ast.AnnAssign) else []
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "MESH_AXES" \
+                        and isinstance(stmt.value, (ast.Tuple, ast.List)):
+                    vals = tuple(e.value for e in stmt.value.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str))
+                    if vals:
+                        axes = vals
+    except (OSError, SyntaxError, ValueError):
+        pass
+    _canonical_axes_cache = axes
+    return axes
+
+
 # -- rule registry -------------------------------------------------------------
 
 
@@ -242,7 +472,8 @@ def _load_rules() -> None:
         return
     _loaded = True
     from kubeflow_tpu.analysis import (  # noqa: F401  (registration import)
-        rules_concurrency, rules_device, rules_metrics,
+        rules_concurrency, rules_device, rules_metrics, rules_resources,
+        rules_sharding,
     )
 
 
@@ -406,7 +637,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="kftpu lint",
         description="codebase-aware static analysis (device hygiene + "
-                    "lock discipline + metric naming)")
+                    "lock discipline + sharding/SPMD + resource pairing "
+                    "+ metric naming)")
     p.add_argument("paths", nargs="*", default=["kubeflow_tpu"],
                    help="files or directories to scan")
     p.add_argument("--json", action="store_true", dest="as_json",
@@ -421,8 +653,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "(each entry still needs a hand-written reason)")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings matched by the baseline")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="BASE",
+                   help="lint only .py files changed vs BASE (default "
+                        "HEAD: the working tree — the fast pre-commit "
+                        "path); includes untracked files")
     p.add_argument("--list-rules", action="store_true")
     return p
+
+
+def changed_files(base: str = "HEAD",
+                  root: Optional[str] = None) -> list[str]:
+    """Repo-relative .py files changed vs ``base`` (plus untracked ones),
+    restricted to files that still exist. Raises RuntimeError outside a
+    git checkout (the caller turns that into a CLI error)."""
+    import subprocess
+
+    root = os.path.abspath(root or os.getcwd())
+
+    def git(*args: str) -> list[str]:
+        proc = subprocess.run(["git", *args], cwd=root,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: {proc.stderr.strip()}")
+        return [p for p in proc.stdout.split("\0") if p]
+
+    files = set(git("diff", "--name-only", "-z", base, "--"))
+    files |= set(git("ls-files", "-o", "--exclude-standard", "-z"))
+    return sorted(
+        f for f in files
+        if f.endswith(".py") and os.path.isfile(os.path.join(root, f)))
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -432,6 +693,20 @@ def main(argv: Optional[list[str]] = None) -> int:
             print(f"{rule.id}  {rule.name:28} {rule.doc}")
         return 0
     paths = args.paths or ["kubeflow_tpu"]
+    if args.changed is not None:
+        if args.update_baseline:
+            print("--update-baseline needs a full scan, not --changed "
+                  "(a changed-only rewrite would drop every other entry)",
+                  file=sys.stderr)
+            return 2
+        try:
+            paths = changed_files(args.changed)
+        except RuntimeError as exc:
+            print(f"--changed: {exc}", file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"0 files changed vs {args.changed}; nothing to lint")
+            return 0
     baseline: Optional[Baseline] = None
     baseline_path = args.baseline
     if not args.no_baseline and not args.update_baseline:

@@ -96,6 +96,11 @@ class _ClassModel:
         public = {n for n in self.methods
                   if not n.startswith("_") and n != "__init__"}
         self.public_reachable = self._closure(public)
+        # One-level caller-held inference (ISSUE 7): a private helper whose
+        # EVERY same-class call site lexically holds lock L runs under L —
+        # its accesses count as guarded without a # requires_lock:
+        # annotation. Only ever silences C301, never invents a finding.
+        self.caller_locks = self._infer_caller_locks()
         self.accesses: list[_Access] = []
         for name, fn in self.methods.items():
             if name == "__init__":
@@ -205,7 +210,53 @@ class _ClassModel:
             # codebase convention: *_locked methods run under the class's
             # (sole) lock; with several locks the annotation is required
             held.update(self._canonical_lock(a) for a in self.lock_attrs)
+        held.update(getattr(self, "caller_locks", {}).get(name, ()))
         return frozenset(held)
+
+    def _infer_caller_locks(self) -> dict[str, frozenset]:
+        """method -> locks held at EVERY same-class call site. Private,
+        non-thread-entry methods only (public ones are callable from
+        outside, thread targets start lock-free)."""
+        sites: dict[str, list[frozenset]] = {}
+
+        def visit(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, ast.With):
+                extra = set()
+                for item in node.items:
+                    a = _self_attr_name(item.context_expr)
+                    if a:
+                        extra.add(self._canonical_lock(a))
+                inner = frozenset(held | extra)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return
+            if isinstance(node, ast.Call):
+                m = _self_attr_name(node.func)
+                if m and m in self.methods:
+                    sites.setdefault(m, []).append(held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for name, fn in self.methods.items():
+            base = frozenset()
+            ann = self.mod.annotation(fn, "requires_lock")
+            if ann:
+                base = frozenset({self._canonical_lock(ann)})
+            elif name.endswith("_locked") and self.lock_attrs:
+                base = frozenset(self._canonical_lock(a)
+                                 for a in self.lock_attrs)
+            for stmt in fn.body:
+                visit(stmt, base)
+        out: dict[str, frozenset] = {}
+        for m, held_sets in sites.items():
+            if not m.startswith("_") or m in self.thread_entries:
+                continue
+            common = frozenset.intersection(*held_sets)
+            if common:
+                out[m] = common
+        return out
 
     def _collect_accesses(self, method: str, fn: ast.FunctionDef) -> None:
         base = self._method_locks(method, fn)
@@ -389,11 +440,57 @@ class BlockingCallUnderLock(Rule):
                         f"{hit} while holding "
                         f"{sorted('self.' + h for h in held)}; blocking "
                         "under a lock stalls every other thread on it")
+                else:
+                    # one-level call-following (ISSUE 7): a same-class
+                    # helper's NOT-under-its-own-lock blocking calls run
+                    # under everything held here. Skip helpers whose own
+                    # base locks are non-empty — their bodies report
+                    # directly (caller-held inference), and one finding
+                    # per defect is the contract.
+                    m = _self_attr_name(node.func)
+                    helper = cm.methods.get(m) if m else None
+                    if helper is not None \
+                            and not cm._method_locks(m, helper):
+                        inner = self._helper_blocking(mod, cm, helper)
+                        if inner:
+                            yield mod.finding(
+                                self, node,
+                                f"'self.{m}()' makes a blocking call "
+                                f"({inner}) and is called here while "
+                                f"holding "
+                                f"{sorted('self.' + h for h in held)}; "
+                                "blocking under a lock stalls every "
+                                "other thread on it")
             for child in ast.iter_child_nodes(node):
                 yield from visit(child, held)
 
         for stmt in fn.body:
             yield from visit(stmt, base)
+
+    def _helper_blocking(self, mod: Module, cm: _ClassModel,
+                         helper: ast.AST) -> Optional[str]:
+        """First blocking call a helper makes at its top level (not under
+        a ``with`` of its own — those release points are the helper's own
+        business)."""
+        def scan(node: ast.AST) -> Optional[str]:
+            if isinstance(node, (ast.With, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+                return None
+            if isinstance(node, ast.Call):
+                hit = self._blocking(mod, cm, node)
+                if hit:
+                    return hit
+            for child in ast.iter_child_nodes(node):
+                hit = scan(child)
+                if hit:
+                    return hit
+            return None
+
+        for stmt in helper.body:
+            hit = scan(stmt)
+            if hit:
+                return hit
+        return None
 
     @staticmethod
     def _blocking(mod: Module, cm: _ClassModel,

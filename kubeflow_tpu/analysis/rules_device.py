@@ -141,17 +141,40 @@ def _self_attr(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _followed_helpers(mod: Module, regions: list[ast.AST]) -> list[ast.AST]:
+    """One-level call-following (ISSUE 7): same-module helpers called from
+    jit regions whose EVERY resolvable caller is itself a region — their
+    bodies execute traced, so host syncs inside are the same defect.
+    Helpers also reachable from host-side code are skipped (they may be
+    the designed host path)."""
+    region_ids = {id(r) for r in regions}
+    cg = mod.callgraph
+    out: list[ast.AST] = []
+    seen: set[int] = set()
+    for region in regions:
+        for callee in cg.callees(region):
+            if id(callee) in region_ids or id(callee) in seen:
+                continue
+            callers = cg.callers_of(callee)
+            if callers and all(id(c) in region_ids for c in callers):
+                seen.add(id(callee))
+                out.append(callee)
+    return out
+
+
 @register
 class HostSyncInJit(Rule):
     id = "D101"
     name = "host-sync-in-jit"
     doc = ("blocking host sync inside a jax.jit-compiled function "
            "(device_get/.item()/.block_until_ready()/np.asarray/"
-           "float|int on a traced parameter)")
+           "float|int on a traced parameter), including one-level "
+           "same-module helpers only ever called from jitted code")
 
     def check(self, mod: Module) -> Iterable[Finding]:
         seen: set[int] = set()
-        for region in jit_regions(mod):
+        regions = jit_regions(mod)
+        for region in regions + _followed_helpers(mod, regions):
             if id(region) in seen:
                 continue
             seen.add(id(region))
@@ -322,6 +345,35 @@ class DonatedBufferReuse(Rule):
         # watched donated-expression -> (callee, call line)
         watched: dict[str, tuple[str, int]] = {}
 
+        def helper_touch(call: ast.Call, keys: set[str]
+                         ) -> tuple[set[str], set[str]]:
+            """One-level call-following: (reads, writes) of watched
+            ``self.*`` keys inside a same-class helper this call resolves
+            to. A helper that writes the key rebinds it (no finding); one
+            that only reads it is a donated-buffer use."""
+            target = mod.callgraph.resolve_call(call, fn)
+            self_keys = {k for k in keys if k.startswith("self.")}
+            if target is None or not self_keys \
+                    or not isinstance(call.func, ast.Attribute):
+                return set(), set()
+            reads: set[str] = set()
+            writes: set[str] = set()
+            for node in ast.walk(target):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        k = _expr_key(t)
+                        if k in self_keys:
+                            writes.add(k)
+                elif isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load):
+                    k = _expr_key(node)
+                    if k in self_keys:
+                        reads.add(k)
+            return reads - writes, writes
+
         def process(nodes: list[ast.AST], stmt: ast.stmt,
                     rebound: set[str]) -> Iterable[Finding]:
             """Handle the expression payload of ONE statement (a simple
@@ -340,6 +392,13 @@ class DonatedBufferReuse(Rule):
                                     if key:
                                         new_watch[key] = (callee,
                                                           node.lineno)
+                        elif watched:
+                            h_reads, h_writes = helper_touch(
+                                node, set(watched))
+                            reads.update(k for k in h_reads
+                                         if k not in rebound)
+                            for k in h_writes:
+                                watched.pop(k, None)
                     if isinstance(node, (ast.Name, ast.Attribute)):
                         k = _expr_key(node)
                         if k in watched and k not in rebound:

@@ -8,11 +8,19 @@ instead of the first scrape:
 - M201 ``metric-name``: a literal name passed to ``.counter()`` /
   ``.gauge()`` / ``.histogram()`` (or a ``Counter``/``Gauge``/
   ``Histogram`` constructor imported from ``obs.registry``) must carry
-  the ``kftpu_`` prefix and match the exposition grammar. f-strings are
-  checked on their literal head.
+  the ``kftpu_`` prefix and match the exposition grammar. An f-string
+  whose only hole is a variable of an enclosing LITERAL ``for`` loop
+  (the PR-6 ``f"kftpu_serving_{k}"`` labeled-series idiom) expands to
+  every name it can take and each is checked in full; other f-strings
+  are checked on their literal head.
 - M202 ``duplicate-metric``: the same literal name registered twice in
   one function (two families with one name — the registry would raise at
-  runtime; the lint catches it before).
+  runtime; the lint catches it before), loop-expanded names included.
+- M203 ``bad-series-label``: reserved (``le``/``quantile``) or
+  malformed label names at the labeled-series sample sites
+  (``.inc()``/``.set()``/``.observe()``/``.set_cumulative()`` keywords
+  and literal ``**{...}`` splats) — the qos/model label surface PR 6
+  introduced, checked where the labels are written.
 """
 
 from __future__ import annotations
@@ -33,17 +41,56 @@ _REG_CLASSES = {
 }
 
 
-def _literal_name(node: ast.AST) -> tuple[Optional[str], bool]:
-    """(name, exact): the literal metric name, and whether it is complete
-    (False for f-strings, where only the head is known)."""
+def _loop_literals(node: ast.AST, var: str) -> Optional[list[str]]:
+    """The literal string values ``var`` iterates over in an enclosing
+    ``for var in ("a", "b", ...)`` loop, else None."""
+    cur = getattr(node, "_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+        if isinstance(cur, ast.For) and isinstance(cur.target, ast.Name) \
+                and cur.target.id == var \
+                and isinstance(cur.iter, (ast.Tuple, ast.List, ast.Set)):
+            vals = [e.value for e in cur.iter.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            if len(vals) == len(cur.iter.elts):
+                return vals
+        cur = getattr(cur, "_parent", None)
+    return None
+
+
+def _literal_names(node: ast.AST) -> list[tuple[str, bool]]:
+    """[(name, exact)] for the metric-name argument. Plain strings are one
+    exact name. An f-string whose ONLY interpolation is a variable bound
+    by an enclosing literal ``for`` loop expands to every name it can
+    take (all exact — the PR-6 ``f"kftpu_serving_{k}"`` labeled-series
+    pattern, checked in full). Any other f-string contributes its literal
+    head, inexact (prefix check only)."""
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value, True
+        return [(node.value, True)]
     if isinstance(node, ast.JoinedStr) and node.values:
+        holes = [v for v in node.values
+                 if isinstance(v, ast.FormattedValue)]
+        if len(holes) == 1 and isinstance(holes[0].value, ast.Name):
+            fills = _loop_literals(node, holes[0].value.id)
+            if fills is not None:
+                out = []
+                for fill in fills:
+                    parts = []
+                    for v in node.values:
+                        if isinstance(v, ast.Constant):
+                            parts.append(str(v.value))
+                        else:
+                            parts.append(fill)
+                    out.append(("".join(parts), True))
+                return out
         head = node.values[0]
         if isinstance(head, ast.Constant) and isinstance(head.value, str):
-            return head.value, False
-        return None, False
-    return None, True
+            return [(head.value, False)]
+        return []
+    return []
 
 
 def _definition_sites(mod: Module) -> Iterable[tuple[ast.Call, str, bool]]:
@@ -58,10 +105,8 @@ def _definition_sites(mod: Module) -> Iterable[tuple[ast.Call, str, bool]]:
             is_site = True
         if not is_site:
             continue
-        name, exact = _literal_name(node.args[0])
-        if name is None:
-            continue
-        yield node, name, exact
+        for name, exact in _literal_names(node.args[0]):
+            yield node, name, exact
 
 
 @register
@@ -83,6 +128,71 @@ class MetricName(Rule):
                     self, node,
                     f"metric name {name!r} is not a valid exposition "
                     "metric name")
+
+
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_RESERVED_LABELS = {"le", "quantile", "__name__"}
+_LABELED_METHODS = {"inc", "set", "observe", "set_cumulative"}
+
+
+@register
+class BadSeriesLabel(Rule):
+    id = "M203"
+    name = "bad-series-label"
+    doc = ("reserved or malformed label name at a labeled-series sample "
+           "site (.inc/.set/.observe(..., label=...)): 'le'/'quantile' "
+           "are exposition-reserved, dict-splat keys must match the "
+           "label grammar")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        handles = self._metric_handles(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in _LABELED_METHODS:
+                continue
+            recv = node.func.value
+            is_handle = (
+                (isinstance(recv, ast.Name) and recv.id in handles)
+                or (isinstance(recv, ast.Call)
+                    and isinstance(recv.func, ast.Attribute)
+                    and recv.func.attr in _REG_METHODS))
+            if not is_handle:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    # **{...} splat: literal dict keys are checkable
+                    if isinstance(kw.value, ast.Dict):
+                        for k in kw.value.keys:
+                            if isinstance(k, ast.Constant) \
+                                    and isinstance(k.value, str) \
+                                    and (k.value in _RESERVED_LABELS
+                                         or not _LABEL_RE.match(k.value)):
+                                yield mod.finding(
+                                    self, node,
+                                    f"label name {k.value!r} is "
+                                    "reserved or not a valid exposition "
+                                    "label")
+                elif kw.arg in _RESERVED_LABELS:
+                    yield mod.finding(
+                        self, node,
+                        f"label name {kw.arg!r} is reserved by the "
+                        "exposition format (histogram/summary internals)")
+
+    @staticmethod
+    def _metric_handles(mod: Module) -> set[str]:
+        """Local names bound from ``reg.counter(...)``-style calls —
+        the codebase's labeled-series definition idiom."""
+        out: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in _REG_METHODS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
 
 
 @register

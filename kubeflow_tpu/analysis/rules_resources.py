@@ -1,0 +1,306 @@
+"""Family R — resource pairing & lock-ordering rules (ISSUE 7 tentpole).
+
+The refcounted ``PageAllocator`` (serve/paged.py) is about to be shared
+across requests (ROADMAP item 1): every alloc must be balanced by exactly
+one free even when the statement after the alloc raises, every test that
+touches the pool must prove quiescence, and the threaded control plane
+must acquire its locks in one global order. Statically:
+
+- R501 ``leaked-alloc``: pages allocated (``*allocator*.alloc(...)``)
+  with a statement that can raise between the alloc and the point where
+  ownership is recorded, and no ``try`` handler/finally that frees them —
+  the exception path leaks the pages (built on the shared
+  ``core.leaky_allocs`` pairing primitive).
+- R502 ``unaudited-paged-test``: a test function that builds a paged
+  engine/pool (``paged=True`` or ``PageAllocator(...)``) but never —
+  directly or via a one-level helper — calls ``assert_quiescent`` /
+  ``kv_pages_in_use``. Applies to test files only (``tests/`` or
+  ``test_*.py``).
+- R503 ``lock-order-inversion``: build the lock-acquisition order graph
+  (lock L2 acquired while L1 is held, including one level through
+  same-module helper methods) from the same class models C301 uses, and
+  report each cycle once. The runtime half is the
+  ``KFTPU_SANITIZE=lockorder`` watchdog (runtime/sanitize.py), which
+  records the REAL acquisition graph and fails on a cycle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from kubeflow_tpu.analysis.core import (
+    Finding, Module, Rule, leaky_allocs, register,
+)
+from kubeflow_tpu.analysis.rules_concurrency import (
+    _ClassModel, _self_attr_name, class_models,
+)
+
+
+def _attr_chain(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_page_alloc(call: ast.Call) -> bool:
+    """``<something allocator-ish>.alloc(...)`` — tuned to how this
+    codebase spells it (engine._allocator, a local ``allocator``/``pool``
+    variable, or the PageAllocator instance in tests)."""
+    if not isinstance(call.func, ast.Attribute) or call.func.attr != "alloc":
+        return False
+    recv = _attr_chain(call.func.value).lower()
+    return any(s in recv for s in ("alloc", "pool", "pages"))
+
+
+def _releases_pages(stmt: ast.stmt, var: str) -> bool:
+    """Ownership of ``var`` is taken or returned: freed, recorded into a
+    structure, returned, or passed onward."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("free", "extend", "append",
+                                           "incref"):
+                if any(isinstance(a, ast.Name) and a.id == var
+                       for a in node.args):
+                    return True
+            # ownership handed to any callee that receives the var
+            if any(isinstance(a, ast.Name) and a.id == var
+                   for a in node.args):
+                return True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name) and sub.id == var:
+                            return True
+        elif isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id == var:
+                    return True
+    return False
+
+
+@register
+class LeakedAlloc(Rule):
+    id = "R501"
+    name = "leaked-alloc"
+    doc = ("page alloc with a raise-capable statement before ownership "
+           "is recorded and no handler/finally that frees — the "
+           "exception path leaks the pages")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for alloc, var, risky in leaky_allocs(
+                    fn, _is_page_alloc, _releases_pages):
+                where = ("never recorded or freed"
+                         if risky is getattr(alloc, "_parent", None) else
+                         f"line {risky.lineno} can raise first")
+                yield mod.finding(
+                    self, alloc,
+                    f"pages allocated into '{var}' in '{fn.name}' can "
+                    f"leak on an exception path ({where}); record "
+                    "ownership immediately or free in a handler/finally")
+
+
+def _is_test_path(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+@register
+class UnauditedPagedTest(Rule):
+    id = "R502"
+    name = "unaudited-paged-test"
+    doc = ("test touches the paged KV pool (paged=True / "
+           "PageAllocator) without asserting quiescence "
+           "(assert_quiescent / kv_pages_in_use); test files only")
+
+    _AUDITS = ("assert_quiescent", "kv_pages_in_use")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if not _is_test_path(mod.relpath):
+            return
+        cg = mod.callgraph
+
+        def touches_pool(fn: ast.AST) -> bool:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if any(kw.arg == "paged"
+                       and isinstance(kw.value, ast.Constant)
+                       and kw.value.value is True
+                       for kw in node.keywords):
+                    return True
+                qn = mod.qualname(node.func) or ""
+                if qn.split(".")[-1] == "PageAllocator":
+                    return True
+            return False
+
+        def audits(fn: ast.AST) -> bool:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in self._AUDITS:
+                    return True
+            return False
+
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not fn.name.startswith("test_"):
+                continue
+            followed = [fn] + cg.callees(fn)
+            if not any(touches_pool(f) for f in followed):
+                continue
+            if any(audits(f) for f in followed):
+                continue
+            yield mod.finding(
+                self, fn,
+                f"'{fn.name}' touches the paged KV pool but never "
+                "audits refcount balance; call assert_quiescent() (or "
+                "kv_pages_in_use()==0) before teardown")
+
+
+@register
+class LockOrderInversion(Rule):
+    id = "R503"
+    name = "lock-order-inversion"
+    doc = ("cyclic lock-acquisition order across the module's classes "
+           "(lock B taken under lock A in one path, A under B in "
+           "another) — the static half of KFTPU_SANITIZE=lockorder")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        edges: dict[tuple[str, str], list[tuple[str, str], ]] = {}
+        sites: dict[tuple[str, str], ast.AST] = {}
+        for cm in class_models(mod):
+            if not cm.lock_attrs:
+                continue
+            self._class_edges(mod, cm, edges, sites)
+        yield from self._cycles(mod, edges, sites)
+
+    # -- edge collection ---------------------------------------------------
+
+    def _class_edges(self, mod: Module, cm: _ClassModel, edges, sites
+                     ) -> None:
+        cls = cm.cls.name
+
+        def node_of(attr: str) -> str:
+            return f"{cls}.{cm._canonical_lock(attr)}"
+
+        def direct_acquires(fn: ast.AST) -> list[tuple[str, ast.AST]]:
+            out = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        a = _self_attr_name(item.context_expr)
+                        if a and a in cm.lock_attrs:
+                            out.append((node_of(a), node))
+            return out
+
+        def visit(fn_name: str, fn: ast.AST, node: ast.AST,
+                  held: tuple) -> None:
+            if isinstance(node, ast.With):
+                acquired = []
+                for item in node.items:
+                    a = _self_attr_name(item.context_expr)
+                    if a and a in cm.lock_attrs:
+                        acquired.append(node_of(a))
+                for lk in acquired:
+                    for h in held:
+                        if h != lk:
+                            edges.setdefault((h, lk), []).append(
+                                (cls, fn_name))
+                            sites.setdefault((h, lk), node)
+                inner = held + tuple(acquired)
+                for child in node.body:
+                    visit(fn_name, fn, child, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return
+            if held and isinstance(node, ast.Call):
+                # one-level follow: a helper's direct acquisitions happen
+                # under everything held here
+                target = mod.callgraph.resolve_call(node, fn)
+                if target is not None:
+                    t_cls = mod.callgraph.enclosing_class(target)
+                    t_cm = self._model_for(mod, t_cls)
+                    if t_cm is not None:
+                        for lk, site in self._direct_of(t_cm, target):
+                            for h in held:
+                                if h != lk:
+                                    edges.setdefault((h, lk), []).append(
+                                        (cls, fn_name))
+                                    sites.setdefault((h, lk), node)
+            for child in ast.iter_child_nodes(node):
+                visit(fn_name, fn, child, held)
+
+        for name, fn in cm.methods.items():
+            base = tuple(sorted(
+                node_of(a) for a in cm._method_locks(name, fn)))
+            for stmt in fn.body:
+                visit(name, fn, stmt, base)
+
+    _models_cache: Optional[dict] = None
+
+    def _model_for(self, mod: Module, cls_name: Optional[str]):
+        if cls_name is None:
+            return None
+        for cm in class_models(mod):
+            if cm.cls.name == cls_name:
+                return cm
+        return None
+
+    @staticmethod
+    def _direct_of(cm: _ClassModel, fn: ast.AST
+                   ) -> list[tuple[str, ast.AST]]:
+        out = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    a = _self_attr_name(item.context_expr)
+                    if a and a in cm.lock_attrs:
+                        out.append(
+                            (f"{cm.cls.name}.{cm._canonical_lock(a)}",
+                             node))
+        return out
+
+    # -- cycle detection ---------------------------------------------------
+
+    def _cycles(self, mod: Module, edges, sites) -> Iterable[Finding]:
+        adj: dict[str, set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+        seen_cycles: set[frozenset] = set()
+        for start in sorted(adj):
+            stack = [(start, (start,))]
+            while stack:
+                cur, path = stack.pop()
+                for nxt in sorted(adj.get(cur, ())):
+                    if nxt == start:
+                        key = frozenset(path)
+                        if key in seen_cycles:
+                            continue
+                        seen_cycles.add(key)
+                        cycle = list(path) + [start]
+                        edge = (path[-1], start)
+                        where = sites.get(edge) or sites.get(
+                            (start, path[1] if len(path) > 1 else start))
+                        methods = sorted({
+                            f"{c}.{m}" for e in zip(cycle, cycle[1:])
+                            for c, m in edges.get(e, ())})
+                        yield mod.finding(
+                            self, where if where is not None else
+                            mod.tree.body[0],
+                            "lock-order inversion: "
+                            + " -> ".join(cycle)
+                            + f" (acquired in {', '.join(methods)}); "
+                            "pick one global order",
+                            symbol="|".join(sorted(set(cycle))))
+                    elif nxt not in path:
+                        stack.append((nxt, path + (nxt,)))

@@ -454,8 +454,8 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser(
         "lint",
         help="static analysis: device-hygiene + lock-discipline + "
-             "metric-name rules (kubeflow_tpu/analysis; see "
-             "'kftpu lint --help')")
+             "sharding/SPMD + resource-pairing + metric-name rules "
+             "(kubeflow_tpu/analysis; see 'kftpu lint --help')")
 
     sp = sub.add_parser("run", help="one-shot: apply manifests and wait")
     sp.add_argument("-f", "--file", required=True)

@@ -1,0 +1,59 @@
+"""JAX version compatibility shims.
+
+One module owns the cross-version spelling differences so the data-plane
+code reads like current JAX everywhere else:
+
+- ``shard_map``: top-level ``jax.shard_map`` (new), else
+  ``jax.experimental.shard_map.shard_map`` with the ``check_vma`` →
+  ``check_rep`` keyword translated, else ``None`` (callers and tests gate
+  on ``HAS_SHARD_MAP`` — a missing shard_map must degrade to a clean
+  skip, not a collection-time ImportError).
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map  # type: ignore[attr-defined]
+
+    HAS_SHARD_MAP = True
+    SHARD_MAP_NATIVE = True
+except ImportError:  # pragma: no cover - depends on installed jax
+    SHARD_MAP_NATIVE = False
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+        def shard_map(f=None, /, **kw):
+            """``jax.experimental.shard_map`` with new-style keywords."""
+            if "check_vma" in kw:
+                kw["check_rep"] = kw.pop("check_vma")
+            if f is None:
+                return functools.partial(_shard_map_exp, **kw)
+            return _shard_map_exp(f, **kw)
+
+        HAS_SHARD_MAP = True
+    except ImportError:
+        shard_map = None
+        HAS_SHARD_MAP = False
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` where it exists; the classic static
+    ``psum(1, axis)`` idiom (a plain int under shard_map) on older jax."""
+    import jax
+
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
+def require_shard_map():
+    """The resolved shard_map, or an ImportError at CALL time (module
+    import stays safe for environments without any shard_map)."""
+    if shard_map is None:
+        raise ImportError(
+            "this jax provides neither jax.shard_map nor "
+            "jax.experimental.shard_map")
+    return shard_map

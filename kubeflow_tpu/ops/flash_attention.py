@@ -507,8 +507,10 @@ def flash_attention_sharded(
     divisible by the model degree, or a seq-sharded mesh."""
     import functools as _ft
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from kubeflow_tpu.compat import require_shard_map
+    shard_map = require_shard_map()
 
     shape = dict(mesh.shape)
     batch_axes = tuple(a for a in ("dcn", "data", "fsdp")

@@ -49,7 +49,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from kubeflow_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 StageFn = Callable[[Any, Any], Any]

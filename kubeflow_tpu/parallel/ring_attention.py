@@ -40,7 +40,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from kubeflow_tpu.compat import axis_size as _axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import NEG_INF, _repeat_kv
@@ -104,7 +104,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, softcap,
                          interpret):
     from kubeflow_tpu.ops.flash_attention import _flash_fwd
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     qt = jnp.swapaxes(q, 1, 2)                     # [B,H,Sq,D]
     kt = jnp.swapaxes(k, 1, 2)                     # [B,KH,Skv,D] (raw GQA)
@@ -166,7 +166,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, softcap, interpret,
     from kubeflow_tpu.ops.flash_attention import _flash_bwd_pallas
 
     q, k, v, out, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
@@ -250,7 +250,7 @@ def ring_attention(
                            logits_softcap, interpret)
     if impl != "xla":
         raise ValueError(f"unknown ring attention impl {impl!r}")
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     # GQA expansion happens per-step inside _block_attn_step: the ring
@@ -303,7 +303,7 @@ def ulysses_attention(
     swap back (the DeepSpeed-Ulysses schedule, TPU-natively over ICI)."""
     from kubeflow_tpu.ops.attention import multi_head_attention
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     h, kh = q.shape[2], k.shape[2]
     if h % n or kh % n:
         raise ValueError(

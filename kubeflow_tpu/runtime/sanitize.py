@@ -1,0 +1,252 @@
+"""Runtime sanitizers — the dynamic half of ``kftpu lint`` (ISSUE 7).
+
+``KFTPU_SANITIZE`` is a comma-separated list of modes:
+
+- ``transfer`` (also the legacy ``1``): the engine runs every decode pass
+  under ``jax.transfer_guard("disallow")`` (serve/engine.py) — implicit
+  host<->device transfers raise instead of silently stalling the hot
+  loop. Cross-checks the D1xx device-hygiene rules.
+- ``refcount``: the ``PageAllocator`` stamps every page alloc/incref with
+  an owner + call site, and ``assert_quiescent`` reports leaks PER OWNER
+  (which request/path forgot its free). Cross-checks R501/R502.
+- ``lockorder``: a process-wide lock-acquisition watchdog
+  (``install_lockorder_watchdog``) wraps ``threading.Lock``/``RLock``
+  creation, records the runtime acquisition-order graph keyed by lock
+  CREATION SITE, and raises ``LockOrderError`` the moment an acquisition
+  closes a cycle — the dynamic half of R503. Installed automatically at
+  ``import kubeflow_tpu`` when the mode is on.
+- ``all``: everything above.
+
+This module is stdlib-only (no jax): the watchdog must be installable
+before any engine/router constructs its locks, including under a bare
+``import kubeflow_tpu``.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+from typing import Optional
+
+_KNOWN_MODES = frozenset({"transfer", "refcount", "lockorder"})
+
+
+def sanitize_modes() -> frozenset:
+    """The active sanitizer modes from ``KFTPU_SANITIZE``. Legacy truthy
+    values (``1``/``on``/anything unrecognized) mean ``transfer`` — the
+    PR-5 behavior those settings already had."""
+    raw = os.environ.get("KFTPU_SANITIZE", "")
+    if raw.strip() in ("", "0"):
+        return frozenset()
+    out: set[str] = set()
+    for tok in raw.split(","):
+        t = tok.strip().lower()
+        if not t:
+            continue
+        if t == "all":
+            out |= _KNOWN_MODES
+        elif t in _KNOWN_MODES:
+            out.add(t)
+        else:
+            out.add("transfer")
+    return frozenset(out)
+
+
+def enabled(mode: str) -> bool:
+    return mode in sanitize_modes()
+
+
+def call_site(skip_files: tuple = ()) -> str:
+    """``file:line`` of the nearest caller frame outside this module and
+    ``skip_files`` — the owner stamp for refcount mode and the lock
+    identity for lockorder mode."""
+    skip = (__file__,) + tuple(skip_files)
+    frame = sys._getframe(1)
+    for _ in range(32):
+        if frame is None:
+            break
+        fname = frame.f_code.co_filename
+        if fname not in skip and "threading" not in os.path.basename(fname):
+            return f"{os.path.basename(fname)}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+# -- lockorder watchdog --------------------------------------------------------
+
+
+class LockOrderError(AssertionError):
+    """An acquisition closed a cycle in the runtime lock-order graph."""
+
+
+class _LockOrderWatchdog:
+    """Process-wide acquisition-order recorder.
+
+    Lock identity is the CREATION call site (``router.py:101``), so every
+    Router's ``_lock`` is one node — the graph describes the code, not
+    one process's object population. Edges A->B mean "B acquired while A
+    held". Same-site edges are skipped (reentrant RLocks and ordered
+    traversal over same-class instances are both legitimate). Cycle check
+    runs on each NEW edge only."""
+
+    def __init__(self):
+        self.graph: dict[str, set[str]] = {}
+        self.edge_threads: dict[tuple, str] = {}
+        self._meta = _thread.allocate_lock()   # raw: never itself watched
+        self._tls = threading.local()
+
+    # -- per-thread held stack --------------------------------------------
+
+    def _held(self) -> list:
+        return getattr(self._tls, "held", [])
+
+    def note_acquire(self, site: str, obj_id: int) -> None:
+        held = self._held()
+        new_edges = []
+        for h_site, _ in held:
+            if h_site != site:
+                new_edges.append((h_site, site))
+        cycle = None
+        if new_edges:
+            with self._meta:
+                for a, b in new_edges:
+                    peers = self.graph.setdefault(a, set())
+                    if b in peers:
+                        continue
+                    peers.add(b)
+                    self.edge_threads[(a, b)] = \
+                        threading.current_thread().name
+                    cycle = cycle or self._find_cycle(b, a)
+        if cycle is not None:
+            # Do NOT record the acquisition: the caller releases the
+            # underlying lock and re-raises.
+            raise LockOrderError(
+                "lock-order inversion at runtime: "
+                + " -> ".join(cycle + [cycle[0]])
+                + f" (closing edge acquired on thread "
+                f"'{threading.current_thread().name}'); "
+                "the static analyzer's R503 models this cycle")
+        self._tls.held = held + [(site, obj_id)]
+
+    def note_release(self, site: str, obj_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == (site, obj_id):
+                self._tls.held = held[:i] + held[i + 1:]
+                return
+
+    def _find_cycle(self, start: str, target: str) -> Optional[list]:
+        """Path start ->* target in the graph (meta lock held), i.e. the
+        cycle target -> start ->* target. Returns node list from target."""
+        stack = [(start, [start])]
+        seen = {start}
+        while stack:
+            cur, path = stack.pop()
+            for nxt in self.graph.get(cur, ()):
+                if nxt == target:
+                    return [target] + path
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def report(self) -> dict:
+        with self._meta:
+            return {a: sorted(bs) for a, bs in sorted(self.graph.items())}
+
+
+class _WatchedLock:
+    """Wraps one real lock; forwards everything, reporting acquire/release
+    to the watchdog. Works as a Condition's backing lock through the
+    stdlib's acquire/release fallbacks."""
+
+    __slots__ = ("_lk", "_site", "_wd")
+
+    def __init__(self, lk, site: str, wd: _LockOrderWatchdog):
+        self._lk = lk
+        self._site = site
+        self._wd = wd
+
+    def acquire(self, *args, **kwargs):
+        got = self._lk.acquire(*args, **kwargs)
+        if got:
+            try:
+                self._wd.note_acquire(self._site, id(self))
+            except LockOrderError:
+                self._lk.release()
+                raise
+        return got
+
+    def release(self):
+        self._wd.note_release(self._site, id(self))
+        self._lk.release()
+
+    def locked(self):
+        return self._lk.locked()
+
+    def __getattr__(self, name):
+        # stdlib internals poke at real-lock attributes we don't model
+        # (_at_fork_reinit in concurrent.futures, acquire_lock aliases) —
+        # forward them; the bookkeeping only needs acquire/release.
+        return getattr(self._lk, name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<WatchedLock {self._site} of {self._lk!r}>"
+
+
+_watchdog: Optional[_LockOrderWatchdog] = None
+_originals: Optional[tuple] = None
+
+
+def install_lockorder_watchdog() -> _LockOrderWatchdog:
+    """Patch ``threading.Lock``/``RLock`` so every lock created AFTER this
+    call is watched. Idempotent; returns the active watchdog."""
+    global _watchdog, _originals
+    if _watchdog is not None:
+        return _watchdog
+    wd = _LockOrderWatchdog()
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+
+    def make_lock():
+        return _WatchedLock(orig_lock(), call_site(), wd)
+
+    def make_rlock():
+        return _WatchedLock(orig_rlock(), call_site(), wd)
+
+    threading.Lock = make_lock           # type: ignore[assignment]
+    threading.RLock = make_rlock         # type: ignore[assignment]
+    _originals = (orig_lock, orig_rlock)
+    _watchdog = wd
+    return wd
+
+
+def uninstall_lockorder_watchdog() -> None:
+    """Restore the real factories. Locks created while installed keep
+    working (they wrap real locks); they go on reporting to the detached
+    watchdog object, which nothing consults anymore."""
+    global _watchdog, _originals
+    if _originals is not None:
+        threading.Lock, threading.RLock = _originals
+        _originals = None
+    _watchdog = None
+
+
+def lockorder_watchdog() -> Optional[_LockOrderWatchdog]:
+    return _watchdog
+
+
+def maybe_install() -> None:
+    """Called from ``kubeflow_tpu/__init__`` so ``KFTPU_SANITIZE=lockorder``
+    covers every lock the platform creates, whatever the entry point."""
+    if "lockorder" in sanitize_modes():
+        install_lockorder_watchdog()

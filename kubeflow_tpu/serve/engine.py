@@ -57,7 +57,6 @@ import contextlib
 import dataclasses
 import itertools
 import logging
-import os
 import queue
 import threading
 import time
@@ -1126,15 +1125,18 @@ class LLMEngine:
         self.qos_policies = dict(b.qos.classes)
         self.qos_preemption = bool(b.qos.preemption)
         self._id_gen = itertools.count()
-        # Runtime sanitizer (KFTPU_SANITIZE=1): run every scheduler step
-        # under ``jax.transfer_guard("disallow")``. The engine's transfer
-        # contract is that every host↔device move is EXPLICIT
-        # (``jnp.asarray`` at admission/sync sites, ``jax.device_get`` at
-        # the designed fetch points) — an implicit transfer anywhere in
-        # the step is a regression of exactly the class the static
-        # device-hygiene rules (kftpu lint, D1xx) catch, so the two
-        # cross-check each other.
-        self.sanitize = os.environ.get("KFTPU_SANITIZE", "") not in ("", "0")
+        # Runtime sanitizer (KFTPU_SANITIZE=transfer, legacy =1): run every
+        # scheduler step under ``jax.transfer_guard("disallow")``. The
+        # engine's transfer contract is that every host↔device move is
+        # EXPLICIT (``jnp.asarray`` at admission/sync sites,
+        # ``jax.device_get`` at the designed fetch points) — an implicit
+        # transfer anywhere in the step is a regression of exactly the
+        # class the static device-hygiene rules (kftpu lint, D1xx) catch,
+        # so the two cross-check each other. The refcount/lockorder modes
+        # live in runtime/sanitize.py + serve/paged.py.
+        from kubeflow_tpu.runtime.sanitize import sanitize_modes
+
+        self.sanitize = "transfer" in sanitize_modes()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
@@ -1566,7 +1568,8 @@ class LLMEngine:
             if self.paged:
                 # Paged admission is always chunked; the prefix cache
                 # trims the work to the uncached tail.
-                hit = self._allocator.match_prefix(req.prompt_tokens)
+                hit = self._allocator.match_prefix(req.prompt_tokens,
+                                                   owner=req.id)
                 self._release_slot_pages(slot_idx)
                 self._slot_pages[slot_idx] = list(hit)
                 self._table[slot_idx, :] = -1
@@ -1669,6 +1672,18 @@ class LLMEngine:
 
     # -- paged bookkeeping -----------------------------------------------------
 
+    def _slot_owner(self, slot_idx: int) -> Optional[str]:
+        """Request id owning ``slot_idx`` right now (occupant or in-flight
+        chunked prefill) — the refcount sanitizer's leak-attribution
+        label."""
+        s = self.slots[slot_idx]
+        if s is not None:
+            return s.request.id
+        for ch in self._chunkings:
+            if ch.slot == slot_idx:
+                return ch.request.id
+        return None
+
     def _ensure_pages(self, slot_idx: int, upto: int) -> bool:
         """Grow ``slot_idx``'s page list to cover positions [0, upto)."""
         from kubeflow_tpu.serve.paged import PagePoolExhausted
@@ -1678,7 +1693,8 @@ class LLMEngine:
         if need <= have:
             return True
         try:
-            new = self._allocator.alloc(need - have)
+            new = self._allocator.alloc(need - have,
+                                        owner=self._slot_owner(slot_idx))
         except PagePoolExhausted:
             return False
         self._table[slot_idx, have:need] = new

@@ -86,7 +86,42 @@ class PageAllocator:
         self._key_of: dict[int, tuple] = {}
         # ref==0 pages that still hold cached content, LRU order
         self._reclaimable: "OrderedDict[int, None]" = OrderedDict()
-        self.stats = {"prefix_hits": 0, "prefix_queries": 0, "evictions": 0}
+        self.stats = {"prefix_hits": 0, "prefix_queries": 0, "evictions": 0,
+                      "stamped_allocs": 0}
+        # KFTPU_SANITIZE=refcount (runtime/sanitize.py): stamp every
+        # alloc/incref with owner + call site so assert_quiescent can say
+        # WHO leaked, not just that someone did. One stamp per outstanding
+        # reference, popped LIFO by free().
+        from kubeflow_tpu.runtime.sanitize import enabled
+
+        self.refcount_debug = enabled("refcount")
+        self._stamps: dict[int, list[str]] = {}
+
+    # -- refcount sanitizer ------------------------------------------------
+
+    def _stamp(self, page: int, owner: Optional[str]) -> None:
+        from kubeflow_tpu.runtime.sanitize import call_site
+
+        label = owner if owner is not None else call_site((__file__,))
+        self._stamps.setdefault(page, []).append(label)
+        self.stats["stamped_allocs"] += 1
+
+    def _unstamp(self, page: int) -> None:
+        stamps = self._stamps.get(page)
+        if stamps:
+            stamps.pop()
+            if not stamps:
+                del self._stamps[page]
+
+    def leak_report_by_owner(self) -> dict:
+        """owner label -> number of page references it still holds
+        (refcount mode only; {} when quiescent). The chaos suite's
+        per-owner zero-leak assertion reads this."""
+        out: dict[str, int] = {}
+        for page in np.flatnonzero(self._ref > 0):
+            for label in self._stamps.get(int(page), ()) or ["<unstamped>"]:
+                out[label] = out.get(label, 0) + 1
+        return out
 
     # -- raw pages ---------------------------------------------------------
 
@@ -110,14 +145,21 @@ class PageAllocator:
     def assert_quiescent(self) -> None:
         """Refcount-balance invariant for the chaos suite: once every
         request has completed or been reaped, every alloc/incref must have
-        been balanced by exactly one free — no page may stay referenced."""
+        been balanced by exactly one free — no page may stay referenced.
+        Under ``KFTPU_SANITIZE=refcount`` the failure names the owners
+        whose stamps are still outstanding."""
         leaked = self.leak_report()
         if leaked:
-            raise AssertionError(
-                f"KV page leak: {len(leaked)} page(s) still referenced "
-                f"(page -> ref): {dict(list(leaked.items())[:16])}")
+            msg = (f"KV page leak: {len(leaked)} page(s) still referenced "
+                   f"(page -> ref): {dict(list(leaked.items())[:16])}")
+            if self.refcount_debug:
+                by_owner = self.leak_report_by_owner()
+                msg += ("; outstanding references by owner: "
+                        + ", ".join(f"{o}={n}" for o, n in
+                                    sorted(by_owner.items())))
+            raise AssertionError(msg)
 
-    def alloc(self, n: int) -> list[int]:
+    def alloc(self, n: int, owner: Optional[str] = None) -> list[int]:
         """n fresh pages (ref=1 each). Evicts cached pages LRU if needed."""
         if self.available() < n:
             raise PagePoolExhausted(f"need {n}, have {self.available()}")
@@ -132,14 +174,20 @@ class PageAllocator:
                     self._by_key.pop(key, None)
                 self.stats["evictions"] += 1
             self._ref[p] = 1
+            if self.refcount_debug:
+                self._stamps.pop(p, None)   # fresh ownership history
+                self._stamp(p, owner)
             out.append(p)
         return out
 
-    def incref(self, pages: Sequence[int]) -> None:
+    def incref(self, pages: Sequence[int],
+               owner: Optional[str] = None) -> None:
         for p in pages:
             if self._ref[p] == 0:
                 self._reclaimable.pop(p, None)
             self._ref[p] += 1
+            if self.refcount_debug:
+                self._stamp(p, owner)
 
     def free(self, pages: Sequence[int]) -> None:
         """Drop one reference; ref-0 pages become reclaimable (cached) if
@@ -147,6 +195,8 @@ class PageAllocator:
         for p in pages:
             self._ref[p] -= 1
             assert self._ref[p] >= 0, f"double free of page {p}"
+            if self.refcount_debug:
+                self._unstamp(p)
             if self._ref[p] == 0:
                 if p in self._key_of:
                     self._reclaimable[p] = None    # keep content, LRU
@@ -164,7 +214,8 @@ class PageAllocator:
             keys.append(parent)
         return keys
 
-    def match_prefix(self, tokens: Sequence[int]) -> list[int]:
+    def match_prefix(self, tokens: Sequence[int],
+                     owner: Optional[str] = None) -> list[int]:
         """Longest run of cached pages for ``tokens``' full-page prefix
         (capped so at least one prompt token remains to prefill — the first
         sampled token needs real last-token logits). Bumps refs on the hit
@@ -180,7 +231,7 @@ class PageAllocator:
                 break
             hit.append(page)
         if hit:
-            self.incref(hit)
+            self.incref(hit, owner=owner)
             self.stats["prefix_hits"] += 1
         return hit
 

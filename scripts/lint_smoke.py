@@ -5,9 +5,11 @@ Fails on ANY finding not matched by the checked-in baseline
 (.kftpu-lint-baseline.json) — pre-existing debt is baselined with a
 justification, new findings block. Also self-checks the analyzer the way
 the acceptance criteria demand: each rule family must still catch its
-seeded regression (the PR-4 per-round ``jnp.asarray(self._table)`` upload
-and a dropped router lock acquisition), so a rule that silently stops
-firing fails the gate too, not just the test suite.
+seeded regression — the PR-4 per-round ``jnp.asarray(self._table)``
+upload (D103), a dropped router lock acquisition (C301), a de-donated
+decode carry (S401), an exception-path page leak (R501), and an inverted
+router lock pair (R503) — so a rule that silently stops firing fails the
+gate too, not just the test suite.
 
 Prints one JSON object; ``"lint_smoke": "ok"`` is the pass marker
 smoke.sh greps for. Findings render as ``file:line:col`` so they are
@@ -31,14 +33,22 @@ def _seeded_regressions() -> list[str]:
     still fires exactly once. Returns a list of failure descriptions."""
     fails: list[str] = []
 
-    def new_findings(path: str, old: str, new: str, rule: str,
-                     needle: str) -> None:
+    def new_findings(path: str, edits, rule: str, needle: str) -> None:
+        """``edits``: one (old, new) pair or a list of them — some seeds
+        (the R503 lock-order inversion) need both an __init__ line and
+        the inverted methods."""
+        if isinstance(edits, tuple):
+            edits = [edits]
         with open(os.path.join(REPO, path)) as f:
             src = f.read()
-        mut = src.replace(old, new, 1)
-        if mut == src:
-            fails.append(f"{rule}: mutation anchor not found in {path}")
-            return
+        mut = src
+        for old, new in edits:
+            nxt = mut.replace(old, new, 1)
+            if nxt == mut:
+                fails.append(
+                    f"{rule}: mutation anchor not found in {path}")
+                return
+            mut = nxt
         before = {f.fingerprint for f in lint_source(src, path)}
         fresh = [f for f in lint_source(mut, path)
                  if f.fingerprint not in before]
@@ -52,16 +62,49 @@ def _seeded_regressions() -> list[str]:
     # Family A: the PR-4 bug — full page-table re-upload per decode round.
     new_findings(
         "kubeflow_tpu/serve/engine.py",
-        "        self._sync_decode_state()\n",
-        "        self._sync_decode_state()\n"
-        "        table = jnp.asarray(self._table)\n",
+        ("        self._sync_decode_state()\n",
+         "        self._sync_decode_state()\n"
+         "        table = jnp.asarray(self._table)\n"),
         "D103", "self._table")
     # Family B: drop one router lock acquisition.
     new_findings(
         "kubeflow_tpu/serve/router.py",
-        "    def note_activity(self) -> None:\n        with self._lock:\n",
-        "    def note_activity(self) -> None:\n        if True:\n",
+        ("    def note_activity(self) -> None:\n        with self._lock:\n",
+         "    def note_activity(self) -> None:\n        if True:\n"),
         "C301", "_last_activity")
+    # Family S: drop the dense decode dispatch's carry donation (2x HBM).
+    new_findings(
+        "kubeflow_tpu/serve/engine.py",
+        ("self._decode_n = jax.jit(_decode_fn, static_argnums=(4, 5),\n"
+         "                                 donate_argnums=(1, 2))",
+         "self._decode_n = jax.jit(_decode_fn, static_argnums=(4, 5))"),
+        "S401", "self._decode_n")
+    # Family R: a raise-capable call between page alloc and the ownership
+    # recording — the exception path leaks the pages.
+    new_findings(
+        "kubeflow_tpu/serve/engine.py",
+        ("owner=self._slot_owner(slot_idx))\n",
+         "owner=self._slot_owner(slot_idx))\n"
+         "            self._refresh_pool_gauge()\n"),
+        "R501", "_ensure_pages")
+    # Family R: a second router lock acquired in both orders (the cycle
+    # KFTPU_SANITIZE=lockorder would catch at runtime).
+    new_findings(
+        "kubeflow_tpu/serve/router.py",
+        [("        self._lock = threading.Lock()\n",
+          "        self._lock = threading.Lock()\n"
+          "        self._aux_lock = threading.Lock()\n"),
+         ("    def note_activity(self) -> None:\n",
+          "    def _seed_ab(self):\n"
+          "        with self._lock:\n"
+          "            with self._aux_lock:\n"
+          "                pass\n\n"
+          "    def _seed_ba(self):\n"
+          "        with self._aux_lock:\n"
+          "            with self._lock:\n"
+          "                pass\n\n"
+          "    def note_activity(self) -> None:\n")],
+        "R503", "lock-order inversion")
     return fails
 
 

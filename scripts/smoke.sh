@@ -10,9 +10,11 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "== kftpu lint (static analysis vs committed baseline) =="
-# Cheapest gate first: device-hygiene + lock-discipline + metric-name
-# rules over the whole tree; any finding not in .kftpu-lint-baseline.json
-# fails, and each rule family must still catch its seeded regression.
+# Cheapest gate first: device-hygiene + lock-discipline + sharding/SPMD +
+# resource-pairing + metric-name rules over the whole tree; any finding
+# not in .kftpu-lint-baseline.json fails, and each rule family must still
+# catch its seeded regression (D103 re-upload, C301 dropped lock, S401
+# de-donated carry, R501 exception-path page leak, R503 lock inversion).
 timeout -k 10 120 python scripts/lint_smoke.py | tee /tmp/_smoke_lint.json
 lint_rc=${PIPESTATUS[0]}
 grep -q '"lint_smoke": "ok"' /tmp/_smoke_lint.json || lint_rc=1

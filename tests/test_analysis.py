@@ -424,6 +424,511 @@ class TestSwallowedException:
         assert rules_of(src) == []
 
 
+# -- Family S: sharding / SPMD -------------------------------------------------
+
+
+class TestUndonatedCarry:
+    def test_carry_without_donation(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c)\n"
+            "    def go(self):\n"
+            "        self.cache = self._fn(self.cache)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["S401"]
+        assert "self._fn" in fs[0].message and "self.cache" in fs[0].message
+
+    def test_tuple_target_carry(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda p, c: (1, c))\n"
+            "    def go(self):\n"
+            "        out, self.cache = self._fn(self.params, self.cache)\n")
+        assert rules_of(src) == ["S401"]
+
+    def test_donated_carry_is_clean(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self):\n"
+            "        self.cache = self._fn(self.cache)\n")
+        assert rules_of(src) == []
+
+    def test_non_carry_call_is_clean(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda x: x)\n"
+            "    def go(self):\n"
+            "        out = self._fn(self.logits)\n"
+            "        return out\n")
+        assert rules_of(src) == []
+
+
+class TestUnknownMeshAxis:
+    def test_typo_in_partition_spec(self):
+        src = (
+            "from jax.sharding import PartitionSpec\n"
+            "spec = PartitionSpec('modle', None)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["S402"]
+        assert "modle" in fs[0].message
+
+    def test_axis_name_kwarg_and_tuple(self):
+        src = (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "spec = P(('dcn', 'dat'), None)\n"
+            "def f(x):  # mesh-context: test fixture\n"
+            "    return jax.lax.psum(x, axis_name='modell')\n")
+        assert rules_of(src) == ["S402", "S402"]
+
+    def test_canonical_axes_clean(self):
+        src = (
+            "from jax.sharding import PartitionSpec as P\n"
+            "spec = P(('dcn', 'data', 'fsdp'), 'seq', 'model')\n")
+        assert rules_of(src) == []
+
+    def test_canonical_set_matches_runtime_mesh(self):
+        from kubeflow_tpu.analysis.core import canonical_mesh_axes
+        from kubeflow_tpu.runtime.mesh import MESH_AXES
+
+        assert canonical_mesh_axes() == MESH_AXES
+
+
+class TestHostRoundTrip:
+    def test_fetch_then_dispatch(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self, st):\n"
+            "        lens = jax.device_get(st)\n"
+            "        return self._fn(lens)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["S403"]
+        assert "lens" in fs[0].message
+
+    def test_taint_propagates_through_assignment(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self, st):\n"
+            "        host = np.asarray(st)\n"
+            "        padded = host + 1\n"
+            "        return self._fn(padded)\n")
+        assert rules_of(src) == ["S403"]
+
+    def test_fetch_after_dispatch_is_clean(self):
+        # the engine's draft-propose pattern: dispatch first, fetch after
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self, st):\n"
+            "        out = self._fn(st)\n"
+            "        host = jax.device_get(out)\n"
+            "        return host\n")
+        assert rules_of(src) == []
+
+    def test_rebinding_clears_taint(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self, st):\n"
+            "        host = jax.device_get(st)\n"
+            "        host = jnp.zeros((4,))\n"
+            "        return self._fn(host)\n")
+        assert rules_of(src) == []
+
+
+class TestImplicitReplication:
+    def test_unsharded_params_device_put(self):
+        src = (
+            "import jax\n"
+            "from jax.sharding import NamedSharding\n"
+            "def load(params):\n"
+            "    return jax.device_put(params)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["S404"]
+        assert "shard_params" in fs[0].message
+
+    def test_sharded_put_is_clean(self):
+        src = (
+            "import jax\n"
+            "from jax.sharding import NamedSharding\n"
+            "def load(params, sh):\n"
+            "    return jax.device_put(params, sh)\n")
+        assert rules_of(src) == []
+
+    def test_non_mesh_module_is_clean(self):
+        src = (
+            "import jax\n"
+            "def load(params):\n"
+            "    return jax.device_put(params)\n")
+        assert rules_of(src) == []
+
+
+class TestUnboundCollective:
+    def test_literal_axis_without_shard_map(self):
+        src = (
+            "import jax\n"
+            "def allreduce(x):\n"
+            "    return jax.lax.psum(x, 'model')\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["S405"]
+        assert "model" in fs[0].message
+
+    def test_shard_mapped_fn_is_bound(self):
+        src = (
+            "import jax\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "def worker(x):\n"
+            "    return jax.lax.psum(x, 'model')\n"
+            "def build(mesh, spec):\n"
+            "    return shard_map(worker, mesh=mesh, in_specs=(spec,),\n"
+            "                     out_specs=spec)\n")
+        assert rules_of(src) == []
+
+    def test_one_level_callee_of_shard_mapped_fn_is_bound(self):
+        src = (
+            "import jax\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "def reduce_part(x):\n"
+            "    return jax.lax.psum(x, 'model')\n"
+            "def worker(x):\n"
+            "    return reduce_part(x) + 1\n"
+            "def build(mesh, spec):\n"
+            "    return shard_map(worker, mesh=mesh, in_specs=(spec,),\n"
+            "                     out_specs=spec)\n")
+        assert rules_of(src) == []
+
+    def test_mesh_context_annotation_closes_it(self):
+        src = (
+            "import jax\n"
+            "def allreduce(x):  # mesh-context: stage fn, bound in pipeline.py\n"
+            "    return jax.lax.psum(x, 'model')\n")
+        assert rules_of(src) == []
+
+    def test_variable_axis_is_clean(self):
+        src = (
+            "import jax\n"
+            "def allreduce(x, axis_name):\n"
+            "    return jax.lax.psum(x, axis_name)\n")
+        assert rules_of(src) == []
+
+
+# -- Family R: resources & ordering --------------------------------------------
+
+
+class TestLeakedAlloc:
+    def test_risky_call_between_alloc_and_record(self):
+        src = (
+            "class E:\n"
+            "    def grow(self, idx, n):\n"
+            "        new = self._allocator.alloc(n)\n"
+            "        self._refresh_gauge()\n"
+            "        self._slot_pages[idx].extend(new)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["R501"]
+        assert "new" in fs[0].message and "grow" in fs[0].message
+
+    def test_immediate_record_is_clean(self):
+        src = (
+            "class E:\n"
+            "    def grow(self, idx, n):\n"
+            "        new = self._allocator.alloc(n)\n"
+            "        self._slot_pages[idx].extend(new)\n"
+            "        self._refresh_gauge()\n")
+        assert rules_of(src) == []
+
+    def test_handler_free_is_clean(self):
+        src = (
+            "class E:\n"
+            "    def grow(self, idx, n):\n"
+            "        try:\n"
+            "            new = self._allocator.alloc(n)\n"
+            "            self._risky_dispatch()\n"
+            "        except Exception:\n"
+            "            self._allocator.free(new)\n"
+            "            raise\n"
+            "        self._slot_pages[idx].extend(new)\n")
+        assert rules_of(src) == []
+
+    def test_record_after_try_is_clean(self):
+        # the engine's real _ensure_pages shape: alloc inside try (for
+        # PagePoolExhausted), ownership recorded right after the try.
+        src = (
+            "class E:\n"
+            "    def grow(self, idx, n):\n"
+            "        try:\n"
+            "            new = self._allocator.alloc(n)\n"
+            "        except PagePoolExhausted:\n"
+            "            return False\n"
+            "        self._slot_pages[idx].extend(new)\n"
+            "        return True\n")
+        assert rules_of(src) == []
+
+    def test_never_recorded_alloc_fires(self):
+        src = (
+            "class E:\n"
+            "    def grow(self, n):\n"
+            "        new = self._allocator.alloc(n)\n")
+        assert rules_of(src) == ["R501"]
+
+
+class TestUnauditedPagedTest:
+    def test_paged_test_without_audit(self):
+        src = (
+            "def test_paged_decode(mk_engine):\n"
+            "    eng = mk_engine(paged=True)\n"
+            "    eng.generate([1, 2, 3])\n")
+        fs = lint_source(src, "tests/test_fixture_x.py")
+        assert [f.rule for f in fs] == ["R502"]
+
+    def test_direct_audit_is_clean(self):
+        src = (
+            "def test_paged_decode(mk_engine):\n"
+            "    eng = mk_engine(paged=True)\n"
+            "    eng.generate([1, 2, 3])\n"
+            "    eng._allocator.assert_quiescent()\n")
+        assert [f.rule for f in lint_source(
+            src, "tests/test_fixture_x.py")] == []
+
+    def test_helper_audit_one_level_is_clean(self):
+        src = (
+            "def audit(eng):\n"
+            "    assert eng.kv_pages_in_use() == 0\n"
+            "def test_paged_decode(mk_engine):\n"
+            "    eng = mk_engine(paged=True)\n"
+            "    eng.generate([1, 2, 3])\n"
+            "    audit(eng)\n")
+        assert [f.rule for f in lint_source(
+            src, "tests/test_fixture_x.py")] == []
+
+    def test_non_test_path_ignored(self):
+        src = (
+            "def test_paged_decode(mk_engine):\n"
+            "    eng = mk_engine(paged=True)\n")
+        assert [f.rule for f in lint_source(
+            src, "kubeflow_tpu/serve/fixture.py")] == []
+
+
+class TestLockOrderInversion:
+    INVERTED = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n")
+
+    def test_two_lock_cycle(self):
+        fs = lint_source(self.INVERTED)
+        assert [f.rule for f in fs] == ["R503"]
+        assert "S._a" in fs[0].message and "S._b" in fs[0].message
+
+    def test_consistent_order_is_clean(self):
+        src = self.INVERTED.replace(
+            "        with self._b:\n"
+            "            with self._a:\n",
+            "        with self._a:\n"
+            "            with self._b:\n")
+        assert rules_of(src) == []
+
+    def test_one_level_helper_acquisition(self):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            self._grab_b()\n"
+            "    def _grab_b(self):\n"
+            "        with self._b:\n"
+            "            pass\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n")
+        assert rules_of(src) == ["R503"]
+
+    def test_condition_canonicalizes_to_its_lock(self):
+        # Condition(self._a) IS lock _a: with-ing the condition in one
+        # method and the lock in another is NOT an inversion.
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._a)\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._cv:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def two(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n")
+        assert rules_of(src) == []
+
+
+# -- interprocedural core (one-level call-following) ---------------------------
+
+
+class TestCallFollowing:
+    def test_d101_sees_through_helper(self):
+        # helper only ever called from jitted code: its host sync fires
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "def fetch(x):\n"
+            "    return np.asarray(x)\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return fetch(x) + 1\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["D101"]
+        assert fs[0].symbol == "fetch"
+
+    def test_d101_skips_helper_shared_with_host_path(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "def fetch(x):\n"
+            "    return np.asarray(x)\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return fetch(x) + 1\n"
+            "def host_side(x):\n"
+            "    return fetch(x)\n")
+        assert rules_of(src) == []
+
+    def test_d104_read_inside_helper(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self):\n"
+            "        out = self._fn(self.cache)\n"
+            "        self._peek()\n"
+            "        return out\n"
+            "    def _peek(self):\n"
+            "        return self.cache.shape\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["D104"]
+        assert "self.cache" in fs[0].message
+
+    def test_d104_helper_rebind_is_clean(self):
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+            "    def go(self):\n"
+            "        out = self._fn(self.cache)\n"
+            "        self._rebuild()\n"
+            "        return self.cache\n"
+            "    def _rebuild(self):\n"
+            "        self.cache = None\n")
+        assert rules_of(src) == []
+
+    def test_c301_caller_held_lock_inference(self):
+        # private helper only called under the lock: its mutation counts
+        # as guarded WITHOUT a # requires_lock annotation
+        src = (
+            "import threading\n"
+            "class G:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded_by: _lock\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._bump_inner()\n"
+            "    def _bump_inner(self):\n"
+            "        self._n += 1\n")
+        assert rules_of(src) == []
+
+    def test_c301_mixed_call_sites_still_fire(self):
+        # one call site does NOT hold the lock: inference must not silence
+        src = (
+            "import threading\n"
+            "class G:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded_by: _lock\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._bump_inner()\n"
+            "    def bump_unlocked(self):\n"
+            "        self._bump_inner()\n"
+            "    def _bump_inner(self):\n"
+            "        self._n += 1\n")
+        assert rules_of(src) == ["C301"]
+
+    def test_c302_blocking_helper_under_lock(self):
+        # the helper is only ever called under the lock, so caller-held
+        # inference flags its sleep DIRECTLY (one finding, in the helper)
+        src = (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def poll(self):\n"
+            "        with self._lock:\n"
+            "            self._wait_a_bit()\n"
+            "    def _wait_a_bit(self):\n"
+            "        time.sleep(0.1)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["C302"]
+        assert fs[0].symbol.endswith("_wait_a_bit")
+
+    def test_c302_helper_followed_from_mixed_call_sites(self):
+        # one unlocked call site kills the inference; the lock-held call
+        # site still reports via one-level following
+        src = (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def poll(self):\n"
+            "        with self._lock:\n"
+            "            self._wait_a_bit()\n"
+            "    def idle(self):\n"
+            "        self._wait_a_bit()\n"
+            "    def _wait_a_bit(self):\n"
+            "        time.sleep(0.1)\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["C302"]
+        assert "_wait_a_bit" in fs[0].message
+
+
 # -- metric-name rules ---------------------------------------------------------
 
 
@@ -457,6 +962,53 @@ class TestMetricRules:
             "def setup(reg):\n"
             "    reg.counter('kftpu_reqs_total', 'a')\n"
             "    reg.histogram('kftpu_latency_seconds', 'b')\n")
+        assert rules_of(src) == []
+
+    def test_fstring_expanded_via_literal_loop(self):
+        # the PR-6 labeled-series idiom: the loop's literal values expand
+        # the f-string, so FULL grammar (not just the prefix) is checked
+        src = (
+            "def setup(reg, snap):\n"
+            "    for k in ('ttft_p95_ms', 'bad-grammar'):\n"
+            "        reg.gauge(f'kftpu_serving_{k}').set(snap[k])\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["M201"]
+        assert "bad-grammar" in fs[0].message
+
+    def test_fstring_loop_expansion_all_good_is_clean(self):
+        src = (
+            "def setup(reg, snap):\n"
+            "    for k in ('ttft_p95_ms', 'queue_delay_p95_ms'):\n"
+            "        reg.gauge(f'kftpu_serving_{k}').set(snap[k])\n")
+        assert rules_of(src) == []
+
+    def test_fstring_loop_expansion_duplicate_detected(self):
+        src = (
+            "def setup(reg):\n"
+            "    for k in ('depth', 'depth'):\n"
+            "        reg.gauge(f'kftpu_q_{k}')\n")
+        assert rules_of(src) == ["M202"]
+
+    def test_reserved_label_at_sample_site(self):
+        src = (
+            "def setup(reg):\n"
+            "    g = reg.gauge('kftpu_latency_p95_ms')\n"
+            "    g.set(1.0, le='0.5')\n")
+        fs = lint_source(src)
+        assert [f.rule for f in fs] == ["M203"]
+        assert "le" in fs[0].message
+
+    def test_reserved_label_in_dict_splat(self):
+        src = (
+            "def setup(reg):\n"
+            "    reg.counter('kftpu_reqs_total').inc(1, **{'quantile': 'x'})\n")
+        assert rules_of(src) == ["M203"]
+
+    def test_normal_labels_clean(self):
+        src = (
+            "def setup(reg, name, cls):\n"
+            "    q = reg.counter('kftpu_serving_qos_requests_total')\n"
+            "    q.inc(3, model=name, qos=cls)\n")
         assert rules_of(src) == []
 
 
@@ -511,7 +1063,9 @@ class TestRegistry:
     def test_all_families_registered(self):
         ids = {r.id for r in all_rules()}
         assert {"D101", "D102", "D103", "D104", "D105",
-                "C301", "C302", "C303", "M201", "M202"} <= ids
+                "C301", "C302", "C303", "M201", "M202", "M203",
+                "S401", "S402", "S403", "S404", "S405",
+                "R501", "R502", "R503"} <= ids
 
     def test_parse_error_is_reported_not_raised(self, tmp_path):
         bad = tmp_path / "bad.py"
@@ -572,6 +1126,60 @@ class TestSeededRegressions:
             'reg.gauge("serving_queue_depth")')
         assert [f.rule for f in fresh] == ["M201"]
 
+    def test_dropped_decode_donation_is_caught(self):
+        """Removing the dense decode dispatch's donate_argnums — the 2x-HBM
+        carry — produces exactly one S401."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/engine.py",
+            "self._decode_n = jax.jit(_decode_fn, static_argnums=(4, 5),\n"
+            "                                 donate_argnums=(1, 2))",
+            "self._decode_n = jax.jit(_decode_fn, static_argnums=(4, 5))")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "S401" and "self._decode_n" in f.message
+
+    def test_exception_path_page_leak_is_caught(self):
+        """A raise-capable call between the page alloc and its ownership
+        recording produces exactly one R501."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/engine.py",
+            "owner=self._slot_owner(slot_idx))\n",
+            "owner=self._slot_owner(slot_idx))\n"
+            "            self._refresh_pool_gauge()\n")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "R501" and "_ensure_pages" in f.message
+
+    def test_injected_router_lock_inversion_is_caught(self):
+        """A second router lock acquired in both orders produces exactly
+        one R503 naming the cycle."""
+        relpath = "kubeflow_tpu/serve/router.py"
+        with open(os.path.join(REPO, relpath)) as f:
+            src = f.read()
+        mut = src.replace(
+            "        self._lock = threading.Lock()\n",
+            "        self._lock = threading.Lock()\n"
+            "        self._aux_lock = threading.Lock()\n", 1)
+        mut = mut.replace(
+            "    def note_activity(self) -> None:\n",
+            "    def _seed_ab(self):\n"
+            "        with self._lock:\n"
+            "            with self._aux_lock:\n"
+            "                pass\n\n"
+            "    def _seed_ba(self):\n"
+            "        with self._aux_lock:\n"
+            "            with self._lock:\n"
+            "                pass\n\n"
+            "    def note_activity(self) -> None:\n", 1)
+        assert mut != src
+        before = {f.fingerprint for f in lint_source(src, relpath)}
+        fresh = [f for f in lint_source(mut, relpath)
+                 if f.fingerprint not in before]
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "R503"
+        assert "Router._aux_lock" in f.message and "Router._lock" in f.message
+
 
 # -- self-scan + CLI -----------------------------------------------------------
 
@@ -630,5 +1238,51 @@ class TestCli:
             [sys.executable, "-m", "kubeflow_tpu.analysis", "--list-rules"],
             capture_output=True, text=True, cwd=REPO)
         assert proc.returncode == 0
-        for rid in ("D103", "C301", "M201"):
+        for rid in ("D103", "C301", "M201", "S401", "R503"):
             assert rid in proc.stdout
+
+    def _git_repo(self, tmp_path):
+        def git(*args):
+            subprocess.run(
+                ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                 *args], cwd=tmp_path, check=True, capture_output=True)
+        git("init", "-q")
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        git("add", "clean.py")
+        git("commit", "-qm", "seed")
+        return git
+
+    def test_changed_lints_only_touched_files(self, tmp_path):
+        self._git_repo(tmp_path)
+        # clean.py is committed and untouched; dirty.py is new + dirty
+        (tmp_path / "dirty.py").write_text(
+            TestFullBufferReupload.POSITIVE)
+        env = dict(os.environ, PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", "--changed",
+             "--no-baseline", "--json"],
+            capture_output=True, text=True, cwd=tmp_path, env=env)
+        doc = json.loads(proc.stdout)
+        assert proc.returncode == 1
+        assert doc["files_scanned"] == 1       # dirty.py only
+        assert [f["rule"] for f in doc["findings"]] == ["D103"]
+
+    def test_changed_with_nothing_touched_is_ok(self, tmp_path):
+        self._git_repo(tmp_path)
+        env = dict(os.environ, PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", "--changed",
+             "--no-baseline"],
+            capture_output=True, text=True, cwd=tmp_path, env=env)
+        assert proc.returncode == 0
+        assert "0 files changed" in proc.stdout
+
+    def test_changed_rejects_update_baseline(self, tmp_path):
+        self._git_repo(tmp_path)
+        env = dict(os.environ, PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", "--changed",
+             "--update-baseline"],
+            capture_output=True, text=True, cwd=tmp_path, env=env)
+        assert proc.returncode == 2
+        assert "full scan" in proc.stderr

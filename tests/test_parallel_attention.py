@@ -11,6 +11,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+pytest.importorskip(
+    "jax.experimental.pallas",
+    reason="Pallas unavailable: flash/ring kernels need it")
+from kubeflow_tpu.compat import HAS_SHARD_MAP  # noqa: E402
+
+if not HAS_SHARD_MAP:
+    pytest.skip("this jax has no shard_map (native or experimental)",
+                allow_module_level=True)
+
 from kubeflow_tpu.ops.attention import multi_head_attention
 from kubeflow_tpu.ops.flash_attention import flash_attention
 
@@ -645,7 +654,8 @@ class TestShardedFlashTraining:
 
         outs = {}
         for impl in ("xla", "pallas"):
-            loss, grads = jax.jit(jax.value_and_grad(
+            # two traces total, one per impl — not compile-cache churn
+            loss, grads = jax.jit(jax.value_and_grad(  # lint: disable=D105
                 lambda p: decoder_loss(p, tokens, cfg, mesh=mesh,
                                        attn_impl=impl)[0]))(params)
             outs[impl] = (float(loss), grads)

@@ -1,0 +1,243 @@
+"""Runtime sanitizers (ISSUE 7): KFTPU_SANITIZE mode parsing, the
+refcount owner-stamping allocator, and the lockorder watchdog — the
+dynamic cross-checks of the S4xx/R5xx static rules.
+
+The watchdog tests install/uninstall within the process; every test
+restores the real threading factories on exit (the uninstall is in a
+finally) so the rest of the suite runs unpatched."""
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.runtime import sanitize
+from kubeflow_tpu.runtime.sanitize import (
+    LockOrderError, install_lockorder_watchdog, sanitize_modes,
+    uninstall_lockorder_watchdog,
+)
+
+
+class TestModeParsing:
+    def test_unset_and_zero_are_off(self, monkeypatch):
+        monkeypatch.delenv("KFTPU_SANITIZE", raising=False)
+        assert sanitize_modes() == frozenset()
+        monkeypatch.setenv("KFTPU_SANITIZE", "0")
+        assert sanitize_modes() == frozenset()
+
+    def test_legacy_one_means_transfer(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_SANITIZE", "1")
+        assert sanitize_modes() == {"transfer"}
+
+    def test_named_modes_and_lists(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+        assert sanitize_modes() == {"refcount"}
+        monkeypatch.setenv("KFTPU_SANITIZE", "refcount,lockorder")
+        assert sanitize_modes() == {"refcount", "lockorder"}
+        monkeypatch.setenv("KFTPU_SANITIZE", "all")
+        assert sanitize_modes() == {"transfer", "refcount", "lockorder"}
+
+    def test_unknown_token_degrades_to_transfer(self, monkeypatch):
+        # pre-ISSUE-7 setups used arbitrary truthy values for the
+        # transfer guard; they must keep meaning what they meant
+        monkeypatch.setenv("KFTPU_SANITIZE", "yes")
+        assert sanitize_modes() == {"transfer"}
+
+    def test_refcount_mode_does_not_engage_transfer_guard(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+        assert "transfer" not in sanitize_modes()
+
+
+class TestRefcountStamping:
+    @pytest.fixture()
+    def pool(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+        from kubeflow_tpu.serve.paged import PageAllocator
+
+        return PageAllocator(8, 4)
+
+    def test_owner_attribution_and_balance(self, pool):
+        assert pool.refcount_debug
+        # deliberately unrecorded allocs: the leak report is the subject
+        a = pool.alloc(2, owner="req-A")  # lint: disable=R501
+        b = pool.alloc(1, owner="req-B")  # lint: disable=R501
+        rep = pool.leak_report_by_owner()
+        assert rep == {"req-A": 2, "req-B": 1}
+        pool.free(a)
+        assert pool.leak_report_by_owner() == {"req-B": 1}
+        pool.free(b)
+        assert pool.leak_report_by_owner() == {}
+        pool.assert_quiescent()
+        assert pool.stats["stamped_allocs"] == 3
+
+    def test_incref_stacks_stamps(self, pool):
+        pages = pool.alloc(1, owner="first")
+        pool.incref(pages, owner="second")
+        assert pool.leak_report_by_owner() == {"first": 1, "second": 1}
+        pool.free(pages)     # LIFO: pops "second"
+        assert pool.leak_report_by_owner() == {"first": 1}
+        pool.free(pages)
+        pool.assert_quiescent()
+
+    def test_quiescence_failure_names_the_owner(self, pool):
+        pool.alloc(1, owner="req-leaky")
+        with pytest.raises(AssertionError, match="req-leaky"):
+            pool.assert_quiescent()
+
+    def test_site_stamp_when_no_owner(self, pool):
+        pool.alloc(1)
+        (label,) = pool.leak_report_by_owner()
+        assert "test_sanitizers.py" in label
+
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("KFTPU_SANITIZE", raising=False)
+        from kubeflow_tpu.serve.paged import PageAllocator
+
+        pool = PageAllocator(4, 4)
+        pool.free(pool.alloc(2, owner="x"))
+        assert not pool.refcount_debug
+        assert pool._stamps == {}
+        assert pool.stats["stamped_allocs"] == 0
+        pool.assert_quiescent()
+
+
+class TestLockOrderWatchdog:
+    def test_inversion_raises_and_releases(self):
+        wd = install_lockorder_watchdog()
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with pytest.raises(LockOrderError, match="inversion"):
+                with b:
+                    with a:
+                        pass
+            # the failed acquisition must not leave 'a' locked
+            assert a.acquire(timeout=1)
+            a.release()
+            rep = wd.report()
+            assert any(rep.values())
+        finally:
+            uninstall_lockorder_watchdog()
+
+    def test_consistent_order_is_silent(self):
+        install_lockorder_watchdog()
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        finally:
+            uninstall_lockorder_watchdog()
+
+    def test_same_site_instances_are_exempt(self):
+        # ordered traversal over same-class instances (two Routers' _lock
+        # from one creation line) is legitimate, not an inversion
+        install_lockorder_watchdog()
+        try:
+            def mk():
+                return threading.Lock()
+
+            a, b = mk(), mk()
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        finally:
+            uninstall_lockorder_watchdog()
+
+    def test_condition_event_queue_still_work(self):
+        import queue
+
+        install_lockorder_watchdog()
+        try:
+            q = queue.Queue()
+            q.put(1)
+            assert q.get(timeout=1) == 1
+            ev = threading.Event()
+            ev.set()
+            assert ev.wait(0.5)
+            lk = threading.Lock()
+            cv = threading.Condition(lk)
+            hits = []
+
+            def waiter():
+                with cv:
+                    while not hits:
+                        cv.wait(1.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            with cv:
+                hits.append(1)
+                cv.notify_all()
+            t.join(timeout=5)
+            assert not t.is_alive()
+        finally:
+            uninstall_lockorder_watchdog()
+
+    def test_cross_thread_edges_compose(self):
+        # thread 1 records a->b; the MAIN thread closing b->a still fails:
+        # the graph is process-wide, not per-thread
+        install_lockorder_watchdog()
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def t1():
+                with a:
+                    with b:
+                        pass
+
+            t = threading.Thread(target=t1)
+            t.start()
+            t.join(timeout=5)
+            with pytest.raises(LockOrderError):
+                with b:
+                    with a:
+                        pass
+        finally:
+            uninstall_lockorder_watchdog()
+
+    def test_install_is_idempotent_and_uninstall_restores(self):
+        orig = threading.Lock
+        wd1 = install_lockorder_watchdog()
+        try:
+            wd2 = install_lockorder_watchdog()
+            assert wd1 is wd2
+        finally:
+            uninstall_lockorder_watchdog()
+        assert threading.Lock is orig
+        assert sanitize.lockorder_watchdog() is None
+
+
+class TestEngineWiring:
+    def test_transfer_flag_tracks_mode(self, monkeypatch):
+        """engine.sanitize (the transfer guard) engages for transfer-ish
+        values only — refcount/lockorder runs must not change the decode
+        path's transfer semantics."""
+        jax = pytest.importorskip("jax")  # noqa: F841
+        from kubeflow_tpu.core.serving import BatchingSpec
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.serve.engine import LLMEngine
+
+        cfg = preset("tiny")
+
+        def mk():
+            return LLMEngine(
+                cfg, BatchingSpec(max_batch_size=1, max_seq_len=32,
+                                  prefill_buckets=[16]), seed=0)
+
+        monkeypatch.setenv("KFTPU_SANITIZE", "1")
+        assert mk().sanitize is True
+        monkeypatch.setenv("KFTPU_SANITIZE", "transfer,refcount")
+        assert mk().sanitize is True
+        monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+        assert mk().sanitize is False
+        monkeypatch.delenv("KFTPU_SANITIZE")
+        assert mk().sanitize is False

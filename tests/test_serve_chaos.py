@@ -318,6 +318,62 @@ def test_chaos_qos_overload_sheds_batch_first(stack):
     audit_quiescent(a, b)
 
 
+def test_chaos_refcount_sanitizer_kill_mid_traffic(monkeypatch):
+    """ISSUE 7: one chaos scenario end-to-end under
+    ``KFTPU_SANITIZE=refcount`` — SIGKILL analog mid-traffic, then the
+    recovery audit must produce a PER-OWNER zero-leak report: every page
+    reference was stamped with the request that took it, and every stamp
+    was popped by a balancing free. Self-contained stack (the sanitize
+    mode must be on BEFORE the engines build their allocators)."""
+    monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+    cfg = preset("tiny", vocab_size=512)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+
+    def mk(name):
+        eng = LLMEngine(
+            cfg,
+            BatchingSpec(max_batch_size=2, max_seq_len=96,
+                         prefill_buckets=[32], paged=True, page_size=16,
+                         chunked_prefill_tokens=16, decode_steps=4,
+                         pipelined_decode=True),
+            params=params)
+        srv = ModelServer(name, eng, port=0)
+        srv.start()
+        return srv
+
+    a, b = mk("rc-a"), mk("rc-b")
+    assert a.engine._allocator.refcount_debug, \
+        "refcount mode not active at allocator construction"
+    router = Router(queue_timeout=5.0, eject_threshold=2, eject_period=0.4,
+                    max_retries=2, upstream_timeout=30.0)
+    router.set_backends({"latest": [a.url, b.url]})
+    router.start()
+    try:
+        results = fire(router.url, 12, timeout_s=6.0,
+                       mid_fault=lambda: kill_model_server(b),
+                       fault_after=2)
+        assert set(results) <= EXPLICIT_STATUSES
+        assert results.count(200) >= 4, results
+        audit_quiescent(a, b)
+        for srv in (a, b):
+            alloc = srv.engine._allocator
+            # traffic really was stamped, and every stamp was popped:
+            # the per-owner report must be EMPTY, not merely small
+            assert alloc.stats["stamped_allocs"] > 0, \
+                f"{srv.name}: no stamped page traffic recorded"
+            report = alloc.leak_report_by_owner()
+            assert report == {}, \
+                f"{srv.name}: per-owner leaks after recovery: {report}"
+            alloc.assert_quiescent()
+    finally:
+        router.stop()
+        for s in (a, b):
+            try:
+                s.stop()
+            except OSError:
+                pass
+
+
 def test_chaos_zz_replica_kill_mid_traffic(stack):
     """SIGKILL analog mid-traffic (runs last: b never comes back). Requests
     racing the kill resolve explicitly; the router ejects the corpse and

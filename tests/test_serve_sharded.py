@@ -13,6 +13,15 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytest.importorskip(
+    "jax.experimental.pallas",
+    reason="Pallas unavailable: the sharded prefill path's kernels need it")
+from kubeflow_tpu.compat import HAS_SHARD_MAP  # noqa: E402
+
+if not HAS_SHARD_MAP:
+    pytest.skip("this jax has no shard_map (native or experimental)",
+                allow_module_level=True)
+
 from kubeflow_tpu.core.serving import BatchingSpec
 from kubeflow_tpu.models.config import preset
 from kubeflow_tpu.models.decoder import init_decoder_params
@@ -93,11 +102,16 @@ def test_tp2_sampled_matches_single_device(cfg, params):
 
 
 def test_tp2_paged_matches_single_device(cfg, params):
-    want = run_all(mk_engine(cfg, params, paged=True, page_size=16,
-                             chunked_prefill_tokens=16))
-    got = run_all(mk_engine(cfg, params, tp=2, paged=True, page_size=16,
-                            chunked_prefill_tokens=16))
+    dense = mk_engine(cfg, params, paged=True, page_size=16,
+                      chunked_prefill_tokens=16)
+    sharded = mk_engine(cfg, params, tp=2, paged=True, page_size=16,
+                        chunked_prefill_tokens=16)
+    want = run_all(dense)
+    got = run_all(sharded)
     assert got == want
+    for eng in (dense, sharded):
+        assert eng.kv_pages_in_use() == 0
+        eng._allocator.assert_quiescent()
 
 
 def test_tp2_chunked_prefill_matches(cfg, params):
