@@ -27,10 +27,12 @@ __version__ = "0.1.0"
 import os as _os
 
 if _os.environ.get("KFTPU_SANITIZE", "").strip() not in ("", "0"):
-    # Runtime sanitizers (ISSUE 7): the lockorder watchdog must wrap
+    # Runtime sanitizers (ISSUEs 7/8): the lockorder watchdog must wrap
     # threading.Lock/RLock BEFORE any engine/router/controller constructs
-    # its locks, so installation happens at package import. Free when the
-    # env var is unset (the normal case never reaches this import).
+    # its locks, and the recompile watchdog must be listening before the
+    # first jit dispatch, so installation happens at package import. Free
+    # when the env var is unset (the normal case never reaches this
+    # import).
     from kubeflow_tpu.runtime import sanitize as _sanitize
 
     _sanitize.maybe_install()

@@ -5,14 +5,16 @@ call graph + resource-pairing primitives, ``--changed``),
 ``rules_device.py`` for the device-hygiene family (D1xx),
 ``rules_concurrency.py`` for the lock-discipline family (C3xx),
 ``rules_metrics.py`` for the metric-name rules (M2xx),
-``rules_sharding.py`` for the sharding/SPMD family (S4xx), and
+``rules_sharding.py`` for the sharding/SPMD family (S4xx),
 ``rules_resources.py`` for the resource-pairing / lock-order family
-(R5xx). The runtime cross-checks (``KFTPU_SANITIZE=refcount|lockorder``)
-live in ``kubeflow_tpu/runtime/sanitize.py``.
+(R5xx), and ``rules_compile.py`` for the compilation-stability family
+(F6xx, built on the whole-program ``Program`` call graph). The runtime
+cross-checks (``KFTPU_SANITIZE=refcount|lockorder|recompile``) live in
+``kubeflow_tpu/runtime/sanitize.py``.
 """
 
 from kubeflow_tpu.analysis.core import (  # noqa: F401
-    Baseline, Finding, LintResult, Module, Rule, all_rules,
-    canonical_mesh_axes, changed_files, find_baseline, lint_source, main,
-    run_lint,
+    Baseline, Finding, JitFact, LintResult, Module, Program, Rule,
+    all_rules, canonical_mesh_axes, changed_files, find_baseline,
+    jit_table, lint_source, lint_sources, main, run_lint,
 )

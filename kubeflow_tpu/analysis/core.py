@@ -34,6 +34,10 @@ directly above):
 - ``# mesh-context: <reason>`` — on a ``def``: the function runs under a
   mesh / ``shard_map`` context established by a caller this module cannot
   see; collectives with literal axis names inside are bound there (S405).
+- ``# retrace-ok: <reason>`` — on a line inside a function: this jitted
+  call site's dispatch-signature instability is intentional (a cold path
+  where the retrace is cheaper than padding); closes the F6xx
+  compilation-stability rules on that line.
 - ``# lint: disable=D101[,C301...]`` — suppress specific rules on this
   line.
 
@@ -43,6 +47,19 @@ region scanning, donation tracking, lock-held regions, resource pairing —
 see through same-module helper calls without whole-program analysis, plus
 a shared resource-pairing primitive (``leaky_allocs``) for the
 alloc/free-on-exception-path rule family.
+
+Whole-program core (ISSUE 8): one ``Program`` per lint run parses every
+``kubeflow_tpu/*`` module exactly ONCE (a process-level AST cache shares
+parses across rule families, seeded-regression re-lints, and ``--changed``
+subsets that still need package-wide resolution context), resolves
+imports across modules (``from kubeflow_tpu.serve.spec_decode import
+verify_step`` makes the callee's def visible to a rule scanning the
+importer), and propagates jit/donation/static-argnum facts transitively
+through the cross-module call graph with a depth bound
+(``Program.transitive_callees``). The compilation-stability family
+(``rules_compile.py``, F6xx) is built on this: a dispatch-signature fact
+attached to a jitted callable in one module follows it to call sites in
+every other.
 
 Baseline: a checked-in JSON file (default ``.kftpu-lint-baseline.json``,
 discovered upward from the scanned paths) holding fingerprints of known
@@ -62,6 +79,7 @@ import json
 import os
 import re
 import sys
+import time
 import tokenize
 from collections import Counter
 from typing import Iterable, Optional
@@ -102,6 +120,7 @@ _ANNOT_RES = {
     "traced": re.compile(r"#\s*traced\b"),
     "sync_point": re.compile(r"#\s*sync-point:\s*(\S.*)"),
     "mesh_context": re.compile(r"#\s*mesh-context:\s*(\S.*)"),
+    "retrace_ok": re.compile(r"#\s*retrace-ok:\s*(\S.*)"),
 }
 _DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)")
 
@@ -114,9 +133,14 @@ class Module:
         self.relpath = relpath.replace(os.sep, "/")
         self.text = text
         self.tree = ast.parse(text)
-        for node in ast.walk(self.tree):
+        # One walk serves both the parent links and the cached node list
+        # (``Module.walk``): every whole-tree scan a rule family does
+        # afterwards iterates this list instead of re-walking the tree.
+        self._nodes: list[ast.AST] = [self.tree]
+        for node in self._nodes:        # grows while iterating: BFS
             for child in ast.iter_child_nodes(node):
                 child._parent = node  # type: ignore[attr-defined]
+                self._nodes.append(child)
         self.comments: dict[int, str] = {}
         try:
             for tok in tokenize.generate_tokens(io.StringIO(text).readline):
@@ -126,6 +150,31 @@ class Module:
             pass
         self.aliases = self._build_aliases()
         self._callgraph: Optional["CallGraph"] = None
+        # Set by Program when this module is linted in a whole-program
+        # run; None for standalone lint_source fixtures (rules degrade to
+        # module-local analysis).
+        self.program: Optional["Program"] = None
+        self._memo: dict = {}
+
+    def memo(self, key: str, build):
+        """Per-module computed-structure cache (class models, hot-loop
+        lists, jit tables): each is derived from the immutable tree, so
+        rule families share ONE computation per module instead of
+        re-deriving it per rule — the parse-once contract extended to
+        everything parsed FROM the parse."""
+        if key not in self._memo:
+            self._memo[key] = build(self)
+        return self._memo[key]
+
+    def walk(self, *types: type) -> Iterable[ast.AST]:
+        """Whole-tree node iteration off the cached list built at parse
+        (``ast.walk(mod.tree)`` re-walks the tree per call — at ~30
+        whole-tree scans per module across the rule families that was
+        the self-scan's single biggest cost). ``types`` filters by
+        isinstance."""
+        if not types:
+            return iter(self._nodes)
+        return (n for n in self._nodes if isinstance(n, types))
 
     @property
     def callgraph(self) -> "CallGraph":
@@ -137,7 +186,7 @@ class Module:
 
     def _build_aliases(self) -> dict[str, str]:
         aliases: dict[str, str] = {}
-        for node in ast.walk(self.tree):
+        for node in self._nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     aliases[a.asname or a.name.split(".")[0]] = a.name
@@ -330,6 +379,269 @@ class CallGraph:
         return [self._by_id[i] for i in self._callers.get(id(fn), ())]
 
 
+# -- jit facts -----------------------------------------------------------------
+
+
+_JIT_CTOR_QNS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+
+@dataclasses.dataclass
+class JitFact:
+    """What the analyzer knows about one jitted-callable spelling: the
+    constructor call, which positional args are static (hashed, not
+    traced), and which are donated. The single source every dispatch-
+    signature rule (F6xx) and donation rule (D104/S401) reads, so the
+    fact set can't drift between families."""
+
+    name: str                       # call-site spelling ('self._decode_n')
+    ctor: ast.AST                   # the jax.jit(...) call or decorated def
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+    donate_argnames: tuple[str, ...] = ()
+    fn_node: Optional[ast.AST] = None   # the wrapped def, when resolvable
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_argnums or self.donate_argnames)
+
+
+def _int_tuple(node: Optional[ast.AST]) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+def _str_tuple(node: Optional[ast.AST]) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _fact_from_ctor(mod: Module, name: str, call: ast.Call) -> JitFact:
+    fact = JitFact(name=name, ctor=call)
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "donate_argnums"):
+            setattr(fact, kw.arg, _int_tuple(kw.value))
+        elif kw.arg in ("static_argnames", "donate_argnames"):
+            setattr(fact, kw.arg, _str_tuple(kw.value))
+    if call.args and isinstance(call.args[0], ast.Name):
+        cg = mod.callgraph
+        fact.fn_node = cg.module_fns.get(call.args[0].id)
+    return fact
+
+
+def _expr_spelling(node: ast.AST) -> Optional[str]:
+    """Dotted source spelling of a Name/Attribute chain (``self._fn``,
+    ``engine._decode_n``) — the call-site key jit facts are stored under."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return ".".join([node.id] + list(reversed(parts)))
+    return None
+
+
+def jit_table(mod: Module) -> dict[str, JitFact]:
+    """Every jitted-callable spelling this module defines: ``X = jax.jit
+    (...)`` / ``self.X = jax.jit(...)`` assignments anywhere, plus
+    ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs (keyed by
+    the def's name). Cached on the module."""
+    return mod.memo("jit_table", _build_jit_table)
+
+
+def _build_jit_table(mod: Module) -> dict[str, JitFact]:
+    out: dict[str, JitFact] = {}
+    for node in mod.walk():
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.value, ast.Call) \
+                and mod.qualname(node.value.func) in _JIT_CTOR_QNS:
+            name = _expr_spelling(node.targets[0])
+            if name:
+                out[name] = _fact_from_ctor(mod, name, node.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if mod.qualname(dec) in _JIT_CTOR_QNS:
+                    out[node.name] = JitFact(name=node.name, ctor=node,
+                                             fn_node=node)
+                    break
+                if isinstance(dec, ast.Call):
+                    dqn = mod.qualname(dec.func)
+                    if dqn in _JIT_CTOR_QNS or (
+                            dqn in ("functools.partial", "partial")
+                            and dec.args
+                            and mod.qualname(dec.args[0]) in _JIT_CTOR_QNS):
+                        fact = _fact_from_ctor(mod, node.name, dec)
+                        fact.fn_node = node
+                        out[node.name] = fact
+                        break
+    return out
+
+
+# -- whole-program core --------------------------------------------------------
+
+
+def module_dotted_name(relpath: str) -> Optional[str]:
+    """``kubeflow_tpu/serve/engine.py`` → ``kubeflow_tpu.serve.engine``;
+    ``kubeflow_tpu/__init__.py`` → ``kubeflow_tpu``. None for paths
+    outside an importable layout (scripts, bench drivers)."""
+    if not relpath.endswith(".py"):
+        return None
+    parts = relpath[:-3].replace(os.sep, "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts or not all(p.isidentifier() for p in parts):
+        return None
+    return ".".join(parts)
+
+
+# Process-level parse cache: (abspath, mtime_ns, size, relpath) → Module.
+# One lint run parses each file once and every rule family shares the
+# tree; repeated runs in one process (the seeded-regression self-checks,
+# test suites) re-parse only files that actually changed.
+_MODULE_CACHE: dict[str, tuple[int, int, str, Module]] = {}
+
+
+def load_module(path: str, relpath: str) -> Module:
+    apath = os.path.abspath(path)
+    st = os.stat(apath)
+    hit = _MODULE_CACHE.get(apath)
+    if hit is not None and hit[:3] == (st.st_mtime_ns, st.st_size, relpath):
+        return hit[3]
+    with open(apath, encoding="utf-8") as f:
+        text = f.read()
+    mod = Module(relpath, text)
+    _MODULE_CACHE[apath] = (st.st_mtime_ns, st.st_size, relpath, mod)
+    return mod
+
+
+class Program:
+    """Whole-program view over one lint run: every module parsed once,
+    imports resolved across ``kubeflow_tpu/*``, and jit/donation facts
+    followable transitively (depth-bounded) through the cross-module call
+    graph. Rules receive it via ``Module.program`` and must degrade to
+    module-local analysis when it is None (standalone fixtures)."""
+
+    #: Transitive call-following stops here: deep enough to cross a
+    #: dispatch helper chain, shallow enough that one mega-module cannot
+    #: make the analysis quadratic.
+    MAX_DEPTH = 4
+
+    def __init__(self, modules: Iterable[Module]):
+        self.modules: list[Module] = list(modules)
+        self.by_path: dict[str, Module] = {}
+        self.by_name: dict[str, Module] = {}
+        for m in self.modules:
+            self.by_path[m.relpath] = m
+            dotted = module_dotted_name(m.relpath)
+            if dotted is not None:
+                self.by_name[dotted] = m
+            m.program = self
+        self._jit_by_qual: Optional[dict[str, JitFact]] = None
+
+    # -- name resolution ---------------------------------------------------
+
+    def resolve(self, qualname: str
+                ) -> Optional[tuple[Module, ast.AST]]:
+        """(module, def/class node) for a fully-dotted name — longest
+        module prefix wins, then module-level ``def``/``class`` or one
+        ``Class.method`` level."""
+        parts = qualname.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self.by_name.get(".".join(parts[:cut]))
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            cg = mod.callgraph
+            if len(rest) == 1:
+                fn = cg.module_fns.get(rest[0])
+                if fn is not None:
+                    return mod, fn
+                for stmt in mod.tree.body:
+                    if isinstance(stmt, ast.ClassDef) \
+                            and stmt.name == rest[0]:
+                        return mod, stmt
+            elif len(rest) == 2:
+                m = cg.class_methods.get(rest[0], {}).get(rest[1])
+                if m is not None:
+                    return mod, m
+            return None
+        return None
+
+    def resolve_call(self, mod: Module, call: ast.Call, fn: ast.AST
+                     ) -> Optional[tuple[Module, ast.AST]]:
+        """Cross-module call resolution: same-module first (the ISSUE-7
+        callgraph), then the alias-expanded qualname against the program
+        (``verify_step(...)`` under ``from ..spec_decode import
+        verify_step`` lands on the def in spec_decode.py)."""
+        local = mod.callgraph.resolve_call(call, fn)
+        if local is not None:
+            return mod, local
+        qn = mod.qualname(call.func)
+        if qn is None:
+            return None
+        return self.resolve(qn)
+
+    def transitive_callees(self, mod: Module, fn: ast.AST,
+                           depth: int = MAX_DEPTH
+                           ) -> list[tuple[Module, ast.AST]]:
+        """BFS over the cross-module call graph from ``fn``, depth-
+        bounded — the propagation primitive jit-region scanning and the
+        F6xx fact-following use."""
+        out: list[tuple[Module, ast.AST]] = []
+        seen = {id(fn)}
+        frontier: list[tuple[Module, ast.AST]] = [(mod, fn)]
+        for _ in range(max(depth, 0)):
+            nxt: list[tuple[Module, ast.AST]] = []
+            for cmod, cfn in frontier:
+                for node in ast.walk(cfn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    got = self.resolve_call(cmod, node, cfn)
+                    if got is None or id(got[1]) in seen:
+                        continue
+                    seen.add(id(got[1]))
+                    out.append(got)
+                    nxt.append(got)
+            frontier = nxt
+            if not frontier:
+                break
+        return out
+
+    # -- jit facts ---------------------------------------------------------
+
+    def jit_facts(self, mod: Module) -> dict[str, JitFact]:
+        """The jit table visible AT CALL SITES in ``mod``: its own
+        definitions plus imported spellings that resolve to jitted
+        module-level names elsewhere in the program (``from a import G``
+        with ``G = jax.jit(...)`` in a.py makes ``G(...)`` here carry
+        a.py's static/donate facts)."""
+        out = dict(jit_table(mod))
+        if self._jit_by_qual is None:
+            self._jit_by_qual = {}
+            for m in self.modules:
+                dotted = module_dotted_name(m.relpath)
+                if dotted is None:
+                    continue
+                for name, fact in jit_table(m).items():
+                    if "." not in name:      # module-level spellings only
+                        self._jit_by_qual[f"{dotted}.{name}"] = fact
+        for alias, target in mod.aliases.items():
+            fact = self._jit_by_qual.get(target)
+            if fact is not None and alias not in out:
+                out[alias] = fact
+        return out
+
+
 def leaky_allocs(fn: ast.AST, is_alloc, releases_var):
     """Shared resource-pairing dataflow: yield ``(alloc_call, var,
     risky_stmt)`` for every ``var = <alloc>`` whose resource can leak on an
@@ -472,8 +784,8 @@ def _load_rules() -> None:
         return
     _loaded = True
     from kubeflow_tpu.analysis import (  # noqa: F401  (registration import)
-        rules_concurrency, rules_device, rules_metrics, rules_resources,
-        rules_sharding,
+        rules_compile, rules_concurrency, rules_device, rules_metrics,
+        rules_resources, rules_sharding,
     )
 
 
@@ -500,13 +812,19 @@ class Baseline:
     def from_findings(cls, findings: Iterable[Finding],
                       reason: str = "baselined pre-existing debt"
                       ) -> "Baseline":
-        return cls([{"fingerprint": f.fingerprint, "reason": reason}
-                    for f in findings])
+        # Sorted at construction AND at save: --update-baseline output is
+        # a pure function of the finding SET, so rewriting the baseline
+        # from a differently-ordered scan produces a byte-identical file
+        # and baseline diffs stay reviewable.
+        return cls(sorted(({"fingerprint": f.fingerprint, "reason": reason}
+                           for f in findings),
+                          key=lambda e: e["fingerprint"]))
 
     def save(self, path: str) -> None:
         doc = {"version": 1,
                "entries": sorted(self.entries,
-                                 key=lambda e: e["fingerprint"])}
+                                 key=lambda e: (e["fingerprint"],
+                                                e.get("reason", "")))}
         with open(path, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)
             f.write("\n")
@@ -554,6 +872,7 @@ class LintResult:
     baselined: list[Finding]
     errors: list[Finding]
     files_scanned: int
+    wall_time_s: float = 0.0
 
     @property
     def ok(self) -> bool:
@@ -592,6 +911,20 @@ def lint_source(text: str, relpath: str = "<memory>.py",
     return lint_module(Module(relpath, text), rules=rules)
 
 
+def lint_sources(sources: dict[str, str],
+                 lint: Optional[list[str]] = None,
+                 rules: Optional[list[Rule]] = None) -> list[Finding]:
+    """Multi-module fixture entry point: parse every source under its
+    relpath, wire them into one Program (cross-module resolution works),
+    and lint ``lint`` (default: all of them)."""
+    mods = {rel: Module(rel, text) for rel, text in sources.items()}
+    Program(mods.values())
+    findings: list[Finding] = []
+    for rel in (lint if lint is not None else sorted(mods)):
+        findings.extend(lint_module(mods[rel], rules=rules))
+    return findings
+
+
 class _ParseError(Rule):
     id = "E000"
     name = "parse-error"
@@ -600,34 +933,58 @@ class _ParseError(Rule):
 _PARSE_ERROR = _ParseError()
 
 
+def _package_context(root: str) -> list[str]:
+    """Files the whole-program resolver should see even when only a
+    subset is being linted (the ``--changed`` pre-commit path): the main
+    package under ``root``."""
+    pkg = os.path.join(root, "kubeflow_tpu")
+    return iter_py_files([pkg]) if os.path.isdir(pkg) else []
+
+
 def run_lint(paths: list[str], baseline: Optional[Baseline] = None,
              root: Optional[str] = None) -> LintResult:
     """Lint every .py under ``paths``. Finding paths are relative to
-    ``root`` (default: cwd), matching how the baseline was recorded."""
+    ``root`` (default: cwd), matching how the baseline was recorded.
+
+    All modules — the linted set plus the package-wide resolution
+    context — are parsed once into one ``Program`` shared by every rule
+    family; ``wall_time_s`` on the result covers parse + all rules."""
+    t0 = time.perf_counter()
     root = os.path.abspath(root or os.getcwd())
     findings: list[Finding] = []
     errors: list[Finding] = []
     files = iter_py_files(paths)
+    mods: list[Module] = []
     for path in files:
         rel = os.path.relpath(os.path.abspath(path), root)
         try:
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            mod = Module(rel, text)
-        except (SyntaxError, ValueError, UnicodeDecodeError) as exc:
+            mods.append(load_module(path, rel))
+        except (OSError, SyntaxError, ValueError, UnicodeDecodeError) as exc:
             errors.append(Finding(
                 rule="E000", name="parse-error",
                 path=rel.replace(os.sep, "/"),
                 line=getattr(exc, "lineno", 0) or 0, col=1,
                 message=f"cannot parse: {exc}"))
+    lint_paths = {m.relpath for m in mods}
+    context = list(mods)
+    for path in _package_context(root):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        if rel in lint_paths:
             continue
+        try:
+            context.append(load_module(path, rel))
+        except (OSError, SyntaxError, ValueError, UnicodeDecodeError):
+            continue    # context only — its own lint run reports it
+    Program(context)
+    for mod in mods:
         findings.extend(lint_module(mod))
     if baseline is not None:
         new, matched = baseline.split(findings)
     else:
         new, matched = findings, []
     return LintResult(new=new, baselined=matched, errors=errors,
-                      files_scanned=len(files))
+                      files_scanned=len(files),
+                      wall_time_s=time.perf_counter() - t0)
 
 
 # -- CLI -----------------------------------------------------------------------
@@ -664,9 +1021,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 def changed_files(base: str = "HEAD",
                   root: Optional[str] = None) -> list[str]:
-    """Repo-relative .py files changed vs ``base`` (plus untracked ones),
-    restricted to files that still exist. Raises RuntimeError outside a
-    git checkout (the caller turns that into a CLI error)."""
+    """Paths of .py files changed vs ``base`` (plus untracked ones).
+
+    Parses ``git diff --name-status`` rather than ``--name-only`` so
+    deleted files (status ``D``) and the OLD half of a rename (``Rxxx``)
+    are skipped by STATUS, not by racing the filesystem — a removed .py
+    must never reach the file walker (it would error the pre-commit
+    path). Git emits paths relative to the repo toplevel, so they are
+    resolved there and returned relative to ``root`` (default cwd).
+    Raises RuntimeError outside a git checkout (the caller turns that
+    into a CLI error)."""
     import subprocess
 
     root = os.path.abspath(root or os.getcwd())
@@ -679,11 +1043,34 @@ def changed_files(base: str = "HEAD",
                 f"git {' '.join(args)} failed: {proc.stderr.strip()}")
         return [p for p in proc.stdout.split("\0") if p]
 
-    files = set(git("diff", "--name-only", "-z", base, "--"))
-    files |= set(git("ls-files", "-o", "--exclude-standard", "-z"))
-    return sorted(
-        f for f in files
-        if f.endswith(".py") and os.path.isfile(os.path.join(root, f)))
+    toplevel = git("rev-parse", "--show-toplevel")[0].strip()
+    files: set[str] = set()
+    fields = git("diff", "--name-status", "-z", base, "--")
+    i = 0
+    while i < len(fields):
+        status = fields[i]
+        if status.startswith(("R", "C")):
+            # Rxxx/Cxxx carry two paths: the old name (gone for R) and
+            # the new one — only the new name is lintable.
+            if i + 2 < len(fields):
+                files.add(fields[i + 2])
+            i += 3
+        else:
+            if not status.startswith("D"):      # deleted: nothing to lint
+                files.add(fields[i + 1])
+            i += 2
+    files |= set(git("ls-files", "-o", "--exclude-standard",
+                     "--full-name", "-z"))
+    out = []
+    for f in sorted(files):
+        if not f.endswith(".py"):
+            continue
+        abspath = os.path.join(toplevel, f)
+        # Belt and braces: a path added in the diff but removed from the
+        # working tree since (or a directory shadowing it) is skipped.
+        if os.path.isfile(abspath):
+            out.append(os.path.relpath(abspath, root))
+    return out
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -726,6 +1113,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.as_json:
         print(json.dumps({
             "files_scanned": result.files_scanned,
+            "wall_time_s": round(result.wall_time_s, 4),
             "findings": [f.to_json() for f in result.new],
             "baselined": [f.to_json() for f in result.baselined],
             "errors": [f.to_json() for f in result.errors],
@@ -739,7 +1127,8 @@ def main(argv: Optional[list[str]] = None) -> int:
                 print(f"{f.render()}  (baselined)")
         tail = (f"{result.files_scanned} files, "
                 f"{len(result.new)} finding(s), "
-                f"{len(result.baselined)} baselined")
+                f"{len(result.baselined)} baselined, "
+                f"{result.wall_time_s:.2f}s")
         if baseline is not None and baseline.path:
             tail += f" ({os.path.basename(baseline.path)})"
         print(tail)

@@ -1,0 +1,602 @@
+"""Family F — compilation-stability rules (ISSUE 8 tentpole).
+
+The jit cache is keyed by the full dispatch signature: argument shapes,
+dtypes, weak-type flags, static-arg hashes, and pytree structure. A
+change in ANY of them silently recompiles — minutes per retrace at
+supercluster scale (ROADMAP open item 4), and a recompile storm in the
+decode hot loop erases the PR-4 host-overhead win. These rules encode
+the dispatch contracts the engine already follows (pow2-padded tables,
+``jnp.asarray(..., dtype=)`` at upload sites, jit ctors built once with
+explicit ``static_argnums``) and fail anything that drifts from them:
+
+- F601 ``unstable-trace-shape``: a jitted callable dispatched with an
+  array whose shape derives from ``len()``/``qsize()`` (list growth,
+  non-padded batch state) rather than a padded/bucketed size — every
+  distinct length is a fresh trace.
+- F602 ``weak-type-leak``: a Python scalar (literal, ``float()``/
+  ``int()`` result, ``.item()`` fetch) riding into a NON-static arg of a
+  jitted call without an explicit dtype — weak-typed avals are their own
+  cache entries, doubling the trace set per scalar source.
+- F603 ``dtype-promotion-drift``: call sites of the same jitted callable
+  pin DIFFERENT explicit dtypes onto the same argument position (f32 at
+  one site, bf16 at another) — each promoted signature compiles
+  separately, and the numerics silently differ between them.
+- F604 ``static-arg-instability``: a ``static_argnums`` position fed a
+  value rebuilt per call with unstable hash/identity — a tuple literal
+  holding runtime values, a fresh ``lambda``, a ``functools.partial`` —
+  forcing a retrace (or an unbounded cache) per dispatch.
+- F605 ``pytree-structure-instability``: the dict/state-dict argument of
+  a jitted callable changes STRUCTURE between dispatches — different
+  literal key sets across call sites, or keys inserted conditionally
+  before the dispatch — a new pytree treedef is a new compile.
+
+Escapes: ``# retrace-ok: <reason>`` on the call-site line marks an
+intentional cold-path instability; ``# lint: disable=F60x`` suppresses a
+single rule. With a whole-program ``Program`` attached (core.py), jit
+facts imported from other ``kubeflow_tpu/*`` modules carry their
+static/donate argument specs to call sites here; standalone fixtures
+degrade to module-local facts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from kubeflow_tpu.analysis.core import (
+    Finding, JitFact, Module, Rule, jit_table, register,
+)
+
+_LEN_QNS = {"len"}
+_LEN_METHODS = {"qsize"}
+_SHAPE_CTORS = {
+    "numpy.zeros", "numpy.ones", "numpy.full", "numpy.empty",
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+    "jax.numpy.empty",
+}
+_ASARRAY_QNS = {"jax.numpy.asarray", "jax.numpy.array"}
+_DTYPE_CTOR_SUFFIXES = {
+    "float32", "float64", "bfloat16", "float16", "int32", "int64",
+    "int16", "int8", "uint8", "uint32", "bool_",
+}
+#: Size-stabilizing spellings: a value produced by one of these is a
+#: padded/bucketed size even when its input was len-derived (the
+#: engine's pow2 pad loops assign through these helpers or compare
+#: against the tainted var without ever being assigned FROM it).
+_STABILIZER_MARKERS = ("pad", "bucket", "pow2", "align")
+
+
+def _facts_for(mod: Module) -> dict[str, JitFact]:
+    if mod.program is not None:
+        return mod.program.jit_facts(mod)
+    return jit_table(mod)
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return ".".join([node.id] + list(reversed(parts)))
+    return None
+
+
+def _functions(mod: Module) -> Iterable[ast.AST]:
+    for node in mod.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _jit_calls(mod: Module, fn: ast.AST,
+               facts: dict[str, JitFact]
+               ) -> Iterable[tuple[ast.Call, JitFact]]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            key = _expr_key(node.func)
+            if key in facts:
+                yield node, facts[key]
+
+
+def _retrace_ok(mod: Module, line: int) -> bool:
+    return (mod.line_annotation(line, "retrace_ok") is not None
+            or mod.line_annotation(line - 1, "retrace_ok") is not None)
+
+
+def _static_positions(fact: JitFact) -> frozenset:
+    return frozenset(fact.static_argnums)
+
+
+def _mentions(node: ast.AST, names: set[str]) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return sub.id
+    return None
+
+
+def _is_stabilizer_call(call: ast.Call) -> bool:
+    key = (_expr_key(call.func) or "").lower()
+    return any(m in key for m in _STABILIZER_MARKERS)
+
+
+# -- F601 ----------------------------------------------------------------------
+
+
+def _len_taint(mod: Module, fn: ast.AST) -> tuple[set[str], set[str]]:
+    """(tainted scalar names, unstable-shaped array names) for one
+    function: vars holding ``len()``-class sizes, and arrays whose shape
+    was built from them. Two passes so one-hop chains propagate."""
+    tainted: set[str] = set()
+    unstable: set[str] = set()
+    assigns = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)
+               and len(n.targets) == 1
+               and isinstance(n.targets[0], ast.Name)]
+    for _ in range(2):
+        for node in assigns:
+            name = node.targets[0].id
+            val = node.value
+            if isinstance(val, ast.Call):
+                qn = mod.qualname(val.func)
+                is_len = qn in _LEN_QNS or (
+                    isinstance(val.func, ast.Attribute)
+                    and val.func.attr in _LEN_METHODS)
+                if is_len:
+                    tainted.add(name)
+                    continue
+                if _is_stabilizer_call(val):
+                    tainted.discard(name)
+                    continue
+                if qn in _SHAPE_CTORS:
+                    shape_args = list(val.args[:1]) + [
+                        kw.value for kw in val.keywords
+                        if kw.arg in ("shape", "size")]
+                    if any(_mentions(a, tainted) for a in shape_args):
+                        unstable.add(name)
+                    continue
+                # jnp.asarray(unstable) and friends keep the shape
+                if any(_mentions(a, unstable) for a in val.args):
+                    unstable.add(name)
+                continue
+            if _mentions(val, tainted):
+                tainted.add(name)
+            elif _mentions(val, unstable):
+                unstable.add(name)
+    return tainted, unstable
+
+
+def _slice_taint(node: ast.AST, tainted: set[str]) -> Optional[str]:
+    """A subscript slice whose bound mentions a tainted size
+    (``arr[:n]``) produces an unstable-shaped view."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript) and isinstance(sub.slice,
+                                                         ast.Slice):
+            for bound in (sub.slice.lower, sub.slice.upper):
+                if bound is not None:
+                    hit = _mentions(bound, tainted)
+                    if hit:
+                        return hit
+    return None
+
+
+@register
+class UnstableTraceShape(Rule):
+    id = "F601"
+    name = "unstable-trace-shape"
+    doc = ("jitted callable dispatched with an array whose shape derives "
+           "from len()/list growth instead of a padded/bucketed size — "
+           "every distinct length is a fresh trace")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        facts = _facts_for(mod)
+        if not facts:
+            return
+        for fn in _functions(mod):
+            tainted, unstable = _len_taint(mod, fn)
+            if not tainted and not unstable:
+                continue
+            for call, fact in _jit_calls(mod, fn, facts):
+                if _retrace_ok(mod, call.lineno):
+                    continue
+                static = _static_positions(fact)
+                for i, arg in enumerate(call.args):
+                    if i in static:
+                        continue
+                    hit = _mentions(arg, unstable)
+                    what = hit and (f"array '{hit}', whose shape was "
+                                    "built from a len-like size")
+                    if hit is None:
+                        hit = _slice_taint(arg, tainted)
+                        what = hit and (f"a slice bounded by len-like "
+                                        f"size '{hit}'")
+                    if hit is None and isinstance(arg, ast.Call):
+                        qn = mod.qualname(arg.func)
+                        if qn in _SHAPE_CTORS:
+                            hit = _mentions(arg, tainted)
+                            what = hit and (f"an array shaped inline by "
+                                            f"len-like size '{hit}'")
+                    if hit is None:
+                        continue
+                    yield mod.finding(
+                        self, call,
+                        f"'{fact.name}' is dispatched with {what}; every "
+                        "distinct length is a fresh trace — pad to a "
+                        "pow2/bucketed width so the trace set stays "
+                        "log-bounded")
+                    break
+
+
+# -- F602 ----------------------------------------------------------------------
+
+
+def _scalar_taint(mod: Module, fn: ast.AST) -> set[str]:
+    """Names holding Python scalars: numeric literals, ``float()``/
+    ``int()`` results, ``.item()`` fetches, arithmetic over those."""
+    tainted: set[str] = set()
+    assigns = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)
+               and len(n.targets) == 1
+               and isinstance(n.targets[0], ast.Name)]
+    for _ in range(2):
+        for node in assigns:
+            name = node.targets[0].id
+            val = node.value
+            if _is_py_scalar(mod, val, tainted):
+                tainted.add(name)
+            elif isinstance(val, ast.Name) or isinstance(val, ast.Call):
+                tainted.discard(name)
+    return tainted
+
+
+def _is_py_scalar(mod: Module, node: ast.AST, tainted: set[str]) -> bool:
+    if isinstance(node, ast.Constant):
+        # bools are int subclasses but carry a 2-entry cache at most and
+        # are usually intentional mode flags — not worth the noise.
+        return isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool)
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.UnaryOp):
+        return _is_py_scalar(mod, node.operand, tainted)
+    if isinstance(node, ast.BinOp):
+        return _is_py_scalar(mod, node.left, tainted) \
+            and _is_py_scalar(mod, node.right, tainted)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int"):
+            return True
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            return True
+    return False
+
+
+def _has_explicit_dtype(call: ast.Call) -> bool:
+    return len(call.args) >= 2 or any(kw.arg == "dtype"
+                                      for kw in call.keywords)
+
+
+def _is_dtype_ctor(mod: Module, call: ast.Call) -> bool:
+    qn = mod.qualname(call.func) or ""
+    return qn.rsplit(".", 1)[-1] in _DTYPE_CTOR_SUFFIXES
+
+
+@register
+class WeakTypeLeak(Rule):
+    id = "F602"
+    name = "weak-type-leak"
+    doc = ("Python scalar flowing into a non-static arg of a jitted call "
+           "without an explicit dtype — each distinct weak type is a "
+           "separate compile-cache entry")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        facts = _facts_for(mod)
+        if not facts:
+            return
+        for fn in _functions(mod):
+            tainted = _scalar_taint(mod, fn)
+            for call, fact in _jit_calls(mod, fn, facts):
+                if _retrace_ok(mod, call.lineno):
+                    continue
+                static = _static_positions(fact)
+                for i, arg in enumerate(call.args):
+                    if i in static:
+                        continue
+                    leak = self._weak_leak(mod, arg, tainted)
+                    if leak is None:
+                        continue
+                    yield mod.finding(
+                        self, call,
+                        f"{leak} rides into jitted '{fact.name}' "
+                        f"(arg {i}) as a weak-typed scalar; wrap it "
+                        "jnp.asarray(..., dtype=...) so the dispatch "
+                        "signature is one cache entry, not one per "
+                        "Python type")
+                for kw in call.keywords:
+                    if kw.arg in fact.static_argnames or kw.arg is None:
+                        continue
+                    leak = self._weak_leak(mod, kw.value, tainted)
+                    if leak is None:
+                        continue
+                    yield mod.finding(
+                        self, call,
+                        f"{leak} rides into jitted '{fact.name}' "
+                        f"(kwarg '{kw.arg}') as a weak-typed scalar; "
+                        "wrap it jnp.asarray(..., dtype=...)")
+
+    def _weak_leak(self, mod: Module, arg: ast.AST,
+                   tainted: set[str]) -> Optional[str]:
+        """Human-readable description of the weak-typed payload, or None
+        when the arg is dtype-stable."""
+        if isinstance(arg, ast.Call):
+            if _is_dtype_ctor(mod, arg):
+                return None
+            qn = mod.qualname(arg.func)
+            if qn in _ASARRAY_QNS:
+                if _has_explicit_dtype(arg):
+                    return None
+                if arg.args and _is_py_scalar(mod, arg.args[0], tainted):
+                    return ("a Python scalar through dtype-less "
+                            "jnp.asarray")
+                return None
+        if _is_py_scalar(mod, arg, tainted):
+            if isinstance(arg, ast.Constant):
+                return f"literal {arg.value!r}"
+            if isinstance(arg, ast.Name):
+                return f"host scalar '{arg.id}'"
+            return "a host-computed Python scalar"
+        return None
+
+
+# -- F603 ----------------------------------------------------------------------
+
+
+def _dtype_token(mod: Module, arg: ast.AST) -> Optional[str]:
+    """The explicit dtype a call site pins onto an argument, as a short
+    token ('float32'), or None when no explicit dtype is visible."""
+    if not isinstance(arg, ast.Call):
+        return None
+    if _is_dtype_ctor(mod, arg):
+        return (mod.qualname(arg.func) or "").rsplit(".", 1)[-1]
+    qn = mod.qualname(arg.func)
+    dnode: Optional[ast.AST] = None
+    if qn in _ASARRAY_QNS or (qn or "").endswith(("asarray", "array")):
+        if len(arg.args) >= 2:
+            dnode = arg.args[1]
+        for kw in arg.keywords:
+            if kw.arg == "dtype":
+                dnode = kw.value
+    elif isinstance(arg.func, ast.Attribute) and arg.func.attr == "astype" \
+            and arg.args:
+        dnode = arg.args[0]
+    if dnode is None:
+        return None
+    if isinstance(dnode, ast.Constant) and isinstance(dnode.value, str):
+        return dnode.value
+    key = _expr_key(dnode)
+    if key:
+        suffix = key.rsplit(".", 1)[-1]
+        if suffix in _DTYPE_CTOR_SUFFIXES or suffix.startswith(
+                ("float", "int", "uint", "bfloat", "bool")):
+            return suffix
+    return None
+
+
+@register
+class DtypePromotionDrift(Rule):
+    id = "F603"
+    name = "dtype-promotion-drift"
+    doc = ("call sites of one jitted callable pin different explicit "
+           "dtypes onto the same argument position — each promoted "
+           "signature is its own compile-cache entry")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        facts = _facts_for(mod)
+        if not facts:
+            return
+        # (callable name, arg position) -> {dtype token: first call}
+        seen: dict[tuple[str, int], dict[str, ast.Call]] = {}
+        for fn in _functions(mod):
+            for call, fact in _jit_calls(mod, fn, facts):
+                static = _static_positions(fact)
+                for i, arg in enumerate(call.args):
+                    if i in static:
+                        continue
+                    tok = _dtype_token(mod, arg)
+                    if tok is None:
+                        continue
+                    slot = seen.setdefault((fact.name, i), {})
+                    if tok not in slot:
+                        slot[tok] = call
+                    if len(slot) >= 2 and not _retrace_ok(mod,
+                                                          call.lineno):
+                        others = sorted(t for t in slot if t != tok)
+                        yield mod.finding(
+                            self, call,
+                            f"arg {i} of jitted '{fact.name}' is "
+                            f"'{tok}' here but {', '.join(others)!s} at "
+                            "another call site; the promoted dtype "
+                            "differs per site, so each dispatches a "
+                            "separate compiled program")
+
+
+# -- F604 ----------------------------------------------------------------------
+
+
+@register
+class StaticArgInstability(Rule):
+    id = "F604"
+    name = "static-arg-instability"
+    doc = ("a static_argnums position fed a value rebuilt per call "
+           "(tuple of runtime values, fresh lambda, functools.partial) — "
+           "a retrace per dispatch")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        facts = _facts_for(mod)
+        if not facts:
+            return
+        for fn in _functions(mod):
+            for call, fact in _jit_calls(mod, fn, facts):
+                if _retrace_ok(mod, call.lineno):
+                    continue
+                spots = [(f"arg {i}", call.args[i])
+                         for i in fact.static_argnums
+                         if i < len(call.args)]
+                spots += [(f"kwarg '{kw.arg}'", kw.value)
+                          for kw in call.keywords
+                          if kw.arg in fact.static_argnames]
+                for label, arg in spots:
+                    why = self._unstable(arg)
+                    if why is None:
+                        continue
+                    yield mod.finding(
+                        self, call,
+                        f"static {label} of jitted '{fact.name}' is "
+                        f"{why}; the jit cache hashes static args, so a "
+                        "per-call value means a retrace per dispatch — "
+                        "hoist it or make it a traced arg")
+
+    def _unstable(self, arg: ast.AST) -> Optional[str]:
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            if any(not isinstance(e, ast.Constant) for e in arg.elts):
+                return "a tuple rebuilt from runtime values every call"
+            return None
+        if isinstance(arg, ast.Lambda):
+            return "a fresh lambda (hashed by identity) every call"
+        if isinstance(arg, ast.Call):
+            qn = _expr_key(arg.func) or ""
+            if qn in ("functools.partial", "partial"):
+                return "a fresh functools.partial (hashed by identity)"
+        return None
+
+
+# -- F605 ----------------------------------------------------------------------
+
+
+def _literal_keys(node: ast.AST) -> Optional[frozenset]:
+    """Key set of a dict literal with all-constant-string keys; None for
+    anything with ``**`` spreads or computed keys (opaque — the engine's
+    ``{**st, "tokens": ...}`` rebuilds are structure-preserving by
+    construction)."""
+    if not isinstance(node, ast.Dict):
+        return None
+    keys: set[str] = set()
+    for k in node.keys:
+        if k is None:       # ** spread
+            return None
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        keys.add(k.value)
+    return frozenset(keys)
+
+
+@register
+class PytreeStructureInstability(Rule):
+    id = "F605"
+    name = "pytree-structure-instability"
+    doc = ("the dict argument of a jitted callable changes structure "
+           "between dispatches (different key sets across call sites, "
+           "or keys inserted conditionally) — a new treedef is a new "
+           "compile")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        facts = _facts_for(mod)
+        if not facts:
+            return
+        # Part (a): literal key sets per (callable, position) across the
+        # module's call sites.
+        seen: dict[tuple[str, int], dict[frozenset, ast.Call]] = {}
+        for fn in _functions(mod):
+            for call, fact in _jit_calls(mod, fn, facts):
+                static = _static_positions(fact)
+                for i, arg in enumerate(call.args):
+                    if i in static:
+                        continue
+                    keys = _literal_keys(arg)
+                    if keys is None:
+                        continue
+                    slot = seen.setdefault((fact.name, i), {})
+                    if keys not in slot:
+                        slot[keys] = call
+                    if len(slot) >= 2 and not _retrace_ok(mod,
+                                                          call.lineno):
+                        other = next(k for k in slot if k != keys)
+                        diff = sorted(keys ^ other)
+                        yield mod.finding(
+                            self, call,
+                            f"dict arg {i} of jitted '{fact.name}' has "
+                            f"keys {sorted(keys)} here but a different "
+                            f"set at another call site (diff: {diff}); "
+                            "pytree structure is part of the dispatch "
+                            "signature — keep one treedef")
+            yield from self._conditional_inserts(mod, fn, facts)
+
+    def _conditional_inserts(self, mod: Module, fn: ast.AST,
+                             facts: dict[str, JitFact]
+                             ) -> Iterable[Finding]:
+        """Part (b): ``d = {...}`` then ``d["k"] = ...`` under an ``if``
+        (a key present only on some paths) before ``d`` rides into a
+        jitted dispatch."""
+        literals: dict[str, frozenset] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                keys = _literal_keys(node.value)
+                if keys is not None:
+                    literals[node.targets[0].id] = keys
+        if not literals:
+            return
+        unstable: dict[str, ast.AST] = {}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)):
+                continue
+            sub = node.targets[0]
+            if not isinstance(sub.value, ast.Name) \
+                    or sub.value.id not in literals:
+                continue
+            key = sub.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+                    and key.value in literals[sub.value.id]:
+                continue        # value update, structure unchanged
+            cond = self._conditional_ancestor(node, fn)
+            if cond is not None:
+                unstable.setdefault(sub.value.id, cond)
+        if not unstable:
+            return
+        for call, fact in _jit_calls(mod, fn, facts):
+            if _retrace_ok(mod, call.lineno):
+                continue
+            for i, arg in enumerate(call.args):
+                if i in _static_positions(fact):
+                    continue
+                if isinstance(arg, ast.Name) and arg.id in unstable:
+                    cond = unstable[arg.id]
+                    if self._contains(cond, call):
+                        continue    # same branch: structure fixed there
+                    yield mod.finding(
+                        self, call,
+                        f"dict '{arg.id}' gains a key only on some "
+                        f"paths (conditional insert at line "
+                        f"{cond.lineno}) before dispatching jitted "
+                        f"'{fact.name}'; the treedef flips between "
+                        "dispatches — build both structures as one "
+                        "literal")
+
+    @staticmethod
+    def _conditional_ancestor(node: ast.AST, fn: ast.AST
+                              ) -> Optional[ast.AST]:
+        cur = getattr(node, "_parent", None)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.If, ast.While, ast.For)):
+                return cur
+            cur = getattr(cur, "_parent", None)
+        return None
+
+    @staticmethod
+    def _contains(ancestor: ast.AST, node: ast.AST) -> bool:
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur is ancestor:
+                return True
+            cur = getattr(cur, "_parent", None)
+        return False

@@ -332,8 +332,12 @@ class _ClassModel:
 
 
 def class_models(mod: Module) -> list[_ClassModel]:
-    return [_ClassModel(mod, node) for node in ast.walk(mod.tree)
-            if isinstance(node, ast.ClassDef)]
+    # Building a _ClassModel walks every method several times; three rule
+    # families consult it, so it rides the per-module memo (ISSUE 8's
+    # parse-once contract) instead of being rebuilt per rule.
+    return mod.memo("class_models", lambda m: [
+        _ClassModel(m, node) for node in m.walk()
+        if isinstance(node, ast.ClassDef)])
 
 
 @register
@@ -524,7 +528,7 @@ class SwallowedException(Rule):
     _BROAD = {"Exception", "BaseException"}
 
     def check(self, mod: Module) -> Iterable[Finding]:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not self._is_broad(mod, node):

@@ -30,7 +30,9 @@ from __future__ import annotations
 import ast
 from typing import Iterable, Optional
 
-from kubeflow_tpu.analysis.core import Finding, Module, Rule, register
+from kubeflow_tpu.analysis.core import (
+    Finding, Module, Rule, jit_table, register,
+)
 
 _JIT = {"jax.jit"}
 _UPLOAD = {"jax.numpy.asarray", "jax.numpy.array", "jax.device_put"}
@@ -75,7 +77,7 @@ def jit_regions(mod: Module) -> list[ast.AST]:
     decorated defs, ``jax.jit(fn_or_lambda, ...)`` wrappings, and defs
     annotated ``# traced`` (jit-wrapped from another module)."""
     regions: list[ast.AST] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if mod.annotation(node, "traced") is not None:
                 regions.append(node)
@@ -112,9 +114,10 @@ def _params_of(fn: ast.AST) -> set[str]:
 
 
 def hot_loop_functions(mod: Module) -> list[ast.FunctionDef]:
-    return [node for node in ast.walk(mod.tree)
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and mod.annotation(node, "hot_loop") is not None]
+    return mod.memo("hot_loop_functions", lambda m: [
+        node for node in m.walk()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and m.annotation(node, "hot_loop") is not None])
 
 
 def _walk_own(fn: ast.AST) -> Iterable[ast.AST]:
@@ -142,23 +145,35 @@ def _self_attr(node: ast.AST) -> Optional[str]:
 
 
 def _followed_helpers(mod: Module, regions: list[ast.AST]) -> list[ast.AST]:
-    """One-level call-following (ISSUE 7): same-module helpers called from
-    jit regions whose EVERY resolvable caller is itself a region — their
-    bodies execute traced, so host syncs inside are the same defect.
-    Helpers also reachable from host-side code are skipped (they may be
-    the designed host path)."""
+    """Call-following into helpers whose bodies execute traced.
+
+    Same-module (ISSUE 7): a helper called from jit regions whose EVERY
+    resolvable caller is itself traced counts as a region — host syncs
+    inside are the same defect. Helpers also reachable from host-side
+    code are skipped (they may be the designed host path). With a whole-
+    program ``Program`` attached (ISSUE 8) the following is TRANSITIVE
+    with the program's depth bound, so a jit fact propagates through a
+    helper chain instead of stopping one call deep."""
     region_ids = {id(r) for r in regions}
     cg = mod.callgraph
     out: list[ast.AST] = []
-    seen: set[int] = set()
-    for region in regions:
-        for callee in cg.callees(region):
-            if id(callee) in region_ids or id(callee) in seen:
-                continue
-            callers = cg.callers_of(callee)
-            if callers and all(id(c) in region_ids for c in callers):
-                seen.add(id(callee))
-                out.append(callee)
+    traced = set(region_ids)
+    frontier = list(regions)
+    depth = 1 if mod.program is None else mod.program.MAX_DEPTH
+    for _ in range(depth):
+        nxt: list[ast.AST] = []
+        for region in frontier:
+            for callee in cg.callees(region):
+                if id(callee) in traced:
+                    continue
+                callers = cg.callers_of(callee)
+                if callers and all(id(c) in traced for c in callers):
+                    traced.add(id(callee))
+                    out.append(callee)
+                    nxt.append(callee)
+        frontier = nxt
+        if not frontier:
+            break
     return out
 
 
@@ -272,34 +287,12 @@ class FullBufferReupload(Rule):
 
 def _donating_callables(mod: Module) -> dict[str, tuple[int, ...]]:
     """Map of callee spellings ('self._decode_n' / 'decode_n') to donated
-    positional indices, from ``X = jax.jit(..., donate_argnums=...)``
-    assignments anywhere in the module."""
-    out: dict[str, tuple[int, ...]] = {}
-    for node in ast.walk(mod.tree):
-        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
-            continue
-        call = node.value
-        if not _is_jit_call(mod, call):
-            continue
-        donated: tuple[int, ...] = ()
-        for kw in call.keywords:
-            if kw.arg != "donate_argnums":
-                continue
-            if isinstance(kw.value, ast.Constant) \
-                    and isinstance(kw.value.value, int):
-                donated = (kw.value.value,)
-            elif isinstance(kw.value, (ast.Tuple, ast.List)):
-                donated = tuple(e.value for e in kw.value.elts
-                                if isinstance(e, ast.Constant)
-                                and isinstance(e.value, int))
-        if not donated:
-            continue
-        target = node.targets[0]
-        name = _self_attr(target) if isinstance(target, ast.Attribute) \
-            else (target.id if isinstance(target, ast.Name) else None)
-        if name:
-            out[name] = donated
-    return out
+    positional indices — read from the shared jit-fact table
+    (``core.jit_table``), the same source the F6xx dispatch-signature
+    rules use, so donation facts can't drift between families."""
+    return {name: fact.donate_argnums
+            for name, fact in jit_table(mod).items()
+            if fact.donate_argnums}
 
 
 def _expr_key(node: ast.AST) -> Optional[str]:
@@ -335,7 +328,7 @@ class DonatedBufferReuse(Rule):
         donors = _donating_callables(mod)
         if not donors:
             return
-        for fn in ast.walk(mod.tree):
+        for fn in mod.walk():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             yield from self._check_body(mod, fn)
@@ -471,7 +464,7 @@ class JitInLoop(Rule):
 
     def check(self, mod: Module) -> Iterable[Finding]:
         hot = {id(fn) for fn in hot_loop_functions(mod)}
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not _is_jit_call(mod, node):
                 continue
             cur = getattr(node, "_parent", None)

@@ -94,7 +94,7 @@ def _literal_names(node: ast.AST) -> list[tuple[str, bool]]:
 
 
 def _definition_sites(mod: Module) -> Iterable[tuple[ast.Call, str, bool]]:
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call) or not node.args:
             continue
         is_site = False
@@ -146,7 +146,7 @@ class BadSeriesLabel(Rule):
 
     def check(self, mod: Module) -> Iterable[Finding]:
         handles = self._metric_handles(mod)
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.Call) \
                     or not isinstance(node.func, ast.Attribute) \
                     or node.func.attr not in _LABELED_METHODS:
@@ -184,7 +184,7 @@ class BadSeriesLabel(Rule):
         """Local names bound from ``reg.counter(...)``-style calls —
         the codebase's labeled-series definition idiom."""
         out: set[str] = set()
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if isinstance(node, ast.Assign) \
                     and isinstance(node.value, ast.Call) \
                     and isinstance(node.value.func, ast.Attribute) \

@@ -94,7 +94,7 @@ class LeakedAlloc(Rule):
            "exception path leaks the pages")
 
     def check(self, mod: Module) -> Iterable[Finding]:
-        for fn in ast.walk(mod.tree):
+        for fn in mod.walk():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             for alloc, var, risky in leaky_allocs(
@@ -150,7 +150,7 @@ class UnauditedPagedTest(Rule):
                     return True
             return False
 
-        for fn in ast.walk(mod.tree):
+        for fn in mod.walk():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if not fn.name.startswith("test_"):

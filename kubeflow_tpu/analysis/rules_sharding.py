@@ -39,14 +39,9 @@ import ast
 from typing import Iterable, Optional
 
 from kubeflow_tpu.analysis.core import (
-    Finding, Module, Rule, canonical_mesh_axes, register,
+    Finding, Module, Rule, canonical_mesh_axes, jit_table, register,
 )
 
-_JIT_QNS = {
-    "jax.jit",
-    "jax.experimental.pjit.pjit",
-    "jax.pjit",
-}
 _SPEC_QNS = {
     "jax.sharding.PartitionSpec",
     "jax.sharding.NamedSharding",     # axis literals ride in its spec arg
@@ -65,11 +60,6 @@ _SHARD_MAP_QNS = {
 }
 
 
-def _is_jit_ctor(mod: Module, node: ast.AST) -> bool:
-    return (isinstance(node, ast.Call)
-            and mod.qualname(node.func) in _JIT_QNS)
-
-
 def _expr_key(node: ast.AST) -> Optional[str]:
     parts: list[str] = []
     while isinstance(node, ast.Attribute):
@@ -80,22 +70,14 @@ def _expr_key(node: ast.AST) -> Optional[str]:
     return None
 
 
-def _jit_assignments(mod: Module) -> dict[str, tuple[ast.Call, bool]]:
-    """``X = jax.jit(...)`` / ``pjit(...)`` assignments anywhere in the
-    module: callable spelling -> (ctor call, has donate_argnums)."""
-    out: dict[str, tuple[ast.Call, bool]] = {}
-    for node in ast.walk(mod.tree):
-        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
-            continue
-        if not _is_jit_ctor(mod, node.value):
-            continue
-        name = _expr_key(node.targets[0])
-        if not name:
-            continue
-        donated = any(kw.arg in ("donate_argnums", "donate_argnames")
-                      for kw in node.value.keywords)
-        out[name] = (node.value, donated)
-    return out
+def _jit_assignments(mod: Module) -> dict[str, tuple[ast.AST, bool]]:
+    """Jitted-callable spellings with their donation flag, read from the
+    shared jit-fact table (``core.jit_table``) — assignments and
+    ``@partial(jax.jit, ...)`` decorations alike; bare-decorated defs
+    are excluded (their ctor carries no argument spec to inspect)."""
+    return {name: (fact.ctor, fact.donates)
+            for name, fact in jit_table(mod).items()
+            if isinstance(fact.ctor, ast.Call)}
 
 
 @register
@@ -112,7 +94,7 @@ class UndonatedCarry(Rule):
         if not undonated:
             return
         reported: set[str] = set()
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.Assign):
                 continue
             call = node.value
@@ -170,7 +152,7 @@ class UnknownMeshAxis(Rule):
 
     def check(self, mod: Module) -> Iterable[Finding]:
         axes = set(canonical_mesh_axes())
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.Call):
                 continue
             qn = mod.qualname(node.func)
@@ -265,7 +247,7 @@ class HostRoundTrip(Rule):
         jitted = set(_jit_assignments(mod))
         if not jitted:
             return
-        for fn in ast.walk(mod.tree):
+        for fn in mod.walk():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             visitor = _TaintVisitor(mod, jitted)
@@ -296,7 +278,7 @@ class ImplicitReplication(Rule):
                       or "Mesh(" in text)
         if not mesh_aware:
             return
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.Call):
                 continue
             if mod.qualname(node.func) != "jax.device_put":
@@ -331,7 +313,7 @@ class UnboundCollective(Rule):
         # functions handed to shard_map (by name) are bound; so is
         # anything THEY call (one level), and jit-wrapped/# traced defs
         # (pjit axes bind via the mesh context manager at dispatch).
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.Call):
                 continue
             if mod.qualname(node.func) in _SHARD_MAP_QNS and node.args:
@@ -348,7 +330,7 @@ class UnboundCollective(Rule):
                     bound.add(id(fn))
                     for callee in cg.callees(fn):
                         bound.add(id(callee))
-        for fn in ast.walk(mod.tree):
+        for fn in mod.walk():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if id(fn) in bound:

@@ -8,11 +8,34 @@ code reads like current JAX everywhere else:
   ``check_rep`` keyword translated, else ``None`` (callers and tests gate
   on ``HAS_SHARD_MAP`` — a missing shard_map must degrade to a clean
   skip, not a collection-time ImportError).
+
+The legacy adapter is a standalone factory (``wrap_legacy_shard_map``)
+so its keyword translation is directly unit-testable
+(tests/test_compat.py) regardless of which jax this environment ships —
+the import-time branch below merely selects which implementation feeds
+it.
 """
 
 from __future__ import annotations
 
 import functools
+
+
+def wrap_legacy_shard_map(impl):
+    """Adapt ``jax.experimental.shard_map.shard_map`` to the new-style
+    calling convention: ``check_vma`` becomes ``check_rep``, and calling
+    with only keywords returns a partial (decorator usage)."""
+
+    def shard_map(f=None, /, **kw):
+        """``jax.experimental.shard_map`` with new-style keywords."""
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        if f is None:
+            return functools.partial(impl, **kw)
+        return impl(f, **kw)
+
+    return shard_map
+
 
 try:
     from jax import shard_map  # type: ignore[attr-defined]
@@ -24,14 +47,7 @@ except ImportError:  # pragma: no cover - depends on installed jax
     try:
         from jax.experimental.shard_map import shard_map as _shard_map_exp
 
-        def shard_map(f=None, /, **kw):
-            """``jax.experimental.shard_map`` with new-style keywords."""
-            if "check_vma" in kw:
-                kw["check_rep"] = kw.pop("check_vma")
-            if f is None:
-                return functools.partial(_shard_map_exp, **kw)
-            return _shard_map_exp(f, **kw)
-
+        shard_map = wrap_legacy_shard_map(_shard_map_exp)
         HAS_SHARD_MAP = True
     except ImportError:
         shard_map = None

@@ -15,22 +15,38 @@
   CREATION SITE, and raises ``LockOrderError`` the moment an acquisition
   closes a cycle — the dynamic half of R503. Installed automatically at
   ``import kubeflow_tpu`` when the mode is on.
+- ``recompile``: a compilation watchdog (``install_recompile_watchdog``)
+  hooks JAX's compilation-cache-miss logging (the ``Compiling <fn>``
+  records ``jax._src.interpreters.pxla`` emits once per actual compile)
+  and attributes EVERY retrace to the first non-library stack frame —
+  the call site that dispatched it. After ``mark_compile_warm()`` any
+  further compile is a steady-state recompile: ``recompile_report()``
+  is the audit payload (the ``leak_report_by_owner()`` of the compile
+  cache) and ``assert_no_steady_recompiles()`` raises
+  ``RecompileError`` naming each offending site. The dynamic half of
+  the F6xx compilation-stability rules.
 - ``all``: everything above.
 
-This module is stdlib-only (no jax): the watchdog must be installable
-before any engine/router constructs its locks, including under a bare
-``import kubeflow_tpu``.
+This module is stdlib-only (no jax): the watchdogs must be installable
+before any engine/router constructs its locks — or jax even imports —
+including under a bare ``import kubeflow_tpu``. The recompile hook works
+without touching jax because jax logs every compile at DEBUG even when
+``jax_log_compiles`` is off; raising the LOGGER's level to DEBUG and
+attaching a recording handler is enough, and the records never reach a
+console handler (root stays at WARNING).
 """
 
 from __future__ import annotations
 
 import _thread
+import logging
 import os
 import sys
 import threading
 from typing import Optional
 
-_KNOWN_MODES = frozenset({"transfer", "refcount", "lockorder"})
+_KNOWN_MODES = frozenset({"transfer", "refcount", "lockorder",
+                          "recompile"})
 
 
 def sanitize_modes() -> frozenset:
@@ -245,8 +261,193 @@ def lockorder_watchdog() -> Optional[_LockOrderWatchdog]:
     return _watchdog
 
 
+# -- recompile watchdog --------------------------------------------------------
+
+
+class RecompileError(AssertionError):
+    """A jit compile happened after ``mark_compile_warm()`` — the steady
+    state recompiled. The message attributes every retrace to its
+    dispatch call site."""
+
+
+#: Loggers that announce one record per ACTUAL compile (cache miss).
+#: ``pxla`` covers jit/pjit ("Compiling <fn> with global shapes...") and
+#: pmap ("Compiling <fn> (<id>) for <n> devices..."); both spellings
+#: start with "Compiling ".
+_COMPILE_LOGGERS = ("jax._src.interpreters.pxla",)
+_COMPILE_PREFIX = "Compiling "
+
+
+def _app_call_site() -> str:
+    """``file:line`` of the nearest stack frame outside installed
+    libraries, the logging machinery, and this module — the application
+    code whose dispatch triggered the compile."""
+    frame = sys._getframe(1)
+    for _ in range(128):
+        if frame is None:
+            break
+        fname = frame.f_code.co_filename
+        base = os.path.basename(os.path.dirname(fname))
+        if "site-packages" not in fname and "dist-packages" not in fname \
+                and base != "logging" and fname != __file__ \
+                and not fname.startswith("<frozen"):
+            return f"{os.path.basename(fname)}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+class _RecompileWatchdog(logging.Handler):
+    """Counts and attributes every jit compile in the process.
+
+    Compiles before ``mark_warm()`` are the expected warmup set; each is
+    still attributed (the report shows where every trace came from).
+    Compiles after are steady-state recompiles — the exact defect class
+    the F6xx rules model statically — and fail
+    ``assert_no_steady_recompiles()`` with the full attribution."""
+
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self._meta = _thread.allocate_lock()
+        self._warm = False
+        # phase -> {(fn, site): count}; insertion order = compile order
+        self.compiles: dict[str, dict] = {"warmup": {}, "steady": {}}
+
+    # -- logging.Handler ---------------------------------------------------
+
+    def emit(self, record: logging.LogRecord) -> None:
+        # Installation raises the hooked logger to DEBUG and cuts its
+        # propagation (jax parks a stderr StreamHandler on the "jax"
+        # logger that would otherwise splat every DEBUG compile record
+        # to the console). Anything a user would normally see — WARNING
+        # and up — is forwarded to the parent chain by hand.
+        if record.levelno >= logging.WARNING:
+            logging.getLogger("jax").handle(record)
+        try:
+            msg = record.getMessage()
+        except (TypeError, ValueError):
+            # A malformed record (bad %-args) must never break jax's
+            # dispatch path; it also can't be a compile announcement.
+            return
+        if not msg.startswith(_COMPILE_PREFIX):
+            return
+        fn = str(record.args[0]) if record.args else \
+            msg[len(_COMPILE_PREFIX):].split(" ", 1)[0]
+        site = _app_call_site()
+        with self._meta:
+            phase = "steady" if self._warm else "warmup"
+            key = (fn, site)
+            self.compiles[phase][key] = \
+                self.compiles[phase].get(key, 0) + 1
+
+    # -- audit surface -----------------------------------------------------
+
+    def mark_warm(self) -> None:
+        """Everything the workload needed is compiled; from here on any
+        compile is a steady-state recompile."""
+        with self._meta:
+            self._warm = True
+
+    def reset(self, warm: bool = False) -> None:
+        with self._meta:
+            self._warm = warm
+            self.compiles = {"warmup": {}, "steady": {}}
+
+    def steady_count(self) -> int:
+        with self._meta:
+            return sum(self.compiles["steady"].values())
+
+    def report(self) -> dict:
+        """``{"warm": bool, "warmup": [...], "steady": [...],
+        "steady_count": int}`` with one ``{fn, site, count}`` entry per
+        distinct (compiled function, dispatch site) pair, in first-
+        compile order — who traced, from where, how often."""
+        with self._meta:
+            out = {"warm": self._warm,
+                   "steady_count": sum(self.compiles["steady"].values())}
+            for phase in ("warmup", "steady"):
+                out[phase] = [
+                    {"fn": fn, "site": site, "count": count}
+                    for (fn, site), count in self.compiles[phase].items()]
+            return out
+
+    def assert_no_steady_recompiles(self) -> None:
+        rep = self.report()
+        if rep["steady_count"]:
+            lines = [f"  {e['fn']} x{e['count']} dispatched at "
+                     f"{e['site']}" for e in rep["steady"]]
+            raise RecompileError(
+                f"{rep['steady_count']} steady-state recompile(s) after "
+                "mark_compile_warm() — the dispatch signature drifted "
+                "(shape/dtype/weak-type/static-arg/pytree; the static "
+                "F6xx rules model exactly this):\n" + "\n".join(lines))
+
+
+_recompile_wd: Optional[_RecompileWatchdog] = None
+_logger_prior: dict[str, tuple[int, bool]] = {}
+
+
+def install_recompile_watchdog() -> _RecompileWatchdog:
+    """Attach the compile recorder to jax's compile-announcing loggers.
+    Idempotent; works before jax is imported (loggers are created on
+    demand by name) and never flips ``jax_log_compiles`` — the records
+    exist at DEBUG regardless, they just need a handler that listens."""
+    global _recompile_wd
+    if _recompile_wd is not None:
+        return _recompile_wd
+    wd = _RecompileWatchdog()
+    for name in _COMPILE_LOGGERS:
+        lg = logging.getLogger(name)
+        _logger_prior[name] = (lg.level, lg.propagate)
+        lg.setLevel(logging.DEBUG)
+        lg.propagate = False        # see _RecompileWatchdog.emit
+        lg.addHandler(wd)
+    _recompile_wd = wd
+    return wd
+
+
+def uninstall_recompile_watchdog() -> None:
+    global _recompile_wd
+    if _recompile_wd is None:
+        return
+    for name in _COMPILE_LOGGERS:
+        lg = logging.getLogger(name)
+        lg.removeHandler(_recompile_wd)
+        level, prop = _logger_prior.pop(name, (logging.NOTSET, True))
+        lg.setLevel(level)
+        lg.propagate = prop
+    _recompile_wd = None
+
+
+def recompile_watchdog() -> Optional[_RecompileWatchdog]:
+    return _recompile_wd
+
+
+def mark_compile_warm() -> None:
+    """Module-level convenience mirroring the watchdog method: call at
+    the end of warmup; a no-op when the mode is off."""
+    if _recompile_wd is not None:
+        _recompile_wd.mark_warm()
+
+
+def recompile_report() -> dict:
+    """The audit payload, shaped like ``leak_report_by_owner()``: empty
+    dict when the watchdog is not installed."""
+    if _recompile_wd is None:
+        return {}
+    return _recompile_wd.report()
+
+
+def assert_no_steady_recompiles() -> None:
+    if _recompile_wd is not None:
+        _recompile_wd.assert_no_steady_recompiles()
+
+
 def maybe_install() -> None:
-    """Called from ``kubeflow_tpu/__init__`` so ``KFTPU_SANITIZE=lockorder``
-    covers every lock the platform creates, whatever the entry point."""
-    if "lockorder" in sanitize_modes():
+    """Called from ``kubeflow_tpu/__init__`` so ``KFTPU_SANITIZE=
+    lockorder`` / ``=recompile`` cover every lock the platform creates
+    and every compile it dispatches, whatever the entry point."""
+    modes = sanitize_modes()
+    if "lockorder" in modes:
         install_lockorder_watchdog()
+    if "recompile" in modes:
+        install_recompile_watchdog()

@@ -2224,7 +2224,22 @@ class LLMEngine:
         """Stop the background scheduler. Returns (and records in
         ``stopped_clean``) whether the thread actually exited: a join
         timeout is NOT success — the leaked thread still owns the device
-        buffers, so callers must not silently treat the engine as freed."""
+        buffers, so callers must not silently treat the engine as freed.
+
+        Under ``KFTPU_SANITIZE=recompile`` any steady-state recompiles
+        recorded during this engine's lifetime are logged with their
+        dispatch-site attribution — the decode hot loop is supposed to
+        hold a FIXED trace set once warm (the F6xx contract), and a
+        recompile storm here erases the pipelined-dispatch win."""
+        from kubeflow_tpu.runtime.sanitize import recompile_report
+
+        rep = recompile_report()
+        if rep.get("steady_count"):
+            logger.error(
+                "recompile sanitizer: %d steady-state recompile(s): %s",
+                rep["steady_count"],
+                "; ".join(f"{e['fn']} x{e['count']} at {e['site']}"
+                          for e in rep["steady"]))
         self._stop.set()
         self._wake.set()
         self.stopped_clean = True

@@ -20,6 +20,7 @@ import numpy as np
 
 from kubeflow_tpu.models.config import DecoderConfig, preset
 from kubeflow_tpu.obs.trace import get_tracer
+from kubeflow_tpu.runtime.sanitize import mark_compile_warm, recompile_report
 from kubeflow_tpu.train.checkpoint import CheckpointManager
 from kubeflow_tpu.train.data import DataConfig, make_data_source
 from kubeflow_tpu.train.metrics import MetricsEmitter, Throughput
@@ -190,6 +191,13 @@ class Trainer:
                     tracing = False
             batch = self.make_global_batch(self.data.batch_at(step))
             self.task.state, metrics = self.task.step_fn(self.task.state, batch)
+            if step == start:
+                # Training shapes are fixed: everything compiles on the
+                # first executed step, so under KFTPU_SANITIZE=recompile
+                # any later compile is a dispatch-signature defect — the
+                # runtime half of the F6xx rules. No-op when the
+                # sanitizer is off.
+                mark_compile_warm()
             if (step + 1) % self.cfg.log_every == 0 or step + 1 == self.cfg.steps:
                 metrics = {k: float(jax.device_get(v)) for k, v in metrics.items()}
                 metrics.update(self.throughput.tick(step + 1 - last_tick_step))
@@ -232,6 +240,16 @@ class Trainer:
             self.ckpt.wait()
             self.ckpt.close()
         self.emitter.close()
+        rep = recompile_report()
+        if rep.get("steady_count"):
+            # At 6k-chip scale each of these cost minutes of cluster time
+            # per occurrence; name the dispatch sites so the fix is a
+            # grep, not a bisect.
+            logger.error(
+                "recompile sanitizer: %d steady-state recompile(s) after "
+                "the first step: %s", rep["steady_count"],
+                "; ".join(f"{e['fn']} x{e['count']} at {e['site']}"
+                          for e in rep["steady"]))
         return last_metrics
 
     def _trace_dir(self) -> str:

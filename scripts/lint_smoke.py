@@ -7,13 +7,18 @@ justification, new findings block. Also self-checks the analyzer the way
 the acceptance criteria demand: each rule family must still catch its
 seeded regression — the PR-4 per-round ``jnp.asarray(self._table)``
 upload (D103), a dropped router lock acquisition (C301), a de-donated
-decode carry (S401), an exception-path page leak (R501), and an inverted
-router lock pair (R503) — so a rule that silently stops firing fails the
-gate too, not just the test suite.
+decode carry (S401), an exception-path page leak (R501), an inverted
+router lock pair (R503), a weak-type scalar riding into the dense decode
+dispatch (F602), and a fresh tuple in its static num_steps position
+(F604) — so a rule that silently stops firing fails the gate too, not
+just the test suite.
 
 Prints one JSON object; ``"lint_smoke": "ok"`` is the pass marker
 smoke.sh greps for. Findings render as ``file:line:col`` so they are
-clickable in CI logs.
+clickable in CI logs; ``wall_time_s`` tracks the whole-program scan's
+cost (ISSUE 8: parse-once + shared per-module structures made the
+self-scan faster despite the added F-family and cross-module
+resolution).
 """
 
 import json
@@ -105,6 +110,26 @@ def _seeded_regressions() -> list[str]:
           "                pass\n\n"
           "    def note_activity(self) -> None:\n")],
         "R503", "lock-order inversion")
+    # Family F: a weak-typed Python scalar in the dense decode dispatch
+    # (a fresh compile-cache entry per scalar source) — the cycle
+    # KFTPU_SANITIZE=recompile would catch at runtime.
+    _DECODE_CALL = (
+        "            out, self.cache, st = self._decode_n(\n"
+        "                self.params, self.cache, self._dstate.arrays,"
+        " key, k_steps,\n"
+        "                mode)")
+    new_findings(
+        "kubeflow_tpu/serve/engine.py",
+        (_DECODE_CALL,
+         _DECODE_CALL.replace(" key, k_steps,", " 0.5, k_steps,")),
+        "F602", "self._decode_n")
+    # Family F: a per-call tuple in the dispatch's STATIC num_steps
+    # position — hashed by value each call, a retrace per dispatch.
+    new_findings(
+        "kubeflow_tpu/serve/engine.py",
+        (_DECODE_CALL,
+         _DECODE_CALL.replace(" key, k_steps,", " key, (k_steps,),")),
+        "F604", "self._decode_n")
     return fails
 
 
@@ -118,6 +143,7 @@ def main() -> int:
     print(json.dumps({
         "lint_smoke": "ok" if ok else "FAIL",
         "files_scanned": result.files_scanned,
+        "wall_time_s": round(result.wall_time_s, 3),
         "findings": [f.render() for f in result.errors + result.new],
         "baselined": len(result.baselined),
         "baseline": (os.path.relpath(baseline_path, REPO)

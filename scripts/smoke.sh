@@ -11,10 +11,13 @@ cd "$(dirname "$0")/.."
 
 echo "== kftpu lint (static analysis vs committed baseline) =="
 # Cheapest gate first: device-hygiene + lock-discipline + sharding/SPMD +
-# resource-pairing + metric-name rules over the whole tree; any finding
-# not in .kftpu-lint-baseline.json fails, and each rule family must still
-# catch its seeded regression (D103 re-upload, C301 dropped lock, S401
-# de-donated carry, R501 exception-path page leak, R503 lock inversion).
+# resource-pairing + metric-name + compilation-stability rules over the
+# whole tree (whole-program call graph, ASTs parsed once per run); any
+# finding not in .kftpu-lint-baseline.json fails, and each rule family
+# must still catch its seeded regression (D103 re-upload, C301 dropped
+# lock, S401 de-donated carry, R501 exception-path page leak, R503 lock
+# inversion, F602 weak-type scalar into the decode dispatch, F604 fresh
+# tuple in its static position).
 timeout -k 10 120 python scripts/lint_smoke.py | tee /tmp/_smoke_lint.json
 lint_rc=${PIPESTATUS[0]}
 grep -q '"lint_smoke": "ok"' /tmp/_smoke_lint.json || lint_rc=1
@@ -71,6 +74,17 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 hotloop_rc=${PIPESTATUS[0]}
 grep -q '"hotloop_smoke": "ok"' /tmp/_smoke_hotloop.json || hotloop_rc=1
 
+echo "== recompile smoke (zero steady-state retraces, warmed paged engine) =="
+# Compilation-stability gate (KFTPU_SANITIZE=recompile): warm a paged
+# engine, mark the compile cache warm, replay the same traffic shape —
+# the steady state must compile NOTHING, every warmup trace must carry a
+# named call-site attribution, and greedy outputs must be identical
+# across the phases (the watchdog observes, never perturbs).
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/recompile_smoke.py | tee /tmp/_smoke_recompile.json
+recompile_rc=${PIPESTATUS[0]}
+grep -q '"recompile_smoke": "ok"' /tmp/_smoke_recompile.json || recompile_rc=1
+
 echo "== autoscale smoke (QoS shed ordering + SLO autoscaler loop, CPU) =="
 # Closed-loop gate for the SLO-aware serving loop: a 2-class burst must
 # shed batch-first (interactive all-200), the signal-driven autoscaler
@@ -83,5 +97,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 autoscale_rc=${PIPESTATUS[0]}
 grep -q '"autoscale_smoke": "ok"' /tmp/_smoke_autoscale.json || autoscale_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc autoscale rc=$autoscale_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ]
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc autoscale rc=$autoscale_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ]

@@ -1065,7 +1065,8 @@ class TestRegistry:
         assert {"D101", "D102", "D103", "D104", "D105",
                 "C301", "C302", "C303", "M201", "M202", "M203",
                 "S401", "S402", "S403", "S404", "S405",
-                "R501", "R502", "R503"} <= ids
+                "R501", "R502", "R503",
+                "F601", "F602", "F603", "F604", "F605"} <= ids
 
     def test_parse_error_is_reported_not_raised(self, tmp_path):
         bad = tmp_path / "bad.py"
@@ -1073,6 +1074,419 @@ class TestRegistry:
         result = run_lint([str(bad)], root=str(tmp_path))
         assert not result.ok
         assert [e.rule for e in result.errors] == ["E000"]
+
+
+# -- Family F: compilation stability (ISSUE 8) ---------------------------------
+
+
+_F_PRELUDE = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "import numpy as np\n"
+    "def _impl(x, y=None):\n"
+    "    return x\n"
+)
+
+
+class TestUnstableTraceShape:
+    def test_len_derived_shape_into_dispatch(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, reqs):\n"
+            "        n = len(reqs)\n"
+            "        toks = np.zeros((n, 8), np.int32)\n"
+            "        return self._fn(jnp.asarray(toks))\n")
+        assert rules_of(src) == ["F601"]
+
+    def test_pow2_padded_width_is_clean(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, reqs):\n"
+            "        n = len(reqs)\n"
+            "        width = 1\n"
+            "        while width < n:\n"
+            "            width *= 2\n"
+            "        toks = np.zeros((width, 8), np.int32)\n"
+            "        return self._fn(jnp.asarray(toks))\n")
+        assert rules_of(src) == []
+
+    def test_bucket_helper_stabilizes(self):
+        src = _F_PRELUDE + (
+            "def _bucket_for(n):\n"
+            "    return 64\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, reqs):\n"
+            "        n = _bucket_for(len(reqs))\n"
+            "        return self._fn(np.zeros((n, 8), np.int32))\n")
+        assert rules_of(src) == []
+
+    def test_tainted_slice_bound(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, buf, reqs):\n"
+            "        n = len(reqs)\n"
+            "        return self._fn(buf[:n])\n")
+        assert rules_of(src) == ["F601"]
+
+    def test_retrace_ok_annotation_closes(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, reqs):\n"
+            "        n = len(reqs)\n"
+            "        # retrace-ok: cold admin path, one call per restart\n"
+            "        return self._fn(np.zeros((n,), np.int32))\n")
+        assert rules_of(src) == []
+
+    def test_lint_disable_suppresses(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, reqs):\n"
+            "        n = len(reqs)\n"
+            "        return self._fn(np.zeros((n,), np.int32))  "
+            "# lint: disable=F601\n")
+        assert rules_of(src) == []
+
+
+class TestWeakTypeLeak:
+    def test_scalar_literal_into_traced_arg(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x):\n"
+            "        return self._fn(x, 0.5)\n")
+        assert rules_of(src) == ["F602"]
+
+    def test_float_result_var_and_dtype_less_asarray(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x, raw):\n"
+            "        t = float(raw)\n"
+            "        return self._fn(x, jnp.asarray(t))\n")
+        assert rules_of(src) == ["F602"]
+
+    def test_explicit_dtype_is_clean(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x, raw):\n"
+            "        t = float(raw)\n"
+            "        a = self._fn(x, jnp.float32(0.5))\n"
+            "        b = self._fn(x, jnp.asarray(t, jnp.float32))\n"
+            "        return a, b\n")
+        assert rules_of(src) == []
+
+    def test_static_argnum_position_is_exempt(self):
+        # the engine's `self._decode_n(..., k_steps, mode)` idiom: ints
+        # in static positions are hashed, not traced — no weak type
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl, static_argnums=(1,))\n"
+            "    def run(self, x):\n"
+            "        return self._fn(x, 16)\n")
+        assert rules_of(src) == []
+
+    def test_static_argname_kwarg_is_exempt(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl, static_argnames=('y',))\n"
+            "    def run(self, x):\n"
+            "        return self._fn(x, y=16)\n")
+        assert rules_of(src) == []
+
+    def test_suppression(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x):\n"
+            "        return self._fn(x, 0.5)  # lint: disable=F602\n")
+        assert rules_of(src) == []
+
+
+class TestDtypePromotionDrift:
+    def test_sites_disagree_on_dtype(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def a(self, x):\n"
+            "        return self._fn(jnp.asarray(x, jnp.float32))\n"
+            "    def b(self, x):\n"
+            "        return self._fn(jnp.asarray(x, jnp.bfloat16))\n")
+        found = rules_of(src)
+        assert found == ["F603"]
+
+    def test_consistent_dtype_is_clean(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def a(self, x):\n"
+            "        return self._fn(jnp.asarray(x, jnp.float32))\n"
+            "    def b(self, x):\n"
+            "        return self._fn(x.astype(jnp.float32))\n")
+        assert rules_of(src) == []
+
+    def test_suppression(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def a(self, x):\n"
+            "        return self._fn(jnp.asarray(x, jnp.float32))\n"
+            "    def b(self, x):\n"
+            "        return self._fn(jnp.asarray(x, jnp.bfloat16))  "
+            "# lint: disable=F603\n")
+        assert rules_of(src) == []
+
+
+class TestStaticArgInstability:
+    def test_fresh_tuple_of_runtime_values(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl, static_argnums=(1,))\n"
+            "    def run(self, x, n):\n"
+            "        return self._fn(x, (n, 1))\n")
+        assert rules_of(src) == ["F604"]
+
+    def test_constant_tuple_is_clean(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl, static_argnums=(1,))\n"
+            "    def run(self, x):\n"
+            "        return self._fn(x, (4, 5))\n")
+        assert rules_of(src) == []
+
+    def test_fresh_lambda_and_partial(self):
+        src = _F_PRELUDE + (
+            "import functools\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl, static_argnums=(1,))\n"
+            "    def a(self, x):\n"
+            "        return self._fn(x, lambda v: v)\n"
+            "    def b(self, x, g):\n"
+            "        return self._fn(x, functools.partial(g, 1))\n")
+        assert rules_of(src) == ["F604", "F604"]
+
+    def test_non_static_tuple_is_fine(self):
+        # a tuple in a TRACED position is just a pytree of leaves
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x, a, b):\n"
+            "        return self._fn((a, b))\n")
+        assert rules_of(src) == []
+
+    def test_retrace_ok_escape(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl, static_argnums=(1,))\n"
+            "    def run(self, x, n):\n"
+            "        # retrace-ok: shapes enumerate a tiny fixed set\n"
+            "        return self._fn(x, (n, 1))\n")
+        assert rules_of(src) == []
+
+    def test_lint_disable_suppresses(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl, static_argnums=(1,))\n"
+            "    def run(self, x, n):\n"
+            "        return self._fn(x, (n, 1))  # lint: disable=F604\n")
+        assert rules_of(src) == []
+
+
+class TestPytreeStructureInstability:
+    def test_call_sites_disagree_on_keys(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def a(self, x):\n"
+            "        return self._fn({'a': x, 'b': x})\n"
+            "    def b(self, x):\n"
+            "        return self._fn({'a': x})\n")
+        assert rules_of(src) == ["F605"]
+
+    def test_conditional_key_insert_before_dispatch(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x, flag):\n"
+            "        d = {'a': x}\n"
+            "        if flag:\n"
+            "            d['c'] = x\n"
+            "        return self._fn(d)\n")
+        assert rules_of(src) == ["F605"]
+
+    def test_same_keys_and_unconditional_insert_are_clean(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def a(self, x):\n"
+            "        return self._fn({'a': x, 'b': x})\n"
+            "    def b(self, x):\n"
+            "        d = {'a': x}\n"
+            "        d['b'] = x\n"
+            "        return self._fn(d)\n")
+        assert rules_of(src) == []
+
+    def test_value_update_is_clean(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x, flag):\n"
+            "        d = {'a': x}\n"
+            "        if flag:\n"
+            "            d['a'] = x + 1\n"
+            "        return self._fn(d)\n")
+        assert rules_of(src) == []
+
+    def test_insert_in_same_branch_as_dispatch_is_clean(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x, flag):\n"
+            "        d = {'a': x}\n"
+            "        if flag:\n"
+            "            d['c'] = x\n"
+            "            return self._fn(d)\n"
+            "        return x\n")
+        assert rules_of(src) == []
+
+    def test_spread_rebuild_is_opaque(self):
+        # the engine's `{**st, 'tokens': t}` rebuild preserves structure
+        # by construction and must not be compared against literals
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def a(self, st, t):\n"
+            "        return self._fn({**st, 'tokens': t})\n"
+            "    def b(self, x):\n"
+            "        return self._fn({'a': x})\n")
+        assert rules_of(src) == []
+
+    def test_suppression(self):
+        src = _F_PRELUDE + (
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._fn = jax.jit(_impl)\n"
+            "    def run(self, x, flag):\n"
+            "        d = {'a': x}\n"
+            "        if flag:\n"
+            "            d['c'] = x\n"
+            "        return self._fn(d)  # lint: disable=F605\n")
+        assert rules_of(src) == []
+
+
+# -- whole-program core (ISSUE 8 tentpole) -------------------------------------
+
+
+class TestProgram:
+    A = (
+        "import jax\n"
+        "def g(x, k):\n"
+        "    return x\n"
+        "G = jax.jit(g, static_argnums=(1,))\n")
+
+    def test_imported_jit_fact_carries_static_argnums(self):
+        """A jitted callable defined in one module keeps its static-arg
+        spec at call sites in another: the importing module's bare int in
+        the static position is NOT a weak-type leak, and a fresh tuple
+        there IS an F604."""
+        b_ok = (
+            "from kubeflow_tpu.a import G\n"
+            "def run(x):\n"
+            "    return G(x, 16)\n")
+        b_bad = (
+            "from kubeflow_tpu.a import G\n"
+            "def run(x, n):\n"
+            "    return G(x, (n, 1))\n")
+        from kubeflow_tpu.analysis import lint_sources
+        assert lint_sources({"kubeflow_tpu/a.py": self.A,
+                             "kubeflow_tpu/b.py": b_ok},
+                            lint=["kubeflow_tpu/b.py"]) == []
+        found = lint_sources({"kubeflow_tpu/a.py": self.A,
+                              "kubeflow_tpu/b.py": b_bad},
+                             lint=["kubeflow_tpu/b.py"])
+        assert [f.rule for f in found] == ["F604"]
+
+    def test_resolve_and_transitive_callees(self):
+        from kubeflow_tpu.analysis import Module, Program
+
+        a = Module("kubeflow_tpu/a.py", "def leaf():\n    return 1\n")
+        b = Module("kubeflow_tpu/b.py",
+                   "from kubeflow_tpu.a import leaf\n"
+                   "def mid():\n"
+                   "    return leaf()\n")
+        c = Module("kubeflow_tpu/c.py",
+                   "from kubeflow_tpu.b import mid\n"
+                   "def top():\n"
+                   "    return mid()\n")
+        prog = Program([a, b, c])
+        got = prog.resolve("kubeflow_tpu.a.leaf")
+        assert got is not None and got[0] is a
+        top = c.callgraph.module_fns["top"]
+        names = [fn.name for _, fn in prog.transitive_callees(c, top)]
+        assert names == ["mid", "leaf"]
+        # depth bound: 1 stops at mid
+        names1 = [fn.name
+                  for _, fn in prog.transitive_callees(c, top, depth=1)]
+        assert names1 == ["mid"]
+
+    def test_standalone_module_still_lints(self):
+        # no Program attached: rules degrade to module-local facts
+        src = _F_PRELUDE + (
+            "F = jax.jit(_impl)\n"
+            "def run(x):\n"
+            "    return F(x, 0.5)\n")
+        assert rules_of(src) == ["F602"]
+
+    def test_jit_table_collects_decorated_and_assigned(self):
+        from kubeflow_tpu.analysis import Module, jit_table
+
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))\n"
+            "def dec(a, b, c):\n"
+            "    return a\n"
+            "def _imp(x):\n"
+            "    return x\n"
+            "J = jax.jit(_imp, donate_argnames=('x',))\n")
+        table = jit_table(Module("kubeflow_tpu/t.py", src))
+        assert table["dec"].static_argnums == (2,)
+        assert table["dec"].donate_argnums == (0,)
+        assert table["J"].donate_argnames == ("x",)
+        assert table["J"].donates
 
 
 # -- seeded regressions against the REAL codebase (acceptance criteria) --------
@@ -1180,6 +1594,41 @@ class TestSeededRegressions:
         assert f.rule == "R503"
         assert "Router._aux_lock" in f.message and "Router._lock" in f.message
 
+    def test_weak_type_scalar_into_decode_dispatch_is_caught(self):
+        """Replacing the dense decode dispatch's PRNG key with a bare
+        Python float — a weak-typed cache entry per dispatch — produces
+        exactly one F602."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/engine.py",
+            "            out, self.cache, st = self._decode_n(\n"
+            "                self.params, self.cache, self._dstate.arrays,"
+            " key, k_steps,\n"
+            "                mode)",
+            "            out, self.cache, st = self._decode_n(\n"
+            "                self.params, self.cache, self._dstate.arrays,"
+            " 0.5, k_steps,\n"
+            "                mode)")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "F602" and "self._decode_n" in f.message
+
+    def test_fresh_tuple_static_arg_is_caught(self):
+        """Feeding the decode dispatch's static num_steps position a
+        per-call tuple produces exactly one F604."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/engine.py",
+            "            out, self.cache, st = self._decode_n(\n"
+            "                self.params, self.cache, self._dstate.arrays,"
+            " key, k_steps,\n"
+            "                mode)",
+            "            out, self.cache, st = self._decode_n(\n"
+            "                self.params, self.cache, self._dstate.arrays,"
+            " key, (k_steps,),\n"
+            "                mode)")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "F604" and "self._decode_n" in f.message
+
 
 # -- self-scan + CLI -----------------------------------------------------------
 
@@ -1286,3 +1735,65 @@ class TestCli:
             capture_output=True, text=True, cwd=tmp_path, env=env)
         assert proc.returncode == 2
         assert "full scan" in proc.stderr
+
+    def test_changed_skips_deleted_files(self, tmp_path):
+        """A tracked .py removed from the working tree must not reach the
+        file walker (it used to error the pre-commit path): the deletion
+        shows up in the diff but is excluded by its D status."""
+        git = self._git_repo(tmp_path)
+        git("rm", "-q", "clean.py")
+        (tmp_path / "dirty.py").write_text(
+            TestFullBufferReupload.POSITIVE)
+        env = dict(os.environ, PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", "--changed",
+             "--no-baseline", "--json"],
+            capture_output=True, text=True, cwd=tmp_path, env=env)
+        doc = json.loads(proc.stdout)
+        assert proc.returncode == 1, proc.stderr
+        assert doc["errors"] == []
+        assert doc["files_scanned"] == 1       # dirty.py; NOT clean.py
+        assert [f["rule"] for f in doc["findings"]] == ["D103"]
+
+    def test_changed_rename_lints_only_new_name(self, tmp_path):
+        """A committed rename lints the NEW path only — the old name is
+        gone from disk and must be skipped by its R status."""
+        git = self._git_repo(tmp_path)
+        git("mv", "clean.py", "renamed.py")
+        git("commit", "-qm", "rename")
+        from kubeflow_tpu.analysis import changed_files
+
+        files = changed_files("HEAD~1", root=str(tmp_path))
+        assert files == ["renamed.py"]
+
+    def test_json_reports_wall_time(self, tmp_path):
+        (tmp_path / "one.py").write_text("x = 1\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", "--json",
+             "--no-baseline", str(tmp_path / "one.py")],
+            capture_output=True, text=True, cwd=REPO)
+        doc = json.loads(proc.stdout)
+        assert proc.returncode == 0
+        assert doc["wall_time_s"] > 0
+
+    def test_update_baseline_is_deterministic(self, tmp_path):
+        """The baseline file is a pure function of the finding SET:
+        shuffled finding order writes byte-identical output, so baseline
+        diffs are reviewable."""
+        import random
+
+        from kubeflow_tpu.analysis import lint_source
+
+        src = TestFullBufferReupload.POSITIVE + (
+            "    def again(self):  # hot-loop\n"
+            "        jnp.asarray(self._other)\n")
+        findings = lint_source(src, "kubeflow_tpu/serve/fixture.py")
+        assert len(findings) >= 2
+        blobs = []
+        for seed in (0, 1, 2):
+            shuffled = list(findings)
+            random.Random(seed).shuffle(shuffled)
+            out = tmp_path / f"bl{seed}.json"
+            Baseline.from_findings(shuffled).save(str(out))
+            blobs.append(out.read_bytes())
+        assert blobs[0] == blobs[1] == blobs[2]

@@ -1,0 +1,133 @@
+"""Direct unit tests of ``kubeflow_tpu/compat.py`` (ISSUE 8 satellite).
+
+The shim was previously exercised only indirectly through
+importorskip-guarded suites (test_parallel_attention / test_moe_dispatch /
+test_serve_sharded), so a regression in the fallback's keyword
+translation would surface as a confusing downstream failure — or not at
+all on a jax new enough to never take the fallback. These tests pin the
+adapter's contract with recording fakes, independent of which jax is
+installed, plus the live resolution on THIS environment's jax."""
+
+import jax
+import pytest
+
+from kubeflow_tpu import compat
+from kubeflow_tpu.compat import (
+    axis_size, require_shard_map, wrap_legacy_shard_map,
+)
+
+
+class _RecordingImpl:
+    """Stands in for jax.experimental.shard_map.shard_map."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, f, **kw):
+        self.calls.append((f, kw))
+        return ("wrapped", f)
+
+
+class TestLegacyShardMapWrapper:
+    def test_check_vma_maps_to_check_rep(self):
+        impl = _RecordingImpl()
+        sm = wrap_legacy_shard_map(impl)
+
+        def body(x):
+            return x
+
+        out = sm(body, mesh="m", in_specs="i", out_specs="o",
+                 check_vma=False)
+        assert out == ("wrapped", body)
+        (f, kw), = impl.calls
+        assert f is body
+        assert kw == {"mesh": "m", "in_specs": "i", "out_specs": "o",
+                      "check_rep": False}
+        assert "check_vma" not in kw
+
+    def test_keyword_only_call_returns_partial(self):
+        impl = _RecordingImpl()
+        sm = wrap_legacy_shard_map(impl)
+        deco = sm(mesh="m", in_specs="i", out_specs="o", check_vma=True)
+        assert not impl.calls            # nothing ran yet
+
+        def body(x):
+            return x
+
+        deco(body)
+        (f, kw), = impl.calls
+        assert f is body and kw["check_rep"] is True
+
+    def test_other_keywords_pass_through_untouched(self):
+        impl = _RecordingImpl()
+        sm = wrap_legacy_shard_map(impl)
+        sm(lambda x: x, mesh="m", in_specs="i", out_specs="o")
+        (_, kw), = impl.calls
+        assert "check_rep" not in kw and "check_vma" not in kw
+
+
+class TestResolution:
+    def test_flags_are_consistent(self):
+        if compat.HAS_SHARD_MAP:
+            assert compat.shard_map is not None
+            assert require_shard_map() is compat.shard_map
+        else:
+            assert compat.shard_map is None
+
+    def test_native_flag_matches_jax_surface(self):
+        assert compat.SHARD_MAP_NATIVE == hasattr(jax, "shard_map")
+
+    def test_require_shard_map_raises_when_missing(self, monkeypatch):
+        monkeypatch.setattr(compat, "shard_map", None)
+        with pytest.raises(ImportError, match="shard_map"):
+            require_shard_map()
+
+
+class _FakeLax:
+    """jax.lax stand-in: optionally exposes axis_size, always psum."""
+
+    def __init__(self, with_axis_size: bool):
+        self.psum_calls = []
+        if with_axis_size:
+            self.axis_size = lambda name: ("native", name)
+
+    def __getattr__(self, name):
+        if name == "axis_size":
+            raise AttributeError(name)
+        raise AttributeError(name)
+
+    def psum(self, x, axis_name):
+        self.psum_calls.append((x, axis_name))
+        return ("psum", x, axis_name)
+
+
+class TestAxisSizeShim:
+    def test_prefers_native_axis_size(self, monkeypatch):
+        fake = _FakeLax(with_axis_size=True)
+        monkeypatch.setattr(jax, "lax", fake)
+        assert axis_size("data") == ("native", "data")
+        assert fake.psum_calls == []
+
+    def test_falls_back_to_static_psum(self, monkeypatch):
+        fake = _FakeLax(with_axis_size=False)
+        monkeypatch.setattr(jax, "lax", fake)
+        assert axis_size("data") == ("psum", 1, "data")
+        assert fake.psum_calls == [(1, "data")]
+
+    @pytest.mark.skipif(not compat.HAS_SHARD_MAP,
+                        reason="no shard_map in this jax")
+    def test_live_axis_size_under_shard_map(self):
+        """The shim resolves to the real mesh axis size under an actual
+        shard_map binding on this environment's jax."""
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        sm = require_shard_map()
+
+        def body(x):
+            return x * axis_size("data")
+
+        out = sm(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"))(
+            jax.numpy.ones(4, jax.numpy.int32))
+        assert list(jax.device_get(out)) == [2, 2, 2, 2]

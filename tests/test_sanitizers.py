@@ -1,19 +1,24 @@
-"""Runtime sanitizers (ISSUE 7): KFTPU_SANITIZE mode parsing, the
-refcount owner-stamping allocator, and the lockorder watchdog — the
-dynamic cross-checks of the S4xx/R5xx static rules.
+"""Runtime sanitizers (ISSUEs 7/8): KFTPU_SANITIZE mode parsing, the
+refcount owner-stamping allocator, the lockorder watchdog — the dynamic
+cross-checks of the S4xx/R5xx static rules — and the recompile watchdog,
+the dynamic half of the F6xx compilation-stability family: zero
+steady-state recompiles on warmed dense/paged/spec engines and a warmed
+train step, every warmup trace attributed to a call site.
 
 The watchdog tests install/uninstall within the process; every test
-restores the real threading factories on exit (the uninstall is in a
-finally) so the rest of the suite runs unpatched."""
+restores the real threading factories / logging wiring on exit (the
+uninstall is in a finally) so the rest of the suite runs unpatched."""
 
+import logging
 import threading
 
 import pytest
 
 from kubeflow_tpu.runtime import sanitize
 from kubeflow_tpu.runtime.sanitize import (
-    LockOrderError, install_lockorder_watchdog, sanitize_modes,
-    uninstall_lockorder_watchdog,
+    LockOrderError, RecompileError, install_lockorder_watchdog,
+    install_recompile_watchdog, recompile_report, sanitize_modes,
+    uninstall_lockorder_watchdog, uninstall_recompile_watchdog,
 )
 
 
@@ -34,7 +39,13 @@ class TestModeParsing:
         monkeypatch.setenv("KFTPU_SANITIZE", "refcount,lockorder")
         assert sanitize_modes() == {"refcount", "lockorder"}
         monkeypatch.setenv("KFTPU_SANITIZE", "all")
-        assert sanitize_modes() == {"transfer", "refcount", "lockorder"}
+        assert sanitize_modes() == {"transfer", "refcount", "lockorder",
+                                    "recompile"}
+
+    def test_recompile_is_a_named_mode(self, monkeypatch):
+        # "recompile" must not degrade to the legacy transfer fallback
+        monkeypatch.setenv("KFTPU_SANITIZE", "recompile")
+        assert sanitize_modes() == {"recompile"}
 
     def test_unknown_token_degrades_to_transfer(self, monkeypatch):
         # pre-ISSUE-7 setups used arbitrary truthy values for the
@@ -214,6 +225,155 @@ class TestLockOrderWatchdog:
             uninstall_lockorder_watchdog()
         assert threading.Lock is orig
         assert sanitize.lockorder_watchdog() is None
+
+
+@pytest.fixture()
+def recompile_wd():
+    wd = install_recompile_watchdog()
+    wd.reset()
+    try:
+        yield wd
+    finally:
+        uninstall_recompile_watchdog()
+
+
+class TestRecompileWatchdog:
+    def test_counts_and_attributes_each_compile(self, recompile_wd):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x * 2)
+        f(jnp.ones(3))
+        f(jnp.ones(3))              # cache hit: not a compile
+        recompile_wd.mark_warm()
+        recompile_wd.assert_no_steady_recompiles()   # still clean
+        f(jnp.ones(5))              # new shape: steady retrace
+        rep = recompile_report()
+        assert rep["warm"] is True
+        assert any(e["fn"] == "<lambda>" for e in rep["warmup"])
+        # every entry — warmup and steady — is attributed to THIS file
+        for e in rep["warmup"] + rep["steady"]:
+            assert "test_sanitizers.py" in e["site"], e
+        assert rep["steady_count"] >= 1
+        with pytest.raises(RecompileError) as exc:
+            recompile_wd.assert_no_steady_recompiles()
+        assert "test_sanitizers.py" in str(exc.value)
+
+    def test_weak_type_is_its_own_cache_entry(self, recompile_wd):
+        """The F602 defect, observed dynamically: a Python scalar and an
+        explicitly-dtyped scalar of the same value are two compiles."""
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.float32(2.0))
+        recompile_wd.mark_warm()
+        # retrace-ok: the weak-typed retrace IS this test's subject
+        f(2.0)
+        assert recompile_wd.steady_count() >= 1
+
+    def test_install_is_idempotent_and_uninstall_restores(self):
+        lg = logging.getLogger("jax._src.interpreters.pxla")
+        level, prop = lg.level, lg.propagate
+        wd1 = install_recompile_watchdog()
+        try:
+            assert install_recompile_watchdog() is wd1
+            assert lg.level == logging.DEBUG and lg.propagate is False
+        finally:
+            uninstall_recompile_watchdog()
+        assert lg.level == level and lg.propagate == prop
+        assert sanitize.recompile_watchdog() is None
+        assert recompile_report() == {}          # off = empty payload
+
+    def test_warnings_still_reach_parent_handlers(self, recompile_wd):
+        """Propagation is cut to keep DEBUG compile records off the
+        console, but WARNING+ records must still reach the jax logger's
+        own handlers."""
+        seen = []
+
+        class Probe(logging.Handler):
+            def emit(self, record):
+                seen.append(record.getMessage())
+
+        probe = Probe()
+        parent = logging.getLogger("jax")
+        parent.addHandler(probe)
+        try:
+            logging.getLogger("jax._src.interpreters.pxla").warning(
+                "a real warning")
+        finally:
+            parent.removeHandler(probe)
+        assert seen == ["a real warning"]
+
+
+class TestSteadyStateZeroRecompiles:
+    """The acceptance criterion: warmed engines and a warmed train step
+    hold a FIXED trace set — identical steady-state traffic compiles
+    nothing, and every warmup trace is attributed to a named site."""
+
+    PROMPTS = [[3, 5, 7, 3, 5, 7, 3, 5], [2, 4, 6, 2, 4, 6, 2, 4]]
+
+    def _drive(self, eng, wd):
+        from kubeflow_tpu.serve.engine import SamplingParams
+
+        for p in self.PROMPTS:
+            eng.generate(p, SamplingParams(max_new_tokens=8))
+        wd.mark_warm()
+        for p in self.PROMPTS:
+            eng.generate(p, SamplingParams(max_new_tokens=8))
+        rep = recompile_report()
+        assert rep["warmup"], "warmup must record attributed compiles"
+        assert all(e["site"] != "<unknown>" for e in rep["warmup"])
+        assert rep["steady_count"] == 0, rep["steady"]
+        wd.assert_no_steady_recompiles()
+
+    def test_dense_and_spec_engines(self, recompile_wd):
+        jax = pytest.importorskip("jax")  # noqa: F841
+        from kubeflow_tpu.core.serving import BatchingSpec, SpeculativeSpec
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.serve.engine import LLMEngine
+
+        cfg = preset("tiny")
+        self._drive(LLMEngine(cfg, BatchingSpec(
+            max_batch_size=2, max_seq_len=64, prefill_buckets=[16])),
+            recompile_wd)
+        recompile_wd.reset()
+        self._drive(LLMEngine(cfg, BatchingSpec(
+            max_batch_size=2, max_seq_len=64, prefill_buckets=[16],
+            speculative=SpeculativeSpec(mode="ngram", k=3))),
+            recompile_wd)
+
+    def test_paged_engine(self, recompile_wd):
+        jax = pytest.importorskip("jax")  # noqa: F841
+        from kubeflow_tpu.core.serving import BatchingSpec
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.serve.engine import LLMEngine
+
+        cfg = preset("tiny")
+        eng = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=2, max_seq_len=64, paged=True, page_size=16))
+        self._drive(eng, recompile_wd)
+        eng._allocator.assert_quiescent()
+
+    def test_warmed_train_step(self, recompile_wd):
+        jax = pytest.importorskip("jax")
+        import numpy as np
+
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.runtime.mesh import build_mesh
+        from kubeflow_tpu.train.optim import OptimizerConfig
+        from kubeflow_tpu.train.step import setup_train
+
+        cfg = preset("tiny", vocab_size=256, max_seq_len=32)
+        task = setup_train(cfg, OptimizerConfig(warmup_steps=0),
+                           build_mesh({"data": 8}))
+        batch = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, 17), dtype=np.int32)
+        put = lambda: jax.device_put(batch, task.batch_sharding)  # noqa: E731
+        state, _ = task.step_fn(task.state, put())
+        recompile_wd.mark_warm()
+        state, _ = task.step_fn(state, put())
+        assert recompile_wd.steady_count() == 0, recompile_report()["steady"]
 
 
 class TestEngineWiring:
