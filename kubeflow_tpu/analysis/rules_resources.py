@@ -22,6 +22,16 @@ must acquire its locks in one global order. Statically:
   report each cycle once. The runtime half is the
   ``KFTPU_SANITIZE=lockorder`` watchdog (runtime/sanitize.py), which
   records the REAL acquisition graph and fails on a cycle.
+- R504 ``unhandled-checkpoint-io`` (ISSUE 9 survivability): a
+  ``CheckpointManager.save``/``.restore`` call site (receiver spelled
+  ``ckpt``/``checkpoint``, how this codebase names them) with no
+  exception or return handling. ``restore`` raises
+  ``CheckpointCorruptionError`` on a bad step — an unguarded call turns
+  a corrupt checkpoint into a dead job instead of a fallback; ``save``
+  returns an acceptance bool and can raise on storage failure — a bare
+  expression call drops rejected saves silently, the exact
+  ``Trainer.save`` bug this PR fixed. Production code only (test files
+  exercise these paths raw on purpose).
 """
 
 from __future__ import annotations
@@ -304,3 +314,60 @@ class LockOrderInversion(Rule):
                             symbol="|".join(sorted(set(cycle))))
                     elif nxt not in path:
                         stack.append((nxt, path + (nxt,)))
+
+
+@register
+class UnhandledCheckpointIO(Rule):
+    id = "R504"
+    name = "unhandled-checkpoint-io"
+    doc = ("CheckpointManager save/restore call with no exception or "
+           "return handling — restore raises on a corrupt step (crash "
+           "instead of fallback), save's acceptance bool silently drops "
+           "rejected saves; production code only")
+
+    _CKPT_HINTS = ("ckpt", "checkpoint")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if _is_test_path(mod.relpath):
+            return
+        for call in mod.walk(ast.Call):
+            f = call.func
+            if not isinstance(f, ast.Attribute) \
+                    or f.attr not in ("save", "restore"):
+                continue
+            recv = _attr_chain(f.value).lower()
+            if not any(h in recv for h in self._CKPT_HINTS):
+                continue
+            if self._handled_by_try(call):
+                continue
+            if f.attr == "save":
+                parent = getattr(call, "_parent", None)
+                if not isinstance(parent, ast.Expr):
+                    continue        # acceptance bool consumed
+                yield mod.finding(
+                    self, call,
+                    f"'{recv}.save(...)' drops the acceptance bool and has "
+                    "no exception handling — a rejected or failed save "
+                    "vanishes silently; check the return (count/log "
+                    "failures) or wrap in try/except")
+            else:
+                yield mod.finding(
+                    self, call,
+                    f"'{recv}.restore(...)' has no exception handling — "
+                    "restore raises CheckpointCorruptionError on a bad "
+                    "step, so this call turns a corrupt checkpoint into a "
+                    "crash instead of a fallback (see "
+                    "train/checkpoint.py::resume_from_tiers)")
+
+    @staticmethod
+    def _handled_by_try(node: ast.AST) -> bool:
+        """Any enclosing try-with-except inside the same function counts
+        as handling (else/finally placement included — the author thought
+        about the failure path)."""
+        cur = getattr(node, "_parent", None)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if isinstance(cur, ast.Try) and cur.handlers:
+                return True
+            cur = getattr(cur, "_parent", None)
+        return False

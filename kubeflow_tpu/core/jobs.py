@@ -267,6 +267,16 @@ class JobMetrics(BaseModel):
     mfu: Optional[float] = None
     loss: Optional[float] = None
     last_checkpoint_step: Optional[int] = None
+    # Survivability ledger (train/survival.py GoodputLedger, scraped from
+    # metrics.jsonl): the honest restart economics of the job — useful
+    # step-time over wall time, completed steps lost to restarts, emergency
+    # (preemption) saves, corrupt-checkpoint restore fallbacks, and
+    # rejected/failed interval saves.
+    goodput: Optional[float] = None
+    steps_lost_total: Optional[int] = None
+    emergency_saves: Optional[int] = None
+    restore_fallbacks: Optional[int] = None
+    checkpoint_save_failures: Optional[int] = None
 
 
 class JAXJobStatus(ConditionMixin):

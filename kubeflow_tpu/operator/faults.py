@@ -54,6 +54,24 @@ class FaultInjector:
             return True
         return self.cp.runtime.procman.signal(f"{namespace}.{wname}", sig)
 
+    def preempt_gang(self, job_key: str) -> int:
+        """SIGTERM every live worker of the job — a slice-wide maintenance
+        preemption. Each trainer's preemption handler force-saves to its
+        emergency tier at the next step boundary and exits retryable, so
+        the gang restart resumes with zero completed steps lost. Returns
+        the number of processes signalled."""
+        namespace, name = job_key.split("/", 1)
+        job = self.cp.store.try_get(JAXJob, name, namespace)
+        if job is None or self.cp.runtime is None:
+            return 0
+        n = 0
+        for i in range(job.spec.worker.replicas):
+            wname = worker_name(name, WORKER, i)
+            if self.cp.runtime.procman.signal(
+                    f"{namespace}.{wname}", signal.SIGTERM):
+                n += 1
+        return n
+
     def wedge_worker(self, job_key: str, index: int = 0) -> bool:
         """SIGSTOP a worker: alive but silent — exercises the heartbeat
         failure detector rather than exit-code handling."""
@@ -65,21 +83,28 @@ class FaultInjector:
             f"{namespace}.{wname}", signal.SIGSTOP)
 
     def corrupt_latest_checkpoint(self, job_key: str) -> Optional[str]:
-        """Truncate files of the newest checkpoint step (tests restore
-        fallback to an older step / clean failure, not silent bad numerics)."""
+        """Truncate files of the NEWEST checkpoint step across both tiers —
+        the interval dir and its ``-emergency`` sibling (a just-preempted
+        job's newest step lives there). Tests restore fallback to an older
+        step / clean failure, not silent bad numerics."""
         namespace, name = job_key.split("/", 1)
         job = self.cp.store.try_get(JAXJob, name, namespace)
         if job is None:
             return None
         ckpt_dir = (job.spec.run_policy.checkpoint.directory
                     or os.path.join(self.cp.jaxjob_reconciler.job_dir(job), "ckpt"))
-        try:
-            steps = sorted(int(d) for d in os.listdir(ckpt_dir) if d.isdigit())
-        except OSError:
+        newest: Optional[tuple[int, str]] = None
+        for tier_dir in (ckpt_dir, f"{ckpt_dir}-emergency"):
+            try:
+                steps = [int(d) for d in os.listdir(tier_dir) if d.isdigit()]
+            except OSError:
+                continue
+            for s in steps:
+                if newest is None or s > newest[0]:
+                    newest = (s, os.path.join(tier_dir, str(s)))
+        if newest is None:
             return None
-        if not steps:
-            return None
-        target = os.path.join(ckpt_dir, str(steps[-1]))
+        target = newest[1]
         for root, _, files in os.walk(target):
             for fn in files:
                 with open(os.path.join(root, fn), "wb") as f:

@@ -588,6 +588,10 @@ class JAXJobController:
                 ckpt.directory or os.path.join(jdir, "ckpt"))
             template.config.setdefault("checkpoint_every", ckpt.interval_steps)
             template.config.setdefault("max_checkpoints", ckpt.max_to_keep)
+            # Preemption-aware emergency tier (trainer force-saves on
+            # SIGTERM at the next step boundary; train/checkpoint.py).
+            template.config.setdefault("emergency_checkpointing",
+                                       ckpt.save_on_failure)
         parallelism = (job.spec.parallelism.axis_sizes()
                        if job.spec.parallelism.total > 1 else {})
         w = Worker(
@@ -645,12 +649,17 @@ class JAXJobController:
             except ValueError:
                 return
             job.status.metrics.step = int(m.get("step", job.status.metrics.step))
-            for field in ("tokens_per_sec_per_chip", "step_time_ms", "mfu", "loss"):
+            for field in ("tokens_per_sec_per_chip", "step_time_ms", "mfu",
+                          "loss", "goodput"):
                 if m.get(field) is not None:
                     setattr(job.status.metrics, field, float(m[field]))
-            if m.get("last_checkpoint_step") is not None:
-                job.status.metrics.last_checkpoint_step = \
-                    int(m["last_checkpoint_step"])
+            # Survivability ledger counters (ISSUE 9): restart economics on
+            # job status, where the autoscaler/SRE can see them.
+            for field in ("last_checkpoint_step", "steps_lost_total",
+                          "emergency_saves", "restore_fallbacks",
+                          "checkpoint_save_failures"):
+                if m.get(field) is not None:
+                    setattr(job.status.metrics, field, int(m[field]))
             return
 
     def _update_status(self, job: JAXJob) -> None:

@@ -1,48 +1,109 @@
-"""Checkpointing via orbax: sharded, multi-process-safe save/restore.
+"""Checkpointing via orbax: sharded, multi-process-safe save/restore,
+with per-step integrity manifests and verified restore.
 
 First-class in this platform (the reference delegates checkpointing to user
-code entirely — SURVEY.md §5): the trainer saves on an interval and on
-failure signals; restore reshards to the *current* mesh, which is what makes
-elastic resize (new topology, same logical state) work.
-"""
+code entirely — SURVEY.md §5). Two tiers cooperate at runtime: the trainer
+saves on an interval here, and force-saves to a second *emergency* manager
+(``max_to_keep=1``) at the next step boundary after a preemption signal —
+see ``Trainer.run``. Restore reshards to the *current* mesh, which is what
+makes elastic resize (new topology, same logical state) work.
+
+Integrity contract: after a step commits, a manifest (file list + content
+checksums) is written under ``<dir>/manifests/<step>.json``. ``restore``
+verifies the manifest before handing state back and raises
+``CheckpointCorruptionError`` on any mismatch — a torn or corrupted save can
+never silently poison a resume. ``resume_from_tiers`` walks back to the
+newest step that verifies AND restores across every tier, quarantining bad
+step dirs as it goes, so the worst a corrupt checkpoint costs is the
+interval since the previous good one."""
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
 from typing import Any, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+logger = logging.getLogger("kubeflow_tpu.train.checkpoint")
+
+_MANIFEST_DIR = "manifests"
+_QUARANTINE_DIR = "quarantine"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint step failed manifest verification (missing/extra files
+    or checksum mismatch) — the bytes on disk are not the bytes saved."""
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return h.hexdigest()
+            h.update(b)
+
 
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3, *,
+                 write_manifests: bool = True):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
+        # Manifest writing is coordinator-only in a multi-process gang
+        # (every process verifies, exactly one writes).
+        self.write_manifests = write_manifests
+        self._max_to_keep = max_to_keep
+        self._mgr = self._open()
+
+    def _open(self):
+        return ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=True,
+                max_to_keep=self._max_to_keep, create=True,
+                enable_async_checkpointing=True,
             ),
         )
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        return self._mgr.save(
+        """Register an (async) save. Returns orbax's acceptance bool — False
+        means the save was REJECTED (e.g. save interval policy); callers
+        must not treat a False as durable progress. May raise on storage
+        failure; callers on the training hot path wrap this (see
+        ``Trainer.save``) so a broken checkpoint store degrades to an alarm
+        metric, not a dead job."""
+        accepted = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force)
+        self.flush_manifests()
+        return accepted
 
-    def restore(self, abstract_state: Any, step: Optional[int] = None) -> Optional[Any]:
+    def restore(self, abstract_state: Any, step: Optional[int] = None,
+                *, verify: bool = True) -> Optional[Any]:
         """Restore latest (or given) step onto the shardings carried by
         ``abstract_state`` (a pytree of jax.ShapeDtypeStruct with .sharding
         set — see make_abstract_state). Returns None when nothing saved.
 
-        Because the target shardings describe the *current* mesh, a restore
-        after a topology change reshards automatically (elastic resize)."""
+        Verifies the step's manifest first (when one exists) and raises
+        ``CheckpointCorruptionError`` on mismatch, BEFORE any bytes reach
+        model state. Because the target shardings describe the *current*
+        mesh, a restore after a topology change reshards automatically
+        (elastic resize)."""
         target = step if step is not None else self._mgr.latest_step()
         if target is None:
             return None
-        return self._mgr.restore(target, args=ocp.args.StandardRestore(abstract_state))
+        if verify:
+            self.verify_step(target)
+        return self._mgr.restore(
+            target, args=ocp.args.StandardRestore(abstract_state))
 
     def latest_step(self) -> Optional[int]:
+        """Newest step the manager KNOWS about — async saves register here
+        immediately, before their bytes are durable. See
+        ``latest_committed_step`` for the on-disk truth."""
         return self._mgr.latest_step()
 
     def latest_committed_step(self) -> Optional[int]:
@@ -51,8 +112,123 @@ class CheckpointManager:
         teardown mid-write leaves nothing restorable. Consumers that gate
         destructive moves on "a checkpoint exists" (the elastic autoscaler)
         must use this, not latest_step()."""
+        self.flush_manifests()
         steps = ocp.utils.checkpoint_steps(self.directory)
         return max(steps) if steps else None
+
+    def steps_on_disk(self) -> list[int]:
+        """Step dirs present in the directory, committed or not — the
+        candidate list the verified-resume walk filters. A torn save's dir
+        shows up here (and fails verification); a quarantined one does not."""
+        try:
+            return sorted(int(d) for d in os.listdir(self.directory)
+                          if d.isdigit())
+        except OSError:
+            return []
+
+    # -- integrity manifests ---------------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, _MANIFEST_DIR, f"{step}.json")
+
+    def _step_files(self, step: int) -> dict[str, dict]:
+        root = os.path.join(self.directory, str(step))
+        out: dict[str, dict] = {}
+        for base, _, files in os.walk(root):
+            for fn in files:
+                p = os.path.join(base, fn)
+                rel = os.path.relpath(p, root)
+                out[rel] = {"size": os.path.getsize(p), "sha256": _sha256(p)}
+        return out
+
+    def flush_manifests(self) -> None:
+        """Write manifests for every COMMITTED step that lacks one.
+
+        Called after each save, on commit queries, and at close — an async
+        save gets its manifest on the first call after its background commit
+        lands. A crash inside the commit-to-manifest window leaves a
+        committed-but-unverifiable step; restore treats it as legacy
+        (restorable, errors still caught by the resume walk)."""
+        if not self.write_manifests:
+            return
+        for step in ocp.utils.checkpoint_steps(self.directory):
+            mpath = self._manifest_path(step)
+            if os.path.exists(mpath):
+                continue
+            files = self._step_files(step)
+            os.makedirs(os.path.dirname(mpath), exist_ok=True)
+            tmp = f"{mpath}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": step, "files": files}, f)
+            os.replace(tmp, mpath)
+        # Drop manifests whose step was garbage-collected (max_to_keep).
+        mdir = os.path.join(self.directory, _MANIFEST_DIR)
+        if os.path.isdir(mdir):
+            live = {str(s) for s in self.steps_on_disk()}
+            for fn in os.listdir(mdir):
+                if fn.endswith(".json") and fn[:-5] not in live:
+                    try:
+                        os.remove(os.path.join(mdir, fn))
+                    except OSError:
+                        pass
+
+    def verify_step(self, step: int) -> bool:
+        """Check the step's bytes against its manifest. True = verified,
+        False = no manifest to verify against (pre-manifest checkpoint or a
+        crash in the commit-to-manifest window — restorable, unverified).
+        Raises CheckpointCorruptionError on any mismatch."""
+        mpath = self._manifest_path(step)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            return False
+        except ValueError as exc:
+            raise CheckpointCorruptionError(
+                f"step {step}: manifest unreadable: {exc}") from exc
+        expect: dict = manifest.get("files", {})
+        actual = self._step_files(step)
+        if set(expect) != set(actual):
+            missing = sorted(set(expect) - set(actual))[:3]
+            extra = sorted(set(actual) - set(expect))[:3]
+            raise CheckpointCorruptionError(
+                f"step {step}: file set mismatch (missing={missing}, "
+                f"extra={extra})")
+        for rel, meta in expect.items():
+            got = actual[rel]
+            if (got["size"] != meta["size"]
+                    or got["sha256"] != meta["sha256"]):
+                raise CheckpointCorruptionError(
+                    f"step {step}: checksum mismatch in {rel}")
+        return True
+
+    def quarantine_step(self, step: int) -> Optional[str]:
+        """Move a bad step dir out of the candidate set (into
+        ``quarantine/``, preserved for post-mortem) and reopen the orbax
+        manager so its in-memory step list forgets it. Returns the
+        quarantine path, or None if another process already moved it."""
+        src = os.path.join(self.directory, str(step))
+        qdir = os.path.join(self.directory, _QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, str(step))
+        i = 0
+        while os.path.exists(dst):
+            i += 1
+            dst = os.path.join(qdir, f"{step}.{i}")
+        try:
+            os.rename(src, dst)
+        except OSError:
+            return None     # concurrent quarantine by a gang peer
+        mpath = self._manifest_path(step)
+        try:
+            os.remove(mpath)
+        except OSError:
+            pass
+        logger.warning("quarantined corrupt checkpoint step %d -> %s",
+                       step, dst)
+        self._mgr.close()
+        self._mgr = self._open()
+        return dst
 
     @staticmethod
     def make_abstract_state(init_fn, shardings) -> Any:
@@ -64,6 +240,46 @@ class CheckpointManager:
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self.flush_manifests()
 
     def close(self) -> None:
         self._mgr.close()
+        self.flush_manifests()
+
+
+def resume_from_tiers(managers: list[tuple[str, CheckpointManager]],
+                      abstract_state: Any, *,
+                      quarantine: bool = True):
+    """Restore the newest VALID step across checkpoint tiers.
+
+    ``managers`` is ``[(tier_name, manager), ...]`` in preference order for
+    equal steps (the trainer passes the emergency tier first: after a
+    preemption it holds the newest step; on ties it holds the same bytes).
+    Walks candidates newest-first; a step that fails verification OR whose
+    restore raises is quarantined (post-mortem preserved) and the walk
+    falls back to the next older candidate — a corrupt checkpoint can cost
+    at most the interval since the previous good one, never the job.
+
+    Returns ``(state, step, tier_name, fallbacks)`` or None when no tier
+    holds a restorable step. ``fallbacks`` counts candidates skipped."""
+    candidates: list[tuple[int, int, str, CheckpointManager]] = []
+    for order, (tier, mgr) in enumerate(managers):
+        for step in mgr.steps_on_disk():
+            candidates.append((step, -order, tier, mgr))
+    candidates.sort(key=lambda c: (c[0], c[1]), reverse=True)
+    fallbacks = 0
+    for step, _, tier, mgr in candidates:
+        try:
+            state = mgr.restore(abstract_state, step=step)
+        except Exception as exc:    # corruption OR torn/unreadable save
+            fallbacks += 1
+            logger.error(
+                "restore fallback: step %d (%s tier) invalid: %s",
+                step, tier, exc)
+            if quarantine:
+                mgr.quarantine_step(step)
+            continue
+        if state is None:
+            continue
+        return state, step, tier, fallbacks
+    return None

@@ -26,6 +26,12 @@ class Throughput:
         self._last: Optional[float] = None
         self._ema_dt: Optional[float] = None
 
+    @property
+    def ema_step_time_s(self) -> Optional[float]:
+        """Smoothed steady step time (seconds); None before two ticks.
+        The goodput ledger prices surviving progress with this."""
+        return self._ema_dt
+
     def tick(self, steps_elapsed: int = 1) -> dict:
         """Update with the wall time since the previous tick, which covered
         ``steps_elapsed`` train steps (callers ticking every log interval must

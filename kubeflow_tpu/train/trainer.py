@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import signal
+import threading
 import time
 from typing import Any, Optional
 
@@ -20,12 +22,14 @@ import numpy as np
 
 from kubeflow_tpu.models.config import DecoderConfig, preset
 from kubeflow_tpu.obs.trace import get_tracer
+from kubeflow_tpu.runtime.bootstrap import EXIT_PREEMPTED
 from kubeflow_tpu.runtime.sanitize import mark_compile_warm, recompile_report
-from kubeflow_tpu.train.checkpoint import CheckpointManager
+from kubeflow_tpu.train.checkpoint import CheckpointManager, resume_from_tiers
 from kubeflow_tpu.train.data import DataConfig, make_data_source
 from kubeflow_tpu.train.metrics import MetricsEmitter, Throughput
 from kubeflow_tpu.train.optim import OptimizerConfig
 from kubeflow_tpu.train.step import setup_train
+from kubeflow_tpu.train.survival import GoodputLedger, StepWatchdog
 
 logger = logging.getLogger("kubeflow_tpu.train")
 
@@ -47,6 +51,23 @@ class TrainerConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 100
     max_checkpoints: int = 3
+    # Survivability (ISSUE 9): a preemption (SIGTERM) force-saves to a fast
+    # second tier at the next step boundary, so a graceful preemption loses
+    # ZERO completed steps instead of up-to-checkpoint_every of them.
+    emergency_checkpointing: bool = True
+    emergency_checkpoint_dir: Optional[str] = None   # default: <ckpt>-emergency
+    # Step-progress watchdog: a wedged step (hung collective, stuck input
+    # pipeline) is detected within max(min_seconds, multiplier x observed
+    # step time) and exits retryable — faster AND attributed (stack dump),
+    # vs. the heartbeat lease, which a wedged-but-alive worker never misses.
+    watchdog_enabled: bool = True
+    watchdog_multiplier: float = 20.0
+    watchdog_min_seconds: float = 60.0
+    watchdog_startup_grace_seconds: float = 600.0
+    # Chaos-harness hooks (operator/faults.py drives these through job
+    # config): {"wedge_at_step": N, "wedge_once_file": path,
+    # "save_fail_steps": [N, ...]}. Inert unless set.
+    fault_injection: dict = dataclasses.field(default_factory=dict)
     seed: int = 0
     attn_impl: str = "xla"
     generation: str = "v5e"                   # hardware gen for MFU math
@@ -129,8 +150,26 @@ class Trainer:
             attn_impl=cfg.attn_impl)
 
         self.ckpt: Optional[CheckpointManager] = None
+        self.ckpt_emergency: Optional[CheckpointManager] = None
         if cfg.checkpoint_dir:
-            self.ckpt = CheckpointManager(cfg.checkpoint_dir, cfg.max_checkpoints)
+            self.ckpt = CheckpointManager(
+                cfg.checkpoint_dir, cfg.max_checkpoints,
+                write_manifests=(process_id == 0))
+            if cfg.emergency_checkpointing:
+                self.ckpt_emergency = CheckpointManager(
+                    cfg.emergency_checkpoint_dir
+                    or f"{cfg.checkpoint_dir.rstrip(os.sep)}-emergency",
+                    max_to_keep=1, write_manifests=(process_id == 0))
+
+        # Goodput ledger: coordinator-owned, lives in the workdir so it
+        # survives gang restarts (every attempt shares the workdir).
+        ledger_dir = workdir or (os.path.dirname(metrics_path)
+                                 if metrics_path else None)
+        self.ledger: Optional[GoodputLedger] = (
+            GoodputLedger(ledger_dir)
+            if ledger_dir and process_id == 0 else None)
+        self.save_failures = 0
+        self._preempted = threading.Event()
 
         self.emitter = MetricsEmitter(jsonl_path=metrics_path)
         self.throughput = Throughput(
@@ -143,15 +182,34 @@ class Trainer:
     # -- checkpoint/resume -----------------------------------------------------
 
     def try_resume(self) -> int:
-        """Restore latest checkpoint if present; returns the resume step."""
+        """Restore the newest VALID checkpoint across tiers; returns the
+        resume step.
+
+        The emergency tier is preferred when it holds the newest step (a
+        graceful preemption resumes with zero completed steps lost). A
+        corrupt or torn step is verified against its manifest, quarantined,
+        and the walk falls back to the next older valid step — a bad
+        checkpoint can never crash the resume or silently poison the
+        numerics, and every skip is surfaced as a ``restore_fallbacks``
+        metric."""
         if self.ckpt is None:
             return 0
-        restored = self.ckpt.restore(self._abstract_state())
-        if restored is None:
+        tiers: list = []
+        if self.ckpt_emergency is not None:
+            tiers.append(("emergency", self.ckpt_emergency))
+        tiers.append(("interval", self.ckpt))
+        resumed = resume_from_tiers(
+            tiers, self._abstract_state(),
+            quarantine=(self.process_id == 0))
+        if resumed is None:
             return 0
-        self.task.state = restored
-        step = int(jax.device_get(restored["step"]))
-        logger.info("resumed from checkpoint at step %d", step)
+        state, _, tier, fallbacks = resumed
+        self.task.state = state
+        step = int(jax.device_get(state["step"]))
+        if fallbacks and self.ledger is not None:
+            self.ledger.record_fallback(fallbacks)
+        logger.info("resumed from checkpoint at step %d (tier=%s, "
+                    "fallbacks=%d)", step, tier, fallbacks)
         return step
 
     def _abstract_state(self):
@@ -161,9 +219,31 @@ class Trainer:
             make_state_init(self.model_cfg, self.task.optimizer),
             self.task.state_shardings)
 
-    def save(self, step: int, *, force: bool = False) -> None:
-        if self.ckpt is not None:
-            self.ckpt.save(step, self.task.state, force=force)
+    def save(self, step: int, *, force: bool = False,
+             manager: Optional[CheckpointManager] = None) -> bool:
+        """Save through ``manager`` (default: the interval tier). A rejected
+        (False return) or FAILED (raising) save is an alarm — logged and
+        counted into ``checkpoint_save_failures`` on metrics.jsonl/job
+        status — never a crash: training keeps producing steps while the
+        checkpoint store misbehaves, and the alarm is what pages someone."""
+        mgr = manager if manager is not None else self.ckpt
+        if mgr is None:
+            return False
+        try:
+            if step in set(self.cfg.fault_injection.get("save_fail_steps", ())):
+                raise OSError(f"injected checkpoint save failure at step {step}")
+            accepted = mgr.save(step, self.task.state, force=force)
+            if not accepted:
+                logger.error("checkpoint save at step %d rejected by the "
+                             "manager", step)
+        except Exception:
+            logger.exception("checkpoint save at step %d failed", step)
+            accepted = False
+        if not accepted:
+            self.save_failures += 1
+            if self.ledger is not None:
+                self.ledger.record_save_failure()
+        return accepted
 
     # -- the loop --------------------------------------------------------------
 
@@ -173,73 +253,120 @@ class Trainer:
 
     def run(self, *, on_step=None) -> dict:
         start = self.try_resume()
+        if self.ledger is not None:
+            lost = self.ledger.record_resume(start)
+            if lost:
+                logger.warning(
+                    "restart lost %d completed step(s): last recorded "
+                    "progress outran the resumed checkpoint", lost)
         last_metrics: dict = {}
         last_tick_step = start
         prof = self.cfg.profile_start_step
         tracing = False
         tracer = get_tracer()
         window_start = time.time()
-        for step in range(start, self.cfg.steps):
-            if prof is not None and self.process_id == 0:
-                # `tracing` guards both ends: a resume that lands inside or
-                # past the window must not stop a trace it never started.
-                if step == prof:
-                    jax.profiler.start_trace(self._trace_dir())
-                    tracing = True
-                elif tracing and step >= prof + self.cfg.profile_num_steps:
-                    jax.profiler.stop_trace()
-                    tracing = False
-            batch = self.make_global_batch(self.data.batch_at(step))
-            self.task.state, metrics = self.task.step_fn(self.task.state, batch)
-            if step == start:
-                # Training shapes are fixed: everything compiles on the
-                # first executed step, so under KFTPU_SANITIZE=recompile
-                # any later compile is a dispatch-signature defect — the
-                # runtime half of the F6xx rules. No-op when the
-                # sanitizer is off.
-                mark_compile_warm()
-            if (step + 1) % self.cfg.log_every == 0 or step + 1 == self.cfg.steps:
-                metrics = {k: float(jax.device_get(v)) for k, v in metrics.items()}
-                metrics.update(self.throughput.tick(step + 1 - last_tick_step))
-                # COMMITTED checkpoints only (async saves that a teardown
-                # would abort must not arm the elastic autoscaler): surfaced
-                # through metrics.jsonl onto job status.
-                if self.ckpt is not None:
-                    committed = self.ckpt.latest_committed_step()
-                    if committed is not None:
-                        metrics["last_checkpoint_step"] = committed
-                # One completed span per logged window (obs/trace.py): the
-                # train loop's slice of the platform trace surface. Spans
-                # are retrospective (explicit start) so the hot loop pays
-                # nothing between log points; ``profiling=True`` marks
-                # windows that overlapped a jax.profiler trace, tying the
-                # span to the on-device timeline it summarizes.
-                sp = tracer.start_span(
-                    "train.window", start=window_start,
-                    steps=f"{last_tick_step}-{step + 1}")
-                for k in ("loss", "step_time_ms", "tokens_per_sec", "mfu"):
-                    if k in metrics:
-                        sp.set_attrs(**{k: round(float(metrics[k]), 6)})
-                if tracing:
-                    sp.set_attrs(profiling=True)
-                sp.end()
-                window_start = time.time()
-                last_tick_step = step + 1
-                last_metrics = metrics
-                if self.process_id == 0:
-                    self.emitter.emit(step + 1, metrics)
-            if self.cfg.checkpoint_every and (step + 1) % self.cfg.checkpoint_every == 0:
-                self.save(step + 1)
-            if on_step is not None:
-                on_step(step + 1, last_metrics)
-        if tracing:
-            jax.profiler.stop_trace()   # window ran past the last step
-        if self.ckpt is not None:
-            if self.ckpt.latest_step() != self.cfg.steps:
+        watchdog: Optional[StepWatchdog] = None
+        if self.cfg.watchdog_enabled:
+            watchdog = StepWatchdog(
+                multiplier=self.cfg.watchdog_multiplier,
+                min_seconds=self.cfg.watchdog_min_seconds,
+                startup_grace_seconds=self.cfg.watchdog_startup_grace_seconds)
+            watchdog.start()
+        prev_sigterm = self._install_preemption_handler()
+        # try/finally so ANY exit from the loop — exception mid-window,
+        # preemption SystemExit — still stops an open jax.profiler trace,
+        # drains the async checkpoint managers (an in-flight save must not
+        # be abandoned torn), and closes the metrics emitter.
+        try:
+            for step in range(start, self.cfg.steps):
+                if prof is not None and self.process_id == 0:
+                    # `tracing` guards both ends: a resume that lands inside
+                    # or past the window must not stop a trace it never
+                    # started.
+                    if step == prof:
+                        jax.profiler.start_trace(self._trace_dir())
+                        tracing = True
+                    elif tracing and step >= prof + self.cfg.profile_num_steps:
+                        jax.profiler.stop_trace()
+                        tracing = False
+                batch = self.make_global_batch(self.data.batch_at(step))
+                self.task.state, metrics = self.task.step_fn(self.task.state, batch)
+                if step == start:
+                    # Training shapes are fixed: everything compiles on the
+                    # first executed step, so under KFTPU_SANITIZE=recompile
+                    # any later compile is a dispatch-signature defect — the
+                    # runtime half of the F6xx rules. No-op when the
+                    # sanitizer is off.
+                    mark_compile_warm()
+                if watchdog is not None:
+                    watchdog.step_completed(step + 1)
+                if self._preempted.is_set():
+                    self._emergency_exit(step + 1)      # raises SystemExit
+                if (step + 1) % self.cfg.log_every == 0 or step + 1 == self.cfg.steps:
+                    metrics = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+                    metrics.update(self.throughput.tick(step + 1 - last_tick_step))
+                    # COMMITTED checkpoints only (async saves that a teardown
+                    # would abort must not arm the elastic autoscaler): surfaced
+                    # through metrics.jsonl onto job status.
+                    if self.ckpt is not None:
+                        committed = self.ckpt.latest_committed_step()
+                        if committed is not None:
+                            metrics["last_checkpoint_step"] = committed
+                    # Goodput ledger (train/survival.py): restart/fallback/
+                    # emergency accounting riding every window onto job
+                    # status; the ledger's cumulative counters supersede the
+                    # attempt-local save_failures when present.
+                    metrics["checkpoint_save_failures"] = self.save_failures
+                    if self.ledger is not None:
+                        self.ledger.record_progress(step + 1)
+                        metrics.update(self.ledger.metrics(
+                            step + 1, self.throughput.ema_step_time_s))
+                    # One completed span per logged window (obs/trace.py): the
+                    # train loop's slice of the platform trace surface. Spans
+                    # are retrospective (explicit start) so the hot loop pays
+                    # nothing between log points; ``profiling=True`` marks
+                    # windows that overlapped a jax.profiler trace, tying the
+                    # span to the on-device timeline it summarizes.
+                    sp = tracer.start_span(
+                        "train.window", start=window_start,
+                        steps=f"{last_tick_step}-{step + 1}")
+                    for k in ("loss", "step_time_ms", "tokens_per_sec", "mfu"):
+                        if k in metrics:
+                            sp.set_attrs(**{k: round(float(metrics[k]), 6)})
+                    if tracing:
+                        sp.set_attrs(profiling=True)
+                    sp.end()
+                    window_start = time.time()
+                    last_tick_step = step + 1
+                    last_metrics = metrics
+                    if self.process_id == 0:
+                        self.emitter.emit(step + 1, metrics)
+                if self.cfg.checkpoint_every and (step + 1) % self.cfg.checkpoint_every == 0:
+                    self.save(step + 1)
+                self._maybe_injected_wedge(step + 1)
+                if on_step is not None:
+                    on_step(step + 1, last_metrics)
+            if self.ckpt is not None and self.ckpt.latest_step() != self.cfg.steps:
                 self.save(self.cfg.steps, force=True)
-            self.ckpt.wait()
-            self.ckpt.close()
-        self.emitter.close()
+        finally:
+            if prev_sigterm is not None:
+                signal.signal(signal.SIGTERM, prev_sigterm)
+            if watchdog is not None:
+                watchdog.stop()
+            if tracing:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    logger.exception("stopping profiler trace failed")
+            for mgr in (self.ckpt, self.ckpt_emergency):
+                if mgr is None:
+                    continue
+                try:
+                    mgr.wait()
+                    mgr.close()
+                except Exception:
+                    logger.exception("checkpoint manager close failed")
+            self.emitter.close()
         rep = recompile_report()
         if rep.get("steady_count"):
             # At 6k-chip scale each of these cost minutes of cluster time
@@ -251,6 +378,68 @@ class Trainer:
                 "; ".join(f"{e['fn']} x{e['count']} at {e['site']}"
                           for e in rep["steady"]))
         return last_metrics
+
+    # -- survivability (preemption / wedge / chaos hooks) ----------------------
+
+    def _install_preemption_handler(self):
+        """SIGTERM = preemption notice, not an order to die mid-step: set a
+        flag, emergency-save at the NEXT step boundary, then exit retryable.
+        (worker_main's default handler exits immediately, losing everything
+        since the last interval save.) Main-thread only — the signal module
+        contract; in-process harnesses (tests driving Trainer directly from
+        worker threads) simply keep the host's handler. Returns the previous
+        handler for the finally-restore, or None when not installed."""
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        try:
+            return signal.signal(signal.SIGTERM,
+                                 lambda *_: self._preempted.set())
+        except (ValueError, OSError) as exc:
+            logger.warning("preemption handler not installed: %s", exc)
+            return None
+
+    def _emergency_exit(self, step: int) -> None:
+        """A preemption landed: force-save the just-completed step to the
+        emergency tier, make it durable, record the ledger, and exit with
+        the retryable code so ``JAXJobController._handle_failures``
+        gang-restarts and resume finds this exact step — a graceful
+        preemption loses ZERO completed steps."""
+        mgr = self.ckpt_emergency or self.ckpt
+        saved = False
+        if mgr is not None:
+            saved = self.save(step, force=True, manager=mgr)
+            try:
+                mgr.wait()          # durable before we die, or it never was
+            except Exception:
+                logger.exception("emergency checkpoint wait failed")
+                saved = False
+        if self.ledger is not None:
+            self.ledger.record_progress(step)
+            if saved:
+                self.ledger.record_emergency_save(step)
+        logger.warning(
+            "preemption: emergency checkpoint at step %d (%s); exiting "
+            "retryable", step, "saved" if saved else "SAVE FAILED")
+        raise SystemExit(EXIT_PREEMPTED)
+
+    def _maybe_injected_wedge(self, step: int) -> None:
+        """Chaos hook: hang the loop at a configured step (a hung collective,
+        as far as any failure detector can tell) — the step-progress
+        watchdog is the component under test. ``wedge_once_file`` makes the
+        wedge fire on the first attempt only, so the gang restart that
+        follows can prove the resume."""
+        fi = self.cfg.fault_injection
+        if fi.get("wedge_at_step") != step:
+            return
+        once = fi.get("wedge_once_file")
+        if once:
+            if os.path.exists(once):
+                return
+            with open(once, "w") as f:
+                f.write(str(step))
+        logger.warning("fault injection: wedging at step %d", step)
+        while True:
+            time.sleep(0.25)
 
     def _trace_dir(self) -> str:
         import os

@@ -8,10 +8,10 @@ the acceptance criteria demand: each rule family must still catch its
 seeded regression — the PR-4 per-round ``jnp.asarray(self._table)``
 upload (D103), a dropped router lock acquisition (C301), a de-donated
 decode carry (S401), an exception-path page leak (R501), an inverted
-router lock pair (R503), a weak-type scalar riding into the dense decode
-dispatch (F602), and a fresh tuple in its static num_steps position
-(F604) — so a rule that silently stops firing fails the gate too, not
-just the test suite.
+router lock pair (R503), a fire-and-forget trainer checkpoint save
+(R504), a weak-type scalar riding into the dense decode dispatch (F602),
+and a fresh tuple in its static num_steps position (F604) — so a rule
+that silently stops firing fails the gate too, not just the test suite.
 
 Prints one JSON object; ``"lint_smoke": "ok"`` is the pass marker
 smoke.sh greps for. Findings render as ``file:line:col`` so they are
@@ -110,6 +110,16 @@ def _seeded_regressions() -> list[str]:
           "                pass\n\n"
           "    def note_activity(self) -> None:\n")],
         "R503", "lock-order inversion")
+    # Family R: a fire-and-forget checkpoint save on the training loop —
+    # the acceptance bool dropped, no exception handling (the exact
+    # Trainer.save bug ISSUE 9 fixed; a broken checkpoint store would
+    # vanish silently instead of raising the save-failure alarm).
+    new_findings(
+        "kubeflow_tpu/train/trainer.py",
+        ("        start = self.try_resume()\n",
+         "        start = self.try_resume()\n"
+         "        self.ckpt.save(0, self.task.state)\n"),
+        "R504", "self.ckpt.save")
     # Family F: a weak-typed Python scalar in the dense decode dispatch
     # (a fresh compile-cache entry per scalar source) — the cycle
     # KFTPU_SANITIZE=recompile would catch at runtime.

@@ -16,8 +16,9 @@ echo "== kftpu lint (static analysis vs committed baseline) =="
 # finding not in .kftpu-lint-baseline.json fails, and each rule family
 # must still catch its seeded regression (D103 re-upload, C301 dropped
 # lock, S401 de-donated carry, R501 exception-path page leak, R503 lock
-# inversion, F602 weak-type scalar into the decode dispatch, F604 fresh
-# tuple in its static position).
+# inversion, R504 fire-and-forget trainer checkpoint save, F602 weak-type
+# scalar into the decode dispatch, F604 fresh tuple in its static
+# position).
 timeout -k 10 120 python scripts/lint_smoke.py | tee /tmp/_smoke_lint.json
 lint_rc=${PIPESTATUS[0]}
 grep -q '"lint_smoke": "ok"' /tmp/_smoke_lint.json || lint_rc=1
@@ -85,6 +86,17 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 recompile_rc=${PIPESTATUS[0]}
 grep -q '"recompile_smoke": "ok"' /tmp/_smoke_recompile.json || recompile_rc=1
 
+echo "== train chaos smoke (preemption emergency save + verified fallback) =="
+# Survivable-training gate (ISSUE 9): a SIGTERMed trainer must emergency-
+# save and resume at that exact step (zero completed steps lost in the
+# goodput ledger), and a corrupted newest checkpoint must be quarantined
+# with resume falling back to an older valid step — job still Succeeded,
+# ledger (goodput/fallbacks/emergency saves) lifted onto job status.
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/train_chaos_smoke.py | tee /tmp/_smoke_train_chaos.json
+train_chaos_rc=${PIPESTATUS[0]}
+grep -q '"train_chaos_smoke": "ok"' /tmp/_smoke_train_chaos.json || train_chaos_rc=1
+
 echo "== autoscale smoke (QoS shed ordering + SLO autoscaler loop, CPU) =="
 # Closed-loop gate for the SLO-aware serving loop: a 2-class burst must
 # shed batch-first (interactive all-200), the signal-driven autoscaler
@@ -97,5 +109,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 autoscale_rc=${PIPESTATUS[0]}
 grep -q '"autoscale_smoke": "ok"' /tmp/_smoke_autoscale.json || autoscale_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc autoscale rc=$autoscale_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ]
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ]

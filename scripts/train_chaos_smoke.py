@@ -1,0 +1,197 @@
+"""Training chaos smoke stage for scripts/smoke.sh: survivable training
+proven on a real control plane in one compact run.
+
+Two scenarios against real worker processes (ISSUE 9 acceptance, the CI-fast
+slice of tests/test_train_chaos.py):
+
+1. **Preemption**: a 1-worker llm_pretrain job is SIGTERMed mid-run. The
+   trainer must emergency-save at the next step boundary, exit retryable,
+   gang-restart, and resume AT the emergency step — zero completed steps
+   lost (``steps_lost_total == 0`` in the goodput ledger).
+2. **Corruption**: the job is suspended, its newest checkpoint (either
+   tier) is truncated to garbage, and on resume the verified restore must
+   quarantine it and FALL BACK to an older valid step — the job still
+   reaches Succeeded with ``restore_fallbacks >= 1`` and the goodput
+   ledger lifted onto job status.
+
+Prints one JSON object; ``"train_chaos_smoke": "ok"`` is the pass marker
+smoke.sh greps for.
+
+    JAX_PLATFORMS=cpu python scripts/train_chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _train_job(name: str, *, steps: int, ckpt_every: int):
+    from kubeflow_tpu.core.jobs import (
+        JAXJob, JAXJobSpec, ReplicaSpec, RestartPolicy, TPUResourceSpec,
+        WorkloadSpec,
+    )
+    from kubeflow_tpu.core.object import ObjectMeta
+
+    j = JAXJob(
+        metadata=ObjectMeta(name=name),
+        spec=JAXJobSpec(replica_specs={"worker": ReplicaSpec(
+            replicas=1,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            template=WorkloadSpec(entrypoint="llm_pretrain", config={
+                "model": "tiny",
+                "model_overrides": {"n_layers": 2, "hidden": 128},
+                "steps": steps,
+                "log_every": 2,
+                "data": {"global_batch": 16, "seq_len": 128,
+                         "kind": "synthetic"},
+            }),
+            resources=TPUResourceSpec(tpu_chips=1),
+        )}),
+    )
+    j.spec.run_policy.checkpoint.enabled = True
+    j.spec.run_policy.checkpoint.interval_steps = ckpt_every
+    return j
+
+
+def _wait(cp, name, pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        cur = cp.get_job(name)
+        if cur is not None and pred(cur):
+            return cur
+        time.sleep(0.2)
+    raise AssertionError(f"{name}: timed out waiting for {what}")
+
+
+def _ledger(cp, name):
+    path = os.path.join(cp.config.base_dir, "default", name, "worker-0",
+                        "goodput.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _log(cp, name):
+    with open(os.path.join(cp.config.base_dir, "logs",
+                           f"default.{name}-worker-0.log")) as f:
+        return f.read()
+
+
+def main() -> int:
+    from kubeflow_tpu.core.store import ConflictError
+    from kubeflow_tpu.operator.control_plane import (
+        ControlPlane, ControlPlaneConfig,
+    )
+    from kubeflow_tpu.operator.faults import FaultInjector
+    from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+
+    base = tempfile.mkdtemp(prefix="kftpu-train-chaos-")
+    cp = ControlPlane(ControlPlaneConfig(
+        base_dir=base,
+        cluster=Cluster(slices=[SliceTopology(name="s0", generation="cpu",
+                                              dims=(2, 2))]),
+        platform="cpu", heartbeat_timeout=20.0, rendezvous_timeout=60.0))
+    cp.start()
+    inj = FaultInjector(cp)
+    checks: dict[str, object] = {}
+    failures: list[str] = []
+
+    def check(name: str, ok: bool, detail=None):
+        checks[name] = bool(ok) if detail is None else detail
+        if not ok:
+            failures.append(name)
+
+    try:
+        # -- scenario 1: SIGTERM -> emergency tier, zero steps lost ----------
+        job = cp.submit(_train_job("surv", steps=60, ckpt_every=20))
+        cp.wait_for(job, "Running", timeout=240)
+        _wait(cp, "surv", lambda j: j.status.metrics.step >= 4, 240,
+              "step >= 4")
+        inj.kill_worker("default/surv", index=0, sig=signal.SIGTERM)
+        done = cp.wait_for(job, "Succeeded", timeout=420)
+        led = _ledger(cp, "surv")
+        log = _log(cp, "surv")
+        m_save = re.search(
+            r"preemption: emergency checkpoint at step (\d+) \(saved\)", log)
+        m_res = re.search(
+            r"resumed from checkpoint at step (\d+) \(tier=emergency", log)
+        check("preempt_restarted", done.status.restart_count >= 1)
+        check("preempt_all_steps", done.status.metrics.step == 60)
+        check("preempt_emergency_saved", m_save is not None)
+        check("preempt_resumed_at_emergency_step",
+              bool(m_save and m_res
+                   and m_save.group(1) == m_res.group(1)))
+        check("preempt_zero_steps_lost", led["steps_lost_total"] == 0,
+              detail=led["steps_lost_total"])
+        check("preempt_goodput_on_status",
+              done.status.metrics.goodput is not None
+              and done.status.metrics.emergency_saves >= 1)
+
+        # -- scenario 2: corrupt latest -> verified fallback, job succeeds ---
+        job = cp.submit(_train_job("fallb", steps=80, ckpt_every=6))
+        cp.wait_for(job, "Running", timeout=240)
+        _wait(cp, "fallb",
+              lambda j: (j.status.metrics.last_checkpoint_step or 0) >= 12,
+              240, "two committed interval saves")
+        for _ in range(20):
+            fresh = cp.get_job("fallb")
+            fresh.spec.run_policy.suspend = True
+            try:
+                cp.store.update(fresh)
+                break
+            except ConflictError:
+                time.sleep(0.05)
+        cp.wait_for(job, "Suspended", timeout=120)
+        deadline = time.time() + 60
+        while cp.runtime.procman.alive() and time.time() < deadline:
+            time.sleep(0.1)     # teardown emergency save must land first
+        target = inj.corrupt_latest_checkpoint("default/fallb")
+        check("corrupt_target_found", target is not None, detail=target)
+        for _ in range(20):
+            fresh = cp.get_job("fallb")
+            fresh.spec.run_policy.suspend = False
+            try:
+                cp.store.update(fresh)
+                break
+            except ConflictError:
+                time.sleep(0.05)
+        done = cp.wait_for(job, "Succeeded", timeout=420)
+        led = _ledger(cp, "fallb")
+        log = _log(cp, "fallb")
+        m_res = re.search(
+            r"resumed from checkpoint at step (\d+) \(tier=\w+, "
+            r"fallbacks=(\d+)\)", log)
+        check("corrupt_all_steps", done.status.metrics.step == 80)
+        check("corrupt_fell_back",
+              bool(m_res and int(m_res.group(2)) >= 1))
+        check("corrupt_fallback_on_status",
+              (done.status.metrics.restore_fallbacks or 0) >= 1,
+              detail=done.status.metrics.restore_fallbacks)
+        check("corrupt_ledger_fallbacks", led["restore_fallbacks"] >= 1,
+              detail=led["restore_fallbacks"])
+        check("corrupt_quarantined",
+              target is not None and os.path.isdir(os.path.join(
+                  os.path.dirname(target), "quarantine")))
+    except Exception as exc:    # a hang/timeout is itself the failure
+        failures.append(f"exception: {type(exc).__name__}: {exc}")
+    finally:
+        cp.stop()
+
+    ok = not failures
+    print(json.dumps({
+        "train_chaos_smoke": "ok" if ok else "FAIL",
+        "checks": checks,
+        "failures": failures,
+    }, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

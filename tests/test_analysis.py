@@ -798,6 +798,73 @@ class TestLockOrderInversion:
         assert rules_of(src) == []
 
 
+class TestUnhandledCheckpointIO:
+    def test_bare_save_and_unguarded_restore_fire(self):
+        src = (
+            "def resume(ckpt, abstract):\n"
+            "    state = ckpt.restore(abstract)\n"
+            "    return state\n"
+            "class T:\n"
+            "    def save(self, step):\n"
+            "        self.ckpt.save(step, self.state)\n")
+        fs = lint_source(src, "kubeflow_tpu/train/fixture.py")
+        assert [f.rule for f in fs] == ["R504", "R504"]
+        assert "restore" in fs[0].message and "save" in fs[1].message
+
+    def test_try_handler_is_clean(self):
+        src = (
+            "def resume(ckpt, abstract):\n"
+            "    try:\n"
+            "        return ckpt.restore(abstract)\n"
+            "    except CheckpointCorruptionError:\n"
+            "        return None\n"
+            "class T:\n"
+            "    def save(self, step):\n"
+            "        try:\n"
+            "            self.ckpt.save(step, self.state)\n"
+            "        except OSError:\n"
+            "            self.failures += 1\n")
+        assert rules_of(src, "kubeflow_tpu/train/fixture.py") == []
+
+    def test_consumed_save_return_is_clean(self):
+        src = (
+            "class T:\n"
+            "    def save(self, step):\n"
+            "        accepted = self.ckpt.save(step, self.state)\n"
+            "        if not accepted:\n"
+            "            self.failures += 1\n")
+        assert rules_of(src, "kubeflow_tpu/train/fixture.py") == []
+
+    def test_non_checkpoint_receiver_ignored(self):
+        src = (
+            "def load(mgr, path):\n"
+            "    mgr.restore(path)\n"
+            "    store.save(path)\n")
+        assert rules_of(src, "kubeflow_tpu/serve/fixture.py") == []
+
+    def test_test_paths_exempt(self):
+        src = (
+            "def test_resume(ckpt, abstract):\n"
+            "    state = ckpt.restore(abstract)\n"
+            "    ckpt.save(1, state)\n")
+        assert [f.rule for f in lint_source(src, "tests/test_x.py")] == []
+
+    def test_suppression_comment(self):
+        src = (
+            "def resume(ckpt, abstract):\n"
+            "    return ckpt.restore(abstract)  # lint: disable=R504\n")
+        assert rules_of(src, "kubeflow_tpu/train/fixture.py") == []
+
+    def test_real_trainer_is_clean(self):
+        """The shipped Trainer handles both: try_resume walks tiers under
+        a fallback, save checks the acceptance bool inside try/except."""
+        relpath = "kubeflow_tpu/train/trainer.py"
+        with open(os.path.join(REPO, relpath)) as f:
+            fs = [x for x in lint_source(f.read(), relpath)
+                  if x.rule == "R504"]
+        assert fs == []
+
+
 # -- interprocedural core (one-level call-following) ---------------------------
 
 
@@ -1065,7 +1132,7 @@ class TestRegistry:
         assert {"D101", "D102", "D103", "D104", "D105",
                 "C301", "C302", "C303", "M201", "M202", "M203",
                 "S401", "S402", "S403", "S404", "S405",
-                "R501", "R502", "R503",
+                "R501", "R502", "R503", "R504",
                 "F601", "F602", "F603", "F604", "F605"} <= ids
 
     def test_parse_error_is_reported_not_raised(self, tmp_path):
@@ -1563,6 +1630,18 @@ class TestSeededRegressions:
         assert len(fresh) == 1
         f = fresh[0]
         assert f.rule == "R501" and "_ensure_pages" in f.message
+
+    def test_fire_and_forget_trainer_save_is_caught(self):
+        """A bare ``self.ckpt.save(...)`` dropped into the training loop
+        (the pre-ISSUE-9 Trainer.save shape) produces exactly one R504."""
+        fresh = _new_findings(
+            "kubeflow_tpu/train/trainer.py",
+            "        start = self.try_resume()\n",
+            "        start = self.try_resume()\n"
+            "        self.ckpt.save(0, self.task.state)\n")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "R504" and "self.ckpt.save" in f.message
 
     def test_injected_router_lock_inversion_is_caught(self):
         """A second router lock acquired in both orders produces exactly

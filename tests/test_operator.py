@@ -468,3 +468,55 @@ class TestThroughputFloor:
         j = cp.get_job("job")
         assert j.spec.worker.replicas == 3
         assert j.status.elastic_resizes == 0
+
+
+class TestRetryableExitContract:
+    """The exit-code contract (_is_retryable_exit) pinned: >=128 is a
+    signal/preemption/rendezvous death (retryable), None is a lost process
+    or heartbeat-stale kill (retryable infrastructure failure), anything
+    in [0,128) is the program's own verdict (permanent)."""
+
+    def test_contract_cases(self):
+        from kubeflow_tpu.operator.jaxjob_controller import _is_retryable_exit
+
+        assert _is_retryable_exit(128) is True    # EXIT_RETRYABLE boundary
+        assert _is_retryable_exit(137) is True    # SIGKILL
+        assert _is_retryable_exit(143) is True    # SIGTERM / preemption
+        assert _is_retryable_exit(255) is True
+        assert _is_retryable_exit(None) is True   # no exit code: lost process
+        assert _is_retryable_exit(0) is False     # success is not a retry
+        assert _is_retryable_exit(1) is False     # program bug
+        assert _is_retryable_exit(2) is False     # config error
+        assert _is_retryable_exit(127) is False   # last permanent code
+
+
+class TestSurvivabilityMetricsLift:
+    def test_goodput_ledger_fields_scraped_onto_status(self, cp, tmp_path):
+        """The survivability ledger rides metrics.jsonl onto JAXJob status
+        like every other data-plane metric (ISSUE 9: goodput as the honest
+        metric, visible where the SRE looks)."""
+        import json
+        import os
+
+        job = cp.submit(make_job())
+        cp.step()
+        w = workers_of(cp)[0]
+        workdir = w.spec.template.working_dir
+        os.makedirs(workdir, exist_ok=True)
+        line = {"step": 42, "loss": 2.5, "goodput": 0.83,
+                "steps_lost_total": 4, "emergency_saves": 1,
+                "restore_fallbacks": 2, "checkpoint_save_failures": 3,
+                "last_checkpoint_step": 40}
+        with open(os.path.join(workdir, "metrics.jsonl"), "w") as f:
+            f.write(json.dumps(line) + "\n")
+        for w in workers_of(cp):
+            set_phase(cp, w, WorkerPhase.RUNNING)
+        cp.step()
+        m = cp.get_job("job").status.metrics
+        assert m.step == 42
+        assert m.goodput == 0.83
+        assert m.steps_lost_total == 4
+        assert m.emergency_saves == 1
+        assert m.restore_fallbacks == 2
+        assert m.checkpoint_save_failures == 3
+        assert m.last_checkpoint_step == 40

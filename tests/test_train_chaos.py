@@ -1,0 +1,534 @@
+"""Training chaos suite (ISSUE 9): survivable training proven under faults.
+
+Fast tier (no processes): checkpoint manifest integrity, the verified
+multi-tier resume walk, the goodput ledger, and the step-progress watchdog
+as units.
+
+Slow tier (real worker processes on the emulated control plane): the
+scenarios the serving plane's chaos harness already answers for serving —
+SIGTERM mid-run resumes at the emergency step with zero completed steps
+lost, SIGKILL resumes from the last interval save, a corrupted latest
+checkpoint falls back to an older valid step and the job still succeeds,
+and a wedged step is caught by the watchdog long before the heartbeat
+lease (which a wedged-but-alive worker never misses) would."""
+
+import json
+import os
+import re
+import time
+
+import pytest
+
+from kubeflow_tpu.runtime.bootstrap import EXIT_RETRYABLE
+from kubeflow_tpu.train.survival import GoodputLedger, StepWatchdog
+
+# -- fast: manifests + verified restore ----------------------------------------
+
+
+def _abstract():
+    import jax
+    import jax.numpy as jnp
+
+    return {"step": jax.ShapeDtypeStruct((), jnp.int32),
+            "w": jax.ShapeDtypeStruct((16,), jnp.float32)}
+
+
+def _state(step: int):
+    import jax.numpy as jnp
+
+    return {"step": jnp.int32(step),
+            "w": jnp.arange(16, dtype=jnp.float32) * step}
+
+
+def _corrupt(directory: str, step: int) -> None:
+    root = os.path.join(directory, str(step))
+    for base, _, files in os.walk(root):
+        for fn in files:
+            with open(os.path.join(base, fn), "wb") as f:
+                f.write(b"\0corrupt\0")
+
+
+class TestCheckpointIntegrity:
+    def test_manifest_written_and_verifies(self, tmp_path):
+        from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+        m = CheckpointManager(str(tmp_path / "ckpt"), 3)
+        assert m.save(10, _state(10), force=True)
+        m.wait()
+        assert m.latest_committed_step() == 10
+        mpath = os.path.join(m.directory, "manifests", "10.json")
+        assert os.path.exists(mpath)
+        manifest = json.load(open(mpath))
+        assert manifest["step"] == 10 and manifest["files"]
+        assert all("sha256" in meta for meta in manifest["files"].values())
+        assert m.verify_step(10) is True
+        restored = m.restore(_abstract())
+        assert int(restored["step"]) == 10
+        m.close()
+
+    @pytest.mark.slow
+    def test_corrupt_step_raises_not_restores(self, tmp_path):
+        from kubeflow_tpu.train.checkpoint import (
+            CheckpointCorruptionError, CheckpointManager,
+        )
+
+        m = CheckpointManager(str(tmp_path / "ckpt"), 3)
+        m.save(10, _state(10), force=True)
+        m.wait()
+        _corrupt(m.directory, 10)
+        with pytest.raises(CheckpointCorruptionError):
+            m.restore(_abstract())
+        # a deleted file is caught as a file-set mismatch, not a checksum one
+        m.save(20, _state(20), force=True)
+        m.wait()
+        victim = next(
+            os.path.join(b, fs[0])
+            for b, _, fs in os.walk(os.path.join(m.directory, "20")) if fs)
+        os.remove(victim)
+        with pytest.raises(CheckpointCorruptionError, match="file set"):
+            m.verify_step(20)
+        m.close()
+
+    @pytest.mark.slow
+    def test_unmanifested_step_is_unverified_not_fatal(self, tmp_path):
+        from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+        m = CheckpointManager(str(tmp_path / "ckpt"), 3, write_manifests=False)
+        m.save(10, _state(10), force=True)
+        m.wait()
+        assert not os.path.exists(
+            os.path.join(m.directory, "manifests", "10.json"))
+        # legacy/pre-manifest checkpoint: restorable, reported unverified
+        assert m.verify_step(10) is False
+        assert int(m.restore(_abstract())["step"]) == 10
+        m.close()
+
+    def test_latest_committed_vs_latest_divergence(self, tmp_path):
+        """``latest_step`` is the manager's in-memory registration —
+        async saves appear there the moment save() returns, before their
+        bytes are durable. Model the in-flight window deterministically:
+        commit 10, register 20, then make 20's dir vanish the way a
+        teardown mid-commit leaves it. The two queries MUST diverge, and
+        only latest_committed_step tells the truth the elastic autoscaler
+        can act on."""
+        from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+        m = CheckpointManager(str(tmp_path / "ckpt"), 3)
+        m.save(10, _state(10), force=True)
+        m.save(20, _state(20), force=True)
+        m.wait()
+        os.rename(os.path.join(m.directory, "20"),
+                  os.path.join(m.directory, "20.orbax-checkpoint-tmp-0"))
+        assert m.latest_step() == 20            # registered in memory
+        assert m.latest_committed_step() == 10  # durable on disk
+        m.close()
+
+    @pytest.mark.slow
+    def test_resume_walk_falls_back_and_quarantines(self, tmp_path):
+        from kubeflow_tpu.train.checkpoint import (
+            CheckpointManager, resume_from_tiers,
+        )
+
+        m = CheckpointManager(str(tmp_path / "ckpt"), 3)
+        em = CheckpointManager(str(tmp_path / "em"), 1)
+        for s in (10, 20):
+            m.save(s, _state(s), force=True)
+        m.wait()
+        _corrupt(m.directory, 20)
+        out = resume_from_tiers([("emergency", em), ("interval", m)],
+                                _abstract())
+        assert out is not None
+        state, step, tier, fallbacks = out
+        assert (step, tier, fallbacks) == (10, "interval", 1)
+        assert int(state["step"]) == 10
+        # the bad step is out of the candidate set but kept for post-mortem
+        assert m.steps_on_disk() == [10]
+        assert os.path.isdir(os.path.join(m.directory, "quarantine", "20"))
+        m.close(); em.close()
+
+    @pytest.mark.slow
+    def test_resume_walk_prefers_newest_across_tiers(self, tmp_path):
+        from kubeflow_tpu.train.checkpoint import (
+            CheckpointManager, resume_from_tiers,
+        )
+
+        m = CheckpointManager(str(tmp_path / "ckpt"), 3)
+        em = CheckpointManager(str(tmp_path / "em"), 1)
+        m.save(10, _state(10), force=True)
+        em.save(14, _state(14), force=True)   # the post-preemption shape
+        m.wait(); em.wait()
+        _, step, tier, fb = resume_from_tiers(
+            [("emergency", em), ("interval", m)], _abstract())
+        assert (step, tier, fb) == (14, "emergency", 0)
+        # both tiers empty -> None (fresh start)
+        e1 = CheckpointManager(str(tmp_path / "e1"), 1)
+        e2 = CheckpointManager(str(tmp_path / "e2"), 1)
+        assert resume_from_tiers(
+            [("emergency", e1), ("interval", e2)], _abstract()) is None
+        for c in (m, em, e1, e2):
+            c.close()
+
+
+# -- fast: goodput ledger ------------------------------------------------------
+
+
+class TestGoodputLedger:
+    def test_restart_accounting(self, tmp_path):
+        led = GoodputLedger(str(tmp_path))
+        assert led.record_resume(0) == 0
+        led.record_progress(12)
+        # reload (a new attempt after a SIGKILL): 12 recorded, resumed at 8
+        led2 = GoodputLedger(str(tmp_path))
+        assert led2.record_resume(8) == 4
+        assert led2.data["attempts"] == 2
+        assert led2.data["steps_lost_total"] == 4
+        # graceful preemption path: emergency save means zero lost
+        led2.record_emergency_save(20)
+        led3 = GoodputLedger(str(tmp_path))
+        assert led3.record_resume(20) == 0
+        assert led3.data["steps_lost_total"] == 4
+        assert led3.data["emergency_saves"] == 1
+
+    def test_goodput_math(self, tmp_path):
+        led = GoodputLedger(str(tmp_path))
+        led.record_resume(0)
+        start = led.data["wall_start"]
+        # 100 steps at 0.1s each over 20s of wall time -> 0.5 goodput
+        assert led.goodput(100, 0.1, now=start + 20.0) == pytest.approx(0.5)
+        # capped at 1.0; None without a step time
+        assert led.goodput(1000, 0.1, now=start + 20.0) == 1.0
+        assert led.goodput(100, None) is None
+        m = led.metrics(100, 0.1)
+        assert {"attempts", "steps_lost_total", "emergency_saves",
+                "restore_fallbacks", "checkpoint_save_failures",
+                "goodput"} <= set(m)
+
+    def test_fallback_and_save_failure_counters(self, tmp_path):
+        led = GoodputLedger(str(tmp_path))
+        led.record_fallback(2)
+        led.record_save_failure()
+        led2 = GoodputLedger(str(tmp_path))
+        assert led2.data["restore_fallbacks"] == 2
+        assert led2.data["checkpoint_save_failures"] == 1
+
+
+# -- fast: step watchdog -------------------------------------------------------
+
+
+class TestStepWatchdog:
+    def test_fires_on_stall_with_stack_dump(self):
+        exits: list[int] = []
+        stalls: list[float] = []
+        wd = StepWatchdog(multiplier=2.0, min_seconds=0.2,
+                          startup_grace_seconds=0.2, poll_seconds=0.02,
+                          exit_fn=exits.append, on_stall=stalls.append)
+        wd.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not exits and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert exits == [EXIT_RETRYABLE]
+            assert wd.fired and stalls and stalls[0] >= 0.2
+        finally:
+            wd.stop()
+
+    def test_progress_keeps_it_quiet_then_stall_fires(self):
+        exits: list[int] = []
+        wd = StepWatchdog(multiplier=3.0, min_seconds=0.3,
+                          startup_grace_seconds=10.0, poll_seconds=0.02,
+                          exit_fn=exits.append)
+        wd.start()
+        try:
+            for step in range(1, 6):
+                time.sleep(0.05)
+                wd.step_completed(step)
+            assert not exits and not wd.fired
+            # threshold adapted to observed ~50ms steps, floored at 0.3s
+            assert wd.threshold() == pytest.approx(0.3)
+            deadline = time.monotonic() + 5.0
+            while not exits and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert exits == [EXIT_RETRYABLE]
+        finally:
+            wd.stop()
+
+    def test_stop_prevents_firing(self):
+        exits: list[int] = []
+        wd = StepWatchdog(min_seconds=0.1, startup_grace_seconds=0.1,
+                          poll_seconds=0.02, exit_fn=exits.append)
+        wd.start()
+        wd.stop()
+        time.sleep(0.3)
+        assert not exits and not wd.fired
+
+
+# -- slow: process-level chaos on the emulated control plane -------------------
+
+
+@pytest.fixture()
+def cp(tmp_path):
+    from kubeflow_tpu.operator.control_plane import (
+        ControlPlane, ControlPlaneConfig,
+    )
+    from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+
+    plane = ControlPlane(ControlPlaneConfig(
+        base_dir=str(tmp_path),
+        cluster=Cluster(slices=[SliceTopology(name="s0", generation="cpu",
+                                              dims=(2, 2))]),
+        platform="cpu",
+        heartbeat_timeout=20.0,
+        rendezvous_timeout=60.0,
+    ))
+    plane.start()
+    yield plane
+    plane.stop()
+
+
+def _train_job(name: str, *, steps: int, ckpt_every: int,
+               extra_config: dict = None, backoff: int = 3):
+    from kubeflow_tpu.core.jobs import (
+        JAXJob, JAXJobSpec, ReplicaSpec, RestartPolicy, TPUResourceSpec,
+        WorkloadSpec,
+    )
+    from kubeflow_tpu.core.object import ObjectMeta
+
+    config = {
+        "model": "tiny",
+        # big enough that a step costs real time (the chaos window), small
+        # enough that the suite stays minutes not hours
+        "model_overrides": {"n_layers": 2, "hidden": 128},
+        "steps": steps,
+        "log_every": 2,
+        "data": {"global_batch": 16, "seq_len": 128, "kind": "synthetic"},
+        **(extra_config or {}),
+    }
+    j = JAXJob(
+        metadata=ObjectMeta(name=name),
+        spec=JAXJobSpec(
+            replica_specs={"worker": ReplicaSpec(
+                replicas=1,
+                restart_policy=RestartPolicy.EXIT_CODE,
+                template=WorkloadSpec(entrypoint="llm_pretrain", config=config),
+                resources=TPUResourceSpec(tpu_chips=1),
+            )},
+        ),
+    )
+    j.spec.run_policy.backoff_limit = backoff
+    j.spec.run_policy.checkpoint.enabled = True
+    j.spec.run_policy.checkpoint.interval_steps = ckpt_every
+    return j
+
+
+def _wait_step(cp, name: str, step: int, timeout: float = 300.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        cur = cp.get_job(name)
+        if cur is not None and cur.status.metrics.step >= step:
+            return cur
+        time.sleep(0.2)
+    raise AssertionError(f"{name}: never reached step {step}")
+
+
+def _ledger(cp, name: str) -> dict:
+    path = os.path.join(cp.config.base_dir, "default", name, "worker-0",
+                        "goodput.json")
+    return json.load(open(path))
+
+
+def _worker_log(cp, name: str) -> str:
+    path = os.path.join(cp.config.base_dir, "logs",
+                        f"default.{name}-worker-0.log")
+    return open(path).read()
+
+
+@pytest.mark.slow
+def test_chaos_sigterm_resumes_at_emergency_step(cp):
+    """A graceful preemption (SIGTERM with unbounded grace) loses ZERO
+    completed steps: the trainer force-saves to the emergency tier at the
+    next step boundary, exits retryable, the controller gang-restarts, and
+    resume picks the emergency step — not the interval save up to
+    checkpoint_every older."""
+    import signal
+
+    from kubeflow_tpu.operator.faults import FaultInjector
+
+    job = cp.submit(_train_job("surv", steps=60, ckpt_every=20))
+    cp.wait_for(job, "Running", timeout=240)
+    _wait_step(cp, "surv", 4)
+    inj = FaultInjector(cp)
+    assert inj.kill_worker("default/surv", index=0, sig=signal.SIGTERM)
+    done = cp.wait_for(job, "Succeeded", timeout=420)
+    assert done.status.restart_count >= 1, "SIGTERM did not gang-restart"
+    assert done.status.metrics.step == 60
+
+    log = _worker_log(cp, "surv")
+    m = re.search(r"preemption: emergency checkpoint at step (\d+) \(saved\)",
+                  log)
+    assert m, "no emergency save in worker log"
+    saved_at = int(m.group(1))
+    m = re.search(r"resumed from checkpoint at step (\d+) \(tier=emergency",
+                  log)
+    assert m, "resume did not come from the emergency tier"
+    assert int(m.group(1)) == saved_at
+
+    led = _ledger(cp, "surv")
+    assert led["emergency_saves"] >= 1
+    assert led["steps_lost_total"] == 0, led
+    # the whole ledger rode metrics.jsonl onto job status
+    assert done.status.metrics.emergency_saves >= 1
+    assert done.status.metrics.steps_lost_total == 0
+    assert done.status.metrics.goodput is not None
+    assert 0.0 < done.status.metrics.goodput <= 1.0
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_resumes_from_interval_save(cp):
+    """SIGKILL gives no grace: the emergency tier stays empty and resume
+    comes from the last committed interval save — losing at most
+    checkpoint_every steps, all of them accounted in the ledger."""
+    from kubeflow_tpu.operator.faults import FaultInjector
+
+    job = cp.submit(_train_job("hardk", steps=60, ckpt_every=8))
+    cp.wait_for(job, "Running", timeout=240)
+    _wait_step(cp, "hardk", 10)   # >= one committed interval save
+    inj = FaultInjector(cp)
+    assert inj.kill_worker("default/hardk", index=0)   # SIGKILL
+    done = cp.wait_for(job, "Succeeded", timeout=420)
+    assert done.status.restart_count >= 1
+    assert done.status.metrics.step == 60
+
+    log = _worker_log(cp, "hardk")
+    m = re.search(r"resumed from checkpoint at step (\d+) \(tier=interval",
+                  log)
+    assert m, "resume did not come from the interval tier"
+    assert int(m.group(1)) % 8 == 0 and int(m.group(1)) > 0
+    led = _ledger(cp, "hardk")
+    assert led["emergency_saves"] == 0
+    assert led["attempts"] >= 2
+    assert done.status.metrics.goodput is not None
+
+
+@pytest.mark.slow
+def test_chaos_corrupt_latest_falls_back_and_succeeds(cp):
+    """FaultInjector.corrupt_latest_checkpoint's reason to exist: the
+    newest checkpoint is torn to garbage while the job is stopped; resume
+    must verify, quarantine, FALL BACK to an older valid step, surface the
+    fallback as a metric — and the job must still reach Succeeded."""
+    from kubeflow_tpu.core.store import ConflictError
+    from kubeflow_tpu.operator.faults import FaultInjector
+
+    job = cp.submit(_train_job("fallb", steps=80, ckpt_every=6))
+    cp.wait_for(job, "Running", timeout=240)
+    # Two committed interval saves before suspending: even if the teardown
+    # emergency save loses the grace race, corrupting the newest still
+    # leaves an older VALID step to fall back to.
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        cur = cp.get_job("fallb")
+        if (cur.status.metrics.last_checkpoint_step or 0) >= 12:
+            break
+        time.sleep(0.2)
+    assert (cur.status.metrics.last_checkpoint_step or 0) >= 12
+
+    def _set_suspend(value: bool):
+        for _ in range(20):
+            fresh = cp.get_job("fallb")
+            fresh.spec.run_policy.suspend = value
+            try:
+                cp.store.update(fresh)
+                return
+            except ConflictError:
+                time.sleep(0.05)
+        raise AssertionError("could not update suspend")
+
+    # Deterministic corruption window: suspend stops the gang (the trainer
+    # emergency-saves on the teardown SIGTERM), then the newest step —
+    # whichever tier holds it — is corrupted before resume.
+    _set_suspend(True)
+    cp.wait_for(job, "Suspended", timeout=120)
+    # The Suspended condition lands when the Worker OBJECT is deleted; the
+    # process drains asynchronously (teardown SIGTERM -> emergency save ->
+    # exit). Corrupting before that save commits would miss the newest
+    # step, so wait for the process to be gone.
+    deadline = time.time() + 60
+    while cp.runtime.procman.alive() and time.time() < deadline:
+        time.sleep(0.1)
+    assert not cp.runtime.procman.alive(), "worker never drained"
+    inj = FaultInjector(cp)
+    target = inj.corrupt_latest_checkpoint("default/fallb")
+    assert target is not None
+    _set_suspend(False)
+
+    done = cp.wait_for(job, "Succeeded", timeout=420)
+    assert done.status.metrics.step == 80
+    log = _worker_log(cp, "fallb")
+    m = re.search(r"resumed from checkpoint at step (\d+) \(tier=\w+, "
+                  r"fallbacks=(\d+)\)", log)
+    assert m and int(m.group(2)) >= 1, "no fallback recorded in resume"
+    led = _ledger(cp, "fallb")
+    assert led["restore_fallbacks"] >= 1
+    assert done.status.metrics.restore_fallbacks >= 1
+    # the corrupted step was quarantined for post-mortem, not deleted
+    qroot = os.path.dirname(target)
+    assert os.path.isdir(os.path.join(qroot, "quarantine"))
+
+
+@pytest.mark.slow
+def test_chaos_wedged_step_caught_by_watchdog(cp):
+    """A wedged step (hung collective) never misses a heartbeat — the
+    beat thread is alive — so the lease detector would wait forever. The
+    in-trainer watchdog must catch it within a multiple of the observed
+    step time, dump stacks, and exit retryable; the gang restart then
+    resumes and finishes."""
+    once = os.path.join(cp.config.base_dir, "wedge-once")
+    job = cp.submit(_train_job(
+        "wedge", steps=24, ckpt_every=6,
+        extra_config={
+            "fault_injection": {"wedge_at_step": 8, "wedge_once_file": once},
+            "watchdog_multiplier": 3.0,
+            "watchdog_min_seconds": 2.0,
+            "watchdog_startup_grace_seconds": 120.0,
+        }))
+    done = cp.wait_for(job, "Succeeded", timeout=420)
+    assert done.status.restart_count >= 1, "watchdog never fired"
+    assert done.status.metrics.step == 24
+    log = _worker_log(cp, "wedge")
+    assert "fault injection: wedging at step 8" in log
+    assert "watchdog: no step progress" in log
+    assert "step-watchdog" in log or "--- thread" in log  # stack dump present
+
+    # Detection latency: wedge -> watchdog fire, from the worker log's own
+    # timestamps. Must beat the 20s heartbeat lease by a wide margin (the
+    # lease would in fact NEVER fire here — the heartbeat thread still
+    # beats — which is exactly why the watchdog exists).
+    def _ts(pattern):
+        m = re.search(r"^(\S+ \S+) .*" + pattern, log, re.M)
+        assert m, pattern
+        from datetime import datetime
+
+        return datetime.strptime(m.group(1), "%Y-%m-%d %H:%M:%S,%f")
+
+    wedged = _ts(r"fault injection: wedging")
+    fired = _ts(r"watchdog: no step progress")
+    latency = (fired - wedged).total_seconds()
+    assert 0 <= latency < cp.config.heartbeat_timeout, latency
+
+
+@pytest.mark.slow
+def test_chaos_save_failure_training_continues(cp):
+    """A checkpoint-store failure mid-run must not kill training: the save
+    is logged + counted (checkpoint_save_failures on job status — the
+    alarm someone pages on), the loop keeps stepping, and the job
+    finishes."""
+    job = cp.submit(_train_job(
+        "savef", steps=24, ckpt_every=6,
+        extra_config={"fault_injection": {"save_fail_steps": [6, 12]}}))
+    done = cp.wait_for(job, "Succeeded", timeout=420)
+    assert done.status.restart_count == 0       # a failed save is NOT fatal
+    assert done.status.metrics.step == 24
+    assert done.status.metrics.checkpoint_save_failures == 2
+    led = _ledger(cp, "savef")
+    assert led["checkpoint_save_failures"] == 2
+    log = _worker_log(cp, "savef")
+    assert "checkpoint save at step 6 failed" in log
