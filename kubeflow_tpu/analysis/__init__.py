@@ -7,14 +7,18 @@ call graph + resource-pairing primitives, ``--changed``),
 ``rules_metrics.py`` for the metric-name rules (M2xx),
 ``rules_sharding.py`` for the sharding/SPMD family (S4xx),
 ``rules_resources.py`` for the resource-pairing / lock-order family
-(R5xx), and ``rules_compile.py`` for the compilation-stability family
-(F6xx, built on the whole-program ``Program`` call graph). The runtime
-cross-checks (``KFTPU_SANITIZE=refcount|lockorder|recompile``) live in
+(R5xx), ``rules_compile.py`` for the compilation-stability family
+(F6xx, built on the whole-program ``Program`` call graph), and
+``rules_contracts.py`` for the cross-component name-contract family
+(X7xx: metric series produced vs consumed, ``X-Kftpu-*`` headers set vs
+read, ``KFTPU_*`` env vars, status fields — ``--contracts-json`` dumps
+the extracted table). The runtime cross-checks (``KFTPU_SANITIZE=
+refcount|lockorder|recompile|contract``) live in
 ``kubeflow_tpu/runtime/sanitize.py``.
 """
 
 from kubeflow_tpu.analysis.core import (  # noqa: F401
     Baseline, Finding, JitFact, LintResult, Module, Program, Rule,
-    all_rules, canonical_mesh_axes, changed_files, find_baseline,
-    jit_table, lint_source, lint_sources, main, run_lint,
+    all_rules, build_program, canonical_mesh_axes, changed_files,
+    find_baseline, jit_table, lint_source, lint_sources, main, run_lint,
 )

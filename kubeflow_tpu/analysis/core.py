@@ -38,6 +38,12 @@ directly above):
   call site's dispatch-signature instability is intentional (a cold path
   where the retrace is cheaper than padding); closes the F6xx
   compilation-stability rules on that line.
+- ``# contract: <reason>`` — on a name-exchange site (metric series
+  reference, header set/read, ``KFTPU_*`` env access, status-field
+  read): this name is INTENTIONALLY one-sided — a user-facing knob
+  nothing in the tree sets, a value exported for code outside the lint
+  scan — and the X7xx cross-component contract rules accept it with the
+  stated reason on record.
 - ``# lint: disable=D101[,C301...]`` — suppress specific rules on this
   line.
 
@@ -121,6 +127,7 @@ _ANNOT_RES = {
     "sync_point": re.compile(r"#\s*sync-point:\s*(\S.*)"),
     "mesh_context": re.compile(r"#\s*mesh-context:\s*(\S.*)"),
     "retrace_ok": re.compile(r"#\s*retrace-ok:\s*(\S.*)"),
+    "contract": re.compile(r"#\s*contract:\s*(\S.*)"),
 }
 _DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)")
 
@@ -547,6 +554,16 @@ class Program:
                 self.by_name[dotted] = m
             m.program = self
         self._jit_by_qual: Optional[dict[str, JitFact]] = None
+        self._memo: dict = {}
+
+    def memo(self, key: str, build):
+        """Per-program computed-structure cache (the X-family contract
+        table): whole-program aggregates are derived once per lint run
+        and shared by every rule that needs them — the per-module
+        ``Module.memo`` contract lifted to the Program."""
+        if key not in self._memo:
+            self._memo[key] = build(self)
+        return self._memo[key]
 
     # -- name resolution ---------------------------------------------------
 
@@ -784,8 +801,8 @@ def _load_rules() -> None:
         return
     _loaded = True
     from kubeflow_tpu.analysis import (  # noqa: F401  (registration import)
-        rules_compile, rules_concurrency, rules_device, rules_metrics,
-        rules_resources, rules_sharding,
+        rules_compile, rules_concurrency, rules_contracts, rules_device,
+        rules_metrics, rules_resources, rules_sharding,
     )
 
 
@@ -936,9 +953,45 @@ _PARSE_ERROR = _ParseError()
 def _package_context(root: str) -> list[str]:
     """Files the whole-program resolver should see even when only a
     subset is being linted (the ``--changed`` pre-commit path): the main
-    package under ``root``."""
+    package under ``root`` plus the smoke/bench drivers. The drivers
+    matter to the X-family contract rules — they are the in-scan
+    CONSUMERS of several metric series and the writers of sanitizer env
+    vars, so a changed-file lint without them would misread two-sided
+    names as orphans."""
+    out: list[str] = []
     pkg = os.path.join(root, "kubeflow_tpu")
-    return iter_py_files([pkg]) if os.path.isdir(pkg) else []
+    if os.path.isdir(pkg):
+        out.extend(iter_py_files([pkg]))
+    scripts = os.path.join(root, "scripts")
+    if os.path.isdir(scripts):
+        out.extend(iter_py_files([scripts]))
+    for name in ("bench.py", "bench_serve.py"):
+        path = os.path.join(root, name)
+        if os.path.isfile(path):
+            out.append(path)
+    return out
+
+
+def build_program(paths: list[str], root: Optional[str] = None) -> Program:
+    """Parse ``paths`` plus the package-wide resolution context into one
+    ``Program`` WITHOUT linting — the entry scripts and tests use to
+    reach whole-program tables (the X-family contract extractor, jit
+    facts) directly. Unparseable files are skipped; their own lint run
+    reports them."""
+    root = os.path.abspath(root or os.getcwd())
+    mods: list[Module] = []
+    seen: set[str] = set()
+    for path in iter_py_files(paths) + _package_context(root):
+        apath = os.path.abspath(path)
+        if apath in seen:
+            continue
+        seen.add(apath)
+        rel = os.path.relpath(apath, root)
+        try:
+            mods.append(load_module(path, rel))
+        except (OSError, SyntaxError, ValueError, UnicodeDecodeError):
+            continue
+    return Program(mods)
 
 
 def run_lint(paths: list[str], baseline: Optional[Baseline] = None,
@@ -1016,6 +1069,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "HEAD: the working tree — the fast pre-commit "
                         "path); includes untracked files")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--contracts-json", action="store_true",
+                   dest="contracts_json",
+                   help="dump the statically-extracted cross-component "
+                        "contract table (metric series produced/consumed, "
+                        "X-Kftpu-* headers set/read, KFTPU_* env vars, "
+                        "status fields) as JSON and exit — the manifest "
+                        "the KFTPU_SANITIZE=contract runtime auditor "
+                        "diffs against")
     return p
 
 
@@ -1094,6 +1155,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         if not paths:
             print(f"0 files changed vs {args.changed}; nothing to lint")
             return 0
+    if args.contracts_json:
+        from kubeflow_tpu.analysis import rules_contracts
+
+        program = build_program(paths)
+        print(json.dumps(rules_contracts.contract_manifest(program),
+                         indent=2, sort_keys=True))
+        return 0
     baseline: Optional[Baseline] = None
     baseline_path = args.baseline
     if not args.no_baseline and not args.update_baseline:

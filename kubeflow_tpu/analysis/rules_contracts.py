@@ -1,0 +1,776 @@
+"""Family X — cross-component name-contract rules (ISSUE 10 tentpole).
+
+The platform's control loops are wired together by *names*: the SLO
+autoscaler scrapes literal series names the engine emits through
+``obs/registry``; QoS/deadline/trace semantics ride ``X-Kftpu-*``
+headers; gang rendezvous rides ``KFTPU_*`` env vars produced in
+``runtime/bootstrap`` and consumed in ``worker_main``; the goodput
+ledger's JSON fields are lifted onto job status by literal key. A rename
+on either side of any of those pairs breaks nothing at import time and
+no single-file rule can see it — the consumer just reads ``None``
+forever and the control loop silently HOLDs. These rules extract a
+whole-program **contract table** from the PR-8 ``Program`` and check
+both sides of every pair:
+
+- X701 ``consumed-series-never-produced``: a literal metric-series name
+  compared against ``parse_exposition`` output (or listed in a scrape
+  set) that no registry definition site produces — the renamed-producer
+  half of autoscaler blindness. Producers include the M-rule f-string
+  loop expansion and dynamic f-string heads (prefix match).
+- X702 ``produced-series-unconsumed-undocumented``: an exact series name
+  registered somewhere but neither consumed in the scan set nor listed
+  in the README metric catalog — dead telemetry, or the renamed-consumer
+  half of the same drift.
+- X703 ``header-contract-drift``: an ``X-Kftpu-*`` header read that
+  nothing sets (typo/stale consumer), set that nothing reads, spelled
+  with drifting case across sites, or exchanged on the serving path but
+  missing from the middlebox forward-list (``core/headers.
+  FORWARD_HEADERS`` — a proxy that drops it silently breaks deadlines/
+  QoS/tracing through it).
+- X704 ``orphan-env-var``: a ``KFTPU_*`` env var read that nothing
+  writes into a child environment (or ``os.environ``), or written but
+  never read — the rendezvous-boundary rename.
+- X705 ``status-field-drift``: a JSON field name read off a parsed
+  record (``m = json.loads(...)`` then ``m.get("field")``, including the
+  literal-tuple loop idiom) that no writer produces as a dict key — the
+  metrics.jsonl → job-status scrape boundary.
+
+Extraction is tuned to how THIS codebase spells each exchange (the
+analyzer's standing philosophy): series consumption is a
+``kftpu_``-literal inside a comparison or literal container; header and
+env names resolve through module-level string constants across modules
+(``from kubeflow_tpu.core.headers import QOS_HEADER`` carries the
+spelling to every use site), so centralized constants keep working while
+re-typed literals are checked letter by letter. Histogram families match
+their ``_bucket``/``_sum``/``_count`` fan-out.
+
+Escape: ``# contract: <reason>`` on the site line (or the line above)
+marks a name as intentionally one-sided — a user-facing knob nothing in
+the tree sets, a value exported for consumers outside the lint scan —
+with the reason on record. ``# lint: disable=X70x`` suppresses a single
+rule.
+
+``contract_manifest(program)`` serializes the whole table — the
+``kftpu lint --contracts-json`` document the runtime contract auditor
+(``KFTPU_SANITIZE=contract``, runtime/sanitize.py) diffs its observed
+exchanges against.
+
+With no ``Program`` attached (bare ``lint_source`` fixtures) the X-rules
+stay SILENT rather than degrade: a cross-component judgment made from
+one module alone would flag every one-module view of a two-module
+contract. Fixtures exercise the family through ``lint_sources``, which
+wires a ``Program`` even for a single module.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from kubeflow_tpu.analysis.core import Module, Program, Rule, register
+from kubeflow_tpu.analysis.rules_metrics import _literal_names
+
+_SERIES_RE = re.compile(r"^kftpu_[a-z0-9_:]+$")
+_HEADER_RE = re.compile(r"^X-Kftpu-[A-Za-z0-9-]+$", re.IGNORECASE)
+_ENV_RE = re.compile(r"^KFTPU_[A-Z0-9_]+$")
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_REG_CLASSES = {
+    "kubeflow_tpu.obs.registry.Counter",
+    "kubeflow_tpu.obs.registry.Gauge",
+    "kubeflow_tpu.obs.registry.Histogram",
+}
+_HEADER_SET_METHODS = {"add_header", "putheader", "send_header"}
+_CONSUME_CONTEXTS = (ast.Compare, ast.List, ast.Tuple, ast.Set)
+
+
+def series_base(name: str) -> str:
+    for suffix in HIST_SUFFIXES:
+        if name.endswith(suffix):
+            return name[:-len(suffix)]
+    return name
+
+
+# -- module-level string constants ---------------------------------------------
+
+
+def _str_consts(mod: Module) -> dict:
+    """Module-level ``NAME = "literal"`` (and literal-tuple) assignments,
+    in definition order so a tuple of earlier constants resolves
+    (``FORWARD_HEADERS = (DEADLINE_HEADER, ...)``). Values are ``str`` or
+    ``tuple[str, ...]``."""
+    return mod.memo("xcontract_consts", _build_str_consts)
+
+
+def _build_str_consts(mod: Module) -> dict:
+    out: dict = {}
+    for stmt in mod.tree.body:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target] if isinstance(stmt, ast.AnnAssign) else []
+        value = getattr(stmt, "value", None)
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names or value is None:
+            continue
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            for n in names:
+                out[n] = value.value
+        elif isinstance(value, (ast.Tuple, ast.List)):
+            elems = []
+            for e in value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    elems.append(e.value)
+                elif isinstance(e, ast.Name) and isinstance(
+                        out.get(e.id), str):
+                    elems.append(out[e.id])
+                else:
+                    elems = None
+                    break
+            if elems is not None:
+                for n in names:
+                    out[n] = tuple(elems)
+    return out
+
+
+def _unwrap_case_call(node: ast.AST) -> ast.AST:
+    """``QOS_HEADER.lower()`` → the ``QOS_HEADER`` Name (the gRPC
+    metadata spelling transport; the contract name is the constant's)."""
+    if isinstance(node, ast.Call) and not node.args and not node.keywords \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("lower", "upper", "title"):
+        return node.func.value
+    return node
+
+
+def _resolve_str(mod: Module, node: ast.AST):
+    """(value, pending_qualname): a literal resolves immediately; a Name
+    bound to a same-module constant resolves immediately; a Name imported
+    from elsewhere resolves at aggregation time through the Program
+    (returned as a pending dotted qualname)."""
+    node = _unwrap_case_call(node)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, None
+    if isinstance(node, ast.Name):
+        local = _str_consts(mod).get(node.id)
+        if isinstance(local, str):
+            return local, None
+    qn = mod.qualname(node)
+    if qn is not None and "." in qn:
+        return None, qn
+    return None, None
+
+
+def _resolve_pending(program: Optional[Program], qual: str) -> Optional[str]:
+    if program is None:
+        return None
+    parts = qual.split(".")
+    for cut in range(len(parts) - 1, 0, -1):
+        m2 = program.by_name.get(".".join(parts[:cut]))
+        if m2 is not None:
+            got = _str_consts(m2).get(".".join(parts[cut:]))
+            return got if isinstance(got, str) else None
+    return None
+
+
+# -- per-module extraction -----------------------------------------------------
+
+
+def _extract(mod: Module) -> dict:
+    """All name-exchange sites one module contains, program-independent
+    (cross-module constant references stay symbolic until aggregation).
+    Cached on the module."""
+    return mod.memo("xcontract_extract", _build_extract)
+
+
+def _is_definition_site(mod: Module, call: ast.Call) -> bool:
+    if not isinstance(call, ast.Call) or not call.args:
+        return False
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in _REG_METHODS:
+        return True
+    return mod.qualname(call.func) in _REG_CLASSES
+
+
+def _in_consume_context(node: ast.AST) -> bool:
+    """A series literal counts as CONSUMED when it sits in a comparison
+    or a literal container (scrape sets, match chains) — not when it is
+    a bare assignment value, a call argument (ContextVar names, log
+    strings), or a dict key."""
+    cur = getattr(node, "_parent", None)
+    while cur is not None and not isinstance(cur, ast.stmt):
+        if isinstance(cur, _CONSUME_CONTEXTS):
+            return True
+        if isinstance(cur, (ast.Call, ast.Dict, ast.JoinedStr)):
+            return False
+        cur = getattr(cur, "_parent", None)
+    return False
+
+
+def _loop_fills(fn: Optional[ast.AST], var: str,
+                node: ast.AST) -> Optional[list[str]]:
+    """Literal values ``var`` takes in an enclosing ``for var in ("a",
+    ...)`` loop inside ``fn`` (the ``for field in (...): m.get(field)``
+    consumption idiom), else None."""
+    cur = getattr(node, "_parent", None)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, ast.For) and isinstance(cur.target, ast.Name) \
+                and cur.target.id == var \
+                and isinstance(cur.iter, (ast.Tuple, ast.List, ast.Set)):
+            vals = [e.value for e in cur.iter.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            if len(vals) == len(cur.iter.elts):
+                return vals
+        cur = getattr(cur, "_parent", None)
+    return None
+
+
+def _build_extract(mod: Module) -> dict:
+    out = {
+        "series_produced": [],    # (name, node, exact, is_hist)
+        "series_prefix": [],      # (prefix, node) — dynamic f-string heads
+        "series_consumed": [],    # (name, node)
+        "headers_set": [],        # (spelling, node) | pending
+        "headers_read": [],       # (spelling, node) | pending
+        "headers_pending": [],    # (qualname, direction, node)
+        "forward_list": None,     # (names, node)
+        "env_set": [],            # (name, node) | via constants
+        "env_read": [],
+        "env_pending": [],        # (qualname, direction, node)
+        "fields_produced": set(),
+        "fields_consumed": [],    # (name, node)
+    }
+
+    # Metric series: definition sites (with the M-rule loop expansion)...
+    for node in mod.walk(ast.Call):
+        if not _is_definition_site(mod, node):
+            continue
+        is_hist = (isinstance(node.func, ast.Attribute)
+                   and node.func.attr == "histogram") \
+            or (mod.qualname(node.func) or "").endswith("Histogram")
+        for name, exact in _literal_names(node.args[0]):
+            if not name.startswith("kftpu_"):
+                continue      # a bad prefix is M201's finding, not X's
+            if exact:
+                out["series_produced"].append((name, node, True, is_hist))
+            else:
+                out["series_prefix"].append((name, node))
+
+    # ...and consumption sites: kftpu_ literals in comparisons/containers.
+    for node in mod.walk(ast.Constant):
+        if not isinstance(node.value, str) \
+                or not _SERIES_RE.match(node.value):
+            continue
+        parent = getattr(node, "_parent", None)
+        if isinstance(parent, ast.Call) and _is_definition_site(mod, parent) \
+                and parent.args and parent.args[0] is node:
+            continue
+        if _in_consume_context(node):
+            out["series_consumed"].append((node.value, node))
+
+    def note_header(node: ast.AST, direction: str) -> None:
+        value, pending = _resolve_str(mod, node)
+        if value is not None and _HEADER_RE.match(value):
+            out[f"headers_{direction}"].append((value, node))
+        elif pending is not None:
+            out["headers_pending"].append((pending, direction, node))
+
+    def note_env(node: ast.AST, direction: str) -> None:
+        value, pending = _resolve_str(mod, node)
+        if value is not None and _ENV_RE.match(value):
+            out[f"env_{direction}"].append((value, node))
+        elif pending is not None:
+            out["env_pending"].append((pending, direction, node))
+
+    for node in mod.walk(ast.Call):
+        if not isinstance(node.func, ast.Attribute) or not node.args:
+            continue
+        if node.func.attr in _HEADER_SET_METHODS:
+            note_header(node.args[0], "set")
+        elif node.func.attr == "get":
+            note_header(node.args[0], "read")
+            note_env(node.args[0], "read")
+        elif node.func.attr in ("setdefault", "pop"):
+            note_env(node.args[0],
+                     "set" if node.func.attr == "setdefault" else "read")
+
+    for node in mod.walk(ast.Subscript):
+        direction = "set" if isinstance(node.ctx, ast.Store) else "read"
+        note_header(node.slice, direction)
+        note_env(node.slice, direction)
+
+    for node in mod.walk(ast.Dict):
+        for key in node.keys:
+            if key is None:
+                continue
+            note_header(key, "set")
+            note_env(key, "set")
+
+    # The middlebox forward-list: a module-level *_FORWARD*_ tuple of
+    # header names (core/headers.FORWARD_HEADERS).
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and "FORWARD" in stmt.targets[0].id:
+            got = _str_consts(mod).get(stmt.targets[0].id)
+            if isinstance(got, tuple) and got \
+                    and all(_HEADER_RE.match(h) for h in got):
+                out["forward_list"] = (got, stmt)
+
+    # Status fields: produced = literal dict keys and literal-key
+    # subscript stores anywhere; consumed = .get()/[] on a variable
+    # assigned from json.loads, in the same function.
+    for node in mod.walk(ast.Dict):
+        for key in node.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                out["fields_produced"].add(key.value)
+    for node in mod.walk(ast.Subscript):
+        if isinstance(node.ctx, ast.Store) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            out["fields_produced"].add(node.slice.value)
+
+    for fn in mod.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+        json_vars = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call) \
+                    and mod.qualname(sub.value.func) == "json.loads":
+                json_vars.add(sub.targets[0].id)
+        if not json_vars:
+            continue
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call) or not sub.args \
+                    or not isinstance(sub.func, ast.Attribute) \
+                    or sub.func.attr != "get" \
+                    or not isinstance(sub.func.value, ast.Name) \
+                    or sub.func.value.id not in json_vars:
+                continue
+            arg = sub.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out["fields_consumed"].append((arg.value, sub))
+            elif isinstance(arg, ast.Name):
+                for fill in _loop_fills(fn, arg.id, sub) or ():
+                    out["fields_consumed"].append((fill, sub))
+    return out
+
+
+# -- whole-program aggregation -------------------------------------------------
+
+
+def _table(mod: Module) -> dict:
+    """The aggregated contract table the rules read: program-wide when a
+    ``Program`` is attached, module-local otherwise."""
+    if mod.program is not None:
+        return mod.program.memo("xcontract_table",
+                                lambda p: _aggregate(p.modules, p))
+    return _aggregate([mod], None)
+
+
+def _aggregate(modules: Iterable[Module], program: Optional[Program]) -> dict:
+    t = {
+        "series_produced": {},    # name -> [(mod, node, is_hist)]
+        "series_hist": set(),
+        "series_prefix": [],      # (prefix, mod, node)
+        "series_consumed": {},    # name -> [(mod, node)]
+        "headers_set": {},        # lower -> [(spelling, mod, node)]
+        "headers_read": {},
+        "forward_lists": [],      # (names, mod, node)
+        "env_set": {},            # name -> [(mod, node)]
+        "env_read": {},
+        "fields_produced": set(),
+        "fields_consumed": {},    # name -> [(mod, node)]
+    }
+    for mod in modules:
+        ex = _extract(mod)
+        for name, node, exact, is_hist in ex["series_produced"]:
+            t["series_produced"].setdefault(name, []).append(
+                (mod, node, is_hist))
+            if is_hist:
+                t["series_hist"].add(name)
+        for prefix, node in ex["series_prefix"]:
+            t["series_prefix"].append((prefix, mod, node))
+        for name, node in ex["series_consumed"]:
+            t["series_consumed"].setdefault(name, []).append((mod, node))
+        for direction in ("set", "read"):
+            for spelling, node in ex[f"headers_{direction}"]:
+                t[f"headers_{direction}"].setdefault(
+                    spelling.lower(), []).append((spelling, mod, node))
+        for qual, direction, node in ex["headers_pending"]:
+            value = _resolve_pending(program, qual)
+            if value is not None and _HEADER_RE.match(value):
+                t[f"headers_{direction}"].setdefault(
+                    value.lower(), []).append((value, mod, node))
+        if ex["forward_list"] is not None:
+            names, node = ex["forward_list"]
+            t["forward_lists"].append((names, mod, node))
+        for direction in ("set", "read"):
+            for name, node in ex[f"env_{direction}"]:
+                t[f"env_{direction}"].setdefault(name, []).append(
+                    (mod, node))
+        for qual, direction, node in ex["env_pending"]:
+            value = _resolve_pending(program, qual)
+            if value is not None and _ENV_RE.match(value):
+                t[f"env_{direction}"].setdefault(value, []).append(
+                    (mod, node))
+        t["fields_produced"] |= ex["fields_produced"]
+        for name, node in ex["fields_consumed"]:
+            t["fields_consumed"].setdefault(name, []).append((mod, node))
+    return t
+
+
+def _series_produced_match(t: dict, name: str) -> bool:
+    if name in t["series_produced"]:
+        return True
+    base = series_base(name)
+    if base != name and base in t["series_hist"]:
+        return True
+    return any(name.startswith(prefix) and name != prefix
+               for prefix, _, _ in t["series_prefix"])
+
+
+def _series_consumed_match(t: dict, name: str, is_hist: bool) -> bool:
+    if name in t["series_consumed"]:
+        return True
+    if is_hist:
+        return any(name + suffix in t["series_consumed"]
+                   for suffix in HIST_SUFFIXES)
+    return False
+
+
+# -- README metric catalog (the X702 documented set) ---------------------------
+
+
+_docs_cache: Optional[tuple[str, frozenset]] = None
+
+
+def documented_series(root: Optional[str] = None) -> frozenset:
+    """Every ``kftpu_*`` token in the repo README — the metric catalog.
+    A produced series nobody consumes in-scan is still contract-clean
+    when the README documents it (dashboards and operators are consumers
+    the AST cannot see). Cached per README path."""
+    global _docs_cache
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    candidates = [os.path.join(root, "README.md"),
+                  os.path.join(os.getcwd(), "README.md")]
+    path = next((c for c in candidates if os.path.isfile(c)), None)
+    if path is None:
+        return frozenset()
+    if _docs_cache is not None and _docs_cache[0] == path:
+        return _docs_cache[1]
+    try:
+        with open(path, encoding="utf-8") as f:
+            names = frozenset(re.findall(r"kftpu_[a-z0-9_]+", f.read()))
+    except OSError:
+        names = frozenset()
+    _docs_cache = (path, names)
+    return names
+
+
+def _documented(name: str, docs: frozenset) -> bool:
+    return name in docs or series_base(name) in docs \
+        or any(name + s in docs for s in HIST_SUFFIXES)
+
+
+# -- the rules -----------------------------------------------------------------
+
+
+def _escaped(mod: Module, node: ast.AST) -> bool:
+    return mod.annotation(node, "contract") is not None
+
+
+def _test_module(mod: Module) -> bool:
+    """Test modules CONTRIBUTE to the contract table (a test scraping a
+    series is a real consumer when it is in the linted set) but are never
+    REPORTED on: a test asserting on a stale name fails loudly at test
+    time — the opposite of the silent drift the X-rules exist for — and
+    fixture name-literals inside tests would otherwise be findings every
+    time ``--changed`` touches a test file."""
+    parts = mod.relpath.split("/")
+    return "tests" in parts or parts[-1].startswith(("test_", "conftest"))
+
+
+@register
+class ConsumedSeriesNeverProduced(Rule):
+    id = "X701"
+    name = "consumed-series-never-produced"
+    doc = ("a literal metric-series name is scraped/compared against "
+           "exposition output but no registry definition site produces "
+           "it — the renamed-producer half of autoscaler blindness")
+
+    def check(self, mod: Module) -> Iterable:
+        if mod.program is None:
+            return    # cross-component: needs the other components
+        if _test_module(mod):
+            return    # tests contribute sites, never findings
+        t = _table(mod)
+        for name, node in _extract(mod)["series_consumed"]:
+            if _escaped(mod, node):
+                continue
+            if _series_produced_match(t, name):
+                continue
+            yield mod.finding(
+                self, node,
+                f"series {name!r} is consumed here but nothing in the "
+                "program produces it (no registry definition site, loop "
+                "expansion, or dynamic prefix matches) — renamed "
+                "producer or typo")
+
+
+@register
+class ProducedSeriesUnconsumed(Rule):
+    id = "X702"
+    name = "produced-series-unconsumed-undocumented"
+    doc = ("an exact metric-series name is registered but neither "
+           "consumed anywhere in the scan set nor documented in the "
+           "README metric catalog — dead telemetry or a renamed "
+           "consumer")
+
+    def check(self, mod: Module) -> Iterable:
+        if mod.program is None:
+            return    # cross-component: needs the other components
+        if _test_module(mod):
+            return    # tests contribute sites, never findings
+        t = _table(mod)
+        docs = documented_series()
+        seen: set[tuple] = set()
+        for name, node, exact, is_hist in _extract(mod)["series_produced"]:
+            key = (name, id(node))
+            if key in seen:      # loop-expanded duplicates: one site each
+                continue
+            seen.add(key)
+            if _escaped(mod, node):
+                continue
+            if _series_consumed_match(t, name, is_hist):
+                continue
+            if _documented(name, docs):
+                continue
+            yield mod.finding(
+                self, node,
+                f"series {name!r} is produced but never consumed in the "
+                "scan set and absent from the README metric catalog — "
+                "document it (or annotate '# contract: <reason>') so a "
+                "renamed consumer cannot go unnoticed")
+
+
+@register
+class HeaderContractDrift(Rule):
+    id = "X703"
+    name = "header-contract-drift"
+    doc = ("an X-Kftpu-* header read that nothing sets (typo/stale "
+           "consumer), set that nothing reads, case-drifting spellings, "
+           "or a serving-path header missing from the middlebox "
+           "forward-list")
+
+    def check(self, mod: Module) -> Iterable:
+        if mod.program is None:
+            return    # cross-component: needs the other components
+        if _test_module(mod):
+            return    # tests contribute sites, never findings
+        t = _table(mod)
+        ex = _extract(mod)
+
+        def sites(direction):
+            for spelling, node in ex[f"headers_{direction}"]:
+                yield spelling, node
+            for qual, d, node in ex["headers_pending"]:
+                if d != direction:
+                    continue
+                value = _resolve_pending(mod.program, qual)
+                if value is not None and _HEADER_RE.match(value):
+                    yield value, node
+
+        for spelling, node in sites("read"):
+            if _escaped(mod, node):
+                continue
+            if spelling.lower() not in t["headers_set"]:
+                yield mod.finding(
+                    self, node,
+                    f"header {spelling!r} is read here but nothing in "
+                    "the program sets it — typo, case drift, or a "
+                    "renamed producer")
+        for spelling, node in sites("set"):
+            if _escaped(mod, node):
+                continue
+            if spelling.lower() not in t["headers_read"]:
+                yield mod.finding(
+                    self, node,
+                    f"header {spelling!r} is set here but nothing in "
+                    "the program reads it — dead header or a renamed "
+                    "consumer")
+        # Case drift: every spelling must match the program's canonical
+        # (most frequent) one — HTTP is case-insensitive but the literal
+        # dict lookups around it are not.
+        spell_counts: dict[str, dict] = {}
+        for d in ("set", "read"):
+            for lower, entries in t[f"headers_{d}"].items():
+                counts = spell_counts.setdefault(lower, {})
+                for spelling, _, _ in entries:
+                    counts[spelling] = counts.get(spelling, 0) + 1
+        for direction in ("read", "set"):
+            for spelling, node in sites(direction):
+                counts = spell_counts.get(spelling.lower(), {})
+                if len(counts) < 2 or _escaped(mod, node):
+                    continue
+                canonical = max(sorted(counts), key=counts.get)
+                if spelling != canonical:
+                    yield mod.finding(
+                        self, node,
+                        f"header spelled {spelling!r} here but "
+                        f"{canonical!r} elsewhere — case/spelling drift")
+        # Forward-list: every header exchanged on the serving path must
+        # ride through the chaos middlebox (finding lands on the list's
+        # owning module).
+        for names, fmod, fnode in t["forward_lists"]:
+            if fmod is not mod or _escaped(mod, fnode):
+                continue
+            fwd = {n.lower() for n in names}
+            for lower in sorted(set(t["headers_set"]) & set(
+                    t["headers_read"])):
+                if lower in fwd:
+                    continue
+                on_serving_path = any(
+                    "serve/" in m.relpath
+                    for _, m, _ in (t["headers_set"][lower]
+                                    + t["headers_read"][lower]))
+                if not on_serving_path:
+                    continue
+                spelling = t["headers_set"][lower][0][0]
+                yield mod.finding(
+                    self, fnode,
+                    f"serving-path header {spelling!r} is missing from "
+                    "the middlebox forward-list — a proxy in the path "
+                    "would silently strip it")
+
+
+@register
+class OrphanEnvVar(Rule):
+    id = "X704"
+    name = "orphan-env-var"
+    doc = ("a KFTPU_* env var read that nothing writes into a child "
+           "environment, or written but never read — the rendezvous-"
+           "boundary rename (annotate '# contract:' for user-facing "
+           "knobs)")
+
+    def check(self, mod: Module) -> Iterable:
+        if mod.program is None:
+            return    # cross-component: needs the other components
+        if _test_module(mod):
+            return    # tests contribute sites, never findings
+        t = _table(mod)
+        ex = _extract(mod)
+
+        def sites(direction):
+            for name, node in ex[f"env_{direction}"]:
+                yield name, node
+            for qual, d, node in ex["env_pending"]:
+                if d != direction:
+                    continue
+                value = _resolve_pending(mod.program, qual)
+                if value is not None and _ENV_RE.match(value):
+                    yield value, node
+
+        for name, node in sites("read"):
+            if _escaped(mod, node):
+                continue
+            if name not in t["env_set"]:
+                yield mod.finding(
+                    self, node,
+                    f"env var {name!r} is read here but nothing in the "
+                    "program writes it — renamed producer, or a user "
+                    "knob that needs a '# contract:' reason")
+        for name, node in sites("set"):
+            if _escaped(mod, node):
+                continue
+            if name not in t["env_read"]:
+                yield mod.finding(
+                    self, node,
+                    f"env var {name!r} is written here but nothing in "
+                    "the program reads it — renamed consumer, or an "
+                    "export for out-of-scan code that needs a "
+                    "'# contract:' reason")
+
+
+@register
+class StatusFieldDrift(Rule):
+    id = "X705"
+    name = "status-field-drift"
+    doc = ("a JSON field name read off a parsed record (json.loads → "
+           ".get) that no writer produces as a literal dict key — the "
+           "metrics.jsonl/status scrape boundary rename")
+
+    def check(self, mod: Module) -> Iterable:
+        if mod.program is None:
+            return    # cross-component: needs the other components
+        if _test_module(mod):
+            return    # tests contribute sites, never findings
+        t = _table(mod)
+        for name, node in _extract(mod)["fields_consumed"]:
+            if _escaped(mod, node):
+                continue
+            if name in t["fields_produced"]:
+                continue
+            yield mod.finding(
+                self, node,
+                f"field {name!r} is read off a parsed JSON record but "
+                "no writer in the program produces it as a dict key — "
+                "renamed writer or typo")
+
+
+# -- the manifest (--contracts-json / the runtime auditor's reference) ---------
+
+
+def contract_manifest(program: Program) -> dict:
+    """Serialize the whole-program contract table: the
+    ``kftpu lint --contracts-json`` document. Sites render as
+    ``path:line`` so drift reports are clickable; the runtime contract
+    auditor (``KFTPU_SANITIZE=contract``) diffs observed exchanges
+    against the name lists."""
+    t = program.memo("xcontract_table",
+                     lambda p: _aggregate(p.modules, p))
+
+    def site(mod: Module, node: ast.AST) -> str:
+        return f"{mod.relpath}:{getattr(node, 'lineno', 0)}"
+
+    def named_sites(d: dict) -> dict:
+        return {key: sorted({site(m, n) for m, n in entries})
+                for key, entries in sorted(d.items())}
+
+    series_produced = {}
+    for name, entries in sorted(t["series_produced"].items()):
+        series_produced[name] = sorted({site(m, n) for m, n, _ in entries})
+    headers = {
+        "set": {},
+        "read": {},
+        "forward_list": sorted({n for names, _, _ in t["forward_lists"]
+                                for n in names}),
+    }
+    for direction in ("set", "read"):
+        for lower, entries in sorted(t[f"headers_{direction}"].items()):
+            spelling = entries[0][0]
+            headers[direction][spelling] = sorted(
+                {site(m, n) for _, m, n in entries})
+    return {
+        "version": 1,
+        "series": {
+            "produced": series_produced,
+            "produced_prefixes": sorted(
+                {p for p, _, _ in t["series_prefix"]}),
+            "histograms": sorted(t["series_hist"]),
+            "consumed": named_sites(t["series_consumed"]),
+        },
+        "headers": headers,
+        "env": {
+            "set": named_sites(t["env_set"]),
+            "read": named_sites(t["env_read"]),
+        },
+        "fields": {
+            "produced": sorted(t["fields_produced"]),
+            "consumed": named_sites(t["fields_consumed"]),
+        },
+    }

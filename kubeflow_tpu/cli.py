@@ -24,6 +24,8 @@ from typing import Any, Optional
 
 import yaml
 
+from kubeflow_tpu.core.headers import USER_HEADER
+
 DEFAULT_SERVER = "http://127.0.0.1:8134"
 
 
@@ -31,7 +33,7 @@ def _req(server: str, method: str, path: str, body: Optional[bytes] = None,
          user: Optional[str] = None) -> Any:
     req = urllib.request.Request(server + path, data=body, method=method)
     if user:
-        req.add_header("X-Kftpu-User", user)
+        req.add_header(USER_HEADER, user)
     try:
         with urllib.request.urlopen(req, timeout=30) as resp:
             data = resp.read()

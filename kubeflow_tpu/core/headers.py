@@ -1,0 +1,41 @@
+"""The platform's ``X-Kftpu-*`` header names — ONE module owns them.
+
+Before ISSUE 10 these literals were re-typed across six modules
+(``obs/trace.py`` owned the trace header, ``serve/router.py`` the
+deadline/QoS pair, ``cli.py``/``platform/api_server.py`` spelled the user
+header by hand, and the ChaosProxy kept its own forward-list copy). A
+rename on one side broke nothing at import time — the consumer just read
+``None`` forever. Every header name is now defined here and *imported*
+everywhere else, so a rename is a normal refactor the interpreter (and
+``kftpu lint``'s X703 header-contract rule) can see.
+
+The legacy spellings stay importable from their historical homes
+(``obs.trace.TRACE_HEADER``, ``serve.router.DEADLINE_HEADER`` /
+``QOS_HEADER``) as re-exports of these constants.
+"""
+
+from __future__ import annotations
+
+#: Trace-context propagation: ``<trace_id>-<parent_span_id>``. Stamped by
+#: the router, joined by the model server (REST and gRPC — gRPC carries it
+#: as lowercase invocation metadata).
+TRACE_HEADER = "X-Kftpu-Trace"
+
+#: Remaining client budget in milliseconds; stamped/decremented hop by hop
+#: (client → router → replica) so every layer enforces the SAME deadline.
+DEADLINE_HEADER = "X-Kftpu-Deadline-Ms"
+
+#: Multi-tenant QoS class (core/serving.QOS_CLASSES), carried end-to-end:
+#: client → router → model server → engine scheduler.
+QOS_HEADER = "X-Kftpu-Qos"
+
+#: Caller identity for the platform API server (profile-namespace access
+#: checks). Client-side only — never forwarded onto the serving path.
+USER_HEADER = "X-Kftpu-User"
+
+#: Headers a transparent serving-path middlebox (the ChaosProxy, any
+#: future sidecar) MUST forward for the request-lifecycle machinery to
+#: keep working through it: deadline enforcement, QoS policy, and trace
+#: continuity all ride these. ``kftpu lint`` X703 checks that every
+#: header exchanged on the serving path appears here.
+FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER)

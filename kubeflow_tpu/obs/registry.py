@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import math
 import re
+import sys
 import threading
 from typing import Any, Iterable, Optional
 
@@ -34,6 +35,33 @@ LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
 #: Platform metric-name convention, enforced by ``MetricsRegistry.lint``.
 NAME_PREFIX = "kftpu_"
+
+
+def _contract_auditor():
+    """The runtime contract auditor (``KFTPU_SANITIZE=contract``), iff the
+    sanitizer module is already loaded — looked up through ``sys.modules``
+    so this module (imported by every /metrics surface) never imports the
+    runtime package itself. An auditor can only exist if ``sanitize`` was
+    imported, so a miss here is definitively "mode off"."""
+    mod = sys.modules.get("kubeflow_tpu.runtime.sanitize")
+    return mod.contract_auditor() if mod is not None else None
+
+
+def contract_note_series(name: str, direction: str = "produced") -> None:
+    """Record one metric-series exchange (``produced`` at a render site,
+    ``consumed`` at a scraper match site) with the contract auditor;
+    no-op unless ``KFTPU_SANITIZE=contract`` is live."""
+    aud = _contract_auditor()
+    if aud is not None:
+        aud.note_series(name, direction)
+
+
+def contract_note_header(name: str, direction: str) -> None:
+    """Record one ``X-Kftpu-*`` header exchange (``set``/``read``) with
+    the contract auditor; no-op unless ``KFTPU_SANITIZE=contract``."""
+    aud = _contract_auditor()
+    if aud is not None:
+        aud.note_header(name, direction)
 
 
 def escape_label_value(value: Any) -> str:
@@ -236,6 +264,9 @@ class MetricsRegistry:
             metrics = list(self._metrics.values())
         lines: list[str] = []
         for m in metrics:
+            # Contract audit: every family actually rendered to an
+            # exposition surface is a PRODUCED series (no-op when off).
+            contract_note_series(m.name, "produced")
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 

@@ -46,10 +46,9 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Iterator, Optional
 
-#: Trace-context propagation header: ``<trace_id>-<parent_span_id>``.
-#: Stamped by the router, joined by the model server (REST and gRPC — gRPC
-#: carries it as lowercase invocation metadata).
-TRACE_HEADER = "X-Kftpu-Trace"
+# Trace-context propagation header (``<trace_id>-<parent_span_id>``),
+# re-exported from the one module that owns every X-Kftpu-* name.
+from kubeflow_tpu.core.headers import TRACE_HEADER  # noqa: F401
 
 #: Span-event cap: decode annotates one event per round, and a 4k-token
 #: generation must not grow an unbounded list.

@@ -144,6 +144,7 @@ class ControlPlane:
             if self.observation_service is not None:
                 # Workers report observations straight to the store's gRPC
                 # front (the db-manager path), not through the controller.
+                # contract: read by the out-of-process observation reporter (tests/obs_worker.py), outside the lint scan
                 self.runtime.service_env["KFTPU_OBS_TARGET"] = \
                     self.observation_service.target
             # artifact:// resolution in worker processes (model servers

@@ -39,10 +39,12 @@ import yaml
 
 _SEGMENT_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9_.-]*")
 
+from kubeflow_tpu.core.headers import USER_HEADER
 from kubeflow_tpu.core.manifest import load_manifest
 from kubeflow_tpu.core.registry import known_kinds
 from kubeflow_tpu.core.store import NotFoundError
 from kubeflow_tpu.core.workspace_specs import Profile
+from kubeflow_tpu.obs.registry import contract_note_header
 from kubeflow_tpu.obs.trace import debug_traces_payload
 from kubeflow_tpu.platform.metrics import render_metrics
 
@@ -109,7 +111,8 @@ class ApiServer:
     # -- authz (KFAM analog) ---------------------------------------------------
 
     def _authorized(self, handler, namespace: str) -> bool:
-        user = handler.headers.get("X-Kftpu-User")
+        user = handler.headers.get(USER_HEADER)
+        contract_note_header(USER_HEADER, direction="read")
         if user is None:
             return True   # no identity → single-user mode
         profile = self.cp.store.try_get(Profile, namespace, "default")
@@ -357,7 +360,7 @@ class ApiServer:
         destructive: in multi-user mode only the admin-namespace
         ("kubeflow" Profile) owner may run it; single-user mode is open
         (matching the rest of the surface)."""
-        user = h.headers.get("X-Kftpu-User")
+        user = h.headers.get(USER_HEADER)
         if user is not None:
             admin = self.cp.store.try_get(Profile, "kubeflow", "default")
             if admin is None or user != admin.spec.owner:
@@ -443,6 +446,7 @@ class ApiServer:
                     image=form.get("image", "jax-notebook"),
                     resources=TPUResourceSpec(
                         tpu_chips=int(form.get("tpu_chips", 1)),
+                        # contract: REST form field — produced by the HTTP client, pinned by TPUResourceSpec.memory_gb
                         memory_gb=form.get("memory_gb")),
                     env={str(k): str(v)
                          for k, v in (form.get("env") or {}).items()},
@@ -450,6 +454,7 @@ class ApiServer:
                     idle_cull_seconds=cull,
                     pod_default_labels={
                         str(k): str(v) for k, v in
+                        # contract: REST form field — produced by the HTTP client, pinned by NotebookSpec.pod_default_labels
                         (form.get("pod_default_labels") or {}).items()},
                 ))
         except Exception as exc:  # noqa: BLE001 — bad form is a 400

@@ -199,6 +199,7 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
     import jax
 
     path = path or os.environ.get(
+        # contract: operator-facing knob — set by the user, never by the tree
         "KFTPU_JAX_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "kftpu", "jax"))
     try:

@@ -25,6 +25,15 @@
   cache) and ``assert_no_steady_recompiles()`` raises
   ``RecompileError`` naming each offending site. The dynamic half of
   the F6xx compilation-stability rules.
+- ``contract``: a name-contract auditor (``install_contract_auditor``)
+  records every metric series actually rendered to an exposition
+  endpoint, every series the autoscaler probe actually matched, and
+  every ``X-Kftpu-*`` header actually read or stamped on a hop —
+  ``contract_report()`` is the audit payload and ``contract_diff()``
+  checks it against the statically-extracted contract table
+  (``kftpu lint --contracts-json``). The dynamic half of the X7xx
+  cross-component contract rules: a series name the AST extractor
+  cannot see (built dynamically) shows up here as *undeclared*.
 - ``all``: everything above.
 
 This module is stdlib-only (no jax): the watchdogs must be installable
@@ -46,7 +55,7 @@ import threading
 from typing import Optional
 
 _KNOWN_MODES = frozenset({"transfer", "refcount", "lockorder",
-                          "recompile"})
+                          "recompile", "contract"})
 
 
 def sanitize_modes() -> frozenset:
@@ -442,12 +451,138 @@ def assert_no_steady_recompiles() -> None:
         _recompile_wd.assert_no_steady_recompiles()
 
 
+# -- contract auditor ----------------------------------------------------------
+
+
+#: Suffixes a histogram family fans out into at render time; the static
+#: contract table records the FAMILY name, so runtime/consumed series are
+#: normalized back through these before matching.
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def series_base(name: str) -> str:
+    """``kftpu_x_seconds_bucket`` → ``kftpu_x_seconds`` (histogram fan-out
+    stripped); non-suffixed names pass through."""
+    for suffix in HIST_SUFFIXES:
+        if name.endswith(suffix):
+            return name[:-len(suffix)]
+    return name
+
+
+class _ContractAuditor:
+    """Records the name exchanges a run ACTUALLY performed.
+
+    Four sets, all of plain strings: metric series rendered to an
+    exposition endpoint / matched by a scraper, and ``X-Kftpu-*`` headers
+    stamped onto a forwarded hop / read off a request. Everything is
+    process-local and bounded by the name population (a few dozen), so
+    recording is a set-add under one raw lock — cheap enough to leave in
+    scrape paths."""
+
+    def __init__(self):
+        self._meta = _thread.allocate_lock()   # raw: never itself watched
+        self.series: dict[str, set] = {"produced": set(), "consumed": set()}
+        self.headers: dict[str, set] = {"set": set(), "read": set()}
+
+    def note_series(self, name: str, direction: str) -> None:
+        with self._meta:
+            self.series[direction].add(str(name))
+
+    def note_header(self, name: str, direction: str) -> None:
+        with self._meta:
+            self.headers[direction].add(str(name))
+
+    def report(self) -> dict:
+        with self._meta:
+            return {
+                "series_produced": sorted(self.series["produced"]),
+                "series_consumed": sorted(self.series["consumed"]),
+                "headers_set": sorted(self.headers["set"]),
+                "headers_read": sorted(self.headers["read"]),
+            }
+
+    def reset(self) -> None:
+        with self._meta:
+            for d in (self.series, self.headers):
+                for s in d.values():
+                    s.clear()
+
+
+_contract_auditor: Optional[_ContractAuditor] = None
+
+
+def install_contract_auditor() -> _ContractAuditor:
+    """Idempotent; returns the active auditor. Pure bookkeeping — nothing
+    is patched, the instrumented sites simply start finding an auditor."""
+    global _contract_auditor
+    if _contract_auditor is None:
+        _contract_auditor = _ContractAuditor()
+    return _contract_auditor
+
+
+def uninstall_contract_auditor() -> None:
+    global _contract_auditor
+    _contract_auditor = None
+
+
+def contract_auditor() -> Optional[_ContractAuditor]:
+    return _contract_auditor
+
+
+def contract_report() -> dict:
+    """The audit payload (empty dict when the mode is off) — the
+    ``leak_report_by_owner()`` of the name-contract surface."""
+    if _contract_auditor is None:
+        return {}
+    return _contract_auditor.report()
+
+
+def contract_diff(report: dict, static_doc: dict) -> dict:
+    """Diff a runtime ``contract_report()`` against a static contract
+    table (the ``kftpu lint --contracts-json`` document). Returns the
+    UNDECLARED exchanges — names the run actually used that the static
+    extractor never saw. Empty lists == the static table is an honest
+    superset of runtime behavior.
+
+    Series match by exact name, histogram-suffix family, or a declared
+    dynamic prefix (f-string heads the extractor could not expand);
+    headers match case-insensitively."""
+    series = static_doc.get("series", {})
+    declared = set(series.get("produced", ())) \
+        | set(series.get("consumed", ()))
+    prefixes = tuple(series.get("produced_prefixes", ()))
+    headers = static_doc.get("headers", {})
+    declared_headers = {h.lower() for h in headers.get("set", ())} \
+        | {h.lower() for h in headers.get("read", ())}
+
+    def series_ok(name: str) -> bool:
+        if name in declared or series_base(name) in declared:
+            return True
+        return bool(prefixes) and name.startswith(prefixes)
+
+    out = {"undeclared_series": [], "undeclared_headers": []}
+    for key in ("series_produced", "series_consumed"):
+        for name in report.get(key, ()):
+            if not series_ok(name):
+                out["undeclared_series"].append(name)
+    for key in ("headers_set", "headers_read"):
+        for name in report.get(key, ()):
+            if name.lower() not in declared_headers:
+                out["undeclared_headers"].append(name)
+    out["undeclared_series"] = sorted(set(out["undeclared_series"]))
+    out["undeclared_headers"] = sorted(set(out["undeclared_headers"]))
+    return out
+
+
 def maybe_install() -> None:
     """Called from ``kubeflow_tpu/__init__`` so ``KFTPU_SANITIZE=
-    lockorder`` / ``=recompile`` cover every lock the platform creates
-    and every compile it dispatches, whatever the entry point."""
+    lockorder`` / ``=recompile`` / ``=contract`` cover every lock the
+    platform creates, every compile it dispatches, and every name
+    exchange it performs, whatever the entry point."""
     modes = sanitize_modes()
     if "lockorder" in modes:
         install_lockorder_watchdog()
     if "recompile" in modes:
         install_recompile_watchdog()
+    if "contract" in modes:
+        install_contract_auditor()

@@ -16,6 +16,7 @@ import traceback
 
 def main() -> int:
     logging.basicConfig(
+        # contract: operator-facing knob — set by the user, never by the tree
         level=os.environ.get("KFTPU_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(name)s [w%(process)d] %(message)s",
         stream=sys.stderr,

@@ -34,7 +34,9 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from kubeflow_tpu.core.headers import FORWARD_HEADERS
 from kubeflow_tpu.core.jobs import Worker, WorkerPhase
+from kubeflow_tpu.obs.registry import contract_note_header
 
 logger = logging.getLogger("kubeflow_tpu.serve.faults")
 
@@ -221,14 +223,19 @@ def _chaos_handler(proxy: ChaosProxy):
                 self.end_headers()
                 self.wfile.write(data)
                 return
-            # forward verbatim (headers that matter: content-type, deadline)
+            # Forward verbatim. The forward-list is DERIVED from the
+            # platform header module, not re-typed here: a new serving-path
+            # header (deadline, QoS, trace, whatever comes next) rides
+            # through the chaos middlebox the day it is added to
+            # core/headers.FORWARD_HEADERS.
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n) if n else None
             fwd_headers = {"Content-Type": self.headers.get(
                 "Content-Type", "application/json")}
-            for h in ("X-Kftpu-Deadline-Ms", "X-Kftpu-Qos"):
+            for h in FORWARD_HEADERS:
                 if self.headers.get(h):
                     fwd_headers[h] = self.headers[h]
+                    contract_note_header(h, direction="set")
             req = urllib.request.Request(
                 proxy.target + self.path, data=body, method=self.command,
                 headers=fwd_headers)

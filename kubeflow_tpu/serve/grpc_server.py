@@ -21,11 +21,11 @@ from typing import Optional
 
 import grpc
 
+from kubeflow_tpu.core.headers import QOS_HEADER, TRACE_HEADER
 from kubeflow_tpu.core.serving import QOS_DEFAULT
-from kubeflow_tpu.obs.trace import TRACE_HEADER, get_tracer
+from kubeflow_tpu.obs.trace import get_tracer
 from kubeflow_tpu.serve.engine import EngineOverloaded
 from kubeflow_tpu.serve.protos import oip_pb2 as pb
-from kubeflow_tpu.serve.router import QOS_HEADER
 
 SERVICE = "inference.GRPCInferenceService"
 

@@ -42,7 +42,7 @@ from kubeflow_tpu.core.jobs import (
 )
 from kubeflow_tpu.core.object import ObjectMeta
 from kubeflow_tpu.core.serving import InferenceService, SLOPolicy
-from kubeflow_tpu.obs.registry import parse_exposition
+from kubeflow_tpu.obs.registry import contract_note_series, parse_exposition
 from kubeflow_tpu.core.store import (
     AlreadyExistsError, NotFoundError, ObjectStore, WatchEvent,
 )
@@ -58,6 +58,21 @@ LABEL_GEN = "serving.tpu.kubeflow.dev/generation"
 _RESYNC = 1.0           # readiness/autoscale poll period (seconds)
 _SCALE_DOWN_COOLDOWN = 10.0
 _SCALE_TO_ZERO_COOLDOWN = 10.0
+
+#: Every series name ``default_probe`` matches on — the autoscaler's half
+#: of the engine↔controller metrics contract. The match chain below uses
+#: the same literals; ``kftpu lint``'s X701 checks each against the
+#: engine's definition sites, and tests/test_contracts.py pins the pair
+#: against a REAL rendered /metrics payload (a rename on either side
+#: fails both).
+_PROBE_SERIES = (
+    "kftpu_serving_in_flight",
+    "kftpu_serving_requests_total",
+    "kftpu_serving_ttft_p95_ms",
+    "kftpu_serving_queue_delay_p95_ms",
+    "kftpu_serving_qos_ttft_p95_ms",
+    "kftpu_serving_qos_queue_delay_p95_ms",
+)
 
 
 def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
@@ -82,6 +97,10 @@ def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
         except ValueError:
             return out     # unparseable exposition: ready, but blind
         for name, labels, value in samples:
+            if name in _PROBE_SERIES:
+                # Contract audit: this scrape CONSUMED the series (no-op
+                # unless KFTPU_SANITIZE=contract).
+                contract_note_series(name, "consumed")
             if name == "kftpu_serving_in_flight":
                 out["in_flight"] = int(value)
             elif name == "kftpu_serving_requests_total":

@@ -48,10 +48,16 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from kubeflow_tpu.obs.registry import MetricsRegistry
-from kubeflow_tpu.obs.trace import (
-    TRACE_HEADER, debug_traces_payload, get_tracer,
+# Header names come from the one module that owns every X-Kftpu-* name
+# (core/headers.py); DEADLINE_HEADER/QOS_HEADER are re-exported here for
+# the router's historical importers (scripts, tests, grpc_server).
+from kubeflow_tpu.core.headers import (
+    DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
 )
+from kubeflow_tpu.obs.registry import (
+    MetricsRegistry, contract_note_header,
+)
+from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
 
 
 def quiet_handle_error(httpd) -> None:
@@ -67,18 +73,6 @@ def quiet_handle_error(httpd) -> None:
         traceback.print_exc()
 
     httpd.handle_error = handle_error
-
-#: Remaining client budget in milliseconds; stamped/decremented hop by hop
-#: (client → router → replica) so every layer — proxy socket timeouts, the
-#: model server's result wait, the engine scheduler's reaper — enforces the
-#: SAME deadline instead of each inventing its own.
-DEADLINE_HEADER = "X-Kftpu-Deadline-Ms"
-
-#: Multi-tenant QoS class (core/serving.QOS_CLASSES), carried end-to-end:
-#: client → router → model server → engine scheduler. The router forwards
-#: it verbatim — class policy (quotas, priority, shedding, preemption)
-#: lives in the engine, where the queue actually is.
-QOS_HEADER = "X-Kftpu-Qos"
 
 #: Local (non-proxied) router endpoints.
 ROUTER_METRICS_PATH = "/-/router/metrics"
@@ -359,6 +353,7 @@ def _make_handler(router: Router):
             client sent one, capped by the router's upstream timeout."""
             budget = router.upstream_timeout
             hdr = self.headers.get(DEADLINE_HEADER)
+            contract_note_header(DEADLINE_HEADER, direction="read")
             if hdr:
                 try:
                     budget = min(budget, max(float(hdr) / 1e3, 0.0))
@@ -373,6 +368,7 @@ def _make_handler(router: Router):
             # rides the X-Kftpu-Trace header so the model server and the
             # engine scheduler continue the SAME trace id.
             tracer = get_tracer()
+            contract_note_header(TRACE_HEADER, direction="read")
             with tracer.span(
                     "router.request",
                     parent=tracer.extract(self.headers.get(TRACE_HEADER)),
@@ -429,10 +425,16 @@ def _make_handler(router: Router):
                 if self.headers.get(QOS_HEADER):
                     # QoS class rides to the replica verbatim — the
                     # engine scheduler enforces the class policy.
+                    contract_note_header(QOS_HEADER, direction="read")
                     fwd_headers[QOS_HEADER] = self.headers[QOS_HEADER]
                 trace_hdr = get_tracer().inject(sp)
                 if trace_hdr:
                     fwd_headers[TRACE_HEADER] = trace_hdr
+                # Contract audit (KFTPU_SANITIZE=contract): record which
+                # X-Kftpu-* headers actually ride this hop; no-op when off.
+                for h in fwd_headers:
+                    if h.startswith("X-Kftpu"):
+                        contract_note_header(h, direction="set")
                 req = urllib.request.Request(
                     backend + self.path, data=body, method=self.command,
                     headers=fwd_headers)

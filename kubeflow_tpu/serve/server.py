@@ -36,18 +36,17 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from kubeflow_tpu.obs.registry import MetricsRegistry
-from kubeflow_tpu.obs.trace import (
-    TRACE_HEADER, debug_traces_payload, get_tracer,
+from kubeflow_tpu.core.headers import (
+    DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
 )
+from kubeflow_tpu.obs.registry import MetricsRegistry, contract_note_header
+from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
 from kubeflow_tpu.core.serving import QOS_DEFAULT
 from kubeflow_tpu.serve.engine import (
     EngineOverloaded, HOST_GAP_BUCKETS, LLMEngine, QUEUE_DELAY_BUCKETS,
     Request, SamplingParams,
 )
-from kubeflow_tpu.serve.router import (
-    DEADLINE_HEADER, QOS_HEADER, quiet_handle_error,
-)
+from kubeflow_tpu.serve.router import quiet_handle_error
 from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
 
 
@@ -396,6 +395,7 @@ def _make_handler(server: ModelServer):
             """Remaining client budget (seconds) from the router's deadline
             header; None when the request carries no deadline."""
             hdr = self.headers.get(DEADLINE_HEADER)
+            contract_note_header(DEADLINE_HEADER, direction="read")
             if not hdr:
                 return None
             try:
@@ -460,6 +460,7 @@ def _make_handler(server: ModelServer):
         def do_POST(self) -> None:
             server.track(1)
             tracer = get_tracer()
+            contract_note_header(TRACE_HEADER, direction="read")
             try:
                 # Joins the router's trace via X-Kftpu-Trace (or roots a new
                 # one for direct-to-replica requests); every generation path
@@ -522,6 +523,7 @@ def _make_handler(server: ModelServer):
             field as the headerless fallback). Unknown classes fail loudly
             (engine.submit raises → HTTP 400) rather than silently
             demoting a tenant to the default tier."""
+            contract_note_header(QOS_HEADER, direction="read")
             raw = self.headers.get(QOS_HEADER) or body.get("qos") \
                 or QOS_DEFAULT
             return str(raw).strip().lower()

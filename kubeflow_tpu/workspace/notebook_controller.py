@@ -141,6 +141,7 @@ class NotebookController:
                 "KFTPU_NB_SOCKET": sock,
                 "KFTPU_NB_ACTIVITY": activity,
                 "KFTPU_NB_WORKDIR": d,
+                # contract: exported for user code inside the notebook session; nothing in the platform reads it back
                 "KFTPU_NB_VOLUMES": ":".join(nb.spec.volumes),
                 "PYTHONPATH": (f"{pkg_root}:{pythonpath}" if pythonpath
                                else pkg_root),

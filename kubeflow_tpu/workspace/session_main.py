@@ -88,6 +88,7 @@ def main() -> int:
     # meaning "jax" for sessions launched without a controller.
     pre = os.environ.get("KFTPU_NB_PREIMPORTS")
     if pre is None:
+        # contract: legacy user-facing flag for controllerless sessions
         pre = "jax" if os.environ.get("KFTPU_NB_PREIMPORT", "1") == "1" else ""
     import importlib
 

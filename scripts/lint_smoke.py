@@ -10,8 +10,11 @@ upload (D103), a dropped router lock acquisition (C301), a de-donated
 decode carry (S401), an exception-path page leak (R501), an inverted
 router lock pair (R503), a fire-and-forget trainer checkpoint save
 (R504), a weak-type scalar riding into the dense decode dispatch (F602),
-and a fresh tuple in its static num_steps position (F604) — so a rule
-that silently stops firing fails the gate too, not just the test suite.
+a fresh tuple in its static num_steps position (F604), a renamed
+autoscaler-scraped series (X701, linted under the full package Program
+so the cross-component table sees the real producers), and a typoed
+header literal (X703) — so a rule that silently stops firing fails the
+gate too, not just the test suite.
 
 Prints one JSON object; ``"lint_smoke": "ok"`` is the pass marker
 smoke.sh greps for. Findings render as ``file:line:col`` so they are
@@ -29,8 +32,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from kubeflow_tpu.analysis import Baseline, find_baseline, lint_source, run_lint  # noqa: E402
+from kubeflow_tpu.analysis import core as _core  # noqa: E402
 
 SCAN = ["kubeflow_tpu", "scripts", "bench.py", "bench_serve.py"]
+
+
+def _lint_with_program(relpath: str, src: str):
+    """Lint ONE (possibly mutated) source under the full package-wide
+    Program — the X-family cross-component rules need the real producers
+    and consumers on the other side of each contract visible, which
+    ``lint_source``'s standalone module cannot provide."""
+    mods = []
+    for path in _core.iter_py_files(SCAN):
+        rel = os.path.relpath(os.path.abspath(path), REPO).replace(
+            os.sep, "/")
+        if rel == relpath:
+            mods.append(_core.Module(relpath, src))
+        else:
+            try:
+                mods.append(_core.load_module(path, rel))
+            except (OSError, SyntaxError, ValueError):
+                continue
+    _core.Program(mods)
+    target = next(m for m in mods if m.relpath == relpath)
+    return _core.lint_module(target)
 
 
 def _seeded_regressions() -> list[str]:
@@ -140,6 +165,43 @@ def _seeded_regressions() -> list[str]:
         (_DECODE_CALL,
          _DECODE_CALL.replace(" key, k_steps,", " key, (k_steps,),")),
         "F604", "self._decode_n")
+
+    def new_findings_prog(path: str, old: str, new: str, rule: str,
+                          needle: str) -> None:
+        """The X-family variant: lint the mutated module under the FULL
+        package Program (cross-component contracts need both sides)."""
+        with open(os.path.join(REPO, path)) as f:
+            src = f.read()
+        mut = src.replace(old, new, 1)
+        if mut == src:
+            fails.append(f"{rule}: mutation anchor not found in {path}")
+            return
+        before = {f.fingerprint for f in _lint_with_program(path, src)}
+        fresh = [f for f in _lint_with_program(path, mut)
+                 if f.fingerprint not in before]
+        if len(fresh) != 1 or fresh[0].rule != rule \
+                or needle not in fresh[0].message:
+            fails.append(
+                f"{rule}: seeded regression in {path} produced "
+                f"{[f.render() for f in fresh]!r}, expected exactly one "
+                f"{rule} mentioning {needle!r}")
+
+    # Family X: rename one scraped series in the autoscaler probe — the
+    # engine still produces the old name, the probe now matches nothing
+    # (the silent-HOLD drift class ISSUE 10 exists to kill).
+    new_findings_prog(
+        "kubeflow_tpu/serve/isvc_controller.py",
+        '"kftpu_serving_requests_total"',
+        '"kftpu_serving_requests_totals"',
+        "X701", "kftpu_serving_requests_totals")
+    # Family X: typo one header literal on the model server's read side —
+    # nothing sets the misspelled header, so the QoS class silently
+    # defaults for every request.
+    new_findings_prog(
+        "kubeflow_tpu/serve/server.py",
+        "raw = self.headers.get(QOS_HEADER) or body.get(\"qos\")",
+        "raw = self.headers.get(\"X-Kftpu-Qoss\") or body.get(\"qos\")",
+        "X703", "X-Kftpu-Qoss")
     return fails
 
 

@@ -18,7 +18,8 @@ echo "== kftpu lint (static analysis vs committed baseline) =="
 # lock, S401 de-donated carry, R501 exception-path page leak, R503 lock
 # inversion, R504 fire-and-forget trainer checkpoint save, F602 weak-type
 # scalar into the decode dispatch, F604 fresh tuple in its static
-# position).
+# position, X701 renamed autoscaler-scraped series, X703 typoed header
+# literal).
 timeout -k 10 120 python scripts/lint_smoke.py | tee /tmp/_smoke_lint.json
 lint_rc=${PIPESTATUS[0]}
 grep -q '"lint_smoke": "ok"' /tmp/_smoke_lint.json || lint_rc=1
@@ -109,5 +110,15 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 autoscale_rc=${PIPESTATUS[0]}
 grep -q '"autoscale_smoke": "ok"' /tmp/_smoke_autoscale.json || autoscale_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ]
+echo "== contract smoke (static name-contract table vs a real serve run) =="
+# Cross-component contract gate (ISSUE 10): the kftpu lint --contracts-json
+# manifest must round-trip, and a serve run under KFTPU_SANITIZE=contract
+# must exchange ZERO series/header names the static X7xx extraction does
+# not declare — a dynamically-built name the AST missed fails here.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/contract_smoke.py | tee /tmp/_smoke_contract.json
+contract_rc=${PIPESTATUS[0]}
+grep -q '"contract_smoke": "ok"' /tmp/_smoke_contract.json || contract_rc=1
+
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc contract rc=$contract_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]

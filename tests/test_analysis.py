@@ -1556,6 +1556,231 @@ class TestProgram:
         assert table["J"].donates
 
 
+# -- Family X: cross-component name contracts (ISSUE 10 tentpole) --------------
+
+
+def xrules(sources: dict, lint=None):
+    from kubeflow_tpu.analysis import lint_sources
+
+    return [f for f in lint_sources(sources, lint=lint)
+            if f.rule.startswith("X7")]
+
+
+class TestConsumedSeriesNeverProduced:
+    PRODUCER = (
+        "def reg_metrics(reg, snap):\n"
+        "    reg.counter('kftpu_fix_total')\n"
+        "    reg.histogram('kftpu_fix_delay_seconds', [0.1])\n"
+        "    for k in ('util',):\n"
+        "        reg.gauge(f'kftpu_fixd_{k}')\n"
+        "    for k, v in snap.items():\n"
+        "        reg.gauge(f'kftpu_fixdyn_{k}').set(v)\n")
+
+    def _consumer(self, *names):
+        chain = "".join(
+            f"        elif name == '{n}':\n            out.append(value)\n"
+            for n in names)
+        return ("def probe(samples):\n"
+                "    out = []\n"
+                "    for name, labels, value in samples:\n"
+                "        if False:\n"
+                "            pass\n" + chain + "    return out\n")
+
+    def _lint_consumer(self, *names):
+        return xrules(
+            {"kubeflow_tpu/serve/prod.py": self.PRODUCER,
+             "kubeflow_tpu/serve/cons.py": self._consumer(*names)},
+            lint=["kubeflow_tpu/serve/cons.py"])
+
+    def test_exact_loop_expanded_suffix_and_prefix_names_match(self):
+        """Every producer spelling counts: literal, loop-expanded
+        f-string, histogram ``_bucket`` fan-out, dynamic f-string
+        prefix."""
+        assert self._lint_consumer(
+            "kftpu_fix_total", "kftpu_fixd_util",
+            "kftpu_fix_delay_seconds_bucket",
+            "kftpu_fixdyn_anything") == []
+
+    def test_renamed_consumer_is_caught(self):
+        found = self._lint_consumer("kftpu_fix_total", "kftpu_fix_missing")
+        assert [f.rule for f in found] == ["X701"]
+        assert "kftpu_fix_missing" in found[0].message
+
+    def test_contract_annotation_closes_it(self):
+        src = ("def probe(samples):\n"
+               "    for name, labels, value in samples:\n"
+               "        # contract: produced by an out-of-scan exporter\n"
+               "        if name == 'kftpu_fix_external':\n"
+               "            return value\n")
+        assert xrules({"kubeflow_tpu/serve/cons.py": src}) == []
+
+    def test_standalone_lint_source_is_silent(self):
+        """Without a Program the cross-component family must not guess
+        from one module's half of the contract."""
+        found = lint_source(self._consumer("kftpu_fix_missing"),
+                            "kubeflow_tpu/serve/cons.py")
+        assert [f for f in found if f.rule.startswith("X7")] == []
+
+
+class TestProducedSeriesUnconsumed:
+    def test_unconsumed_undocumented_series_is_caught(self):
+        src = ("def reg_metrics(reg):\n"
+               "    reg.counter('kftpu_fix_orphan_total')\n")
+        found = xrules({"kubeflow_tpu/serve/prod.py": src})
+        assert [f.rule for f in found] == ["X702"]
+        assert "kftpu_fix_orphan_total" in found[0].message
+
+    def test_consumed_in_sibling_module_is_clean(self):
+        found = xrules(
+            {"kubeflow_tpu/serve/prod.py":
+                TestConsumedSeriesNeverProduced.PRODUCER,
+             "kubeflow_tpu/serve/cons.py":
+                TestConsumedSeriesNeverProduced()._consumer(
+                    "kftpu_fix_total", "kftpu_fixd_util",
+                    "kftpu_fix_delay_seconds_bucket")},
+            lint=["kubeflow_tpu/serve/prod.py"])
+        assert found == []
+
+    def test_readme_catalog_counts_as_consumed(self):
+        """A series documented in the real README metric catalog needs no
+        in-scan consumer (dashboards are consumers the AST cannot see)."""
+        src = ("def reg_metrics(reg):\n"
+               "    reg.gauge('kftpu_serving_queue_depth')\n")
+        assert xrules({"kubeflow_tpu/serve/prod.py": src}) == []
+
+
+class TestHeaderContractDrift:
+    def test_read_never_set_is_caught(self):
+        src = ("def qos(h):\n"
+               "    return h.get('X-Kftpu-Qoss')\n")
+        found = xrules({"kubeflow_tpu/serve/s.py": src})
+        assert [f.rule for f in found] == ["X703"]
+        assert "X-Kftpu-Qoss" in found[0].message
+
+    def test_set_never_read_is_caught(self):
+        src = ("def fwd(h, out):\n"
+               "    out['X-Kftpu-Dead'] = h['User-Agent']\n")
+        found = xrules({"kubeflow_tpu/serve/s.py": src})
+        assert [f.rule for f in found] == ["X703"]
+
+    def test_constants_resolve_across_modules(self):
+        """The centralized-constants idiom (core/headers.py) is the X703
+        fix: both sides import ONE spelling, so the pair always
+        matches."""
+        sources = {
+            "kubeflow_tpu/hdrs.py": "BUDGET = 'X-Kftpu-Budget'\n",
+            "kubeflow_tpu/serve/a.py": (
+                "from kubeflow_tpu.hdrs import BUDGET\n"
+                "def stamp(out, ms):\n"
+                "    out[BUDGET] = str(ms)\n"),
+            "kubeflow_tpu/serve/b.py": (
+                "from kubeflow_tpu.hdrs import BUDGET\n"
+                "def read(h):\n"
+                "    return h.get(BUDGET.lower())\n"),
+        }
+        assert xrules(sources) == []
+
+    def test_case_drift_is_caught(self):
+        src = ("def f(h, out):\n"
+               "    out['X-Kftpu-Qos'] = h.get('X-KFTPU-QOS')\n")
+        found = xrules({"kubeflow_tpu/serve/s.py": src})
+        assert found and all(f.rule == "X703" for f in found)
+        assert any("drift" in f.message for f in found)
+
+    def test_serving_path_header_missing_from_forward_list(self):
+        sources = {
+            "kubeflow_tpu/hdrs.py": (
+                "DEADLINE = 'X-Kftpu-Deadline-Ms'\n"
+                "BUDGET = 'X-Kftpu-Budget'\n"
+                "FORWARD_HEADERS = (DEADLINE,)\n"),
+            "kubeflow_tpu/serve/a.py": (
+                "from kubeflow_tpu.hdrs import BUDGET, DEADLINE\n"
+                "def fwd(h, out):\n"
+                "    out[DEADLINE] = h.get(DEADLINE)\n"
+                "    out[BUDGET] = h.get(BUDGET)\n"),
+        }
+        found = xrules(sources, lint=["kubeflow_tpu/hdrs.py"])
+        assert [f.rule for f in found] == ["X703"]
+        assert "X-Kftpu-Budget" in found[0].message
+        assert "forward" in found[0].message
+
+
+class TestOrphanEnvVar:
+    def test_read_never_set_is_caught(self):
+        src = ("import os\n"
+               "def knob():\n"
+               "    return os.environ.get('KFTPU_FIX_KNOB')\n")
+        found = xrules({"kubeflow_tpu/rt.py": src})
+        assert [f.rule for f in found] == ["X704"]
+        assert "KFTPU_FIX_KNOB" in found[0].message
+
+    def test_set_in_child_env_dict_pairs_with_read(self):
+        sources = {
+            "kubeflow_tpu/cp.py": (
+                "def child_env(v):\n"
+                "    return {'KFTPU_FIX_KNOB': v}\n"),
+            "kubeflow_tpu/rt.py": (
+                "import os\n"
+                "def knob():\n"
+                "    return os.environ.get('KFTPU_FIX_KNOB')\n"),
+        }
+        assert xrules(sources) == []
+
+    def test_set_never_read_is_caught_via_constant(self):
+        sources = {
+            "kubeflow_tpu/names.py": "ROOT = 'KFTPU_FIX_ROOT'\n",
+            "kubeflow_tpu/cp.py": (
+                "from kubeflow_tpu.names import ROOT\n"
+                "def launch(env):\n"
+                "    env[ROOT] = '/tmp'\n"),
+        }
+        found = xrules(sources, lint=["kubeflow_tpu/cp.py"])
+        assert [f.rule for f in found] == ["X704"]
+        assert "KFTPU_FIX_ROOT" in found[0].message
+
+    def test_contract_annotation_closes_user_knobs(self):
+        src = ("import os\n"
+               "def knob():\n"
+               "    # contract: operator-facing knob\n"
+               "    return os.environ.get('KFTPU_FIX_KNOB')\n")
+        assert xrules({"kubeflow_tpu/rt.py": src}) == []
+
+
+class TestStatusFieldDrift:
+    WRITER = ("def emit(step):\n"
+              "    rec = {'step': step}\n"
+              "    rec['loss_x'] = 1.0\n"
+              "    return rec\n")
+
+    def test_loop_tuple_consumption_catches_renamed_writer(self):
+        reader = ("import json\n"
+                  "def scrape(line, status):\n"
+                  "    m = json.loads(line)\n"
+                  "    status.step = m.get('step')\n"
+                  "    for field in ('loss_x', 'mfu_x'):\n"
+                  "        value = m.get(field)\n"
+                  "        if value is not None:\n"
+                  "            setattr(status, field, value)\n")
+        found = xrules({"kubeflow_tpu/train/w.py": self.WRITER,
+                        "kubeflow_tpu/op/r.py": reader},
+                       lint=["kubeflow_tpu/op/r.py"])
+        assert [f.rule for f in found] == ["X705"]
+        assert "mfu_x" in found[0].message
+
+    def test_produced_keys_are_clean(self):
+        reader = ("import json\n"
+                  "def scrape(line):\n"
+                  "    m = json.loads(line)\n"
+                  "    return m.get('step'), m.get('loss_x')\n")
+        assert xrules({"kubeflow_tpu/train/w.py": self.WRITER,
+                       "kubeflow_tpu/op/r.py": reader}) == []
+
+    def test_gets_on_non_json_vars_are_ignored(self):
+        src = ("def conf(d):\n"
+               "    return d.get('whatever_missing_key')\n")
+        assert xrules({"kubeflow_tpu/op/r.py": src}) == []
+
+
 # -- seeded regressions against the REAL codebase (acceptance criteria) --------
 
 
@@ -1709,6 +1934,85 @@ class TestSeededRegressions:
         assert f.rule == "F604" and "self._decode_n" in f.message
 
 
+def _new_findings_prog(relpath: str, old: str, new: str):
+    """The X-family seeded-regression helper: lint the (mutated) module
+    under the FULL package Program so the cross-component table sees the
+    real other side of each contract."""
+    from kubeflow_tpu.analysis import core
+
+    with open(os.path.join(REPO, relpath)) as f:
+        src = f.read()
+    mutated = src.replace(old, new, 1)
+    assert mutated != src, f"mutation anchor vanished from {relpath}"
+
+    def lint(text: str):
+        mods = []
+        for path in core.iter_py_files(
+                [os.path.join(REPO, p) for p in
+                 ("kubeflow_tpu", "scripts", "bench.py", "bench_serve.py")]):
+            rel = os.path.relpath(os.path.abspath(path), REPO).replace(
+                os.sep, "/")
+            if rel == relpath:
+                mods.append(core.Module(relpath, text))
+            else:
+                mods.append(core.load_module(path, rel))
+        core.Program(mods)
+        target = next(m for m in mods if m.relpath == relpath)
+        return core.lint_module(target)
+
+    before = {f.fingerprint for f in lint(src)}
+    return [f for f in lint(mutated) if f.fingerprint not in before]
+
+
+class TestContractSeededRegressions:
+    def test_renamed_probe_series_is_caught(self):
+        """Renaming one series the SLO autoscaler's probe scrapes — while
+        the engine keeps emitting the old name — produces exactly one
+        X701: the silent-HOLD drift class ISSUE 10 exists to kill."""
+        fresh = _new_findings_prog(
+            "kubeflow_tpu/serve/isvc_controller.py",
+            '"kftpu_serving_requests_total"',
+            '"kftpu_serving_requests_totals"')
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "X701"
+        assert "kftpu_serving_requests_totals" in f.message
+
+    def test_typoed_header_literal_is_caught(self):
+        """Replacing the model server's QOS_HEADER constant read with a
+        typoed literal produces exactly one X703 — nothing sets the
+        misspelled header, so every request silently defaults."""
+        fresh = _new_findings_prog(
+            "kubeflow_tpu/serve/server.py",
+            'raw = self.headers.get(QOS_HEADER) or body.get("qos")',
+            'raw = self.headers.get("X-Kftpu-Qoss") or body.get("qos")')
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "X703" and "X-Kftpu-Qoss" in f.message
+
+    def test_dropped_forward_list_entry_is_caught(self):
+        """Removing the trace header from core/headers.FORWARD_HEADERS
+        produces exactly one X703 on the forward-list — the ChaosProxy
+        would silently break trace continuity through it."""
+        fresh = _new_findings_prog(
+            "kubeflow_tpu/core/headers.py",
+            "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER)",
+            "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER)")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "X703" and "X-Kftpu-Trace" in f.message
+
+    def test_orphaned_rendezvous_env_is_caught(self):
+        """Renaming one rendezvous env var on the WRITE side (bootstrap's
+        child-env dict) produces X704 on the now-orphaned pair."""
+        fresh = _new_findings_prog(
+            "kubeflow_tpu/runtime/bootstrap.py",
+            '"KFTPU_REPLICA_INDEX": str(self.replica_index)',
+            '"KFTPU_REPLICA_IDX": str(self.replica_index)')
+        assert {f.rule for f in fresh} == {"X704"}
+        assert any("KFTPU_REPLICA_IDX" in f.message for f in fresh)
+
+
 # -- self-scan + CLI -----------------------------------------------------------
 
 
@@ -1766,8 +2070,45 @@ class TestCli:
             [sys.executable, "-m", "kubeflow_tpu.analysis", "--list-rules"],
             capture_output=True, text=True, cwd=REPO)
         assert proc.returncode == 0
-        for rid in ("D103", "C301", "M201", "S401", "R503"):
+        for rid in ("D103", "C301", "M201", "S401", "R503", "X701",
+                    "X703", "X704", "X705"):
             assert rid in proc.stdout
+
+    def test_contracts_json_round_trips(self):
+        """--contracts-json emits the whole-program contract table, and
+        the CLI output equals the in-process extraction byte for byte
+        (after JSON round-trip) — the manifest the runtime contract
+        auditor diffs against."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis",
+             "--contracts-json", "kubeflow_tpu"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == 1
+        produced = doc["series"]["produced"]
+        assert "kftpu_serving_requests_total" in produced
+        assert all(":" in s for s in
+                   produced["kftpu_serving_requests_total"])  # clickable
+        assert "kftpu_router_" in doc["series"]["produced_prefixes"]
+        assert "kftpu_serving_queue_delay_seconds" in \
+            doc["series"]["histograms"]
+        assert "kftpu_serving_qos_ttft_p95_ms" in doc["series"]["consumed"]
+        for h in ("X-Kftpu-Deadline-Ms", "X-Kftpu-Qos", "X-Kftpu-Trace"):
+            assert h in doc["headers"]["set"] and h in doc["headers"]["read"]
+            assert h in doc["headers"]["forward_list"]
+        assert "KFTPU_PROCESS_ID" in doc["env"]["set"]
+        assert "KFTPU_PROCESS_ID" in doc["env"]["read"]
+        assert "goodput" in doc["fields"]["consumed"]
+        assert "goodput" in doc["fields"]["produced"]
+
+        from kubeflow_tpu.analysis import build_program
+        from kubeflow_tpu.analysis.rules_contracts import contract_manifest
+
+        local = json.loads(json.dumps(contract_manifest(
+            build_program([os.path.join(REPO, "kubeflow_tpu")],
+                          root=REPO))))
+        assert local == doc
 
     def _git_repo(self, tmp_path):
         def git(*args):

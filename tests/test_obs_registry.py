@@ -101,6 +101,55 @@ def test_parse_exposition_rejects_garbage():
     assert parse_exposition("kftpu_m +Inf")[0][2] == math.inf
 
 
+def test_parse_exposition_empty_payload():
+    """An empty (or whitespace-only) scrape parses to zero samples —
+    the contract extractor's consumers treat that as "no signal", never
+    as an error."""
+    assert parse_exposition("") == []
+    assert parse_exposition("\n\n   \n") == []
+
+
+def test_parse_exposition_histogram_suffix_family():
+    """A labeled histogram renders the full ``_bucket``/``_sum``/
+    ``_count`` family (the suffix grammar the X-rule contract matching
+    strips back to the family name): cumulative buckets, a ``le`` label
+    per bucket with the ``+Inf`` tail, and consistent count/sum lines."""
+    reg = MetricsRegistry()
+    h = reg.histogram("kftpu_ct_delay_seconds", [0.1, 1.0])
+    h.set_cumulative([2, 3, 1], 7.5, 6, model="m", qos="batch")
+    samples = parse_exposition(reg.render())
+    names = {n for n, _, _ in samples}
+    assert names == {"kftpu_ct_delay_seconds_bucket",
+                     "kftpu_ct_delay_seconds_sum",
+                     "kftpu_ct_delay_seconds_count"}
+    buckets = {lbl["le"]: v for n, lbl, v in samples
+               if n == "kftpu_ct_delay_seconds_bucket"}
+    assert buckets == {"0.1": 2, "1.0": 5, "+Inf": 6}   # cumulative
+    for n, lbl, v in samples:
+        assert lbl["model"] == "m" and lbl["qos"] == "batch"
+        if n.endswith("_count"):
+            assert v == 6
+        if n.endswith("_sum"):
+            assert v == 7.5
+
+
+def test_parse_exposition_escaped_label_values_round_trip():
+    """Escaped quotes/backslashes/newlines inside label values must
+    parse back to the original value — including on histogram suffix
+    series, where a bad unescape would split the ``le`` label."""
+    raw = 'tenant "a"\\eu\nwest'
+    reg = MetricsRegistry()
+    reg.counter("kftpu_ct_reqs_total").inc(1, tenant=raw)
+    h = reg.histogram("kftpu_ct_lat_seconds", [0.5])
+    h.observe(0.2, tenant=raw)
+    samples = parse_exposition(reg.render())
+    assert samples, "payload must parse"
+    for name, labels, _ in samples:
+        assert labels["tenant"] == raw
+        if name == "kftpu_ct_lat_seconds_bucket":
+            assert labels["le"] in ("0.5", "+Inf")
+
+
 # -- lint ----------------------------------------------------------------------
 
 def test_lint_flags_unprefixed_names():

@@ -40,12 +40,14 @@ class TestModeParsing:
         assert sanitize_modes() == {"refcount", "lockorder"}
         monkeypatch.setenv("KFTPU_SANITIZE", "all")
         assert sanitize_modes() == {"transfer", "refcount", "lockorder",
-                                    "recompile"}
+                                    "recompile", "contract"}
 
-    def test_recompile_is_a_named_mode(self, monkeypatch):
-        # "recompile" must not degrade to the legacy transfer fallback
+    def test_recompile_and_contract_are_named_modes(self, monkeypatch):
+        # neither must degrade to the legacy transfer fallback
         monkeypatch.setenv("KFTPU_SANITIZE", "recompile")
         assert sanitize_modes() == {"recompile"}
+        monkeypatch.setenv("KFTPU_SANITIZE", "contract")
+        assert sanitize_modes() == {"contract"}
 
     def test_unknown_token_degrades_to_transfer(self, monkeypatch):
         # pre-ISSUE-7 setups used arbitrary truthy values for the
@@ -401,3 +403,109 @@ class TestEngineWiring:
         assert mk().sanitize is False
         monkeypatch.delenv("KFTPU_SANITIZE")
         assert mk().sanitize is False
+
+
+# -- contract auditor (the dynamic half of the X7xx rules, ISSUE 10) -----------
+
+
+class TestContractAuditor:
+    def test_install_note_report_uninstall(self):
+        from kubeflow_tpu.runtime.sanitize import (
+            contract_report, install_contract_auditor,
+            uninstall_contract_auditor,
+        )
+
+        wd = install_contract_auditor()
+        try:
+            assert install_contract_auditor() is wd   # idempotent
+            wd.note_series("kftpu_b", "produced")
+            wd.note_series("kftpu_a", "produced")
+            wd.note_series("kftpu_a", "produced")     # set semantics
+            wd.note_series("kftpu_a", "consumed")
+            wd.note_header("X-Kftpu-Qos", "set")
+            wd.note_header("X-Kftpu-Trace", "read")
+            rep = contract_report()
+            assert rep["series_produced"] == ["kftpu_a", "kftpu_b"]
+            assert rep["series_consumed"] == ["kftpu_a"]
+            assert rep["headers_set"] == ["X-Kftpu-Qos"]
+            assert rep["headers_read"] == ["X-Kftpu-Trace"]
+            wd.reset()
+            assert contract_report() == {
+                "series_produced": [], "series_consumed": [],
+                "headers_set": [], "headers_read": []}
+        finally:
+            uninstall_contract_auditor()
+        assert contract_report() == {}
+
+    def test_diff_matches_exact_suffix_and_prefix(self):
+        from kubeflow_tpu.runtime.sanitize import contract_diff
+
+        static = {
+            "series": {"produced": ["kftpu_delay_seconds", "kftpu_x"],
+                       "consumed": ["kftpu_scraped"],
+                       "produced_prefixes": ["kftpu_router_"]},
+            "headers": {"set": ["X-Kftpu-Qos"], "read": ["X-Kftpu-Trace"]},
+        }
+        report = {
+            "series_produced": [
+                "kftpu_x",                        # exact
+                "kftpu_delay_seconds_bucket",     # histogram suffix
+                "kftpu_router_whatever",          # declared prefix
+                "kftpu_rogue_total",              # UNDECLARED
+            ],
+            "series_consumed": ["kftpu_scraped"],
+            "headers_set": ["x-kftpu-qos"],       # case-insensitive
+            "headers_read": ["X-Kftpu-Rogue"],    # UNDECLARED
+        }
+        diff = contract_diff(report, static)
+        assert diff["undeclared_series"] == ["kftpu_rogue_total"]
+        assert diff["undeclared_headers"] == ["X-Kftpu-Rogue"]
+
+    def test_diff_accepts_manifest_shaped_dicts(self):
+        # --contracts-json emits {name: [sites]} maps; iteration over
+        # them must mean "the declared names", not the site lists.
+        from kubeflow_tpu.runtime.sanitize import contract_diff
+
+        static = {"series": {"produced": {"kftpu_x": ["a.py:1"]},
+                             "consumed": {}},
+                  "headers": {"set": {"X-Kftpu-Qos": ["b.py:2"]},
+                              "read": {}}}
+        report = {"series_produced": ["kftpu_x"],
+                  "headers_set": ["X-Kftpu-Qos"]}
+        diff = contract_diff(report, static)
+        assert diff == {"undeclared_series": [], "undeclared_headers": []}
+
+    def test_maybe_install_contract_mode(self, monkeypatch):
+        from kubeflow_tpu.runtime.sanitize import (
+            contract_auditor, maybe_install, uninstall_contract_auditor,
+        )
+
+        uninstall_contract_auditor()
+        monkeypatch.setenv("KFTPU_SANITIZE", "contract")
+        try:
+            maybe_install()
+            assert contract_auditor() is not None
+        finally:
+            uninstall_contract_auditor()
+
+    def test_registry_render_hook_is_noop_when_off(self):
+        # The obs/registry bridge resolves through sys.modules and must
+        # not record (or fail) when no auditor is installed.
+        from kubeflow_tpu.obs.registry import (
+            MetricsRegistry, contract_note_series,
+        )
+        from kubeflow_tpu.runtime.sanitize import (
+            contract_report, install_contract_auditor,
+            uninstall_contract_auditor,
+        )
+
+        uninstall_contract_auditor()
+        contract_note_series("kftpu_whatever", "produced")   # no-op
+        install_contract_auditor()
+        try:
+            reg = MetricsRegistry()
+            reg.gauge("kftpu_hooked").set(1)
+            reg.render()
+            assert "kftpu_hooked" in contract_report()["series_produced"]
+        finally:
+            uninstall_contract_auditor()
