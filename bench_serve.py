@@ -12,7 +12,8 @@ measures steady state in two back-to-back segments, reporting both so the
 run-to-run spread is visible in one process. Compile time never lands in
 the measured window.
 
-Workloads:
+Workloads (closed-loop A/Bs; ``--workload scenarios`` is the open-loop
+trace-driven path — see ``run_scenarios`` and kubeflow_tpu/loadgen/):
   uniform — fixed 512-token prompts, 64 new tokens (the round-1/2 shape).
   mixed   — lognormal prompt lengths 64..1024 at high concurrency under the
             SAME KV-pool HBM budget for both engines: the paged engine
@@ -82,13 +83,17 @@ def _drive(engine, prompts, params, concurrency):
 
 
 def _summarize(wall, results):
+    # Quantiles via the shared obs/stats implementation (ISSUE 11): the
+    # same linear-interpolation statistic EngineMetrics and the loadgen
+    # report, so client-side and engine-side percentiles are comparable.
+    from kubeflow_tpu.obs.stats import quantile
+
     ttfts = sorted(r[0] for r in results if r[0] is not None)
     tokens = sum(r[2] for r in results)
-    p = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))]  # noqa: E731
     return {
         "req_s": round(len(results) / wall, 2),
-        "p50_ttft_ms": round(p(ttfts, 0.5) * 1e3, 1),
-        "p99_ttft_ms": round(p(ttfts, 0.99) * 1e3, 1),
+        "p50_ttft_ms": round(quantile(ttfts, 0.5) * 1e3, 1),
+        "p99_ttft_ms": round(quantile(ttfts, 0.99) * 1e3, 1),
         "decode_tok_s": round(tokens / wall, 1),
     }
 
@@ -641,11 +646,98 @@ def run_hotloop_ab(requests: int, concurrency: int, prompt_len: int,
     return rows
 
 
+def run_scenarios(requests: int, rate_rps: float, prompt_len: int,
+                  max_new: int, paged: bool = False,
+                  only: str = "all") -> list[dict]:
+    """Open-loop trace-driven scenario matrix (ISSUE 11): replay the
+    canonical loadgen scenarios (uniform Poisson / bursty multi-QoS /
+    shared-prefix long-tail) against one engine and report the full
+    attribution join — client req/s + TTFT/TPOT percentiles + goodput
+    under SLO, engine-internal /metrics signals, and per-phase
+    (queued/prefill/decode) span breakdowns. Unlike the closed-loop
+    workloads above, the offered rate here is a fixed property of the
+    scenario, so queueing collapse shows up as latency/goodput rows
+    instead of silently throttling the client pool."""
+    import jax
+
+    from kubeflow_tpu.loadgen import (
+        EngineTarget, build_report, run_scenario, standard_matrix,
+    )
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.obs.trace import get_tracer
+    from kubeflow_tpu.serve.server import serving_metrics_registry
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = preset(
+            "llama3-8b",
+            n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
+            mlp_dim=8192, vocab_size=32000, max_seq_len=2048)
+        model_tag = "llama3-0.6b"
+    else:
+        cfg = preset("tiny")
+        model_tag = "tiny"
+        prompt_len = min(prompt_len, 48)
+    cap = cfg.max_seq_len - max_new - 1
+    prompt_len = min(prompt_len, max(cap // 2, 8))
+    scenarios = standard_matrix(num_requests=requests, rate_rps=rate_rps,
+                                prompt_len=prompt_len, max_new=max_new)
+    if only != "all":
+        scenarios = [s for s in scenarios if s.name == only]
+        if not scenarios:
+            raise SystemExit(f"unknown scenario {only!r}")
+    tracer = get_tracer()
+    rows = []
+    for sc in scenarios:
+        slots = 16
+        buckets = sorted({min(_p2(prompt_len), cap), min(2 * prompt_len, cap)})
+        engine = _mk_engine(cfg, paged=paged, slots=slots, buckets=buckets,
+                            max_pages=(slots * cfg.max_seq_len // 128
+                                       if paged else None), on_tpu=on_tpu)
+        engine.start()
+        try:
+            tracer.reset()
+            # Warm segment compiles the dispatch set, then the measured
+            # replay runs on a reset metrics window (the two-segment
+            # protocol lives in scripts/serve_perf_smoke.py; this is the
+            # by-hand bench surface).
+            from kubeflow_tpu.serve.engine import EngineMetrics
+            run_scenario(EngineTarget(engine), sc, vocab_size=cfg.vocab_size,
+                         max_prompt_len=cap - 1, tracer=tracer)
+            engine.metrics = EngineMetrics()
+            tracer.reset()
+            run = run_scenario(EngineTarget(engine), sc,
+                               vocab_size=cfg.vocab_size,
+                               max_prompt_len=cap - 1, tracer=tracer)
+            text = serving_metrics_registry([("bench", engine)]).render()
+            rep = build_report(run, metrics_text=text, tracer=tracer)
+        finally:
+            engine.stop()
+        rows.append({
+            "metric": f"serve_scenario_req_per_sec[{model_tag},{sc.name},"
+                      f"r{rate_rps:g},n{requests}"
+                      f"{',paged' if paged else ''}]",
+            "value": rep["req_s"],
+            "unit": "req/s",
+            "vs_baseline": 1.0,
+            "detail": rep,
+        })
+    return rows
+
+
+def _p2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="uniform",
                     choices=["uniform", "mixed", "prefix", "all", "moe",
-                             "quant", "longctx", "spec", "hotloop"])
+                             "quant", "longctx", "spec", "hotloop",
+                             "scenarios"])
     ap.add_argument("--requests", type=int, default=48,
                     help="per measured segment (two segments run)")
     ap.add_argument("--concurrency", type=int, default=16)
@@ -670,7 +762,20 @@ if __name__ == "__main__":
                          "one variant")
     ap.add_argument("--spec-k", type=int, default=6,
                     help="spec workload: draft tokens per round")
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="scenarios workload: offered open-loop req/s")
+    ap.add_argument("--scenario", default="all",
+                    choices=["all", "uniform", "bursty_qos",
+                             "shared_prefix"],
+                    help="scenarios workload: run one scenario")
     args = ap.parse_args()
+    if args.workload == "scenarios":
+        rows = run_scenarios(args.requests, args.rate, args.prompt_len,
+                             args.max_new, paged=args.paged,
+                             only=args.scenario)
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        raise SystemExit(0)
     if args.workload == "hotloop":
         rows = run_hotloop_ab(args.requests, args.concurrency,
                               args.prompt_len, args.max_new,

@@ -1,0 +1,234 @@
+"""Per-scenario attribution reports: WHERE the latency went.
+
+A bare "req/s moved" row is unactionable at fleet scale. Each scenario
+report joins three views of the same run, so a regression names its
+layer instead of just its magnitude:
+
+1. **client-observed** — req/s, TTFT/TPOT p50/p95/p99, goodput under
+   the scenario's TTFT SLO, per-QoS-class splits, and the generator's
+   own schedule lag (an overloaded loadgen reports itself);
+2. **engine-internal** — scraped from the REAL ``/metrics`` exposition
+   through ``obs.registry.parse_exposition`` (the same grammar the SLO
+   autoscaler scrapes through): queue-delay p95, host-gap percentiles,
+   dispatch depth, shed/preemption counters, per-class attribution;
+3. **per-request phase breakdown** — the engine's queued → prefill →
+   decode span durations (``obs.trace.phase_durations``) aggregated to
+   per-phase percentiles, for the traces the ring still holds.
+
+Reading a regression: client TTFT p95 up + queue-delay p95 up + phases
+showing ``queued_ms`` growth = admission backlog (add replicas / shed
+earlier); TTFT up with queue-delay flat but ``prefill_ms`` up = prefill
+path (bucket/chunking change); TPOT up with ``host_gap`` up = the host
+loop re-serialized (pipelining regression).
+
+``report_registry`` renders the client-side numbers as
+``kftpu_loadgen_*`` series through the platform's one exposition path,
+so a long-running loadgen is scrapeable like any other component.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeflow_tpu.obs import stats
+from kubeflow_tpu.obs.registry import (
+    MetricsRegistry, contract_note_series, parse_exposition,
+)
+from kubeflow_tpu.obs.trace import Tracer, get_tracer, phase_durations
+from kubeflow_tpu.loadgen.runner import ScenarioRun
+
+#: Every engine-side series the attribution join consumes off the
+#: /metrics exposition — the loadgen's half of the engine↔loadgen
+#: metrics contract (X7xx checks each name against the model server's
+#: definition sites, exactly like the autoscaler's ``_PROBE_SERIES``).
+ATTRIBUTION_SERIES = (
+    "kftpu_serving_requests_total",
+    "kftpu_serving_requests_shed_total",
+    "kftpu_serving_preemptions_total",
+    "kftpu_serving_queue_delay_p95_ms",
+    "kftpu_serving_ttft_p95_ms",
+    "kftpu_serving_host_gap_p50_ms",
+    "kftpu_serving_host_gap_p99_ms",
+    "kftpu_engine_dispatch_depth",
+    "kftpu_serving_qos_requests_total",
+    "kftpu_serving_qos_requests_shed_total",
+    "kftpu_serving_qos_preemptions_total",
+    "kftpu_serving_qos_ttft_p95_ms",
+    "kftpu_serving_qos_queue_delay_p95_ms",
+)
+
+#: Engine span-name prefix → report phase keys (obs.trace owns the
+#: span names; phase_durations owns the extraction).
+PHASE_KEYS = ("queued_ms", "prefill_ms", "decode_ms")
+
+
+def engine_attribution(metrics_text: str) -> dict:
+    """Parse one /metrics exposition payload into the engine-internal
+    attribution block. Unknown series pass through untouched; a payload
+    that fails the grammar raises (a gate must not silently lose its
+    engine half)."""
+    out: dict = {"qos": {}}
+    for name, labels, value in parse_exposition(metrics_text):
+        if name in ATTRIBUTION_SERIES:
+            # Contract audit: the loadgen CONSUMED this series (no-op
+            # unless KFTPU_SANITIZE=contract).
+            contract_note_series(name, "consumed")
+        if name == "kftpu_serving_requests_total":
+            out["requests_completed"] = out.get("requests_completed", 0) \
+                + int(value)
+        elif name == "kftpu_serving_requests_shed_total":
+            out["requests_shed"] = out.get("requests_shed", 0) + int(value)
+        elif name == "kftpu_serving_preemptions_total":
+            out["preemptions"] = out.get("preemptions", 0) + int(value)
+        elif name == "kftpu_serving_queue_delay_p95_ms":
+            out["queue_delay_p95_ms"] = round(value, 2)
+        elif name == "kftpu_serving_ttft_p95_ms":
+            out["engine_ttft_p95_ms"] = round(value, 2)
+        elif name == "kftpu_serving_host_gap_p50_ms":
+            out["host_gap_p50_ms"] = round(value, 3)
+        elif name == "kftpu_serving_host_gap_p99_ms":
+            out["host_gap_p99_ms"] = round(value, 3)
+        elif name == "kftpu_engine_dispatch_depth":
+            out["dispatch_depth"] = int(value)
+        elif name.startswith("kftpu_serving_qos_"):
+            cls = labels.get("qos")
+            if cls is None:
+                continue
+            c = out["qos"].setdefault(cls, {})
+            if name == "kftpu_serving_qos_requests_total":
+                c["completed"] = c.get("completed", 0) + int(value)
+            elif name == "kftpu_serving_qos_requests_shed_total":
+                c["shed"] = c.get("shed", 0) + int(value)
+            elif name == "kftpu_serving_qos_preemptions_total":
+                c["preempted"] = c.get("preempted", 0) + int(value)
+            elif name == "kftpu_serving_qos_ttft_p95_ms":
+                c["ttft_p95_ms"] = round(value, 2)
+            elif name == "kftpu_serving_qos_queue_delay_p95_ms":
+                c["queue_delay_p95_ms"] = round(value, 2)
+    if not out["qos"]:
+        del out["qos"]
+    return out
+
+
+def phase_breakdown(trace_ids, tracer: Optional[Tracer] = None) -> dict:
+    """Aggregate per-request engine phase durations (queued / prefill /
+    decode, ms) to p50/p95 across the given traces. ``trace_coverage``
+    counts how many requested traces the ring still held — loadgen runs
+    bigger than the ring report partial coverage instead of pretending
+    the sample is the population."""
+    tracer = tracer or get_tracer()
+    per_phase: dict[str, list[float]] = {k: [] for k in PHASE_KEYS}
+    covered = 0
+    for tid in trace_ids:
+        if not tid:
+            continue
+        tr = tracer.trace(tid)
+        if tr is None:
+            continue
+        ph = phase_durations(tr["spans"])
+        if not ph:
+            continue
+        covered += 1
+        for key in PHASE_KEYS:
+            if key in ph:
+                per_phase[key].append(ph[key])
+    out: dict = {"trace_coverage": covered,
+                 "requests_traced": sum(1 for t in trace_ids if t)}
+    for key, xs in per_phase.items():
+        if xs:
+            out[key] = {"p50": round(stats.quantile(xs, 0.5), 3),
+                        "p95": round(stats.quantile(xs, 0.95), 3)}
+    return out
+
+
+def build_report(run: ScenarioRun, *, metrics_text: Optional[str] = None,
+                 tracer: Optional[Tracer] = None) -> dict:
+    """One scenario's full attribution report (see module docstring)."""
+    sc = run.scenario
+    outs = run.outcomes
+    ok = [o for o in outs if o.ok]
+    ttfts = [o.ttft_s for o in ok if o.ttft_s is not None]
+    tpots = [t for t in (o.tpot_s() for o in ok) if t is not None]
+    wall = max(run.wall_s, 1e-9)
+    by_status: dict[str, int] = {}
+    for o in outs:
+        by_status[o.status] = by_status.get(o.status, 0) + 1
+    report: dict = {
+        "scenario": sc.name,
+        "arrival": {"process": sc.arrival.process,
+                    "rate_rps": sc.arrival.rate_rps},
+        "requests": len(outs),
+        "by_status": by_status,
+        "offered_req_s": round(len(outs) / wall, 3),
+        "req_s": round(len(ok) / wall, 3),
+        "tokens_per_sec": round(sum(o.tokens for o in ok) / wall, 1),
+        "ttft_ms": stats.quantiles_ms(ttfts),
+        "tpot_ms": stats.quantiles_ms(tpots),
+        "schedule_lag_ms": stats.quantiles_ms(
+            [o.lag_s for o in outs], qs=(0.5, 0.95)),
+        "prefix_overlap_declared": sc.prefix_overlap,
+    }
+    if sc.slo_ttft_ms is not None:
+        good = sum(1 for o in ok
+                   if o.ttft_s is not None
+                   and o.ttft_s * 1e3 <= sc.slo_ttft_ms)
+        report["goodput"] = {
+            "slo_ttft_ms": sc.slo_ttft_ms,
+            # Goodput is measured against OFFERED load: a shed or timed-
+            # out request is an SLO miss, not a denominator dropout.
+            "ratio": round(good / max(len(outs), 1), 4),
+            "good_requests": good,
+        }
+    qos_out: dict = {}
+    for cls in sorted({o.qos for o in outs}):
+        cls_ok = [o for o in ok if o.qos == cls]
+        cls_all = [o for o in outs if o.qos == cls]
+        entry = {"requests": len(cls_all), "completed": len(cls_ok),
+                 "shed": sum(1 for o in cls_all if o.status == "shed"),
+                 "ttft_ms": stats.quantiles_ms(
+                     [o.ttft_s for o in cls_ok if o.ttft_s is not None],
+                     qs=(0.5, 0.95))}
+        qos_out[cls] = entry
+    if len(qos_out) > 1:
+        report["qos"] = qos_out
+    if metrics_text is not None:
+        report["engine"] = engine_attribution(metrics_text)
+    report["phases"] = phase_breakdown(
+        [o.trace_id for o in outs], tracer=tracer)
+    return report
+
+
+def report_registry(reports) -> MetricsRegistry:
+    """Render client-side scenario results as ``kftpu_loadgen_*`` series
+    through the platform's single exposition path (one labeled sample
+    set per scenario) — documented in the README metric catalog and
+    consumed by ``scripts/serve_perf_smoke.py``."""
+    reg = MetricsRegistry()
+    requests = reg.counter("kftpu_loadgen_requests_total")
+    failed = reg.counter("kftpu_loadgen_requests_failed_total")
+    req_s = reg.gauge("kftpu_loadgen_req_per_sec")
+    offered = reg.gauge("kftpu_loadgen_offered_req_per_sec")
+    ttft_p50 = reg.gauge("kftpu_loadgen_ttft_p50_ms")
+    ttft_p95 = reg.gauge("kftpu_loadgen_ttft_p95_ms")
+    tpot_p50 = reg.gauge("kftpu_loadgen_tpot_p50_ms")
+    goodput = reg.gauge("kftpu_loadgen_goodput_ratio")
+    lag_p95 = reg.gauge("kftpu_loadgen_schedule_lag_p95_ms")
+    for rep in reports:
+        s = rep["scenario"]
+        total = rep.get("requests", 0)
+        bad = sum(n for st, n in rep.get("by_status", {}).items()
+                  if st != "ok")
+        requests.inc(total, scenario=s)
+        failed.inc(bad, scenario=s)
+        req_s.set(rep.get("req_s", 0.0), scenario=s)
+        offered.set(rep.get("offered_req_s", 0.0), scenario=s)
+        if rep.get("ttft_ms"):
+            ttft_p50.set(rep["ttft_ms"].get("p50", 0.0), scenario=s)
+            ttft_p95.set(rep["ttft_ms"].get("p95", 0.0), scenario=s)
+        if rep.get("tpot_ms"):
+            tpot_p50.set(rep["tpot_ms"].get("p50", 0.0), scenario=s)
+        if "goodput" in rep:
+            goodput.set(rep["goodput"]["ratio"], scenario=s)
+        if rep.get("schedule_lag_ms"):
+            lag_p95.set(rep["schedule_lag_ms"].get("p95", 0.0), scenario=s)
+    return reg

@@ -1,0 +1,263 @@
+"""Open-loop scenario replay against a live engine or model server.
+
+The runner fires each ``ScheduledRequest`` at its scheduled wall-clock
+offset regardless of how the previous ones are doing — the open-loop
+discipline (a closed-loop client pool measures capacity under perfect
+backpressure and HIDES queueing collapse; an open-loop generator exposes
+it, which is the entire point of the scenario model). Each request runs
+on its own thread; at smoke/bench scale (tens to hundreds of in-flight
+requests) an OS thread per request is far below the model server's own
+thread-per-connection cost.
+
+Two targets:
+
+- ``EngineTarget`` — direct ``LLMEngine.submit`` with a per-request
+  ``loadgen.request`` root span as ``trace_parent``, so the engine's
+  queued → prefill → decode phase spans join the loadgen's trace;
+- ``ServerTarget`` — HTTP against a running ``ModelServer`` URL:
+  ``POST /v1/completions`` with ``stream=true`` (TTFT = first SSE
+  chunk), the QoS class on the ``X-Kftpu-Qos`` header and the trace
+  context on ``X-Kftpu-Trace`` — the full protocol path the fleet runs.
+
+Every outcome records client-observed TTFT/total latency/token count
+plus ``lag_s`` — how late the generator itself fired versus the
+schedule (a loadgen that cannot keep up with its own schedule reports
+it instead of silently measuring a slower workload).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+import time
+from typing import Optional
+from urllib.parse import urlparse
+
+from kubeflow_tpu.core.headers import QOS_HEADER, TRACE_HEADER
+from kubeflow_tpu.obs.trace import Tracer, get_tracer
+from kubeflow_tpu.loadgen.scenario import (
+    Scenario, ScheduledRequest, build_schedule,
+)
+
+
+@dataclasses.dataclass
+class RequestOutcome:
+    """Client-observed result of one scheduled request."""
+
+    idx: int
+    qos: str
+    scheduled_t: float          # offset the schedule asked for
+    lag_s: float                # how late the generator actually fired
+    ttft_s: Optional[float]     # first token/chunk latency; None = none seen
+    latency_s: float            # submit → terminal
+    tokens: int
+    status: str                 # ok | shed | timeout | error
+    trace_id: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+    def tpot_s(self) -> Optional[float]:
+        """Mean time-per-output-token past the first (None under 2
+        tokens — a single-token answer has no decode cadence)."""
+        if self.ttft_s is None or self.tokens < 2:
+            return None
+        return (self.latency_s - self.ttft_s) / (self.tokens - 1)
+
+
+class EngineTarget:
+    """Direct in-process replay against one ``LLMEngine`` (started)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def issue(self, sr: ScheduledRequest, root,
+              timeout_s: float) -> RequestOutcome:
+        from kubeflow_tpu.serve.engine import (
+            EngineOverloaded, SamplingParams,
+        )
+
+        t0 = time.perf_counter()
+        try:
+            req = self.engine.submit(
+                list(sr.prompt_tokens),
+                SamplingParams(max_new_tokens=sr.max_new_tokens,
+                               temperature=0.0),
+                deadline=time.monotonic() + timeout_s,
+                trace_parent=root, qos=sr.qos)
+        except EngineOverloaded:
+            return RequestOutcome(
+                idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
+                ttft_s=None, latency_s=time.perf_counter() - t0,
+                tokens=0, status="shed")
+        ttft = None
+        tokens = 0
+        status = "ok"
+        deadline = t0 + timeout_s + 1.0
+        while True:
+            try:
+                tok = req.stream.get(timeout=max(
+                    deadline - time.perf_counter(), 0.01))
+            except Exception:            # queue.Empty: wedged engine
+                req.cancel()
+                status = "timeout"
+                break
+            if tok is None:
+                break
+            tokens += 1
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+        if status == "ok" and req.finish_reason not in ("stop", "length"):
+            status = ("shed" if req.finish_reason == "shed" else "error")
+        return RequestOutcome(
+            idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
+            ttft_s=ttft, latency_s=time.perf_counter() - t0,
+            tokens=tokens, status=status)
+
+
+def tokens_to_text(tokens) -> str:
+    """Deterministic token → printable-ASCII mapping for the HTTP path:
+    one char per token, so prompt LENGTH and shared-prefix structure
+    survive the byte tokenizer round-trip exactly."""
+    return "".join(chr(33 + (t % 94)) for t in tokens)
+
+
+class ServerTarget:
+    """HTTP SSE replay against a running model-server URL."""
+
+    def __init__(self, url: str, model: Optional[str] = None):
+        parsed = urlparse(url)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or 80
+        self.model = model
+
+    def issue(self, sr: ScheduledRequest, root,
+              timeout_s: float) -> RequestOutcome:
+        t0 = time.perf_counter()
+        body = {"prompt": tokens_to_text(sr.prompt_tokens),
+                "max_tokens": sr.max_new_tokens, "temperature": 0.0,
+                "stream": True, "timeout": timeout_s}
+        if self.model:
+            body["model"] = self.model
+        payload = json.dumps(body)
+        headers = {"Content-Type": "application/json",
+                   QOS_HEADER: sr.qos}
+        if root is not None and getattr(root, "context", None) is not None:
+            headers[TRACE_HEADER] = root.context.header_value()
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout_s + 5.0)
+        ttft = None
+        tokens = 0
+        status = "ok"
+        try:
+            conn.request("POST", "/v1/completions", body=payload,
+                         headers=headers)
+            resp = conn.getresponse()
+            if resp.status == 429:
+                resp.read()
+                status = "shed"
+            elif resp.status != 200:
+                resp.read()
+                status = "error"
+            else:
+                # SSE: every "data: {...}" line is one streamed token;
+                # "data: [DONE]" terminates.
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    line = line.strip()
+                    if not line.startswith(b"data:"):
+                        continue
+                    data = line[5:].strip()
+                    if data == b"[DONE]":
+                        break
+                    tokens += 1
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+        except (OSError, http.client.HTTPException):
+            status = "timeout" if time.perf_counter() - t0 >= timeout_s \
+                else "error"
+        finally:
+            conn.close()
+        return RequestOutcome(
+            idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
+            ttft_s=ttft, latency_s=time.perf_counter() - t0,
+            tokens=tokens, status=status)
+
+
+@dataclasses.dataclass
+class ScenarioRun:
+    """Raw material ``loadgen.report`` turns into an attribution report."""
+
+    scenario: Scenario
+    outcomes: list
+    wall_s: float
+    schedule: list              # the ScheduledRequests actually replayed
+
+
+def run_scenario(target, scenario: Scenario, *, vocab_size: int,
+                 max_prompt_len: int,
+                 tracer: Optional[Tracer] = None) -> ScenarioRun:
+    """Replay one scenario open-loop and return every outcome.
+
+    The dispatcher thread (this call) sleeps to each request's scheduled
+    offset and fires it on a fresh worker thread; it never waits for
+    completions mid-schedule. ``wall_s`` spans first fire → last
+    completion."""
+    tracer = tracer or get_tracer()
+    schedule = build_schedule(scenario, vocab_size=vocab_size,
+                              max_prompt_len=max_prompt_len)
+    outcomes: list[RequestOutcome] = []
+    lock = threading.Lock()
+
+    def fire(sr: ScheduledRequest, lag: float) -> None:
+        root = tracer.start_span("loadgen.request", scenario=scenario.name,
+                                 request_idx=sr.idx, qos=sr.qos)
+        try:
+            out = target.issue(sr, root, scenario.request_timeout_s)
+        except Exception as exc:  # a client bug must not hang the join
+            root.set_attrs(error=f"{type(exc).__name__}: {exc}")
+            out = RequestOutcome(
+                idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=lag,
+                ttft_s=None, latency_s=0.0, tokens=0, status="error")
+        out.lag_s = lag
+        out.trace_id = getattr(root, "trace_id", "") or ""
+        root.end("ok" if out.ok else out.status)
+        with lock:
+            outcomes.append(out)
+
+    threads: list[threading.Thread] = []
+    t0 = time.perf_counter()
+    for sr in schedule:
+        delay = t0 + sr.t - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        lag = max(time.perf_counter() - (t0 + sr.t), 0.0)
+        th = threading.Thread(target=fire, args=(sr, lag),
+                              name=f"loadgen-{scenario.name}-{sr.idx}",
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    join_deadline = time.perf_counter() + scenario.request_timeout_s + 30.0
+    for th in threads:
+        th.join(timeout=max(join_deadline - time.perf_counter(), 0.1))
+    wall = time.perf_counter() - t0
+    with lock:
+        done = list(outcomes)
+    if len(done) != len(schedule):
+        # A worker that never reported is itself a finding — record it
+        # as a timeout rather than under-counting offered load.
+        reported = {o.idx for o in done}
+        for sr in schedule:
+            if sr.idx not in reported:
+                done.append(RequestOutcome(
+                    idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
+                    ttft_s=None, latency_s=wall, tokens=0,
+                    status="timeout"))
+    done.sort(key=lambda o: o.idx)
+    return ScenarioRun(scenario=scenario, outcomes=done, wall_s=wall,
+                       schedule=schedule)

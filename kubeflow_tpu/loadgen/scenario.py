@@ -1,0 +1,291 @@
+"""Declarative serving-traffic scenarios + deterministic schedule builder.
+
+The serving benches before ISSUE 11 measured ONE closed-loop synthetic
+workload at a time (``bench_serve._drive``: N client threads, each firing
+its next request the moment the previous one finishes) — which measures
+engine capacity under perfect backpressure, a regime production traffic
+never runs in. Real fleets see OPEN-LOOP arrivals: requests land on a
+clock the server does not control, bursts queue instead of politely
+waiting, and latency percentiles under a given *offered* rate are the
+SLO currency. A ``Scenario`` declares that offered traffic:
+
+- **arrival process** (``Arrival``): ``poisson`` (memoryless, the
+  default fleet model), ``bursty`` (``burst_depth`` requests land
+  together — the thundering-herd/queue-knee probe), ``ramp`` (rate
+  climbs linearly across the run — the autoscaler-trigger shape), or
+  ``uniform`` (fixed spacing — the lowest-variance baseline);
+- **prompt/output length distributions** (``LengthDist``): fixed,
+  uniform, lognormal (the long-tail mix bench_serve's ``mixed``
+  workload hand-rolled), or an explicit choice set;
+- **shared-prefix overlap** (``prefix_overlap``): the leading fraction
+  of every prompt drawn from one scenario-wide token pool — the
+  traffic property the paged prefix cache monetizes;
+- **QoS-class mix** (``qos_mix``): per-class arrival weights, riding
+  the existing ``X-Kftpu-Qos`` header end-to-end;
+- **SLO** (``slo_ttft_ms``): the TTFT bound goodput is measured under.
+
+``build_schedule`` expands a scenario into a concrete request list with
+a SEEDED ``numpy`` RNG — same seed, same scenario → byte-identical
+schedule (arrival times, prompts, QoS labels), so an A/B or a
+regression gate replays the exact same traffic on both sides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.core.serving import QOS_DEFAULT, QOS_PRIORITY
+
+ARRIVAL_PROCESSES = ("poisson", "bursty", "ramp", "uniform")
+LENGTH_KINDS = ("fixed", "uniform", "lognormal", "choice")
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """Open-loop arrival process. ``rate_rps`` is the mean offered rate;
+    ``bursty`` preserves it (bursts of ``burst_depth`` spaced
+    ``burst_depth / rate_rps`` apart unless ``burst_gap_s`` overrides);
+    ``ramp`` climbs from ``rate_rps`` to ``ramp_to_rps`` across the
+    schedule."""
+
+    process: str = "poisson"
+    rate_rps: float = 8.0
+    burst_depth: int = 8
+    burst_gap_s: Optional[float] = None
+    ramp_to_rps: Optional[float] = None
+
+    def validate(self) -> None:
+        if self.process not in ARRIVAL_PROCESSES:
+            raise ValueError(f"unknown arrival process {self.process!r}; "
+                             f"known: {ARRIVAL_PROCESSES}")
+        if self.rate_rps <= 0:
+            raise ValueError("rate_rps must be > 0")
+        if self.process == "bursty" and self.burst_depth < 1:
+            raise ValueError("burst_depth must be >= 1")
+        if self.process == "ramp" and (self.ramp_to_rps is None
+                                       or self.ramp_to_rps <= 0):
+            raise ValueError("ramp needs ramp_to_rps > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class LengthDist:
+    """Token-count distribution (prompt or output length). ``low``/
+    ``high`` clip every kind, so a lognormal tail cannot exceed the
+    engine's sequence budget."""
+
+    kind: str = "fixed"
+    value: int = 64                      # fixed
+    low: int = 1
+    high: int = 100_000
+    mu: float = 5.3                      # lognormal (log-space mean)
+    sigma: float = 0.8
+    choices: tuple = ()                  # choice
+
+    def validate(self) -> None:
+        if self.kind not in LENGTH_KINDS:
+            raise ValueError(f"unknown length kind {self.kind!r}; "
+                             f"known: {LENGTH_KINDS}")
+        if self.kind == "choice" and not self.choices:
+            raise ValueError("choice distribution needs choices")
+        if self.low > self.high:
+            raise ValueError("low > high")
+
+    def sample(self, rng: np.random.Generator, cap: int) -> int:
+        """One draw, clipped to [max(1, low), min(high, cap)]."""
+        lo = max(1, self.low)
+        hi = max(lo, min(self.high, cap))
+        if self.kind == "fixed":
+            raw = self.value
+        elif self.kind == "uniform":
+            raw = int(rng.integers(lo, hi + 1))
+        elif self.kind == "lognormal":
+            raw = int(rng.lognormal(self.mu, self.sigma))
+        elif self.kind == "choice":
+            raw = int(rng.choice(np.asarray(self.choices)))
+        else:
+            raise ValueError(self.kind)
+        return int(min(max(raw, lo), hi))
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One declarative traffic scenario (see module docstring)."""
+
+    name: str
+    num_requests: int = 32
+    arrival: Arrival = dataclasses.field(default_factory=Arrival)
+    prompt_len: LengthDist = dataclasses.field(
+        default_factory=lambda: LengthDist(kind="fixed", value=48))
+    output_len: LengthDist = dataclasses.field(
+        default_factory=lambda: LengthDist(kind="fixed", value=16))
+    #: Leading fraction of every prompt drawn from the scenario-wide
+    #: shared pool (0 = fully unique prompts, 0.9 = 90% shared prefix).
+    prefix_overlap: float = 0.0
+    #: ``((class, weight), ...)``; empty = everything QOS_DEFAULT.
+    qos_mix: tuple = ()
+    seed: int = 0
+    #: TTFT bound (ms) goodput is measured under; None = no SLO.
+    slo_ttft_ms: Optional[float] = 1000.0
+    #: Client-side per-request give-up budget (seconds).
+    request_timeout_s: float = 120.0
+
+    def validate(self) -> None:
+        if self.num_requests < 1:
+            raise ValueError("num_requests must be >= 1")
+        if not 0.0 <= self.prefix_overlap <= 1.0:
+            raise ValueError("prefix_overlap must be in [0, 1]")
+        self.arrival.validate()
+        self.prompt_len.validate()
+        self.output_len.validate()
+        total = 0.0
+        for cls, weight in self.qos_mix:
+            if cls not in QOS_PRIORITY:
+                raise ValueError(f"unknown QoS class {cls!r} in qos_mix; "
+                                 f"known: {sorted(QOS_PRIORITY)}")
+            if weight < 0:
+                raise ValueError("qos_mix weights must be >= 0")
+            total += weight
+        if self.qos_mix and total <= 0:
+            raise ValueError("qos_mix weights sum to 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduledRequest:
+    """One concrete request in a built schedule: fire at ``t`` seconds
+    after the run starts."""
+
+    idx: int
+    t: float
+    prompt_tokens: tuple
+    max_new_tokens: int
+    qos: str
+
+
+def arrival_times(arrival: Arrival, n: int,
+                  rng: np.random.Generator) -> list[float]:
+    """``n`` non-decreasing arrival offsets (seconds from run start)."""
+    arrival.validate()
+    rate = arrival.rate_rps
+    if arrival.process == "uniform":
+        return [i / rate for i in range(n)]
+    if arrival.process == "poisson":
+        gaps = rng.exponential(1.0 / rate, size=n)
+        gaps[0] = 0.0                    # first arrival starts the clock
+        return np.cumsum(gaps).tolist()
+    if arrival.process == "bursty":
+        depth = arrival.burst_depth
+        gap = (arrival.burst_gap_s if arrival.burst_gap_s is not None
+               else depth / rate)
+        return [(i // depth) * gap for i in range(n)]
+    # ramp: per-arrival rate climbs linearly rate → ramp_to_rps.
+    out: list[float] = []
+    t = 0.0
+    for i in range(n):
+        frac = i / max(n - 1, 1)
+        r = rate + (arrival.ramp_to_rps - rate) * frac
+        out.append(t)
+        t += float(rng.exponential(1.0 / r))
+    return out
+
+
+def build_schedule(scenario: Scenario, *, vocab_size: int,
+                   max_prompt_len: int) -> list[ScheduledRequest]:
+    """Expand a scenario into its concrete, deterministic request list.
+
+    One seeded RNG drives everything in a FIXED draw order (arrivals,
+    then per-request lengths/tokens/class), so equal (scenario, vocab,
+    cap) inputs produce identical schedules — the property the perf
+    gate's replay and the determinism tests pin."""
+    scenario.validate()
+    if vocab_size < 2:
+        raise ValueError("vocab_size must be >= 2")
+    if max_prompt_len < 1:
+        raise ValueError("max_prompt_len must be >= 1")
+    rng = np.random.default_rng(scenario.seed)
+    times = arrival_times(scenario.arrival, scenario.num_requests, rng)
+    # The shared pool every prompt's prefix comes from: drawn once per
+    # scenario, so overlapping prompts share ACTUAL token content (the
+    # thing a prefix cache can hit on), not just a length statistic.
+    shared = rng.integers(1, vocab_size, size=max_prompt_len)
+    classes = [cls for cls, _ in scenario.qos_mix] or [QOS_DEFAULT]
+    weights = np.asarray([w for _, w in scenario.qos_mix] or [1.0], float)
+    weights = weights / weights.sum()
+    out: list[ScheduledRequest] = []
+    for i in range(scenario.num_requests):
+        plen = scenario.prompt_len.sample(rng, max_prompt_len)
+        k = int(round(scenario.prefix_overlap * plen))
+        tail = rng.integers(1, vocab_size, size=plen - k)
+        prompt = tuple(int(x) for x in shared[:k]) \
+            + tuple(int(x) for x in tail)
+        out.append(ScheduledRequest(
+            idx=i, t=float(times[i]), prompt_tokens=prompt,
+            max_new_tokens=scenario.output_len.sample(rng, 100_000),
+            qos=str(rng.choice(classes, p=weights))))
+    return out
+
+
+def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
+                    prompt_len: int = 48, max_new: int = 16,
+                    slo_ttft_ms: float = 2000.0,
+                    seed: int = 0) -> list[Scenario]:
+    """The canonical 3-scenario serving matrix the perf gate and
+    ``bench_serve.py --workload scenarios`` both replay:
+
+    - ``uniform`` — Poisson arrivals, fixed lengths, one QoS class: the
+      steady-state baseline every regression is easiest to read on;
+    - ``bursty_qos`` — burst arrivals with a mixed interactive/batch
+      class split: exercises admission, shed ordering, and cross-class
+      preemption (the per-class attribution rows);
+    - ``shared_prefix`` — Poisson arrivals with 75% shared-prefix
+      prompts and a long-tail length mix: the prefix-cache/paged-pool
+      regime (ROADMAP item 1's success metric runs through this shape).
+    """
+    return [
+        Scenario(
+            name="uniform", num_requests=num_requests, seed=seed,
+            arrival=Arrival(process="poisson", rate_rps=rate_rps),
+            prompt_len=LengthDist(kind="fixed", value=prompt_len),
+            output_len=LengthDist(kind="fixed", value=max_new),
+            slo_ttft_ms=slo_ttft_ms),
+        Scenario(
+            name="bursty_qos", num_requests=num_requests, seed=seed + 1,
+            arrival=Arrival(process="bursty", rate_rps=rate_rps,
+                            burst_depth=max(4, num_requests // 4)),
+            prompt_len=LengthDist(kind="uniform", low=max(8, prompt_len // 4),
+                                  high=prompt_len),
+            output_len=LengthDist(kind="fixed", value=max_new),
+            qos_mix=(("interactive", 0.5), ("batch", 0.5)),
+            slo_ttft_ms=slo_ttft_ms),
+        Scenario(
+            name="shared_prefix", num_requests=num_requests, seed=seed + 2,
+            arrival=Arrival(process="poisson", rate_rps=rate_rps),
+            prompt_len=LengthDist(kind="lognormal",
+                                  mu=float(np.log(max(prompt_len, 2))),
+                                  sigma=0.4, low=max(8, prompt_len // 4),
+                                  high=2 * prompt_len),
+            output_len=LengthDist(kind="fixed", value=max_new),
+            prefix_overlap=0.75, slo_ttft_ms=slo_ttft_ms),
+    ]
+
+
+def measured_prefix_overlap(prompts: Sequence[Sequence[int]]) -> float:
+    """Mean shared-prefix fraction over consecutive prompt pairs:
+    ``lcp(p_i, p_{i+1}) / min(len_i, len_{i+1})`` — the check that the
+    generated traffic actually HAS the overlap the scenario declared
+    (for ``prefix_overlap=f`` and immediately-diverging tails this
+    measures ≈ f)."""
+    if len(prompts) < 2:
+        return 0.0
+    fracs = []
+    for a, b in zip(prompts, prompts[1:]):
+        n = min(len(a), len(b))
+        if n == 0:
+            continue
+        lcp = 0
+        while lcp < n and a[lcp] == b[lcp]:
+            lcp += 1
+        fracs.append(lcp / n)
+    return sum(fracs) / max(len(fracs), 1)

@@ -1,0 +1,76 @@
+"""Shared quantile/summary helpers — ONE implementation of "p95".
+
+Before ISSUE 11 the platform computed percentiles three different ways:
+``bench_serve.py`` used a truncating nearest-rank lambda (``xs[int(q *
+len(xs))]`` — biased low, and ``p(xs, 1.0)`` indexed past the end but for
+the clamp), ``EngineMetrics.snapshot`` called ``np.percentile`` (linear
+interpolation), and each new consumer re-picked one. A perf gate that
+compares a client-side p95 against an engine-side p95 needs them to be the
+SAME statistic, so the linear-interpolation definition (numpy's default,
+exact at the boundaries: ``q=0`` → min, ``q=1`` → max, ``q=0.5`` of an
+odd-length list → the middle element) lives here and everything —
+loadgen, bench_serve, ``EngineMetrics`` — imports it.
+
+Pure stdlib on the hot path (no numpy import cost for callers that only
+summarize a handful of floats)."""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional, Sequence
+
+
+def quantile(xs: Sequence[float], q: float) -> float:
+    """Linear-interpolation quantile of ``xs`` (numpy's default method).
+
+    ``q`` in [0, 1]. Exact at the boundaries: ``quantile(xs, 0)`` is the
+    minimum, ``quantile(xs, 1)`` the maximum, and for a sorted odd-length
+    list ``quantile(xs, 0.5)`` is the exact middle element. Raises on an
+    empty sequence (a silent 0.0 would read as a perfect latency)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile q must be in [0, 1], got {q}")
+    s = sorted(xs)
+    if not s:
+        raise ValueError("quantile of empty sequence")
+    pos = q * (len(s) - 1)
+    lo = math.floor(pos)
+    hi = math.ceil(pos)
+    if lo == hi:
+        return float(s[lo])
+    frac = pos - lo
+    return float(s[lo]) * (1.0 - frac) + float(s[hi]) * frac
+
+
+def percentile(xs: Sequence[float], p: float) -> float:
+    """``quantile`` with ``p`` in [0, 100] — the numpy spelling."""
+    return quantile(xs, p / 100.0)
+
+
+def quantiles_ms(xs: Sequence[float],
+                 qs: Iterable[float] = (0.5, 0.95, 0.99)) -> dict:
+    """Seconds → milliseconds percentile summary: ``{"p50": ..., "p95":
+    ..., "p99": ...}`` (keys from ``qs``), rounded to 0.1 ms. Empty input
+    returns {} — absent beats fabricated."""
+    if not xs:
+        return {}
+    s = sorted(xs)
+    return {_plabel(q): round(quantile(s, q) * 1e3, 1) for q in qs}
+
+
+def _plabel(q: float) -> str:
+    # 0.95 → "p95", 0.999 → "p99.9" (float-noise-proof: 0.95*100 is
+    # 94.99999... in binary).
+    return f"p{round(q * 100, 4):g}"
+
+
+def summarize(xs: Sequence[float],
+              qs: Iterable[float] = (0.5, 0.95, 0.99)) -> Optional[dict]:
+    """Count/mean/percentile summary of raw (same-unit) samples, or None
+    for no samples."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    out = {"n": len(s), "mean": sum(s) / len(s), "min": s[0], "max": s[-1]}
+    for q in qs:
+        out[_plabel(q)] = quantile(s, q)
+    return out

@@ -363,12 +363,43 @@ class Tracer:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+#: The engine's per-request lifecycle phases, in span-name order
+#: (``engine.queued`` → ``engine.prefill`` → ``engine.decode``).
+ENGINE_PHASES = ("queued", "prefill", "decode")
+
+
+def phase_durations(spans: list[dict]) -> dict:
+    """Total engine time per lifecycle phase in a span list, in ms:
+    ``{"queued_ms": ..., "prefill_ms": ..., "decode_ms": ...}``.
+
+    Sums every closed ``engine.<phase>`` span — a preempted request
+    contributes two queued (and prefill) spans, and the sum is the real
+    time it spent in that phase. Phases with no closed span are absent;
+    a trace with no engine spans returns {}. This is the per-request
+    breakdown the serving loadgen's attribution reports aggregate, and
+    the rollup ``/debug/traces`` and ``kftpu trace`` print per trace."""
+    out: dict = {}
+    for s in spans:
+        name = s.get("name", "")
+        if not name.startswith("engine."):
+            continue
+        phase = name.split(".", 1)[1]
+        if phase not in ENGINE_PHASES or s.get("duration_ms") is None:
+            continue
+        key = f"{phase}_ms"
+        out[key] = round(out.get(key, 0.0) + s["duration_ms"], 3)
+    return out
+
+
 def debug_traces_payload(path: str,
                          tracer: Optional[Tracer] = None) -> dict:
     """The shared ``/debug/traces`` response body: recent traces as JSON,
     ``?slowest=N`` for the N slowest by root duration, ``?chrome=1`` for a
     Chrome trace-event export. Every HTTP surface (model server, router,
-    platform API server) serves this one payload."""
+    platform API server) serves this one payload. Traces touching the
+    engine carry a ``phases`` rollup (queued/prefill/decode ms) so the
+    slowest-request view says which phase ate the time without reading
+    the span tree."""
     from urllib.parse import parse_qs, urlparse
 
     t = tracer or get_tracer()
@@ -380,7 +411,12 @@ def debug_traces_payload(path: str,
         slowest = int(slowest_raw) if slowest_raw is not None else None
     except ValueError:
         slowest = None
-    return {"traces": t.traces(slowest=slowest)}
+    traces = t.traces(slowest=slowest)
+    for tr in traces:
+        phases = phase_durations(tr["spans"])
+        if phases:
+            tr["phases"] = phases
+    return {"traces": traces}
 
 
 def format_trace_tree(spans: list[dict]) -> str:
@@ -434,6 +470,13 @@ def format_dump(doc: dict) -> str:
             head = f"trace {t['trace_id']}"
             if dur is not None:
                 head += f" ({dur:.1f} ms, {root.get('name')})"
+            # Engine-phase rollup (from the payload when present, else
+            # recomputed — old dump files still get the line).
+            phases = t.get("phases") or phase_durations(t.get("spans", []))
+            if phases:
+                head += "  [" + " ".join(
+                    f"{p}={phases[f'{p}_ms']:.1f}ms" for p in ENGINE_PHASES
+                    if f"{p}_ms" in phases) + "]"
             out.append(head)
             out.append(format_trace_tree(t["spans"]))
         return "\n".join(out)

@@ -74,6 +74,7 @@ from kubeflow_tpu.serve.device_state import DEAD_SLOT, DecodeState
 from kubeflow_tpu.models import layers as L
 from kubeflow_tpu.models.config import DecoderConfig
 from kubeflow_tpu.models.decoder import Params, decoder_forward, init_decoder_params
+from kubeflow_tpu.obs.stats import quantile as _quantile
 from kubeflow_tpu.obs.trace import get_tracer
 
 logger = logging.getLogger("kubeflow_tpu.serve.engine")
@@ -672,9 +673,7 @@ class EngineMetrics:
             if self._qd_n:
                 out["queue_delay_avg_ms"] = self._qd_sum / self._qd_n * 1e3
             if self._qd:
-                arr = np.asarray(self._qd)
-                out["queue_delay_p95_ms"] = float(
-                    np.percentile(arr, 95) * 1e3)
+                out["queue_delay_p95_ms"] = _quantile(self._qd, 0.95) * 1e3
             # Per-class SLO attainment: the series the signal-driven
             # autoscaler and the overload dashboards read.
             qos_out: dict[str, dict[str, Any]] = {}
@@ -683,28 +682,24 @@ class EngineMetrics:
                                      "shed": e["shed"],
                                      "preempted": e["preempted"]}
                 if e["ttft"]:
-                    arr = np.asarray(e["ttft"])
-                    c["ttft_p50_ms"] = float(np.percentile(arr, 50) * 1e3)
-                    c["ttft_p95_ms"] = float(np.percentile(arr, 95) * 1e3)
+                    c["ttft_p50_ms"] = _quantile(e["ttft"], 0.5) * 1e3
+                    c["ttft_p95_ms"] = _quantile(e["ttft"], 0.95) * 1e3
                 if e["qd"]:
-                    arr = np.asarray(e["qd"])
-                    c["queue_delay_p95_ms"] = float(
-                        np.percentile(arr, 95) * 1e3)
+                    c["queue_delay_p95_ms"] = _quantile(e["qd"], 0.95) * 1e3
                 qos_out[cls] = c
             if qos_out:
                 out["qos"] = qos_out
             out["dispatch_depth"] = self.dispatch_depth
             if self._hg_n:
                 out["host_gap_seconds"] = self._hg_sum
-                arr = np.asarray(self._hg)
-                out["host_gap_p50_ms"] = float(np.percentile(arr, 50) * 1e3)
-                out["host_gap_p99_ms"] = float(np.percentile(arr, 99) * 1e3)
+                out["host_gap_p50_ms"] = _quantile(self._hg, 0.5) * 1e3
+                out["host_gap_p99_ms"] = _quantile(self._hg, 0.99) * 1e3
             for name, xs in (("ttft", self._ttft), ("tpot", self._tpot)):
                 if xs:
-                    arr = np.asarray(xs)
-                    out[f"{name}_p50_ms"] = float(np.percentile(arr, 50) * 1e3)
-                    out[f"{name}_p95_ms"] = float(np.percentile(arr, 95) * 1e3)
-                    out[f"{name}_p99_ms"] = float(np.percentile(arr, 99) * 1e3)
+                    srt = sorted(xs)
+                    out[f"{name}_p50_ms"] = _quantile(srt, 0.5) * 1e3
+                    out[f"{name}_p95_ms"] = _quantile(srt, 0.95) * 1e3
+                    out[f"{name}_p99_ms"] = _quantile(srt, 0.99) * 1e3
             if self.spec_rounds:
                 out["spec_rounds"] = self.spec_rounds
                 out["spec_acceptance_rate"] = (

@@ -291,10 +291,6 @@ class ModelServer:
         """Scrape-time registry over the live engine counters — the model
         server's half of the platform's single exposition path
         (obs/registry.py)."""
-        reg = MetricsRegistry()
-        requests_total = reg.counter("kftpu_serving_requests_total")
-        tokens_total = reg.counter("kftpu_serving_tokens_total")
-        reg.gauge("kftpu_serving_in_flight").set(self.in_flight)
         engines: list[tuple[str, LLMEngine]] = []
         if self.engine is not None:
             engines.append((self.name, self.engine))
@@ -305,70 +301,85 @@ class ModelServer:
                 entry = self.repository.peek(item["name"])
                 if entry is not None and entry.engine is not None:
                     engines.append((entry.name, entry.engine))
-        queue_depth = reg.gauge("kftpu_serving_queue_depth")
-        shed = reg.counter("kftpu_serving_requests_shed_total")
-        cancelled = reg.counter("kftpu_serving_requests_cancelled_total")
-        expired = reg.counter("kftpu_serving_requests_expired_total")
-        qdelay = reg.histogram("kftpu_serving_queue_delay_seconds",
-                               QUEUE_DELAY_BUCKETS)
-        # Multi-tenant QoS: per-class SLO attainment (the series the
-        # signal-driven autoscaler weighs) + shed/preemption attribution.
-        preempt = reg.counter("kftpu_serving_preemptions_total")
-        qos_requests = reg.counter("kftpu_serving_qos_requests_total")
-        qos_shed = reg.counter("kftpu_serving_qos_requests_shed_total")
-        qos_preempt = reg.counter("kftpu_serving_qos_preemptions_total")
-        qos_ttft = reg.gauge("kftpu_serving_qos_ttft_p95_ms")
-        qos_qd = reg.gauge("kftpu_serving_qos_queue_delay_p95_ms")
-        qos_qdelay = reg.histogram("kftpu_serving_qos_queue_delay_seconds",
-                                   QUEUE_DELAY_BUCKETS)
-        # Decode hot-loop health (pipelined dispatch): per-round host gap
-        # + how many rounds ride in flight. A pipelined engine shows
-        # near-zero gaps and depth 1; gaps growing toward the round time
-        # mean the host (detokenize/stream/admit) is the bottleneck again.
-        host_gap = reg.histogram("kftpu_engine_host_gap_seconds",
-                                 HOST_GAP_BUCKETS)
-        depth = reg.gauge("kftpu_engine_dispatch_depth")
-        for name, engine in engines:
-            snap = engine.metrics.snapshot()
-            requests_total.inc(snap["requests_completed"], model=name)
-            tokens_total.inc(snap["tokens_generated"], model=name)
-            for k in ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
-                      "tpot_p50_ms", "queue_delay_p95_ms",
-                      "requests_per_sec", "tokens_per_sec",
-                      "spec_acceptance_rate", "spec_tokens_per_step",
-                      "spec_draft_overhead", "host_gap_p50_ms",
-                      "host_gap_p99_ms"):
-                if k in snap:
-                    reg.gauge(f"kftpu_serving_{k}").set(snap[k], model=name)
-            # Load-shedding / lifecycle surface: queue depth, shed and reap
-            # counters, and the queue-delay histogram — the dashboards that
-            # show an overload knee BEFORE clients start timing out.
-            queue_depth.set(engine.queue_depth(), model=name)
-            shed.inc(snap["requests_shed"], model=name)
-            cancelled.inc(snap["requests_cancelled"], model=name)
-            expired.inc(snap["requests_expired"], model=name)
-            _, counts, qsum, qn = engine.metrics.queue_delay_histogram()
-            qdelay.set_cumulative(counts, qsum, qn, model=name)
-            preempt.inc(snap.get("preemptions", 0), model=name)
-            for cls, c in snap.get("qos", {}).items():
-                qos_requests.inc(c["completed"], model=name, qos=cls)
-                qos_shed.inc(c["shed"], model=name, qos=cls)
-                qos_preempt.inc(c["preempted"], model=name, qos=cls)
-                if "ttft_p95_ms" in c:
-                    qos_ttft.set(c["ttft_p95_ms"], model=name, qos=cls)
-                if "queue_delay_p95_ms" in c:
-                    qos_qd.set(c["queue_delay_p95_ms"], model=name, qos=cls)
-                _, ccounts, csum, cn = \
-                    engine.metrics.queue_delay_histogram(cls)
-                qos_qdelay.set_cumulative(ccounts, csum, cn,
-                                          model=name, qos=cls)
-            _, hcounts, hsum, hn = engine.metrics.host_gap_histogram()
-            host_gap.set_cumulative(hcounts, hsum, hn, model=name)
-            depth.set(snap.get("dispatch_depth", 0), model=name)
-        return reg
+        return serving_metrics_registry(engines, in_flight=self.in_flight)
 
     def metrics_text(self) -> str:
         return self.metrics_registry().render()
+
+
+def serving_metrics_registry(engines: list, *,
+                             in_flight: int = 0) -> MetricsRegistry:
+    """Build the serving ``/metrics`` registry for a set of ``(name,
+    engine)`` pairs — the ONE definition of every ``kftpu_serving_*`` /
+    ``kftpu_engine_*`` series. The model server scrapes through it, and
+    the loadgen's direct-engine target renders the SAME exposition for
+    its attribution join, so "engine-internal signals" always means the
+    production series, never a parallel bookkeeping path."""
+    reg = MetricsRegistry()
+    requests_total = reg.counter("kftpu_serving_requests_total")
+    tokens_total = reg.counter("kftpu_serving_tokens_total")
+    reg.gauge("kftpu_serving_in_flight").set(in_flight)
+    queue_depth = reg.gauge("kftpu_serving_queue_depth")
+    shed = reg.counter("kftpu_serving_requests_shed_total")
+    cancelled = reg.counter("kftpu_serving_requests_cancelled_total")
+    expired = reg.counter("kftpu_serving_requests_expired_total")
+    qdelay = reg.histogram("kftpu_serving_queue_delay_seconds",
+                           QUEUE_DELAY_BUCKETS)
+    # Multi-tenant QoS: per-class SLO attainment (the series the
+    # signal-driven autoscaler weighs) + shed/preemption attribution.
+    preempt = reg.counter("kftpu_serving_preemptions_total")
+    qos_requests = reg.counter("kftpu_serving_qos_requests_total")
+    qos_shed = reg.counter("kftpu_serving_qos_requests_shed_total")
+    qos_preempt = reg.counter("kftpu_serving_qos_preemptions_total")
+    qos_ttft = reg.gauge("kftpu_serving_qos_ttft_p95_ms")
+    qos_qd = reg.gauge("kftpu_serving_qos_queue_delay_p95_ms")
+    qos_qdelay = reg.histogram("kftpu_serving_qos_queue_delay_seconds",
+                               QUEUE_DELAY_BUCKETS)
+    # Decode hot-loop health (pipelined dispatch): per-round host gap
+    # + how many rounds ride in flight. A pipelined engine shows
+    # near-zero gaps and depth 1; gaps growing toward the round time
+    # mean the host (detokenize/stream/admit) is the bottleneck again.
+    host_gap = reg.histogram("kftpu_engine_host_gap_seconds",
+                             HOST_GAP_BUCKETS)
+    depth = reg.gauge("kftpu_engine_dispatch_depth")
+    for name, engine in engines:
+        snap = engine.metrics.snapshot()
+        requests_total.inc(snap["requests_completed"], model=name)
+        tokens_total.inc(snap["tokens_generated"], model=name)
+        for k in ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+                  "tpot_p50_ms", "queue_delay_p95_ms",
+                  "requests_per_sec", "tokens_per_sec",
+                  "spec_acceptance_rate", "spec_tokens_per_step",
+                  "spec_draft_overhead", "host_gap_p50_ms",
+                  "host_gap_p99_ms"):
+            if k in snap:
+                reg.gauge(f"kftpu_serving_{k}").set(snap[k], model=name)
+        # Load-shedding / lifecycle surface: queue depth, shed and reap
+        # counters, and the queue-delay histogram — the dashboards that
+        # show an overload knee BEFORE clients start timing out.
+        queue_depth.set(engine.queue_depth(), model=name)
+        shed.inc(snap["requests_shed"], model=name)
+        cancelled.inc(snap["requests_cancelled"], model=name)
+        expired.inc(snap["requests_expired"], model=name)
+        _, counts, qsum, qn = engine.metrics.queue_delay_histogram()
+        qdelay.set_cumulative(counts, qsum, qn, model=name)
+        preempt.inc(snap.get("preemptions", 0), model=name)
+        for cls, c in snap.get("qos", {}).items():
+            qos_requests.inc(c["completed"], model=name, qos=cls)
+            qos_shed.inc(c["shed"], model=name, qos=cls)
+            qos_preempt.inc(c["preempted"], model=name, qos=cls)
+            if "ttft_p95_ms" in c:
+                qos_ttft.set(c["ttft_p95_ms"], model=name, qos=cls)
+            if "queue_delay_p95_ms" in c:
+                qos_qd.set(c["queue_delay_p95_ms"], model=name, qos=cls)
+            _, ccounts, csum, cn = \
+                engine.metrics.queue_delay_histogram(cls)
+            qos_qdelay.set_cumulative(ccounts, csum, cn,
+                                      model=name, qos=cls)
+        _, hcounts, hsum, hn = engine.metrics.host_gap_histogram()
+        host_gap.set_cumulative(hcounts, hsum, hn, model=name)
+        depth.set(snap.get("dispatch_depth", 0), model=name)
+    return reg
 
 
 def _make_handler(server: ModelServer):

@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Serving-perf gate (scripts/smoke.sh): trace-driven scenario matrix +
+thresholded regression check — the serving analogue of the train bench
+gate (ISSUE 11).
+
+Replays the canonical 3-scenario loadgen matrix (uniform Poisson /
+bursty multi-QoS / shared-prefix on the paged prefix-cache engine)
+open-loop over the FULL protocol path — HTTP SSE against a real
+``ModelServer``, QoS on the ``X-Kftpu-Qos`` header, trace context on
+``X-Kftpu-Trace`` — and gates on:
+
+- **two-segment agreement**: each scenario runs two back-to-back
+  measured segments after a warm segment; the segments must agree on
+  req/s and TTFT p95 within the noise band derived from their own
+  spread (``loadgen.gate.noise_band_pct`` — the bench.py methodology);
+- **seeded regression detection**: an artificially throttled dispatch
+  (a sleep wedged into ``engine.step``) replayed on the uniform
+  scenario MUST breach the threshold and the failure must carry the
+  attribution diff naming where the latency went — a comparator that
+  cannot see a planted regression gates nothing;
+- **attribution completeness**: engine-internal signals (queue-delay
+  p95, host gap, per-class shed/preempt counters) joined from the real
+  ``/metrics`` exposition, per-phase (queued/prefill/decode) span
+  breakdowns with nonzero trace coverage, per-class rows for BOTH QoS
+  classes in the bursty scenario, and the measured shared-prefix
+  overlap within tolerance of the declared fraction;
+- **hygiene**: ``open_spans() == 0`` after every segment (the
+  quiescence invariant), zero leaked KV pages on the paged engine, the
+  ``kftpu_loadgen_*`` report registry passing the metric-name lint and
+  the exposition grammar, and ``/debug/traces?slowest=N`` surfacing the
+  per-phase rollup.
+
+Writes the measured matrix to ``BENCH_SERVE_r01.json`` at the repo root
+(the serving twin of ``BENCH_r0x.json`` — one row per scenario with the
+full attribution report), prints one JSON object;
+``{"serve_perf_smoke": "ok"}`` is the gate line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Loadgen report series the stage consumes off the rendered registry —
+#: the consumer half of the kftpu_loadgen_* metric contract (X7xx).
+LOADGEN_SERIES = (
+    "kftpu_loadgen_requests_total",
+    "kftpu_loadgen_requests_failed_total",
+    "kftpu_loadgen_req_per_sec",
+    "kftpu_loadgen_offered_req_per_sec",
+    "kftpu_loadgen_ttft_p50_ms",
+    "kftpu_loadgen_ttft_p95_ms",
+    "kftpu_loadgen_tpot_p50_ms",
+    "kftpu_loadgen_goodput_ratio",
+    "kftpu_loadgen_schedule_lag_p95_ms",
+)
+
+PROMPT_LEN = 32
+MAX_NEW = 8
+
+
+def make_server(*, paged: bool):
+    import jax
+
+    from kubeflow_tpu.core.serving import BatchingSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.models.decoder import init_decoder_params
+    from kubeflow_tpu.serve.engine import LLMEngine
+    from kubeflow_tpu.serve.server import ModelServer
+
+    cfg = preset("tiny")
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+    engine = LLMEngine(cfg, BatchingSpec(
+        max_batch_size=8, max_seq_len=cfg.max_seq_len,
+        prefill_buckets=[32, 64], chunked_prefill_tokens=32,
+        paged=paged, page_size=16, decode_steps=4), params=params)
+    srv = ModelServer("perf-smoke", engine, port=0)
+    srv.start()
+    return srv, cfg
+
+
+def scrape(url: str, path: str = "/metrics") -> str:
+    with urllib.request.urlopen(url + path, timeout=10.0) as r:
+        return r.read().decode()
+
+
+def warm_server(srv, cfg) -> None:
+    """Compile the whole dispatch set BEFORE measuring (the bench_serve
+    methodology: compile time never lands in a measured window). The
+    lazy set is width-shaped: prefill GROUPS and first-token sampler
+    batches compile per power-of-two size, so a measured segment whose
+    Poisson arrivals happen to co-batch 2 requests for the first time
+    eats a fresh ~0.5s compile mid-measurement. Bunches of each p2 depth
+    per bucket, submitted back-to-back and drained between bunches, in
+    two passes (a racy admit split in pass 1 leaves widths pass 2
+    covers)."""
+    from kubeflow_tpu.serve.engine import SamplingParams
+
+    eng = srv.engine
+    params = SamplingParams(max_new_tokens=MAX_NEW, temperature=0.0)
+    for _ in range(2):
+        for bucket in (32, 64):
+            for depth in (8, 4, 2, 1):
+                reqs = [eng.submit(
+                    [1 + (7 * i + j) % (cfg.vocab_size - 2)
+                     for j in range(bucket - 2)], params)
+                    for i in range(depth)]
+                for r in reqs:
+                    r.result(timeout=60.0)
+
+
+def run_segment(srv, cfg, scenario):
+    """One measured segment: fresh engine metrics + trace ring, replay,
+    scrape, report. Returns (report, open_spans_after)."""
+    from kubeflow_tpu.loadgen import ServerTarget, build_report, run_scenario
+    from kubeflow_tpu.obs.trace import get_tracer
+    from kubeflow_tpu.serve.engine import EngineMetrics
+
+    tracer = get_tracer()
+    tracer.reset()
+    srv.engine.metrics = EngineMetrics()
+    run = run_scenario(ServerTarget(srv.url), scenario,
+                       vocab_size=cfg.vocab_size,
+                       max_prompt_len=cfg.max_seq_len - MAX_NEW - 2,
+                       tracer=tracer)
+    text = scrape(srv.url)
+    rep = build_report(run, metrics_text=text, tracer=tracer)
+    # The scheduler may still be closing the final request's span when
+    # the last stream chunk lands client-side; settle briefly.
+    deadline = time.monotonic() + 5.0
+    while tracer.open_spans() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return rep, run, tracer.open_spans()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=16,
+                    help="per measured segment")
+    # Offered rate sits clearly UNDER the tiny CPU engine's ~8 req/s
+    # capacity: the gate measures latency at a sustainable rate (the
+    # regime where two segments agree), not queueing collapse — the
+    # seeded throttle below drives capacity under the offered rate,
+    # which is exactly the regression shape the gate must catch.
+    ap.add_argument("--rate", type=float, default=5.0)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BENCH_SERVE_r01.json"))
+    args = ap.parse_args()
+
+    from kubeflow_tpu.loadgen import (
+        build_schedule, compare_matrix, measured_prefix_overlap,
+        noise_band_pct, report_registry, spread_pct, standard_matrix,
+    )
+    from kubeflow_tpu.obs.registry import parse_exposition
+
+    result: dict = {}
+
+    def fail(msg: str) -> int:
+        result["serve_perf_smoke"] = msg
+        print(json.dumps(result, indent=2))
+        return 1
+
+    matrix = standard_matrix(num_requests=args.requests,
+                             rate_rps=args.rate, prompt_len=PROMPT_LEN,
+                             max_new=MAX_NEW, slo_ttft_ms=5000.0)
+
+    # 1) Measure: per scenario, warm + two measured segments. The
+    #    shared-prefix scenario runs on the paged prefix-cache engine
+    #    (its traffic property is the cache's whole case); the others on
+    #    the dense engine.
+    rows = []
+    baseline_rows = []
+    candidate_rows = []
+    bands: dict = {}
+    for sc in matrix:
+        paged = sc.name == "shared_prefix"
+        srv, cfg = make_server(paged=paged)
+        try:
+            warm_server(srv, cfg)
+            run_segment(srv, cfg, sc)        # settle: the scenario's own mix
+            segs = []
+            for attempt in range(3):
+                rep, run, open_spans = run_segment(srv, cfg, sc)
+                if open_spans:
+                    return fail(f"{sc.name}: {open_spans} leaked open "
+                                "spans after a full scenario run")
+                segs.append((rep, run))
+                if len(segs) < 2:
+                    continue
+                a, b = segs[-2][0], segs[-1][0]
+                if max(spread_pct(a["req_s"], b["req_s"]),
+                       spread_pct(a["ttft_ms"].get("p95", 0.0),
+                                  b["ttft_ms"].get("p95", 0.0))) <= 25.0:
+                    break
+                # One straggler compile can still land in a measured
+                # segment (a width the warm races missed); it compiles
+                # exactly once, so the LAST two segments converge — keep
+                # them and let the spread-derived band tell the truth.
+            segs = segs[-2:]
+            if paged and srv.engine.kv_pages_in_use() != 0:
+                return fail(f"{sc.name}: leaked KV pages")
+            # /debug/traces?slowest=N must carry the per-phase rollup
+            # (the surface the loadgen's breakdown rides in production).
+            doc = json.loads(scrape(srv.url, "/debug/traces?slowest=4"))
+        finally:
+            srv.stop()
+        rep_a, rep_b = segs[0][0], segs[1][0]
+        for rep in (rep_a, rep_b):
+            n_ok = rep["by_status"].get("ok", 0)
+            if n_ok < args.requests * 0.75:
+                return fail(f"{sc.name}: only {n_ok}/{args.requests} "
+                            f"requests completed: {rep['by_status']}")
+            if rep["phases"].get("trace_coverage", 0) < n_ok * 0.5:
+                return fail(f"{sc.name}: phase breakdown covers "
+                            f"{rep['phases'].get('trace_coverage')} of "
+                            f"{n_ok} requests")
+            if "engine" not in rep or "queue_delay_p95_ms" not in \
+                    rep["engine"]:
+                return fail(f"{sc.name}: engine attribution missing")
+        traced = [t for t in doc.get("traces", []) if t.get("phases")]
+        if not traced or not any("decode_ms" in t["phases"]
+                                 for t in traced):
+            return fail(f"{sc.name}: /debug/traces?slowest=N has no "
+                        "per-phase rollup")
+        if sc.name == "bursty_qos":
+            classes = set((rep_b.get("engine", {}).get("qos") or {}))
+            if not {"interactive", "batch"} <= classes:
+                return fail(f"bursty_qos: per-class engine attribution "
+                            f"incomplete: {sorted(classes)}")
+        if sc.name == "shared_prefix":
+            sched = build_schedule(sc, vocab_size=cfg.vocab_size,
+                                   max_prompt_len=cfg.max_seq_len
+                                   - MAX_NEW - 2)
+            got = measured_prefix_overlap(
+                [r.prompt_tokens for r in sched])
+            if abs(got - sc.prefix_overlap) > 0.15:
+                return fail(f"shared_prefix: measured overlap {got:.2f} "
+                            f"vs declared {sc.prefix_overlap}")
+            result["measured_prefix_overlap"] = round(got, 3)
+        # Noise band from the two-segment spread (bench.py methodology);
+        # the segments themselves must agree within it.
+        sp_req = spread_pct(rep_a["req_s"], rep_b["req_s"])
+        ttfts = [r["ttft_ms"].get("p95", 0.0) for r in (rep_a, rep_b)]
+        band = noise_band_pct([sp_req, spread_pct(*ttfts)])
+        bands[sc.name] = band
+        baseline_rows.append(rep_a)
+        candidate_rows.append(rep_b)
+        rows.append({
+            "metric": f"serve_scenario_req_per_sec[tiny,{sc.name},"
+                      f"r{args.rate:g},n{args.requests}"
+                      f"{',paged' if paged else ''}]",
+            "value": round((rep_a["req_s"] + rep_b["req_s"]) / 2, 3),
+            "unit": "req/s",
+            "vs_baseline": 1.0,
+            "detail": {"segments": [rep_a, rep_b],
+                       "spread_pct": round(sp_req, 1),
+                       "noise_band_pct": round(band, 1)},
+        })
+    verdict = compare_matrix(baseline_rows, candidate_rows, bands=bands)
+    if not verdict["ok"]:
+        result["segment_disagreement"] = verdict
+        return fail("two-segment spread breached its own noise band")
+    result["scenarios"] = {r["metric"]: r["value"] for r in rows}
+    result["noise_bands_pct"] = {k: round(v, 1) for k, v in bands.items()}
+
+    # 2) Seeded regression: throttle the dispatch and the gate MUST see
+    #    it — req/s down and/or TTFT p95 up beyond every band above.
+    srv, cfg = make_server(paged=False)
+    try:
+        orig_step = srv.engine.step
+
+        def throttled_step():
+            time.sleep(0.08)
+            return orig_step()
+
+        warm_server(srv, cfg)                # warm at full speed first
+        srv.engine.step = throttled_step
+        slow_rep, _, _ = run_segment(srv, cfg, matrix[0])
+    finally:
+        srv.stop()
+    slow_verdict = compare_matrix([baseline_rows[0]], [slow_rep],
+                                  bands=bands)
+    if slow_verdict["ok"]:
+        return fail("seeded throttled-dispatch regression NOT flagged "
+                    f"(baseline req/s {baseline_rows[0]['req_s']}, "
+                    f"throttled {slow_rep['req_s']}, "
+                    f"band {bands['uniform']:.0f}%)")
+    reg = slow_verdict["regressions"][0]
+    if "diff" not in reg or "engine" not in reg["diff"]:
+        return fail("regression verdict lacks the attribution diff")
+    result["seeded_regression"] = {
+        "problems": reg["problems"],
+        "baseline_req_s": baseline_rows[0]["req_s"],
+        "throttled_req_s": slow_rep["req_s"],
+        "throttled_queue_delay_p95_ms":
+            slow_rep.get("engine", {}).get("queue_delay_p95_ms"),
+    }
+
+    # 3) The loadgen's own report registry: lints clean, parses, and
+    #    carries every series this stage (its in-scan consumer) reads.
+    reg2 = report_registry(candidate_rows)
+    problems = reg2.lint()
+    if problems:
+        return fail(f"loadgen registry lint: {problems}")
+    names = {n for n, _, _ in parse_exposition(reg2.render())}
+    missing = [n for n in LOADGEN_SERIES if n not in names]
+    if missing:
+        return fail(f"loadgen series missing from exposition: {missing}")
+    result["loadgen_series"] = "ok"
+
+    # 4) Trajectory artifact — the serving BENCH_r0x twin.
+    with open(args.out, "w") as f:
+        json.dump({"schema": 1,
+                   "generated_by": "scripts/serve_perf_smoke.py",
+                   "config": {"requests_per_segment": args.requests,
+                              "rate_rps": args.rate,
+                              "prompt_len": PROMPT_LEN,
+                              "max_new": MAX_NEW},
+                   "rows": rows}, f, indent=2)
+        f.write("\n")
+    result["artifact"] = os.path.relpath(args.out, REPO)
+
+    result["serve_perf_smoke"] = "ok"
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
