@@ -33,9 +33,19 @@ QOS_HEADER = "X-Kftpu-Qos"
 #: checks). Client-side only — never forwarded onto the serving path.
 USER_HEADER = "X-Kftpu-User"
 
+#: Disaggregated prefill/decode serving: the URL of the decode-pool
+#: backend a prefill replica must hand its KV off to. Stamped by the
+#: token-aware router (which picked it on least-resident-KV-pages) onto
+#: the request it places on the prefill pool; the prefill model server
+#: reads it and POSTs the paged-KV handoff there. Absent header = no
+#: handoff (unified-fallback path: the replica decodes locally).
+DECODE_BACKEND_HEADER = "X-Kftpu-Decode-Backend"
+
 #: Headers a transparent serving-path middlebox (the ChaosProxy, any
 #: future sidecar) MUST forward for the request-lifecycle machinery to
-#: keep working through it: deadline enforcement, QoS policy, and trace
-#: continuity all ride these. ``kftpu lint`` X703 checks that every
-#: header exchanged on the serving path appears here.
-FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER)
+#: keep working through it: deadline enforcement, QoS policy, trace
+#: continuity, and disaggregated handoff placement all ride these.
+#: ``kftpu lint`` X703 checks that every header exchanged on the
+#: serving path appears here.
+FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
+                   DECODE_BACKEND_HEADER)

@@ -155,11 +155,27 @@ class QoSSpec(BaseModel):
         return self
 
 
+#: Engine roles for disaggregated prefill/decode serving (the
+#: DistServe/Splitwise motif, TPU-native). ``unified`` is the classic
+#: engine; ``prefill`` runs prompt chunks, samples the FIRST token, and
+#: exports the slot's KV as a paged handoff instead of decoding;
+#: ``decode`` adopts handed-off KV into its own page pool and runs the
+#: decode hot loop. Role specializes what a pool is USED for — every
+#: role keeps the full engine machinery, so any replica can serve a
+#: whole request locally (the unified-fallback path when a pool is
+#: unhealthy).
+ENGINE_ROLES = ("unified", "prefill", "decode")
+
+
 class BatchingSpec(BaseModel):
     """Continuous-batching engine knobs (≈ vLLM engine args in the HF runtime)."""
 
     model_config = ConfigDict(extra="forbid")
 
+    # Disaggregated serving role (ENGINE_ROLES). "prefill" engines stop
+    # at the first token and export a KV handoff; "decode" engines adopt
+    # handoffs; "unified" (default) is the classic single-engine path.
+    role: str = "unified"
     max_batch_size: int = 8          # decode batch slots
     max_seq_len: int = 2048
     # Paged KV cache (vLLM analog): HBM budget decoupled from
@@ -274,6 +290,21 @@ class BatchingSpec(BaseModel):
     # "standard" unless a request declares otherwise).
     qos: QoSSpec = Field(default_factory=QoSSpec)
 
+    @model_validator(mode="after")
+    def _check_role(self) -> "BatchingSpec":
+        if self.role not in ENGINE_ROLES:
+            raise ValueError(
+                f"unknown engine role {self.role!r}; one of {ENGINE_ROLES}")
+        if self.role != "unified" and self.kv_cache_dtype is not None:
+            # Handoff payloads carry raw cache-dtype KV; a quantized pool
+            # would need a requantize round-trip whose per-token scales
+            # are not guaranteed to reproduce the unified path's bits —
+            # and token identity across the boundary is the contract.
+            raise ValueError(
+                "disaggregated roles require kv_cache_dtype=None "
+                "(handoff transfers raw-dtype KV pages)")
+        return self
+
 
 class SLOPolicy(BaseModel):
     """Signal-driven autoscaling targets ((U) Knative KPA, but the signal
@@ -327,6 +358,39 @@ class SLOPolicy(BaseModel):
         return self
 
 
+class PoolSplitSpec(BaseModel):
+    """Disaggregated predictor pools: ``prefill`` prefill-specialized and
+    ``decode`` decode-specialized replicas behind one token-aware router
+    (engine roles ride to each replica in its batching config). The
+    counts are per-pool MINIMUMS; with an ``SLOPolicy`` the autoscaler
+    resizes each pool on its own signal — prefill on queue-delay p95
+    (admission backlog lives there), decode on TTFT p95 of adopted
+    requests (the decode-side scheduling latency) — up to the per-pool
+    maximums."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    prefill: int = 1
+    decode: int = 1
+    max_prefill: Optional[int] = None    # default: the minimum (fixed pool)
+    max_decode: Optional[int] = None
+
+    @model_validator(mode="after")
+    def _check(self) -> "PoolSplitSpec":
+        if self.prefill < 1 or self.decode < 1:
+            raise ValueError("pool split needs prefill >= 1 and decode >= 1")
+        if self.max_prefill is not None and self.max_prefill < self.prefill:
+            raise ValueError("max_prefill < prefill")
+        if self.max_decode is not None and self.max_decode < self.decode:
+            raise ValueError("max_decode < decode")
+        return self
+
+    def cap(self, role: str) -> int:
+        if role == "prefill":
+            return self.max_prefill or self.prefill
+        return self.max_decode or self.decode
+
+
 class PredictorSpec(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
@@ -340,6 +404,11 @@ class PredictorSpec(BaseModel):
     # the concurrency heuristic above (which remains the default).
     slo: Optional[SLOPolicy] = None
     canary_traffic_percent: Optional[int] = None
+    # Disaggregated prefill/decode pools ({prefill: N, decode: M}): the
+    # controller runs two role-specialized replica pools behind the
+    # token-aware router instead of one homogeneous rotation. Mutually
+    # exclusive with canary splits (pools ARE the traffic topology).
+    pools: Optional[PoolSplitSpec] = None
     resources: TPUResourceSpec = Field(default_factory=TPUResourceSpec)
     parallelism: ParallelismSpec = Field(default_factory=ParallelismSpec)
     batching: BatchingSpec = Field(default_factory=BatchingSpec)
@@ -372,6 +441,15 @@ class PredictorSpec(BaseModel):
                 f"resources.tpu_chips={self.resources.tpu_chips} does not "
                 f"match parallelism product {p.total} (set it to "
                 f"{p.total}, or leave it 1 to derive it)")
+        if self.pools is not None:
+            if self.canary_traffic_percent is not None:
+                raise ValueError(
+                    "pools and canary_traffic_percent are mutually "
+                    "exclusive (a pool split IS the traffic topology)")
+            if self.batching.role != "unified":
+                raise ValueError(
+                    "leave batching.role='unified' with pools set — the "
+                    "controller stamps each pool's role onto its replicas")
         return self
 
 
@@ -413,6 +491,9 @@ class InferenceServiceStatus(ConditionMixin):
     # None = the autoscaler hasn't decided yet (first reconcile seeds it);
     # 0 is a real state — scaled to zero (min_replicas=0, idle).
     desired_replicas: Optional[int] = None
+    # Disaggregated pool sizes (role -> desired count), autoscaler-owned
+    # once seeded; empty on non-pooled services.
+    desired_pool_replicas: dict[str, int] = Field(default_factory=dict)
     traffic: dict[str, int] = Field(default_factory=dict)  # generation -> percent
     latest_ready_generation: Optional[int] = None
 

@@ -59,7 +59,7 @@ ATTRIBUTION_SERIES = (
 
 #: Engine span-name prefix → report phase keys (obs.trace owns the
 #: span names; phase_durations owns the extraction).
-PHASE_KEYS = ("queued_ms", "prefill_ms", "decode_ms")
+PHASE_KEYS = ("queued_ms", "prefill_ms", "handoff_ms", "decode_ms")
 
 
 def engine_attribution(metrics_text: str) -> dict:
@@ -168,17 +168,38 @@ def build_report(run: ScenarioRun, *, metrics_text: Optional[str] = None,
             [o.lag_s for o in outs], qs=(0.5, 0.95)),
         "prefix_overlap_declared": sc.prefix_overlap,
     }
-    if sc.slo_ttft_ms is not None:
-        good = sum(1 for o in ok
-                   if o.ttft_s is not None
-                   and o.ttft_s * 1e3 <= sc.slo_ttft_ms)
+    if sc.slo_ttft_ms is not None or sc.slo_tpot_ms is not None:
+        # The denominator is the SLO-bearing traffic: all requests by
+        # default, or only ``slo_classes`` when the scenario scopes its
+        # SLO (a batch tier without a latency SLO is judged on
+        # completion, not TTFT — the platform's QoS semantics).
+        slo_outs = [o for o in outs
+                    if not sc.slo_classes or o.qos in sc.slo_classes]
+
+        def _good(o) -> bool:
+            if o.status != "ok":
+                return False
+            if sc.slo_ttft_ms is not None and (
+                    o.ttft_s is None or o.ttft_s * 1e3 > sc.slo_ttft_ms):
+                return False
+            if sc.slo_tpot_ms is not None:
+                tpot = o.tpot_s()
+                if tpot is not None and tpot * 1e3 > sc.slo_tpot_ms:
+                    return False
+            return True
+
+        good = sum(1 for o in slo_outs if _good(o))
         report["goodput"] = {
             "slo_ttft_ms": sc.slo_ttft_ms,
             # Goodput is measured against OFFERED load: a shed or timed-
             # out request is an SLO miss, not a denominator dropout.
-            "ratio": round(good / max(len(outs), 1), 4),
+            "ratio": round(good / max(len(slo_outs), 1), 4),
             "good_requests": good,
         }
+        if sc.slo_tpot_ms is not None:
+            report["goodput"]["slo_tpot_ms"] = sc.slo_tpot_ms
+        if sc.slo_classes:
+            report["goodput"]["slo_classes"] = list(sc.slo_classes)
     qos_out: dict = {}
     for cls in sorted({o.qos for o in outs}):
         cls_ok = [o for o in ok if o.qos == cls]

@@ -126,9 +126,28 @@ class Scenario:
     prefix_overlap: float = 0.0
     #: ``((class, weight), ...)``; empty = everything QOS_DEFAULT.
     qos_mix: tuple = ()
+    #: Per-class length overrides: ``((class, prompt LengthDist,
+    #: output LengthDist), ...)``. Lets one scenario correlate class
+    #: with shape — e.g. ``mixed_interference``'s bursty long-prefill
+    #: batch arrivals interleaved with short interactive decodes (the
+    #: head-of-line-blocking probe disaggregation exists to fix).
+    #: Classes absent here use the scenario-wide distributions.
+    class_profiles: tuple = ()
     seed: int = 0
     #: TTFT bound (ms) goodput is measured under; None = no SLO.
     slo_ttft_ms: Optional[float] = 1000.0
+    #: Mean time-per-output-token bound (ms) goodput additionally
+    #: requires; None = TTFT-only. The decode-side SLO: a streaming
+    #: request whose tokens stall behind co-resident prefill chunks
+    #: misses this even when its TTFT was fine — the interference axis
+    #: disaggregation removes.
+    slo_tpot_ms: Optional[float] = None
+    #: QoS classes the TTFT SLO applies to (the goodput denominator).
+    #: Empty = every request. The platform's QoS model gives latency
+    #: SLOs to the interactive/standard tiers while batch is a
+    #: throughput class — a scenario mixing them scopes its goodput to
+    #: the SLO-bearing traffic (``mixed_interference`` does).
+    slo_classes: tuple = ()
     #: Client-side per-request give-up budget (seconds).
     request_timeout_s: float = 120.0
 
@@ -150,6 +169,23 @@ class Scenario:
             total += weight
         if self.qos_mix and total <= 0:
             raise ValueError("qos_mix weights sum to 0")
+        for cls in self.slo_classes:
+            if cls not in QOS_PRIORITY:
+                raise ValueError(
+                    f"unknown QoS class {cls!r} in slo_classes; "
+                    f"known: {sorted(QOS_PRIORITY)}")
+        for entry in self.class_profiles:
+            if len(entry) != 3:
+                raise ValueError(
+                    "class_profiles entries are (class, prompt LengthDist, "
+                    "output LengthDist)")
+            cls, pdist, odist = entry
+            if cls not in QOS_PRIORITY:
+                raise ValueError(
+                    f"unknown QoS class {cls!r} in class_profiles; "
+                    f"known: {sorted(QOS_PRIORITY)}")
+            pdist.validate()
+            odist.validate()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -213,8 +249,25 @@ def build_schedule(scenario: Scenario, *, vocab_size: int,
     classes = [cls for cls, _ in scenario.qos_mix] or [QOS_DEFAULT]
     weights = np.asarray([w for _, w in scenario.qos_mix] or [1.0], float)
     weights = weights / weights.sum()
+    profiles = {cls: (pd, od) for cls, pd, od in scenario.class_profiles}
     out: list[ScheduledRequest] = []
     for i in range(scenario.num_requests):
+        if profiles:
+            # Class-correlated shapes: the class draw moves FIRST so it
+            # can select the distributions. Profile-free scenarios keep
+            # the historical draw order (byte-identical schedules).
+            qos = str(rng.choice(classes, p=weights))
+            pdist, odist = profiles.get(
+                qos, (scenario.prompt_len, scenario.output_len))
+            plen = pdist.sample(rng, max_prompt_len)
+            k = int(round(scenario.prefix_overlap * plen))
+            tail = rng.integers(1, vocab_size, size=plen - k)
+            prompt = tuple(int(x) for x in shared[:k]) \
+                + tuple(int(x) for x in tail)
+            out.append(ScheduledRequest(
+                idx=i, t=float(times[i]), prompt_tokens=prompt,
+                max_new_tokens=odist.sample(rng, 100_000), qos=qos))
+            continue
         plen = scenario.prompt_len.sample(rng, max_prompt_len)
         k = int(round(scenario.prefix_overlap * plen))
         tail = rng.integers(1, vocab_size, size=plen - k)
@@ -230,8 +283,9 @@ def build_schedule(scenario: Scenario, *, vocab_size: int,
 def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
                     prompt_len: int = 48, max_new: int = 16,
                     slo_ttft_ms: float = 2000.0,
+                    mixed_slo_tpot_ms: Optional[float] = None,
                     seed: int = 0) -> list[Scenario]:
-    """The canonical 3-scenario serving matrix the perf gate and
+    """The canonical 4-scenario serving matrix the perf gate and
     ``bench_serve.py --workload scenarios`` both replay:
 
     - ``uniform`` — Poisson arrivals, fixed lengths, one QoS class: the
@@ -241,7 +295,12 @@ def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
       preemption (the per-class attribution rows);
     - ``shared_prefix`` — Poisson arrivals with 75% shared-prefix
       prompts and a long-tail length mix: the prefix-cache/paged-pool
-      regime (ROADMAP item 1's success metric runs through this shape).
+      regime (ROADMAP item 1's success metric runs through this shape);
+    - ``mixed_interference`` — bursty long-prefill batch arrivals
+      interleaved with short interactive requests (class-correlated
+      shapes via ``class_profiles``): makes prefill→decode head-of-line
+      blocking measurable — the disaggregated prefill/decode split
+      proves its goodput win through this shape (ROADMAP item 2).
     """
     return [
         Scenario(
@@ -268,6 +327,24 @@ def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
                                   high=2 * prompt_len),
             output_len=LengthDist(kind="fixed", value=max_new),
             prefix_overlap=0.75, slo_ttft_ms=slo_ttft_ms),
+        Scenario(
+            name="mixed_interference", num_requests=num_requests,
+            seed=seed + 3,
+            arrival=Arrival(process="bursty", rate_rps=rate_rps,
+                            burst_depth=max(4, num_requests // 6)),
+            prompt_len=LengthDist(kind="fixed", value=prompt_len),
+            output_len=LengthDist(kind="fixed", value=max_new),
+            qos_mix=(("interactive", 0.75), ("batch", 0.25)),
+            class_profiles=(
+                ("interactive",
+                 LengthDist(kind="fixed", value=max(8, prompt_len // 4)),
+                 LengthDist(kind="fixed", value=max_new)),
+                ("batch",
+                 LengthDist(kind="fixed", value=4 * prompt_len),
+                 LengthDist(kind="fixed", value=max(2, max_new // 2))),
+            ),
+            slo_ttft_ms=slo_ttft_ms, slo_tpot_ms=mixed_slo_tpot_ms,
+            slo_classes=("interactive",)),
     ]
 
 

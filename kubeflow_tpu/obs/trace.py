@@ -364,8 +364,12 @@ class Tracer:
 
 
 #: The engine's per-request lifecycle phases, in span-name order
-#: (``engine.queued`` → ``engine.prefill`` → ``engine.decode``).
-ENGINE_PHASES = ("queued", "prefill", "decode")
+#: (``engine.queued`` → ``engine.prefill`` [→ ``engine.handoff``] →
+#: ``engine.decode``). ``handoff`` appears only on disaggregated
+#: requests: the prefill model server opens it around KV export + POST
+#: + ack, and the adopting engine's queued/decode spans continue the
+#: SAME trace on the decode side.
+ENGINE_PHASES = ("queued", "prefill", "handoff", "decode")
 
 
 def phase_durations(spans: list[dict]) -> dict:

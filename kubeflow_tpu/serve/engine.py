@@ -372,6 +372,14 @@ class Request:
     # Recompute-preemption bookkeeping (paged engine): output tokens already
     # folded back into prompt_tokens when the slot was preempted.
     resumed_from: int = 0
+    # Disaggregated serving (serve/handoff.py). ``handoff_requested``:
+    # this prefill-side request stops at the first token and exports its
+    # KV instead of decoding (finish_reason="handoff", payload in
+    # ``handoff``). ``adopt``: this decode-side request was born from a
+    # handoff payload — admission uploads its KV instead of prefilling.
+    handoff_requested: bool = False
+    handoff: Optional[Any] = None
+    adopt: Optional[Any] = None
     # results
     output_tokens: list[int] = dataclasses.field(default_factory=list)
     first_token_time: Optional[float] = None
@@ -518,6 +526,12 @@ class EngineMetrics:
         self.requests_cancelled = 0     # guarded_by: _lock
         self.requests_expired = 0       # guarded_by: _lock
         self.preemptions = 0            # guarded_by: _lock
+        # Disaggregated-serving handoff health: exports leaving a prefill
+        # engine, adoptions landing on a decode engine, and failed/aborted
+        # handoffs (decode side never acked — the recompute path fired).
+        self.handoffs_exported = 0      # guarded_by: _lock
+        self.handoffs_adopted = 0       # guarded_by: _lock
+        self.handoffs_failed = 0        # guarded_by: _lock
         self._qd_counts = [0] * (len(QUEUE_DELAY_BUCKETS) + 1)  # guarded_by: _lock
         self._qd_sum = 0.0              # guarded_by: _lock
         self._qd_n = 0                  # guarded_by: _lock
@@ -577,6 +591,17 @@ class EngineMetrics:
         with self._lock:
             self.preemptions += 1
             self._qos_entry(qos)["preempted"] += 1
+
+    def note_handoff(self, event: str) -> None:
+        """One handoff lifecycle event: ``exported`` | ``adopted`` |
+        ``failed``."""
+        with self._lock:
+            if event == "exported":
+                self.handoffs_exported += 1
+            elif event == "adopted":
+                self.handoffs_adopted += 1
+            else:
+                self.handoffs_failed += 1
 
     def note_abandoned(self, reason: str) -> None:
         with self._lock:
@@ -669,6 +694,9 @@ class EngineMetrics:
                 "requests_cancelled": self.requests_cancelled,
                 "requests_expired": self.requests_expired,
                 "preemptions": self.preemptions,
+                "handoffs_exported": self.handoffs_exported,
+                "handoffs_adopted": self.handoffs_adopted,
+                "handoffs_failed": self.handoffs_failed,
             }
             if self._qd_n:
                 out["queue_delay_avg_ms"] = self._qd_sum / self._qd_n * 1e3
@@ -976,6 +1004,37 @@ class LLMEngine:
         self._preempted: list[Request] = []     # lockfree: scheduler-confined
         self._backlog: list[Request] = []       # lockfree: scheduler-confined
         self._admit_seq = itertools.count()
+        # Disaggregated serving (serve/handoff.py). ``role`` comes from
+        # BatchingSpec: "prefill" submits default to handoff-at-first-
+        # token; "decode" engines adopt payloads via submit_handoff;
+        # every role keeps the full engine (unified fallback).
+        self.role = b.role
+        # Exports awaiting their batched device→host KV fetch (one
+        # jax.device_get per admit round, like first-token sampling).
+        self._pending_exports: list = []        # lockfree: scheduler-confined
+        # Pages backing an exported payload, held until the decode side
+        # acks (request id -> (request, pages)). The allocator is
+        # scheduler-confined, so server-thread acks marshal through
+        # ``_handoff_release`` and free on the next step.
+        self._handoff_holds: dict[str, tuple] = {}  # lockfree: scheduler-confined
+        self._handoff_release: "queue.Queue[tuple[str, bool]]" = queue.Queue()
+        if self.paged:
+            def _adopt_paged_fn(c, k, v, pidx):
+                # OOB page ids (the power-of-two pad) drop their writes —
+                # one trace per padded page-count, log-bounded.
+                npages = c["k"].shape[1]
+                pi = jnp.where((pidx >= 0) & (pidx < npages), pidx, npages)
+                out = {**c, "k": c["k"].at[:, pi].set(k, mode="drop"),
+                       "v": c["v"].at[:, pi].set(v, mode="drop")}
+                return self._pin(out)
+        else:
+            def _adopt_paged_fn(c, k, v, slot):
+                # Dense adoption: the padded tail past plen is junk the
+                # length-masked attention never reads.
+                out = {**c, "k": c["k"].at[:, slot, :k.shape[1]].set(k),
+                       "v": c["v"].at[:, slot, :k.shape[1]].set(v)}
+                return self._pin(out)
+        self._adopt_upload = jax.jit(_adopt_paged_fn, donate_argnums=(0,))
         self._sampler = jax.jit(_sample_batch, static_argnums=(5,))
         # K decode steps per dispatch amortizes host round-trip latency
         # (sampling happens on-device; the while_loop exits early when every
@@ -1192,7 +1251,8 @@ class LLMEngine:
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None, *,
                deadline: Optional[float] = None,
-               trace_parent=None, qos: str = QOS_DEFAULT) -> Request:
+               trace_parent=None, qos: str = QOS_DEFAULT,
+               handoff: Optional[bool] = None) -> Request:
         if not prompt_tokens:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.max_len:
@@ -1223,15 +1283,76 @@ class LLMEngine:
                 raise EngineOverloaded(
                     f"admission queue full ({depth} >= "
                     f"max_queue={self.max_queue})", qos=qos)
+        # Disaggregated default: a prefill-role engine hands off at the
+        # first token unless the caller says otherwise (handoff=False is
+        # the unified-fallback local decode).
+        wants_handoff = (self.role == "prefill" if handoff is None
+                         else bool(handoff))
+        if wants_handoff and self.kv_quant:
+            raise ValueError("handoff requires kv_cache_dtype=None")
         req = Request(prompt_tokens=list(prompt_tokens),
                       params=params or SamplingParams(),
                       id=request_id or f"req-{next(self._id_gen)}",
-                      deadline=deadline, trace_parent=trace_parent, qos=qos)
+                      deadline=deadline, trace_parent=trace_parent, qos=qos,
+                      handoff_requested=wants_handoff)
         _span_open(req, "engine.queued", prompt_tokens=len(prompt_tokens),
                    qos=qos)
         self.waiting.put(req)
         self._wake.set()
         return req
+
+    def submit_handoff(self, payload, *, deadline: Optional[float] = None,
+                       trace_parent=None) -> Request:
+        """Adopt a handed-off request (decode side of serve/handoff.py).
+
+        The request is born mid-lifecycle: its prompt KV arrives in the
+        payload, its first token is already emitted client-side by the
+        prefill replica. ``prompt_tokens`` carries ``prompt +
+        [first_token]`` so the slot invariant (the last token's KV is
+        not yet written) and the recompute-preemption fold-back both
+        hold exactly as for a locally-prefilled request. Admission
+        uploads the KV into this engine's own pool instead of running
+        prefill; the emitted stream starts at the SECOND token."""
+        payload.validate()
+        if self.kv_quant:
+            raise ValueError("handoff adoption requires kv_cache_dtype=None")
+        plen = payload.kv_len
+        if plen + 1 >= self.max_len:
+            raise ValueError(
+                f"handoff KV length {plen} does not fit max_seq_len "
+                f"{self.max_len}")
+        expect = (self.cfg.n_layers, plen, self.cfg.n_kv_heads,
+                  self.cfg.head_dim)
+        if tuple(payload.kv_k.shape) != expect:
+            raise ValueError(
+                f"handoff KV shape {payload.kv_k.shape} != {expect}")
+        if payload.qos not in QOS_PRIORITY:
+            raise ValueError(f"unknown QoS class {payload.qos!r}")
+        params = SamplingParams(
+            max_new_tokens=payload.max_new_tokens,
+            temperature=payload.temperature, top_k=payload.top_k,
+            top_p=payload.top_p, stop_token=payload.stop_token)
+        req = Request(
+            prompt_tokens=list(payload.prompt_tokens) + [payload.first_token],
+            params=params, id=payload.request_id, deadline=deadline,
+            trace_parent=trace_parent, qos=payload.qos, adopt=payload)
+        _span_open(req, "engine.queued", prompt_tokens=plen, qos=payload.qos,
+                   adopted=True)
+        self.waiting.put(req)
+        self._wake.set()
+        return req
+
+    def complete_handoff(self, request_id: str) -> None:
+        """Decode side acked: release the exported pages (marshalled to
+        the scheduler thread — safe from any thread)."""
+        self._handoff_release.put((request_id, True))
+        self._wake.set()
+
+    def fail_handoff(self, request_id: str) -> None:
+        """Decode side never acked: release the hold and count the
+        failure — the caller recomputes (re-submits locally)."""
+        self._handoff_release.put((request_id, False))
+        self._wake.set()
 
     # -- scheduler -------------------------------------------------------------
 
@@ -1303,9 +1424,13 @@ class LLMEngine:
     def _admit_with_token(self, req: Request, slot_idx: int, plen: int,
                           tok: int) -> None:
         if req.trace_parent is not None:
-            # prefill → decode: the first token is out.
+            # prefill → decode: the first token is out. A handoff-bound
+            # request opens NO decode span here — its decode phase runs
+            # on the adopting engine, and the server's handoff span fills
+            # the gap in the same trace.
             _span_close(req, prompt_tokens=plen)
-            _span_open(req, "engine.decode", slot=slot_idx)
+            if not req.handoff_requested:
+                _span_open(req, "engine.decode", slot=slot_idx)
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
         req.output_tokens.append(tok)
@@ -1324,7 +1449,11 @@ class LLMEngine:
             # Fresh occupant: the draft model has consumed none of it yet
             # (the first spec round runs a catch-up prefill).
             self._draft_pos[slot_idx] = 0
-        self._finish_if_done(slot_idx)
+        done = self._finish_if_done(slot_idx)
+        if not done and req.handoff_requested:
+            # Prefill role: the first token is out and decode remains —
+            # export the slot's KV instead of decoding locally.
+            self._export_handoff(slot_idx)
 
     def _advance_one(self, ch: "_Chunking") -> int:
         """Run ONE chunk of one in-flight chunked prefill. Returns work done
@@ -1449,6 +1578,17 @@ class LLMEngine:
                 self._release_slot_pages(ch.slot)
                 self._fail_request(ch.request, reason)
                 n += 1
+        # Handoff holds: pages backing an exported payload whose request
+        # was cancelled or deadlined (e.g. the decode side died and the
+        # relay gave up) are released here — a hold can never outlive
+        # its request's lifecycle, so a killed server strands nothing.
+        for rid, (hreq, pages) in list(self._handoff_holds.items()):
+            if hreq.abandon_reason(now):
+                del self._handoff_holds[rid]
+                if self._allocator is not None:
+                    self._allocator.free(pages)
+                self.metrics.note_handoff("failed")
+                n += 1
         for lane in (self._preempted, self._backlog):
             for req in list(lane):
                 reason = req.abandon_reason(now)
@@ -1540,7 +1680,11 @@ class LLMEngine:
         while True:
             if len(self._chunkings) >= self.max_concurrent_prefills \
                     and self.paged:
-                break
+                # Chunking slots exhausted: a strictly higher-class
+                # arrival may evict the lowest-class in-flight chunking
+                # (cross-class chunking preemption) and take its slot.
+                if not self._maybe_preempt_chunking_for_priority():
+                    break
             slot_idx = self._free_slot(
                 frozenset(p[1] for p in pending))
             if slot_idx is None:
@@ -1553,6 +1697,12 @@ class LLMEngine:
             req = self._next_admissible()
             if req is None:
                 break
+            if req.adopt is not None:
+                # Handed-off request: its KV arrives in the payload —
+                # upload instead of prefilling (spans handled inside).
+                self._adopt_handoff(req, slot_idx)
+                n += 1
+                continue
             if req.trace_parent is not None:
                 # queued → prefill (covers both fresh admissions and
                 # preempted-lane resumes, which skip _note_admitted).
@@ -1592,6 +1742,8 @@ class LLMEngine:
         # Chunked-prefill completions parked by _start_first_token: one
         # batched sampler dispatch + one fetch for the whole admit round.
         self._flush_first_tokens()
+        # Prefill-role exports queued this round: one batched KV fetch.
+        self._flush_handoffs()
         if n:
             # The device just ran prefill work — the next decode round's
             # host-gap sample would measure admission, not the hot loop.
@@ -1664,6 +1816,178 @@ class LLMEngine:
         # FRONT of the backlog, original arrival order: they were admitted
         # once already — nothing may overtake them now.
         self._backlog[:0] = [item[0] for item in requeue_items]
+
+    # -- disaggregated handoff (serve/handoff.py) ------------------------------
+
+    def _export_handoff(self, slot_idx: int) -> None:
+        """Queue one just-prefilled slot's KV for export: enqueue the
+        device-side gather now (program order guarantees it reads the
+        pre-overwrite values even if a later admission reuses the slot),
+        fetch batched in ``_flush_handoffs``. Paged ownership moves to
+        the ack hold; the slot frees either way."""
+        s = self.slots[slot_idx]
+        req = s.request
+        plen = s.length
+        if self.paged:
+            pages = self._slot_pages[slot_idx]
+            need = -(-plen // self.page_size)
+            ids = jnp.asarray(np.asarray(pages[:need], np.int32))
+            k_dev = self.cache["k"][:, ids].reshape(
+                self.cfg.n_layers, need * self.page_size,
+                self.cfg.n_kv_heads, self.cfg.head_dim)
+            v_dev = self.cache["v"][:, ids].reshape(
+                self.cfg.n_layers, need * self.page_size,
+                self.cfg.n_kv_heads, self.cfg.head_dim)
+            # Ownership transfer: the slot's page refs back the payload
+            # until the decode side acks — NOT freed, NOT on the table.
+            self._handoff_holds[req.id] = (req, pages)
+            self._slot_pages[slot_idx] = []
+            self._table[slot_idx, :] = -1
+            self._dstate.mark_row(slot_idx)
+        else:
+            k_dev = self.cache["k"][:, slot_idx]
+            v_dev = self.cache["v"][:, slot_idx]
+        self.slots[slot_idx] = None
+        self._dstate.mark_slot(slot_idx)
+        self._pending_exports.append((req, k_dev, v_dev, plen))
+
+    def _flush_handoffs(self) -> int:
+        """ONE batched device→host fetch for every export queued this
+        admit round, then finish each request with its payload attached
+        (finish_reason="handoff" — the model server relays from there)."""
+        if not self._pending_exports:
+            return 0
+        from kubeflow_tpu.serve.handoff import payload_from_export
+
+        items, self._pending_exports = self._pending_exports, []
+        fetched = jax.device_get([(k, v) for _, k, v, _ in items])  # sync-point: one batched export fetch per admit round
+        now = time.monotonic()
+        for (req, _, _, plen), (k, v) in zip(items, fetched):
+            req.handoff = payload_from_export(req, np.asarray(k),
+                                              np.asarray(v), plen)
+            req.finish_reason = "handoff"
+            req.finish_time = now
+            self.metrics.observe(req)
+            self.metrics.note_handoff("exported")
+            req.stream.put(None)
+            req.done.set()
+        return len(items)
+
+    def _adopt_handoff(self, req: Request, slot_idx: int) -> None:
+        """Admission for a handed-off request: upload its KV into this
+        engine's own pool (alloc + scatter + table-row rebuild, owner
+        stamped) and seed the slot exactly where the prefill side
+        stopped — length=plen, last_token=first_token, budget intact."""
+        p = req.adopt
+        plen = p.kv_len
+        if req.trace_parent is not None:
+            # queued → decode directly: the prefill phase happened on the
+            # exporting engine, in the same trace.
+            _span_close(req)
+            _span_open(req, "engine.decode", slot=slot_idx, adopted=True)
+        dt = self.cache["k"].dtype
+        cfg = self.cfg
+        kv_k = np.asarray(p.kv_k)
+        kv_v = np.asarray(p.kv_v)
+        if kv_k.dtype != dt:
+            kv_k = kv_k.astype(dt)
+            kv_v = kv_v.astype(dt)
+        if self.paged:
+            pg = self.page_size
+            need = -(-plen // pg)
+            self._release_slot_pages(slot_idx)
+            # Cross-request reuse ACROSS the handoff boundary: pages this
+            # decode pool already holds for the prompt's prefix are
+            # adopted by reference (incref) — only the uncovered tail
+            # uploads. match_prefix caps itself one token short, so the
+            # tail is never empty.
+            hit = self._allocator.match_prefix(p.prompt_tokens,
+                                               owner=req.id)
+            fresh = self._allocator.alloc(need - len(hit), owner=req.id)
+            try:
+                pages = list(hit) + fresh
+                start = len(hit) * pg        # tokens the hits cover
+                n2 = 1
+                while n2 < len(fresh):
+                    n2 *= 2
+                buf_k = np.zeros((cfg.n_layers, n2 * pg, cfg.n_kv_heads,
+                                  cfg.head_dim), dt)
+                buf_v = np.zeros_like(buf_k)
+                buf_k[:, :plen - start] = kv_k[:, start:plen]
+                buf_v[:, :plen - start] = kv_v[:, start:plen]
+                shape5 = (cfg.n_layers, n2, pg, cfg.n_kv_heads,
+                          cfg.head_dim)
+                pidx = np.full((n2,), self._num_pages, np.int32)
+                pidx[:len(fresh)] = fresh
+                self.cache = self._adopt_upload(
+                    self.cache, jnp.asarray(buf_k.reshape(shape5)),
+                    jnp.asarray(buf_v.reshape(shape5)), jnp.asarray(pidx))
+            except Exception:
+                # A failed upload must not strand the refs just taken —
+                # the request fails loudly, the pool stays balanced.
+                self._allocator.free(fresh)
+                self._allocator.free(hit)
+                raise
+            self._slot_pages[slot_idx] = list(pages)
+            self._table[slot_idx, :] = -1
+            self._table[slot_idx, :need] = pages
+            self._dstate.mark_row(slot_idx)
+            # The adopted pages hold full-prefix KV — register them so
+            # same-prefix traffic landing on this decode engine reuses
+            # them (decode writes start at plen, never touching these).
+            self._allocator.register_prefix(
+                p.prompt_tokens, pages[:plen // pg])
+        else:
+            width = 1
+            while width < plen:
+                width *= 2
+            width = min(width, self.max_len)
+            buf_k = np.zeros((cfg.n_layers, width, cfg.n_kv_heads,
+                              cfg.head_dim), dt)
+            buf_v = np.zeros_like(buf_k)
+            buf_k[:, :plen] = kv_k
+            buf_v[:, :plen] = kv_v
+            self.cache = self._adopt_upload(
+                self.cache, jnp.asarray(buf_k), jnp.asarray(buf_v),
+                jnp.int32(slot_idx))
+        self.slots[slot_idx] = _Slot(request=req, length=plen,
+                                     last_token=p.first_token,
+                                     generated=0,
+                                     admit_seq=next(self._admit_seq))
+        self._dstate.mark_slot(slot_idx)
+        self._dstate.mark_row(slot_idx)
+        if self._draft_cfg is not None:
+            self._draft_pos[slot_idx] = 0
+        self.metrics.note_handoff("adopted")
+        self._finish_if_done(slot_idx)
+
+    def _drain_handoff_releases(self) -> int:
+        """Apply server-thread handoff acks/aborts on the scheduler
+        thread (the allocator's single owner). Returns releases applied."""
+        n = 0
+        while True:
+            try:
+                rid, ok = self._handoff_release.get_nowait()
+            except queue.Empty:
+                break
+            hold = self._handoff_holds.pop(rid, None)
+            if hold is not None and self._allocator is not None:
+                self._allocator.free(hold[1])
+            if not ok:
+                self.metrics.note_handoff("failed")
+            n += 1
+        return n
+
+    def pending_prefill_tokens(self) -> int:
+        """Prompt tokens waiting to be prefilled on this engine
+        (admission queue + backlog + the unprefilled tails of in-flight
+        chunkings) — the token-aware router's prefill-placement signal.
+        Approximate under concurrency, like ``queue_depth``."""
+        waiting = sum(len(r.prompt_tokens) for r in list(self.waiting.queue))
+        backlog = sum(len(r.prompt_tokens) for r in list(self._backlog))
+        chunking = sum(max(len(ch.request.prompt_tokens) - ch.pos, 0)
+                       for ch in list(self._chunkings))
+        return waiting + backlog + chunking
 
     # -- paged bookkeeping -----------------------------------------------------
 
@@ -1744,6 +2068,46 @@ class LLMEngine:
         ranks = [QOS_PRIORITY.get(r.qos, 1)
                  for r in self._backlog + self._preempted]
         return min(ranks) if ranks else None
+
+    def _maybe_preempt_chunking_for_priority(self) -> bool:
+        """Cross-class CHUNKING preemption: every chunking slot is held
+        and a STRICTLY higher class waits → evict the youngest in-flight
+        chunked prefill of the lowest running class. Its request requeues
+        through the preempted lane with zero tokens lost (nothing was
+        emitted yet), and the chunks already written are registered as
+        prefix-cache content BEFORE the pages release — a later resume
+        usually match_prefix's straight back to where it stopped. This
+        is what keeps a batch long-prompt train from head-of-line
+        blocking interactive admissions on a prefill-specialized engine
+        (the mixed_interference tail)."""
+        if not self.qos_preemption or not self._chunkings:
+            return False
+        waiting = self._waiting_priority()
+        if waiting is None:
+            return False
+        ranked = sorted(
+            ((QOS_PRIORITY.get(ch.request.qos, 1), i)
+             for i, ch in enumerate(self._chunkings)))
+        vrank, vidx = ranked[-1]
+        if vrank <= waiting:
+            return False
+        ch = self._chunkings[vidx]
+        req = ch.request
+        if req.trace_parent is not None:
+            _span_close(req, preempted=True, chunked=True)
+            _span_open(req, "engine.queued", requeued=True)
+        if self.paged and self._allocator is not None and ch.pos:
+            # The written chunks hold real full-page prefix KV — hash
+            # them so the resume's match_prefix skips the rework (freed
+            # pages linger reclaimable until the pool needs them).
+            self._allocator.register_prefix(
+                req.prompt_tokens[:ch.pos],
+                self._slot_pages[ch.slot][:ch.pos // self.page_size])
+        self._chunkings.remove(ch)
+        self._release_slot_pages(ch.slot)
+        self._preempted.append(req)
+        self.metrics.note_preempted(req.qos)
+        return True
 
     def _maybe_preempt_for_priority(self) -> bool:
         """Cross-class recompute preemption: every slot is busy and a
@@ -1936,6 +2300,11 @@ class LLMEngine:
                 s.generated += 1
                 n_emit += 1
             emitted += n_emit
+            if n_emit and s.request.first_token_time is None:
+                # Adopted (handed-off) requests see their first LOCAL
+                # token here — this engine's TTFT is its decode-side
+                # scheduling latency, the decode pool's autoscale signal.
+                s.request.first_token_time = time.monotonic()
             if s.request.span is not None and n_emit:
                 # Round annotation as a span EVENT: one decode round is one
                 # device dispatch shared by every slot — a span per round
@@ -2076,6 +2445,8 @@ class LLMEngine:
             for tok in emit:
                 s.request.output_tokens.append(tok)
                 s.request.stream.put(tok)
+            if emit and s.request.first_token_time is None:
+                s.request.first_token_time = time.monotonic()
             if s.request.span is not None and emit:
                 s.request.span.add_event("decode_round", spec=True,
                                          drafted=len(d), tokens=len(emit))
@@ -2191,7 +2562,7 @@ class LLMEngine:
         with implicit transfers disallowed — the runtime half of the
         static device-hygiene rules."""
         n = self._reap_abandoned() + self._enforce_queue_bound() \
-            + self._admit()
+            + self._drain_handoff_releases() + self._admit()
         with self._transfer_guard():
             n += self._decode_once()
         if n == 0:

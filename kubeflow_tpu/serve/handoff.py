@@ -1,0 +1,182 @@
+"""Paged-KV handoff between prefill- and decode-specialized engines —
+the transfer contract of disaggregated serving (ROADMAP item 2; the
+DistServe/Splitwise motif, TPU-native).
+
+Prefill and decode have opposite compute profiles: prefill is
+FLOPs-bound (one big causal block over the prompt), decode is
+HBM-bandwidth-bound (one token per step against the whole KV). A
+unified engine interleaves them on one chip, so a long prefill
+head-of-line-blocks every resident decode stream's tokens. Splitting
+the fleet into role-specialized pools removes that interference — IF
+the prompt's KV can move from the prefill chip to the decode chip. The
+page is the natural transfer unit: the prefill side exports the slot's
+pages (one batched device→host fetch per admit round), the decode side
+adopts them into its OWN ``PageAllocator`` pool (alloc + scatter upload
++ page-table row rebuild, ``owner=`` stamped so
+``KFTPU_SANITIZE=refcount`` attributes leaks across the boundary).
+
+Ownership protocol (who owns pages when):
+
+1. **Export** (prefill engine, scheduler thread): the first token is
+   sampled, the slot's KV is fetched to host, and the slot is freed —
+   but its page references move to a HOLD keyed by request id, not to
+   the free list. The payload is now host memory; the pages back it
+   until the decode side confirms receipt.
+2. **Ack** (prefill model server): the decode replica answered the
+   handoff POST — the payload bytes are in its memory — so the hold is
+   released (``engine.complete_handoff``). The release is marshalled
+   through a queue onto the scheduler thread; the allocator stays
+   single-owner.
+3. **Failure = recompute**: if the decode side never acks (connect
+   failure, 5xx, death mid-POST), ``engine.fail_handoff`` frees the
+   hold and the model server re-submits the request LOCALLY as a
+   unified request — the prefix cache usually makes the recompute one
+   admission. A hold whose request is cancelled or past its deadline is
+   reaped by the scheduler like any abandoned request, so a killed
+   server can never strand pages (the mid-handoff SIGKILL chaos
+   scenario audits exactly this).
+
+Adoption seeds the decode slot at the exact state ``_admit_with_token``
+would have left it: ``length = plen`` (the prompt's KV is written; the
+first token's is not), ``last_token = first_token``, and the request's
+``prompt_tokens`` carry ``prompt + [first_token]`` so recompute
+preemption and speculative context reconstruction keep their
+invariants. Greedy outputs are therefore token-identical to the unified
+path on dense and paged backends (pinned in tests).
+
+Wire format: one JSON metadata line + raw little-endian KV bytes
+(dtype/shape in the metadata — bf16 rides as raw ml_dtypes bytes, no
+pickle). Rides ``POST /v1/handoff`` with the usual ``X-Kftpu-*``
+headers, so a handed-off request keeps ONE trace with a new ``handoff``
+phase between ``prefill`` and the decode side's ``queued``/``decode``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+import numpy as np
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes extras (bfloat16)
+    numpy itself does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclasses.dataclass
+class HandoffPayload:
+    """One prefilled request's transferable state: identity + sampling
+    contract + the prompt's KV as contiguous host arrays
+    ``[L, plen, KV, Dh]`` (page structure is re-imposed by the adopting
+    pool — its page size, its free list, its refcounts)."""
+
+    request_id: str
+    prompt_tokens: list[int]        # the plen tokens whose KV rides along
+    first_token: int                # sampled on the prefill side (TTFT)
+    max_new_tokens: int             # REMAINING decode budget (>= 1)
+    temperature: float
+    top_k: int
+    top_p: float
+    stop_token: Optional[int]
+    qos: str
+    kv_k: np.ndarray
+    kv_v: np.ndarray
+
+    @property
+    def kv_len(self) -> int:
+        return int(self.kv_k.shape[1])
+
+    def validate(self) -> None:
+        if self.kv_k.shape != self.kv_v.shape:
+            raise ValueError("kv_k/kv_v shape mismatch")
+        if self.kv_k.ndim != 4:
+            raise ValueError(
+                f"KV must be [L, plen, KV, Dh]; got {self.kv_k.shape}")
+        if self.kv_len != len(self.prompt_tokens):
+            raise ValueError(
+                f"KV covers {self.kv_len} positions but the payload "
+                f"names {len(self.prompt_tokens)} prompt tokens")
+        if self.max_new_tokens < 1:
+            raise ValueError("handoff with no decode budget left")
+
+    # -- wire format -------------------------------------------------------
+
+    def to_wire(self) -> bytes:
+        """JSON metadata line + raw K bytes + raw V bytes."""
+        k = np.ascontiguousarray(self.kv_k)
+        v = np.ascontiguousarray(self.kv_v)
+        meta = {
+            "request_id": self.request_id,
+            "prompt_tokens": list(self.prompt_tokens),
+            "first_token": int(self.first_token),
+            "max_new_tokens": int(self.max_new_tokens),
+            "temperature": float(self.temperature),
+            "top_k": int(self.top_k),
+            "top_p": float(self.top_p),
+            "stop_token": self.stop_token,
+            "qos": self.qos,
+            "dtype": str(k.dtype),
+            "shape": list(k.shape),
+        }
+        return json.dumps(meta).encode() + b"\n" + k.tobytes() + v.tobytes()
+
+    @classmethod
+    def from_wire(cls, data: bytes) -> "HandoffPayload":
+        head, sep, raw = data.partition(b"\n")
+        if not sep:
+            raise ValueError("handoff payload missing metadata line")
+        meta = json.loads(head)
+        dtype = _np_dtype(meta["dtype"])
+        shape = tuple(int(x) for x in meta["shape"])
+        n = int(np.prod(shape)) * dtype.itemsize
+        if len(raw) != 2 * n:
+            raise ValueError(
+                f"handoff payload truncated: {len(raw)} KV bytes, "
+                f"expected {2 * n}")
+        kv_k = np.frombuffer(raw[:n], dtype=dtype).reshape(shape)
+        kv_v = np.frombuffer(raw[n:], dtype=dtype).reshape(shape)
+        payload = cls(
+            request_id=str(meta["request_id"]),
+            prompt_tokens=[int(t) for t in meta["prompt_tokens"]],
+            first_token=int(meta["first_token"]),
+            max_new_tokens=int(meta["max_new_tokens"]),
+            temperature=float(meta["temperature"]),
+            top_k=int(meta["top_k"]),
+            top_p=float(meta["top_p"]),
+            stop_token=(None if meta["stop_token"] is None
+                        else int(meta["stop_token"])),
+            qos=str(meta["qos"]),
+            kv_k=kv_k, kv_v=kv_v)
+        payload.validate()
+        return payload
+
+
+def payload_from_export(req, kv_k: np.ndarray, kv_v: np.ndarray,
+                        plen: int) -> HandoffPayload:
+    """Build the payload at flush time: ``kv_*`` are the fetched host
+    arrays (dense exports fetch the full cache row — trim to ``plen``),
+    and the decode budget is the original budget minus the first token
+    the prefill side already emitted."""
+    p = req.params
+    payload = HandoffPayload(
+        request_id=req.id,
+        prompt_tokens=list(req.prompt_tokens),
+        first_token=int(req.output_tokens[0]),
+        max_new_tokens=int(p.max_new_tokens) - 1,
+        temperature=float(p.temperature),
+        top_k=int(p.top_k),
+        top_p=float(p.top_p),
+        stop_token=p.stop_token,
+        qos=req.qos,
+        kv_k=np.ascontiguousarray(kv_k[:, :plen]),
+        kv_v=np.ascontiguousarray(kv_v[:, :plen]))
+    payload.validate()
+    return payload

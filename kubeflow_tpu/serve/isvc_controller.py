@@ -54,6 +54,7 @@ from kubeflow_tpu.serve.router import Router
 LABEL_ISVC = "serving.tpu.kubeflow.dev/service"
 LABEL_REPLICA = "serving.tpu.kubeflow.dev/replica"
 LABEL_GEN = "serving.tpu.kubeflow.dev/generation"
+LABEL_ROLE = "serving.tpu.kubeflow.dev/role"
 
 _RESYNC = 1.0           # readiness/autoscale poll period (seconds)
 _SCALE_DOWN_COOLDOWN = 10.0
@@ -186,6 +187,12 @@ class ISVCController:
             router = Router()
             router.start()
             self._routers[key] = router
+
+        if pred.pools is not None:
+            # Disaggregated prefill/decode pools: a dedicated converge
+            # path (no canary/scale-to-zero interplay — the pool split
+            # IS the traffic topology).
+            return self._reconcile_pools(isvc, key, router)
 
         # Desired count: autoscaler-owned once seeded; 0 is a real state.
         desired = isvc.status.desired_replicas
@@ -378,6 +385,159 @@ class ISVCController:
                         signals=signals, probes_failed=probes_failed)
         self._update_status(isvc)
         return ReconcileResult(requeue_after=_RESYNC)
+
+    # -- disaggregated pools (ISSUE 12 tentpole) -------------------------------
+
+    def _reconcile_pools(self, isvc: InferenceService, key: str,
+                         router: Router) -> ReconcileResult:
+        """Converge a ``{prefill: N, decode: M}`` predictor: two
+        role-specialized worker pools behind the token-aware router.
+        Each replica gets its pool's engine role stamped into its
+        batching config; ready members register per-role via
+        ``router.set_pools`` (which also runs the placement-signal
+        scrape), and the split autoscaler resizes each pool on its own
+        signal."""
+        pred = isvc.spec.predictor
+        pools = pred.pools
+        desired = dict(isvc.status.desired_pool_replicas)
+        for role in ("prefill", "decode"):
+            base = getattr(pools, role)
+            want = desired.get(role, base)
+            desired[role] = max(base, min(want, pools.cap(role)))
+
+        # Replace crashed replicas (a model server never "succeeds").
+        for w in self._workers(key):
+            if w.status.phase in (WorkerPhase.FAILED, WorkerPhase.SUCCEEDED):
+                self.recorder.warning(
+                    isvc, "ReplicaCrashed",
+                    f"{w.metadata.name}: exit={w.status.exit_code}; "
+                    "replacing")
+                self._delete_worker(w)
+
+        gen = isvc.metadata.generation
+        by: dict[tuple[str, int], Worker] = {}
+        for w in self._workers(key):
+            role = w.metadata.labels.get(LABEL_ROLE, "prefill")
+            i = int(w.metadata.labels[LABEL_REPLICA])
+            by[(role, i)] = w
+        for role in ("prefill", "decode"):
+            for i in range(desired[role]):
+                if (role, i) not in by:
+                    by[(role, i)] = self._create_replica(isvc, i, gen,
+                                                         role=role)
+        for (role, i) in sorted(by):
+            if role in desired and i >= desired[role]:
+                self._retire_worker(key, router, by.pop((role, i)), isvc)
+
+        # Probe per pool: readiness + the SLO signals each pool scales
+        # on (prefill: queue-delay p95 — the admission backlog lives
+        # there; decode: TTFT p95 of adopted requests — the decode-side
+        # scheduling latency).
+        ready: dict[str, list[str]] = {"prefill": [], "decode": []}
+        signals: dict[str, list[dict]] = {"prefill": [], "decode": []}
+        probes_failed = 0
+        in_flight = 0
+        for (role, i), w in sorted(by.items()):
+            if w.status.phase != WorkerPhase.RUNNING:
+                continue
+            url = self._replica_url(w)
+            got = self.probe(url)
+            if got is not None:
+                ready.setdefault(role, []).append(url)
+                signals.setdefault(role, []).append(got)
+                in_flight += got.get("in_flight", 0)
+            else:
+                probes_failed += 1
+
+        router.set_pools({"prefill": ready["prefill"],
+                          "decode": ready["decode"]})
+
+        n_ready = sum(len(u) for u in ready.values())
+        n_desired = sum(desired.values())
+        isvc.status.url = router.url
+        isvc.status.desired_replicas = n_desired
+        isvc.status.desired_pool_replicas = desired
+        isvc.status.ready_replicas = n_ready
+        isvc.status.traffic = {"latest": 100}
+        sp = get_tracer().current()
+        if sp is not None:
+            sp.set_attrs(desired=n_desired, ready=n_ready, pooled=True)
+        if ready["prefill"] and ready["decode"]:
+            if not isvc.status.has_condition("Ready"):
+                self.recorder.normal(
+                    isvc, "Ready",
+                    f"pools ready (prefill {len(ready['prefill'])}/"
+                    f"{desired['prefill']}, decode {len(ready['decode'])}/"
+                    f"{desired['decode']}) at {router.url}")
+            isvc.status.set_condition("PredictorReady")
+            isvc.status.set_condition("Ready")
+        else:
+            isvc.status.set_condition(
+                "Ready", status=False,
+                reason=("NoReadyReplicas" if n_ready == 0
+                        else "PoolDegraded"))
+
+        if pred.slo is not None:
+            self._autoscale_pools(isvc, key, signals, probes_failed,
+                                  desired)
+        self._update_status(isvc)
+        return ReconcileResult(requeue_after=_RESYNC)
+
+    def _autoscale_pools(self, isvc: InferenceService, key: str,
+                         signals: dict[str, list[dict]],
+                         probes_failed: int,
+                         desired: dict[str, int]) -> None:
+        """Split-pool SLO autoscaling: each pool forms its OWN ratio —
+        prefill against ``target_queue_delay_ms``, decode against
+        ``target_ttft_ms`` — and resizes independently within its spec
+        bounds, sharing the hysteresis band and cooldown. Blind pools
+        (failed probes, fewer reporters than members) HOLD, exactly
+        like the homogeneous autoscaler."""
+        pred = isvc.spec.predictor
+        slo = pred.slo
+        pools = pred.pools
+        now = time.monotonic()
+        self._last_scale.setdefault(key, now)
+        if probes_failed:
+            return
+        if now - self._last_scale[key] < slo.cooldown_s:
+            return
+        plans = (
+            ("prefill", "queue_delay_p95_ms", slo.target_queue_delay_ms),
+            ("decode", "ttft_p95_ms", slo.target_ttft_ms),
+        )
+        for role, sig_key, target in plans:
+            if target is None:
+                continue
+            sigs = signals.get(role, [])
+            if len(sigs) < desired.get(role, 0):
+                continue            # pool not fully reporting: hold
+            vals = [s.get(sig_key) for s in sigs]
+            loaded = any(s.get("in_flight", 0) > 0 for s in sigs)
+            if any(v is None for v in vals):
+                if loaded:
+                    continue        # loaded but blind: hold
+                vals = [v for v in vals if v is not None]
+            if not vals:
+                continue
+            ratio = max(vals) / target
+            cur = desired[role]
+            if ratio > slo.scale_up_ratio and cur < pools.cap(role):
+                desired[role] = cur + 1
+                self._last_scale[key] = now
+                self.recorder.normal(
+                    isvc, "ScaledUp",
+                    f"{role} pool {sig_key} ratio {ratio:.2f} > "
+                    f"{slo.scale_up_ratio}: {cur} -> {cur + 1}")
+            elif ratio < slo.scale_down_ratio \
+                    and cur > getattr(pools, role):
+                desired[role] = cur - 1
+                self._last_scale[key] = now
+                self.recorder.normal(
+                    isvc, "ScaledDown",
+                    f"{role} pool {sig_key} ratio {ratio:.2f} < "
+                    f"{slo.scale_down_ratio}: {cur} -> {cur - 1}")
+        isvc.status.desired_pool_replicas = desired
 
     # -- autoscaler (KPA analog) -----------------------------------------------
 
@@ -584,7 +744,8 @@ class ISVCController:
 
     def _create_replica(self, isvc: InferenceService, index: int,
                         generation: int,
-                        clone_from: Optional[Worker] = None) -> Worker:
+                        clone_from: Optional[Worker] = None,
+                        role: Optional[str] = None) -> Worker:
         pred = isvc.spec.predictor
         port = free_port()
         resources = pred.resources
@@ -610,24 +771,34 @@ class ISVCController:
             parallelism = dict(clone_from.spec.parallelism)
         else:
             model = pred.model
+            batching = pred.batching.model_dump()
+            if role is not None:
+                # Pool membership IS the engine role: the replica's
+                # engine builds prefill-/decode-specialized.
+                batching["role"] = role
             config = {
                 "service": model.model_name or isvc.metadata.name,
                 "model": model.config or {"preset": "tiny"},
                 "storage_uri": model.storage_uri,
-                "batching": pred.batching.model_dump(),
+                "batching": batching,
                 "port": port,
             }
             if isvc.spec.transformer is not None:
                 config["transformer"] = isvc.spec.transformer.model_dump()
             if isvc.spec.explainer is not None:
                 config["explainer"] = isvc.spec.explainer.model_dump()
+        labels = {LABEL_ISVC: isvc.metadata.name,
+                  LABEL_REPLICA: str(index),
+                  LABEL_GEN: str(generation)}
+        name = f"{isvc.metadata.name}-predictor-g{generation}-{index}"
+        if role is not None:
+            labels[LABEL_ROLE] = role
+            name = f"{isvc.metadata.name}-predictor-{role}-{index}"
         w = Worker(
             metadata=ObjectMeta(
-                name=f"{isvc.metadata.name}-predictor-g{generation}-{index}",
+                name=name,
                 namespace=isvc.metadata.namespace,
-                labels={LABEL_ISVC: isvc.metadata.name,
-                        LABEL_REPLICA: str(index),
-                        LABEL_GEN: str(generation)},
+                labels=labels,
                 owner=isvc.key,
             ),
             spec=WorkerSpec(

@@ -52,12 +52,26 @@ from typing import Optional
 # (core/headers.py); DEADLINE_HEADER/QOS_HEADER are re-exported here for
 # the router's historical importers (scripts, tests, grpc_server).
 from kubeflow_tpu.core.headers import (
-    DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
+    DEADLINE_HEADER, DECODE_BACKEND_HEADER, QOS_HEADER, TRACE_HEADER,
 )
 from kubeflow_tpu.obs.registry import (
-    MetricsRegistry, contract_note_header,
+    MetricsRegistry, contract_note_header, contract_note_series,
+    parse_exposition,
 )
 from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
+
+#: Engine series the token-aware router scrapes off every pooled
+#: backend's /metrics for placement — the router's half of the
+#: engine↔router metrics contract (X7xx two-sided, like the
+#: autoscaler's ``_PROBE_SERIES``): prefills place on
+#: least-pending-prefill-tokens, decodes on least-resident-KV-pages,
+#: in-flight breaks ties (and stands in for pages on dense engines,
+#: which always report zero resident pages).
+ROUTER_SCRAPE_SERIES = (
+    "kftpu_engine_pending_prefill_tokens",
+    "kftpu_engine_kv_pages_resident",
+    "kftpu_serving_in_flight",
+)
 
 
 def quiet_handle_error(httpd) -> None:
@@ -118,7 +132,22 @@ class Router:
                       "http_5xx": 0, "ejections": 0, "half_open_probes": 0,
                       "panic_picks": 0, "panic_total": 0, "probe_total": 0,
                       "queue_timeouts": 0,
-                      "deadline_exhausted": 0}
+                      "deadline_exhausted": 0,
+                      "disagg_picks": 0, "disagg_fallbacks": 0}
+        # Disaggregated fleet mode (set_pools): role -> backend urls,
+        # plus the freshest scraped placement signals per backend.
+        self._pools: dict[str, list[str]] = {}     # guarded_by: _lock
+        self._signals: dict[str, dict] = {}        # guarded_by: _lock
+        # Scrape-origin health: a pool member that stops answering its
+        # /metrics scrape gets ejected even though it takes no proxied
+        # traffic (a dead DECODE backend would otherwise be picked
+        # forever, costing every request a failed handoff + recompute).
+        # Kept separate from the request-failure counter so a healthy
+        # scrape can never launder real traffic failures.
+        self._scrape_fails: dict[str, int] = {}    # guarded_by: _lock
+        self.scrape_interval = 0.25
+        self._scrape_stop = threading.Event()
+        self._scrape_thread: Optional[threading.Thread] = None
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.httpd.daemon_threads = True
         quiet_handle_error(self.httpd)
@@ -138,12 +167,170 @@ class Router:
             # ports get reused, and a recycled port must not inherit its
             # predecessor's failure history.
             live = {u for urls in self._groups.values() for u in urls}
-            for d in (self._fails, self._ejected_until):
+            for d in (self._fails, self._ejected_until, self._scrape_fails):
                 for u in [u for u in d if u not in live]:
                     d.pop(u)
             self._draining &= live
             if self._groups:
                 self._cond.notify_all()   # wake cold-start queued requests
+
+    # -- disaggregated pools (token-aware placement) ------------------------
+
+    def set_pools(self, pools: dict[str, list[str]], *,
+                  scrape: bool = True) -> None:
+        """Register role-specialized backend pools (``prefill`` /
+        ``decode`` / ``unified``). All pool members join the regular
+        rotation (so ejection, draining, panic routing and scale-from-
+        zero parking keep working unchanged); placement then routes
+        every request through ``pick_disaggregated`` on the scraped
+        token signals. An empty dict leaves fleet mode."""
+        union: list[str] = []
+        for urls in pools.values():
+            for u in urls:
+                if u not in union:
+                    union.append(u)
+        self.set_backends({"latest": union} if union else {})
+        with self._lock:
+            self._pools = {r: list(urls) for r, urls in pools.items()
+                           if urls}
+            live = set(union)
+            for u in [u for u in self._signals if u not in live]:
+                self._signals.pop(u)
+        if scrape and union:
+            self.start_signal_scrape()
+
+    @property
+    def has_pools(self) -> bool:
+        with self._lock:
+            return bool(self._pools)
+
+    def note_signals(self, url: str, signals: dict) -> None:
+        """Feed one backend's placement signals (the scrape loop's
+        writer; tests and controllers may inject directly)."""
+        with self._lock:
+            self._signals[url] = dict(signals)
+
+    def start_signal_scrape(self) -> None:
+        if self._scrape_thread is not None and \
+                self._scrape_thread.is_alive():
+            return
+        self._scrape_stop.clear()
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, daemon=True, name="router-scrape")
+        self._scrape_thread.start()
+
+    def _scrape_loop(self) -> None:
+        while not self._scrape_stop.wait(self.scrape_interval):
+            self.scrape_signals()
+
+    def scrape_signals(self) -> None:
+        """One pass over every pooled backend's /metrics exposition
+        (the same grammar the SLO autoscaler scrapes through). An
+        unreachable backend keeps its last-known signals — ejection,
+        not staleness, is what removes it from placement."""
+        with self._lock:
+            urls = [u for urls in self._pools.values() for u in urls]
+        for url in dict.fromkeys(urls):
+            try:
+                with urllib.request.urlopen(url + "/metrics",
+                                            timeout=1.0) as r:
+                    text = r.read().decode()
+            except OSError:
+                with self._lock:
+                    self._scrape_fails[url] = \
+                        self._scrape_fails.get(url, 0) + 1
+                    if self._scrape_fails[url] >= self.eject_threshold:
+                        now = time.monotonic()
+                        if self._ejected_until.get(url, 0.0) <= now:
+                            self.stats["ejections"] += 1
+                        self._ejected_until[url] = now + self.eject_period
+                continue
+            with self._lock:
+                self._scrape_fails.pop(url, None)
+            sig = self._parse_signals(text)
+            if sig is not None:
+                self.note_signals(url, sig)
+
+    @staticmethod
+    def _parse_signals(text: str) -> Optional[dict]:
+        out = {"pending_prefill_tokens": 0.0, "kv_pages_resident": 0.0,
+               "in_flight": 0.0}
+        try:
+            samples = parse_exposition(text)
+        except ValueError:
+            return None
+        for name, _labels, value in samples:
+            if name in ROUTER_SCRAPE_SERIES:
+                # Contract audit: the router CONSUMED this series
+                # (no-op unless KFTPU_SANITIZE=contract).
+                contract_note_series(name, "consumed")
+            if name == "kftpu_engine_pending_prefill_tokens":
+                out["pending_prefill_tokens"] += value
+            elif name == "kftpu_engine_kv_pages_resident":
+                out["kv_pages_resident"] += value
+            elif name == "kftpu_serving_in_flight":
+                out["in_flight"] += value
+        return out
+
+    def _healthy_locked(self, urls, exclude: frozenset,
+                        now: float) -> list[str]:
+        return [u for u in urls
+                if u not in exclude and u not in self._draining
+                and self._ejected_until.get(u, 0.0) <= now]
+
+    def pick_disaggregated(self, exclude: frozenset = frozenset()
+                           ) -> tuple[Optional[str], Optional[str]]:
+        """Token-aware placement: ``(backend, decode_target)``.
+
+        Healthy prefill AND decode pools → the least-pending-prefill-
+        tokens prefill backend carries the request, stamped with the
+        least-resident-KV-pages decode backend for its handoff. A pool
+        with no healthy member → unified fallback: any healthy backend
+        (unified first, then decode, then prefill — every role serves a
+        whole request locally), no handoff header. Everything ejected →
+        panic-route like the classic picker. ``(None, None)`` = nothing
+        at all to try."""
+        now = time.monotonic()
+        with self._lock:
+            rot = next(self._rr)
+            prefills = self._healthy_locked(
+                self._pools.get("prefill", ()), exclude, now)
+            decodes = self._healthy_locked(
+                self._pools.get("decode", ()), exclude, now)
+            if prefills and decodes:
+                def sig(u):
+                    return self._signals.get(u, {})
+
+                # Rotate before min: equal signals round-robin instead
+                # of pinning one backend (min is stable).
+                prefills = prefills[rot % len(prefills):] \
+                    + prefills[:rot % len(prefills)]
+                decodes = decodes[rot % len(decodes):] \
+                    + decodes[:rot % len(decodes)]
+                p = min(prefills,
+                        key=lambda u: (sig(u).get("pending_prefill_tokens",
+                                                  0.0),
+                                       sig(u).get("in_flight", 0.0)))
+                d = min(decodes,
+                        key=lambda u: (sig(u).get("kv_pages_resident", 0.0),
+                                       sig(u).get("in_flight", 0.0)))
+                self.stats["disagg_picks"] += 1
+                return p, d
+            for pool in ("unified", "decode", "prefill"):
+                ok = self._healthy_locked(self._pools.get(pool, ()),
+                                          exclude, now)
+                if ok:
+                    self.stats["disagg_fallbacks"] += 1
+                    return ok[rot % len(ok)], None
+            suspects = [u for urls in self._pools.values() for u in urls
+                        if u not in exclude and u not in self._draining]
+            if suspects:
+                self.stats["panic_picks"] += 1
+                self.stats["panic_total"] += 1
+                return min(suspects,
+                           key=lambda u: self._ejected_until.get(u, 0.0)), \
+                    None
+            return None, None
 
     # -- outlier ejection / draining ----------------------------------------
 
@@ -294,6 +481,10 @@ class Router:
         self._thread.start()
 
     def stop(self) -> None:
+        self._scrape_stop.set()
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5.0)
+            self._scrape_thread = None
         with self._cond:
             self._closed = True
             self._cond.notify_all()   # release every parked request
@@ -387,7 +578,15 @@ def _make_handler(router: Router):
                     router.count("deadline_exhausted")
                     sp.set_attrs(code=504)
                     return self._error(504, "deadline exhausted in router")
-                if first_attempt:
+                decode_target = None
+                if router.has_pools:
+                    # Disaggregated fleet: token-aware placement decides
+                    # BOTH hops here — the prefill backend that carries
+                    # the request and the decode backend its KV hands
+                    # off to (stamped on the forwarded request below).
+                    backend, decode_target = router.pick_disaggregated(
+                        exclude=frozenset(tried))
+                elif first_attempt:
                     # Only the first pick parks (scale-from-zero): a retry
                     # already had a live-looking rotation moments ago, so a
                     # blocking wait would just burn the client's budget.
@@ -427,6 +626,10 @@ def _make_handler(router: Router):
                     # engine scheduler enforces the class policy.
                     contract_note_header(QOS_HEADER, direction="read")
                     fwd_headers[QOS_HEADER] = self.headers[QOS_HEADER]
+                if decode_target:
+                    # Handoff placement: the prefill replica POSTs its
+                    # KV to exactly this decode-pool member.
+                    fwd_headers[DECODE_BACKEND_HEADER] = decode_target
                 trace_hdr = get_tracer().inject(sp)
                 if trace_hdr:
                     fwd_headers[TRACE_HEADER] = trace_hdr

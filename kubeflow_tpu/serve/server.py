@@ -28,6 +28,7 @@ in-flight request — fine at platform scale, and zero dependencies.
 
 from __future__ import annotations
 
+import http.client
 import json
 import queue
 import re
@@ -35,9 +36,10 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
+from urllib.parse import urlparse
 
 from kubeflow_tpu.core.headers import (
-    DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
+    DEADLINE_HEADER, DECODE_BACKEND_HEADER, QOS_HEADER, TRACE_HEADER,
 )
 from kubeflow_tpu.obs.registry import MetricsRegistry, contract_note_header
 from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
@@ -64,6 +66,55 @@ def _raise_for_reaped(req: Request) -> None:
             f"request {req.id} shed: queue delay exceeded budget")
     if req.finish_reason == "error":
         raise RuntimeError(f"request {req.id} failed in-engine")
+
+def open_handoff(decode_url: str, payload, *, chat: bool, qos: str,
+                 trace_hdr: Optional[str], deadline_s: Optional[float],
+                 timeout: float):
+    """POST a KV handoff to a decode replica; returns ``(conn, resp)``
+    once the decode side ACKED (HTTP 200 — the payload bytes are in its
+    memory, so the prefill side may release its page hold). Raises
+    OSError on anything short of an ack, which is the caller's signal to
+    ``fail_handoff`` and recompute locally."""
+    parsed = urlparse(decode_url)
+    conn = http.client.HTTPConnection(parsed.hostname or "127.0.0.1",
+                                      parsed.port or 80, timeout=timeout)
+    headers = {"Content-Type": "application/octet-stream",
+               QOS_HEADER: qos}
+    contract_note_header(QOS_HEADER, direction="set")
+    if trace_hdr:
+        headers[TRACE_HEADER] = trace_hdr
+        contract_note_header(TRACE_HEADER, direction="set")
+    if deadline_s is not None:
+        headers[DEADLINE_HEADER] = str(int(max(deadline_s, 0.0) * 1e3))
+        contract_note_header(DEADLINE_HEADER, direction="set")
+    path = "/v1/handoff" + ("?chat=1" if chat else "")
+    try:
+        conn.request("POST", path, body=payload.to_wire(), headers=headers)
+        resp = conn.getresponse()
+    except (OSError, http.client.HTTPException) as exc:
+        conn.close()
+        raise OSError(f"handoff POST to {decode_url} failed: {exc}") from exc
+    if resp.status != 200:
+        body = resp.read()
+        conn.close()
+        raise OSError(
+            f"handoff to {decode_url} rejected: HTTP {resp.status} "
+            f"{body[:200]!r}")
+    return conn, resp
+
+
+def iter_sse_data(resp):
+    """Yield the value of every ``data:`` line of an SSE response (the
+    decode replica's token chunks), ending at stream end."""
+    while True:
+        line = resp.readline()
+        if not line:
+            return
+        line = line.strip()
+        if not line.startswith(b"data:"):
+            continue
+        yield line[5:].strip().decode()
+
 
 _V1_PREDICT = re.compile(r"^/v1/models/([^/:]+):predict$")
 _V1_EXPLAIN = re.compile(r"^/v1/models/([^/:]+):explain$")
@@ -230,7 +281,8 @@ class ModelServer:
     def generate_text(self, prompt: str, body: dict, model: Optional[str],
                       strict: bool = False,
                       deadline_s: Optional[float] = None,
-                      qos: str = QOS_DEFAULT) -> tuple[str, "Request"]:
+                      qos: str = QOS_DEFAULT,
+                      decode_url: Optional[str] = None) -> tuple[str, "Request"]:
         """Pre-hop → tokenize → engine → detokenize → post-hop: the one
         generation path every protocol surface (REST v1/v2, OpenAI, gRPC)
         shares.
@@ -249,21 +301,104 @@ class ModelServer:
         tracer = get_tracer()
         with self.lease(model, strict=strict) as (engine, tokenizer, _):
             toks = tokenizer.encode(prompt)
+            # Disaggregated placement: on a prefill-role engine with a
+            # router-stamped decode backend, stop at the first token and
+            # hand the KV off; without one, decode locally (the
+            # unified-fallback path).
+            wants_handoff = engine.role == "prefill" and decode_url
+            handoff_flag: Optional[bool] = None
+            if engine.role == "prefill":
+                handoff_flag = bool(wants_handoff)
             req = engine.submit(toks, self.sampling_from(body, tokenizer),
                                 deadline=time.monotonic() + timeout,
-                                trace_parent=tracer.current(), qos=qos)
+                                trace_parent=tracer.current(), qos=qos,
+                                handoff=handoff_flag)
             try:
                 out = req.result(timeout=timeout + 1.0)
             except TimeoutError:
                 req.cancel()
                 raise
-            _raise_for_reaped(req)
-            with tracer.span("server.detokenize", tokens=len(out)):
-                text = tokenizer.decode(
-                    [t for t in out if t != tokenizer.eos_id])
+            if req.finish_reason == "handoff":
+                text = self._relay_handoff_text(
+                    engine, tokenizer, req, toks, body, decode_url,
+                    qos=qos, timeout=timeout)
+            else:
+                _raise_for_reaped(req)
+                with tracer.span("server.detokenize", tokens=len(out)):
+                    text = tokenizer.decode(
+                        [t for t in out if t != tokenizer.eos_id])
         if self.transformer is not None:
             text = self.transformer(text, "post")
         return text, req
+
+    def _relay_handoff_text(self, engine, tokenizer, req, toks: list[int],
+                            body: dict, decode_url: str, *, qos: str,
+                            timeout: float) -> str:
+        """Non-streaming half of the handoff relay: POST the payload,
+        join the decode replica's token pieces after the locally-sampled
+        first token. Failure before the ack = recompute locally
+        (handoff contract: failure costs a prefill, never the request)."""
+        tracer = get_tracer()
+        deadline = time.monotonic() + timeout
+        with tracer.span("engine.handoff", backend=decode_url,
+                         request=req.id) as sp:
+            try:
+                conn, resp = open_handoff(
+                    decode_url, req.handoff, chat=False, qos=qos,
+                    trace_hdr=tracer.inject(sp),
+                    deadline_s=timeout, timeout=timeout + 5.0)
+            except OSError as exc:
+                sp.set_attrs(error=str(exc), fallback="recompute")
+                engine.fail_handoff(req.id)
+                return self._recompute_locally(engine, tokenizer, req,
+                                               toks, body, qos=qos,
+                                               timeout=timeout)
+            engine.complete_handoff(req.id)
+            # Collect raw token ids (the handoff SSE carries them) and
+            # decode the WHOLE sequence once — piecewise decoding would
+            # split multi-byte characters the unified path decodes
+            # together.
+            tokens = list(req.output_tokens)
+            try:
+                try:
+                    for data in iter_sse_data(resp):
+                        if data == "[DONE]":
+                            break
+                        choice = json.loads(data)["choices"][0]
+                        tokens.append(int(choice["token"]))
+                        if time.monotonic() > deadline + 1.0:
+                            raise TimeoutError(
+                                f"handoff relay for {req.id} exceeded "
+                                "its deadline")
+                finally:
+                    conn.close()
+            except (OSError, ValueError, KeyError) as exc:
+                # Post-ack failure: the decode side died mid-stream. The
+                # pages are gone (ack released them) and tokens may have
+                # reached nobody — surface an explicit error.
+                raise RuntimeError(
+                    f"decode replica failed mid-handoff for {req.id}: "
+                    f"{exc}") from exc
+            sp.set_attrs(tokens=len(tokens))
+        return tokenizer.decode(
+            [t for t in tokens if t != tokenizer.eos_id])
+
+    def _recompute_locally(self, engine, tokenizer, req, toks: list[int],
+                           body: dict, *, qos: str, timeout: float) -> str:
+        """Handoff failure = recompute: re-run the request as a unified
+        local decode (the prefix cache usually makes the second prefill
+        one admission)."""
+        req2 = engine.submit(toks, self.sampling_from(body, tokenizer),
+                             deadline=time.monotonic() + timeout,
+                             trace_parent=get_tracer().current(), qos=qos,
+                             handoff=False, request_id=f"{req.id}-recompute")
+        try:
+            out = req2.result(timeout=timeout + 1.0)
+        except TimeoutError:
+            req2.cancel()
+            raise
+        _raise_for_reaped(req2)
+        return tokenizer.decode([t for t in out if t != tokenizer.eos_id])
 
     # -- request plumbing ------------------------------------------------------
 
@@ -342,6 +477,14 @@ def serving_metrics_registry(engines: list, *,
     host_gap = reg.histogram("kftpu_engine_host_gap_seconds",
                              HOST_GAP_BUCKETS)
     depth = reg.gauge("kftpu_engine_dispatch_depth")
+    # Disaggregated serving: the token-aware router's placement signals
+    # (pending prefill tokens → prefill pool, resident KV pages → decode
+    # pool) plus the handoff lifecycle counters.
+    pending_prefill = reg.gauge("kftpu_engine_pending_prefill_tokens")
+    pages_resident = reg.gauge("kftpu_engine_kv_pages_resident")
+    handoffs_out = reg.counter("kftpu_engine_handoffs_exported_total")
+    handoffs_in = reg.counter("kftpu_engine_handoffs_adopted_total")
+    handoffs_bad = reg.counter("kftpu_engine_handoffs_failed_total")
     for name, engine in engines:
         snap = engine.metrics.snapshot()
         requests_total.inc(snap["requests_completed"], model=name)
@@ -379,6 +522,11 @@ def serving_metrics_registry(engines: list, *,
         _, hcounts, hsum, hn = engine.metrics.host_gap_histogram()
         host_gap.set_cumulative(hcounts, hsum, hn, model=name)
         depth.set(snap.get("dispatch_depth", 0), model=name)
+        pending_prefill.set(engine.pending_prefill_tokens(), model=name)
+        pages_resident.set(engine.kv_pages_in_use(), model=name)
+        handoffs_out.inc(snap.get("handoffs_exported", 0), model=name)
+        handoffs_in.inc(snap.get("handoffs_adopted", 0), model=name)
+        handoffs_bad.inc(snap.get("handoffs_failed", 0), model=name)
     return reg
 
 
@@ -482,6 +630,9 @@ def _make_handler(server: ModelServer):
                         parent=tracer.extract(
                             self.headers.get(TRACE_HEADER)),
                         path=self.path, server=server.name):
+                    if self.path.split("?", 1)[0] == "/v1/handoff":
+                        # Binary payload — must not ride the JSON drain.
+                        return self._handoff()
                     # Always drain the body first: HTTP/1.1 keep-alive
                     # breaks if unread bytes remain on the connection.
                     body = self._body()
@@ -539,12 +690,20 @@ def _make_handler(server: ModelServer):
                 or QOS_DEFAULT
             return str(raw).strip().lower()
 
+        def _decode_backend(self) -> Optional[str]:
+            """Decode-pool backend the token-aware router picked for this
+            request's KV handoff (absent = unified local decode)."""
+            contract_note_header(DECODE_BACKEND_HEADER, direction="read")
+            url = self.headers.get(DECODE_BACKEND_HEADER)
+            return url.strip() if url else None
+
         def _generate_text(self, prompt: str, body: dict,
                            model: Optional[str],
                            strict: bool = False) -> tuple[str, Request]:
             return server.generate_text(prompt, body, model, strict=strict,
                                         deadline_s=self._deadline_s(),
-                                        qos=self._qos(body))
+                                        qos=self._qos(body),
+                                        decode_url=self._decode_backend())
 
         def _v1_predict(self, body: dict, model: str) -> None:
             instances = body.get("instances")
@@ -609,6 +768,67 @@ def _make_handler(server: ModelServer):
                 "usage": usage,
             })
 
+        def _send_sse_headers(self) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+        def _chunk(self, data: str) -> None:
+            payload = f"data: {data}\n\n".encode()
+            self.wfile.write(f"{len(payload):x}\r\n".encode()
+                             + payload + b"\r\n")
+            self.wfile.flush()
+
+        def _stream_tokens(self, req, tokenizer, *, chat: bool,
+                           model: Optional[str], timeout: float,
+                           with_token_ids: bool = False) -> None:
+            """Send SSE headers and stream one engine request's tokens
+            to the client (the local-decode half of every streaming
+            path: unified, decode-side adoption, and the recompute
+            fallback). ``with_token_ids`` adds the raw token id to each
+            chunk — the handoff relay uses it so a non-streaming caller
+            can re-decode the WHOLE sequence at once (piecewise byte
+            decoding would mangle multi-byte characters)."""
+            self._send_sse_headers()
+            try:
+                while True:
+                    try:
+                        tok = req.stream.get(timeout=timeout + 1.0)
+                    except queue.Empty:
+                        # Engine never finished within the deadline
+                        # (its own reaper should have; this is the
+                        # wedged-scheduler fallback): cancel so a
+                        # recovering engine drops the orphan.
+                        req.cancel()
+                        break
+                    if tok is None:
+                        break
+                    if tok == tokenizer.eos_id:
+                        continue
+                    piece = tokenizer.decode([tok])
+                    if chat:
+                        delta = {"choices": [
+                            {"index": 0, "delta": {"content": piece}}]}
+                    else:
+                        delta = {"choices": [{"index": 0,
+                                              "text": piece}]}
+                    if with_token_ids:
+                        delta["choices"][0]["token"] = tok
+                    self._chunk(json.dumps({"id": req.id, "object": "chunk",
+                                            "model": model or server.name,
+                                            **delta}))
+            except OSError:
+                # Client hung up mid-stream: free the slot and its KV
+                # pages now instead of decoding to completion for a
+                # reader that is gone.
+                req.cancel()
+                self.close_connection = True
+                return
+            self._chunk("[DONE]")
+            self.wfile.write(b"0\r\n\r\n")
+
         def _completions_stream(self, prompt: str, body: dict, *, chat: bool,
                                 model: Optional[str]) -> None:
             # The pre-hook applies to the prompt like the non-streaming path;
@@ -619,56 +839,125 @@ def _make_handler(server: ModelServer):
             timeout = server.request_timeout(body, self._deadline_s())
             with server.lease(model) as (engine, tokenizer, _):
                 toks = tokenizer.encode(prompt)
+                decode_url = self._decode_backend()
+                wants_handoff = engine.role == "prefill" and decode_url
+                handoff_flag: Optional[bool] = None
+                if engine.role == "prefill":
+                    handoff_flag = bool(wants_handoff)
                 req = engine.submit(toks,
                                     server.sampling_from(body, tokenizer),
                                     deadline=time.monotonic() + timeout,
                                     trace_parent=get_tracer().current(),
-                                    qos=self._qos(body))
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
+                                    qos=self._qos(body),
+                                    handoff=handoff_flag)
+                if wants_handoff:
+                    return self._stream_disaggregated(
+                        engine, tokenizer, req, toks, body, decode_url,
+                        chat=chat, model=model, timeout=timeout)
+                self._stream_tokens(req, tokenizer, chat=chat, model=model,
+                                    timeout=timeout)
 
-                def chunk(data: str) -> None:
-                    payload = f"data: {data}\n\n".encode()
-                    self.wfile.write(f"{len(payload):x}\r\n".encode()
-                                     + payload + b"\r\n")
-                    self.wfile.flush()
-
+        def _stream_disaggregated(self, engine, tokenizer, req,
+                                  toks: list[int], body: dict,
+                                  decode_url: str, *, chat: bool,
+                                  model: Optional[str],
+                                  timeout: float) -> None:
+            """Streaming handoff relay. The client's SSE response opens
+            only AFTER the decode side acks (or the fallback engages) —
+            a prefill replica dying mid-handoff therefore dies with
+            ZERO response bytes on the wire, which is exactly the
+            condition under which the router's connect-failure retry
+            can requeue the request onto a surviving pool."""
+            tracer = get_tracer()
+            if not req.done.wait(timeout + 1.0):
+                req.cancel()
+                return self._json(504, {"error": f"request {req.id} timed "
+                                        "out in prefill"})
+            if req.finish_reason != "handoff":
+                # Finished at the first token (stop/length) — nothing to
+                # hand off; stream the one-token answer. Reap failures
+                # surface through the usual mapping.
+                if req.finish_reason in ("stop", "length"):
+                    return self._stream_tokens(req, tokenizer, chat=chat,
+                                               model=model, timeout=timeout)
+                _raise_for_reaped(req)
+                raise RuntimeError(
+                    f"request {req.id} ended {req.finish_reason!r}")
+            with tracer.span("engine.handoff", backend=decode_url,
+                             request=req.id) as sp:
                 try:
-                    while True:
-                        try:
-                            tok = req.stream.get(timeout=timeout + 1.0)
-                        except queue.Empty:
-                            # Engine never finished within the deadline
-                            # (its own reaper should have; this is the
-                            # wedged-scheduler fallback): cancel so a
-                            # recovering engine drops the orphan.
-                            req.cancel()
+                    conn, resp = open_handoff(
+                        decode_url, req.handoff, chat=chat,
+                        qos=self._qos(body), trace_hdr=tracer.inject(sp),
+                        deadline_s=timeout, timeout=timeout + 5.0)
+                except OSError as exc:
+                    # Never acked: recompute locally (failure = recompute).
+                    sp.set_attrs(error=str(exc), fallback="recompute")
+                    engine.fail_handoff(req.id)
+                    req2 = engine.submit(
+                        toks, server.sampling_from(body, tokenizer),
+                        deadline=time.monotonic() + timeout,
+                        trace_parent=tracer.current(),
+                        qos=self._qos(body), handoff=False,
+                        request_id=f"{req.id}-recompute")
+                    return self._stream_tokens(req2, tokenizer, chat=chat,
+                                               model=model, timeout=timeout)
+                engine.complete_handoff(req.id)
+            self._send_sse_headers()
+            try:
+                # First token was sampled prefill-side; its chunk opens
+                # the client stream, then decode chunks relay verbatim.
+                first = [t for t in req.output_tokens
+                         if t != tokenizer.eos_id]
+                if first:
+                    piece = tokenizer.decode(first)
+                    delta = ({"choices": [{"index": 0,
+                                           "delta": {"content": piece}}]}
+                             if chat else
+                             {"choices": [{"index": 0, "text": piece}]})
+                    self._chunk(json.dumps({"id": req.id, "object": "chunk",
+                                            "model": model or server.name,
+                                            **delta}))
+                done = False
+                try:
+                    for data in iter_sse_data(resp):
+                        self._chunk(data)
+                        if data == "[DONE]":
+                            done = True
                             break
-                        if tok is None:
-                            break
-                        if tok == tokenizer.eos_id:
-                            continue
-                        piece = tokenizer.decode([tok])
-                        if chat:
-                            delta = {"choices": [
-                                {"index": 0, "delta": {"content": piece}}]}
-                        else:
-                            delta = {"choices": [{"index": 0,
-                                                  "text": piece}]}
-                        chunk(json.dumps({"id": req.id, "object": "chunk",
-                                          "model": model or server.name,
-                                          **delta}))
-                except OSError:
-                    # Client hung up mid-stream: free the slot and its KV
-                    # pages now instead of decoding to completion for a
-                    # reader that is gone.
-                    req.cancel()
-                    self.close_connection = True
+                finally:
+                    conn.close()
+                if done:
+                    self.wfile.write(b"0\r\n\r\n")
                     return
-                chunk("[DONE]")
-                self.wfile.write(b"0\r\n\r\n")
+                # Upstream ended without [DONE]: the decode side died
+                # mid-stream — close so the client sees an explicit error.
+                self.close_connection = True
+            except OSError:
+                self.close_connection = True
+
+        def _handoff(self) -> None:
+            """Decode side of the handoff: adopt the payload into this
+            engine's pool and stream the SECOND token onward as SSE.
+            Sending the 200 response line IS the ack — the payload bytes
+            are in this process's memory, so the prefill side's page
+            hold can release."""
+            if server.engine is None:
+                return self._json(
+                    400, {"error": "handoff needs a single-engine server"})
+            from kubeflow_tpu.serve.handoff import HandoffPayload
+
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n)
+            chat = "chat=1" in (self.path.split("?", 1) + [""])[1]
+            payload = HandoffPayload.from_wire(raw)
+            deadline_s = self._deadline_s()
+            timeout = deadline_s if deadline_s is not None else 300.0
+            req = server.engine.submit_handoff(
+                payload, deadline=time.monotonic() + timeout,
+                trace_parent=get_tracer().current())
+            self._stream_tokens(req, server.tokenizer, chat=chat,
+                                model=None, timeout=timeout,
+                                with_token_ids=True)
 
     return Handler

@@ -1,0 +1,405 @@
+#!/usr/bin/env python
+"""Disaggregated-serving gate (scripts/smoke.sh): 1-prefill + 1-decode
+CPU fleet behind the token-aware router, A/B'd against 2 unified
+replicas at the SAME offered load (ISSUE 12).
+
+Asserts, over the full HTTP protocol path:
+
+- **token identity**: greedy output through the disaggregated fleet
+  (prefill → paged-KV handoff → decode) is byte-identical to a unified
+  replica's, streaming and non-streaming;
+- **the disaggregation win**: on the ``mixed_interference`` loadgen
+  scenario (bursty long-prefill batch arrivals interleaved with short
+  interactive requests) the disaggregated split achieves HIGHER
+  goodput-under-SLO than two unified replicas at the same offered
+  load, with interactive TTFT p95 no worse — and ``bursty_qos`` is
+  replayed on both fleets for the record;
+- **handoff plumbing**: handoff counters nonzero on both sides
+  (exported == adopted), router ``disagg_picks`` nonzero, zero failed
+  handoffs in the measured segments;
+- **seeded regression**: a wedged handoff (sleep injected into the
+  handoff POST hop) replayed on the same scenario MUST breach the
+  spread-derived noise band AND the attribution diff must name the
+  ``handoff`` phase (its per-request span duration blowing up is what
+  distinguishes "the handoff hop broke" from "the engine got slow");
+- **hygiene**: zero page leaks and empty handoff holds on every engine
+  after every segment (``assert_quiescent``), clean under
+  ``KFTPU_SANITIZE=refcount``.
+
+Writes ``BENCH_SERVE_r02.json`` — the disaggregation round of the
+serving bench trajectory: one row per (scenario, fleet) with the full
+attribution report. ``{"disagg_smoke": "ok"}`` is the gate line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Handoff series this stage consumes off the fleet's rendered
+#: exposition — the consumer half of the handoff metric contract (X7xx).
+HANDOFF_SERIES = (
+    "kftpu_engine_handoffs_exported_total",
+    "kftpu_engine_handoffs_adopted_total",
+    "kftpu_engine_handoffs_failed_total",
+)
+
+#: Operating point (tuned on the 1-core CI shape): interactive TTFT SLO
+#: traffic at a rate near the unified knee, 25% of arrivals being 4×
+#: long batch prefills. The unified engines pay the classic continuous-
+#: batching tension — decode rounds sized for dispatch amortization
+#: (decode_steps=32, the engine default) block prefill admissions and
+#: slow chunk cadence — while the split prefill engine never rounds.
+PROMPT_LEN = 48
+MAX_NEW = 48
+RATE = 10.0
+REQUESTS = 64
+#: The gate SLO is TPOT-led: the decode-side stall (interactive tokens
+#: waiting behind co-resident prefill chunks) is the interference axis
+#: the split removes STRUCTURALLY, so it separates far outside host
+#: noise (measured ~3x: unified tpot p95 ≈ 9-11 ms vs disagg ≈ 3 ms at
+#: this operating point). The TTFT bound stays generous — queue-order
+#: luck on a single shared core makes tight TTFT gates flaky.
+SLO_TTFT_MS = 2000.0
+SLO_TPOT_MS = 6.0
+SEGMENTS = 3
+#: Gate margins: single-host CPU A/Bs jitter, so the goodput win must
+#: clear an absolute margin and the interactive-TTFT "no worse" check
+#: carries a noise tolerance (both over per-fleet segment MEANS). The
+#: tolerance also absorbs the handoff floor every disaggregated TTFT
+#: pays on a single shared core (~15 ms of export+POST+adopt riding on
+#: the same CPU the engines compute on); the absolute backstop pins the
+#: disagg p95 to comfortable TTFT-SLO headroom regardless.
+GOODPUT_MARGIN = 0.05
+TTFT_TOLERANCE = 1.4
+
+
+def make_fleet(kind: str):
+    """``unified`` → 2 unified replicas; ``disagg`` → 1 prefill + 1
+    decode replica with token-aware pool routing. Same engine spec
+    apart from the role split; the prefill engine carries the WHOLE
+    fleet's admission concurrency (max_concurrent_prefills=4 = two
+    unified engines' worth — it has no decode work to protect)."""
+    import jax
+
+    from kubeflow_tpu.core.serving import BatchingSpec
+    from kubeflow_tpu.models.config import preset
+    from kubeflow_tpu.models.decoder import init_decoder_params
+    from kubeflow_tpu.serve.engine import LLMEngine
+    from kubeflow_tpu.serve.router import Router
+    from kubeflow_tpu.serve.server import ModelServer
+
+    cfg = preset("tiny", n_layers=4, hidden=128, mlp_dim=256,
+                 max_seq_len=256)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+
+    def mk(name, role):
+        b = dict(max_batch_size=8, max_seq_len=cfg.max_seq_len,
+                 paged=True, page_size=16, chunked_prefill_tokens=32,
+                 decode_steps=32, role=role)
+        if role == "prefill":
+            b.update(max_concurrent_prefills=4)
+        eng = LLMEngine(cfg, BatchingSpec(**b), params=params)
+        srv = ModelServer(name, eng, port=0)
+        srv.start()
+        return srv
+
+    router = Router(queue_timeout=10.0, eject_threshold=3,
+                    eject_period=1.0, max_retries=2, upstream_timeout=60.0)
+    router.scrape_interval = 0.1
+    if kind == "unified":
+        servers = [mk("uni-0", "unified"), mk("uni-1", "unified")]
+        router.set_backends({"latest": [s.url for s in servers]})
+    else:
+        servers = [mk("prefill-0", "prefill"), mk("decode-0", "decode")]
+        router.set_pools({"prefill": [servers[0].url],
+                          "decode": [servers[1].url]})
+    router.start()
+    return router, servers, cfg
+
+
+def stop_fleet(router, servers):
+    router.stop()
+    for s in servers:
+        try:
+            s.stop()
+        except OSError:
+            pass
+
+
+def fleet_metrics_text(servers) -> str:
+    """ONE rendered exposition for the whole fleet through the
+    production registry path (the attribution join's engine half)."""
+    from kubeflow_tpu.serve.server import serving_metrics_registry
+
+    return serving_metrics_registry(
+        [(s.name, s.engine) for s in servers]).render()
+
+
+def completion(url: str, prompt: str, *, stream: bool,
+               timeout: float = 60.0) -> str:
+    body = {"prompt": prompt, "max_tokens": MAX_NEW, "temperature": 0.0,
+            "timeout": timeout}
+    if stream:
+        body["stream"] = True
+    req = urllib.request.Request(
+        url + "/v1/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout + 10) as r:
+        data = r.read()
+    if not stream:
+        return json.loads(data)["choices"][0]["text"]
+    pieces = []
+    for line in data.split(b"\n"):
+        line = line.strip()
+        if line.startswith(b"data:"):
+            v = line[5:].strip()
+            if v == b"[DONE]":
+                break
+            pieces.append(json.loads(v)["choices"][0].get("text", ""))
+    return "".join(pieces)
+
+
+def audit_fleet(servers) -> None:
+    """Post-segment hygiene: every engine quiesces to zero pages and
+    zero outstanding handoff holds (driving step() like a supervisor)."""
+    deadline = time.monotonic() + 30.0
+    for s in servers:
+        eng = s.engine
+        while (eng.kv_pages_in_use() > 0 or eng._handoff_holds
+               or eng._rounds):
+            time.sleep(0.05)
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"{s.name}: engine did not quiesce "
+                    f"(pages={eng.kv_pages_in_use()}, "
+                    f"holds={len(eng._handoff_holds)})")
+        eng._allocator.assert_quiescent()
+
+
+def run_segment(router, servers, cfg, scenario):
+    from kubeflow_tpu.loadgen import ServerTarget, build_report, run_scenario
+    from kubeflow_tpu.obs.trace import get_tracer
+    from kubeflow_tpu.serve.engine import EngineMetrics
+
+    tracer = get_tracer()
+    tracer.reset()
+    for s in servers:
+        s.engine.metrics = EngineMetrics()
+    run = run_scenario(ServerTarget(router.url), scenario,
+                       vocab_size=cfg.vocab_size,
+                       max_prompt_len=cfg.max_seq_len - MAX_NEW - 2,
+                       tracer=tracer)
+    rep = build_report(run, metrics_text=fleet_metrics_text(servers),
+                       tracer=tracer)
+    audit_fleet(servers)
+    return rep
+
+
+def measure(router, servers, cfg, scenario, *, segments: int = SEGMENTS):
+    run_segment(router, servers, cfg, scenario)       # settle/warm
+    return [run_segment(router, servers, cfg, scenario)
+            for _ in range(segments)]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=REQUESTS)
+    ap.add_argument("--rate", type=float, default=RATE)
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "BENCH_SERVE_r02.json"))
+    args = ap.parse_args()
+
+    from kubeflow_tpu.loadgen import (
+        compare_matrix, noise_band_pct, spread_pct, standard_matrix,
+    )
+    from kubeflow_tpu.obs.registry import parse_exposition
+
+    result: dict = {}
+
+    def fail(msg: str) -> int:
+        result["disagg_smoke"] = msg
+        print(json.dumps(result, indent=2))
+        return 1
+
+    matrix = {s.name: s for s in standard_matrix(
+        num_requests=args.requests, rate_rps=args.rate,
+        prompt_len=PROMPT_LEN, max_new=MAX_NEW, slo_ttft_ms=SLO_TTFT_MS,
+        mixed_slo_tpot_ms=SLO_TPOT_MS)}
+    scenarios = [matrix["mixed_interference"], matrix["bursty_qos"]]
+
+    fleets = {}
+    rows = []
+    reports: dict[str, dict] = {}
+    for kind in ("unified", "disagg"):
+        router, servers, cfg = make_fleet(kind)
+        fleets[kind] = (router, servers, cfg)
+        try:
+            # Token identity first (doubles as the warmup): greedy
+            # output through this fleet must match the other's.
+            for stream in (False, True):
+                for prompt in ("disagg token identity pin",
+                               "a longer prompt, exercising the chunked "
+                               "prefill path across several pages of kv"):
+                    key = (prompt, stream)
+                    text = completion(router.url, prompt, stream=stream)
+                    if key in reports.setdefault("_identity", {}):
+                        if reports["_identity"][key] != text:
+                            return fail(
+                                f"greedy output diverges across fleets "
+                                f"(stream={stream}): "
+                                f"{reports['_identity'][key]!r} vs {text!r}")
+                    else:
+                        reports["_identity"][key] = text
+            for sc in scenarios:
+                segs = measure(router, servers, cfg, sc)
+                reports[f"{kind}:{sc.name}"] = segs
+                rows.append({
+                    "metric": f"disagg_goodput[{kind},{sc.name},"
+                              f"r{args.rate:g},n{args.requests}]",
+                    "value": round(sum(
+                        s["goodput"]["ratio"] for s in segs) / len(segs), 4),
+                    "unit": "goodput_ratio",
+                    "vs_baseline": 1.0,
+                    "detail": {"segments": segs},
+                })
+            if kind == "disagg":
+                # Handoff plumbing proof: counters flowed in the LAST
+                # measured segment's registry scrape.
+                text = fleet_metrics_text(servers)
+                counts = {}
+                for name, labels, value in parse_exposition(text):
+                    if name in HANDOFF_SERIES:
+                        counts[name] = counts.get(name, 0) + int(value)
+                if counts.get(HANDOFF_SERIES[0], 0) < 1 or \
+                        counts.get(HANDOFF_SERIES[1], 0) < 1:
+                    return fail(f"no handoffs flowed: {counts}")
+                if counts.get(HANDOFF_SERIES[2], 0) != 0:
+                    return fail(f"handoffs failed mid-measurement: {counts}")
+                result["handoff_counters"] = counts
+                snap = router.snapshot()
+                if snap.get("disagg_picks", 0) < 1:
+                    return fail(f"router made no token-aware picks: {snap}")
+        except Exception as exc:  # noqa: BLE001 - gate surfaces, never hides
+            stop_fleet(router, servers)
+            fleets.pop(kind, None)
+            raise
+    # -- the disaggregation win (acceptance criterion) ---------------------
+    mi = "mixed_interference"
+
+    def mean(xs):
+        xs = list(xs)
+        return sum(xs) / max(len(xs), 1)
+
+    uni_good = mean(r["goodput"]["ratio"] for r in reports[f"unified:{mi}"])
+    dis_good = mean(r["goodput"]["ratio"] for r in reports[f"disagg:{mi}"])
+    uni_ttft = mean((r.get("qos", {}).get("interactive", {})
+                     .get("ttft_ms", {}).get("p95") or 0.0)
+                    for r in reports[f"unified:{mi}"])
+    dis_ttft = mean((r.get("qos", {}).get("interactive", {})
+                     .get("ttft_ms", {}).get("p95") or 0.0)
+                    for r in reports[f"disagg:{mi}"])
+    result["win"] = {
+        "goodput_unified": round(uni_good, 4),
+        "goodput_disagg": round(dis_good, 4),
+        "interactive_ttft_p95_unified_ms": round(uni_ttft, 1),
+        "interactive_ttft_p95_disagg_ms": round(dis_ttft, 1),
+    }
+    if not dis_good > uni_good + GOODPUT_MARGIN:
+        stop_all(fleets)
+        return fail(
+            f"disaggregation did not win goodput: disagg {dis_good:.3f} "
+            f"<= unified {uni_good:.3f} + {GOODPUT_MARGIN} margin")
+    if dis_ttft > uni_ttft * TTFT_TOLERANCE and dis_ttft - uni_ttft > 50.0:
+        stop_all(fleets)
+        return fail(
+            f"disaggregation degraded interactive TTFT p95: "
+            f"{dis_ttft:.0f}ms vs {uni_ttft:.0f}ms unified "
+            f"(tolerance {TTFT_TOLERANCE}x)")
+    if dis_ttft > SLO_TTFT_MS / 2:
+        stop_all(fleets)
+        return fail(
+            f"disagg interactive TTFT p95 {dis_ttft:.0f}ms has no "
+            f"headroom against the {SLO_TTFT_MS:.0f}ms SLO")
+
+    # -- seeded regression: wedge the handoff hop --------------------------
+    router, servers, cfg = fleets["disagg"]
+    base_a, base_b = reports[f"disagg:{mi}"][-2:]
+    band = noise_band_pct([
+        spread_pct(base_a["req_s"], base_b["req_s"]),
+        spread_pct(base_a["ttft_ms"].get("p95", 0.0),
+                   base_b["ttft_ms"].get("p95", 0.0))])
+    from kubeflow_tpu.serve import server as server_mod
+
+    orig_open = server_mod.open_handoff
+
+    def wedged_open(*a, **kw):
+        time.sleep(0.5)
+        return orig_open(*a, **kw)
+
+    server_mod.open_handoff = wedged_open
+    try:
+        slow_rep = run_segment(router, servers, cfg, matrix[mi])
+    finally:
+        server_mod.open_handoff = orig_open
+    verdict = compare_matrix([base_b], [slow_rep], bands={mi: band})
+    if verdict["ok"]:
+        stop_all(fleets)
+        return fail(
+            f"seeded wedged-handoff regression NOT flagged "
+            f"(baseline ttft p95 {base_b['ttft_ms'].get('p95')}, wedged "
+            f"{slow_rep['ttft_ms'].get('p95')}, band {band:.0f}%)")
+    reg = verdict["regressions"][0]
+    cand_phases = (reg.get("diff", {}).get("phases", {})
+                   .get("candidate") or {})
+    base_phases = (reg.get("diff", {}).get("phases", {})
+                   .get("baseline") or {})
+    wedged_handoff = cand_phases.get("handoff_ms", {}).get("p50", 0.0)
+    base_handoff = base_phases.get("handoff_ms", {}).get("p50", 0.0)
+    if wedged_handoff < 400.0 or wedged_handoff < 4 * max(base_handoff, 1.0):
+        stop_all(fleets)
+        return fail(
+            f"regression attribution does not name the handoff phase: "
+            f"baseline handoff_ms p50 {base_handoff}, wedged "
+            f"{wedged_handoff}")
+    result["seeded_regression"] = {
+        "problems": reg["problems"],
+        "band_pct": round(band, 1),
+        "handoff_ms_p50_baseline": base_handoff,
+        "handoff_ms_p50_wedged": wedged_handoff,
+    }
+    stop_all(fleets)
+
+    # -- trajectory artifact ----------------------------------------------
+    with open(args.out, "w") as f:
+        json.dump({"schema": 1,
+                   "generated_by": "scripts/disagg_smoke.py",
+                   "config": {"requests_per_segment": args.requests,
+                              "rate_rps": args.rate,
+                              "prompt_len": PROMPT_LEN,
+                              "max_new": MAX_NEW,
+                              "slo_ttft_ms": SLO_TTFT_MS},
+                   "win": result["win"],
+                   "rows": rows}, f, indent=2)
+        f.write("\n")
+    result["artifact"] = os.path.relpath(args.out, REPO)
+
+    result["disagg_smoke"] = "ok"
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+def stop_all(fleets) -> None:
+    for router, servers, _ in fleets.values():
+        stop_fleet(router, servers)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,15 +113,31 @@ grep -q '"autoscale_smoke": "ok"' /tmp/_smoke_autoscale.json || autoscale_rc=1
 echo "== serve perf smoke (trace-driven scenario matrix + threshold gate) =="
 # Serving-perf gate (ISSUE 11): the canonical loadgen scenario matrix
 # (uniform Poisson, bursty multi-QoS, shared-prefix on the paged prefix-
-# cache engine) replayed open-loop over HTTP; two measured segments must
-# agree within their own spread-derived noise band, a seeded throttled-
-# dispatch regression must breach the threshold WITH an attribution diff,
-# per-phase span breakdowns and per-class engine counters must join, and
+# cache engine, mixed-interference class-correlated shapes) replayed
+# open-loop over HTTP; two measured segments must agree within their own
+# spread-derived noise band, a seeded throttled-dispatch regression must
+# breach the threshold WITH an attribution diff, per-phase span
+# breakdowns and per-class engine counters must join, and
 # BENCH_SERVE_r01.json is (re)written as the serving bench trajectory.
-timeout -k 10 420 env JAX_PLATFORMS=cpu \
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
   python scripts/serve_perf_smoke.py | tee /tmp/_smoke_serve_perf.json
 serve_perf_rc=${PIPESTATUS[0]}
 grep -q '"serve_perf_smoke": "ok"' /tmp/_smoke_serve_perf.json || serve_perf_rc=1
+
+echo "== disagg smoke (prefill/decode split A/B + paged-KV handoff gate) =="
+# Disaggregated-serving gate (ISSUE 12): a 1-prefill + 1-decode fleet
+# behind the token-aware router vs 2 unified replicas at the same
+# offered load. Greedy output must be token-identical across the
+# prefill→handoff→decode boundary, the split must win goodput-under-SLO
+# on the mixed_interference scenario (TPOT-led SLO — the decode-stall
+# axis the split removes) with interactive TTFT no worse, handoff
+# counters must flow with ZERO failures/leaks, and a seeded wedged
+# handoff must be flagged with the attribution naming the handoff phase.
+# Writes BENCH_SERVE_r02.json (the disaggregation bench round).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/disagg_smoke.py | tee /tmp/_smoke_disagg.json
+disagg_rc=${PIPESTATUS[0]}
+grep -q '"disagg_smoke": "ok"' /tmp/_smoke_disagg.json || disagg_rc=1
 
 echo "== contract smoke (static name-contract table vs a real serve run) =="
 # Cross-component contract gate (ISSUE 10): the kftpu lint --contracts-json
@@ -133,5 +149,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 contract_rc=${PIPESTATUS[0]}
 grep -q '"contract_smoke": "ok"' /tmp/_smoke_contract.json || contract_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc contract rc=$contract_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc contract rc=$contract_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]

@@ -1996,8 +1996,10 @@ class TestContractSeededRegressions:
         would silently break trace continuity through it."""
         fresh = _new_findings_prog(
             "kubeflow_tpu/core/headers.py",
-            "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER)",
-            "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER)")
+            "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,\n"
+            "                   DECODE_BACKEND_HEADER)",
+            "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER,\n"
+            "                   DECODE_BACKEND_HEADER)")
         assert len(fresh) == 1
         f = fresh[0]
         assert f.rule == "X703" and "X-Kftpu-Trace" in f.message

@@ -22,8 +22,8 @@ import jax
 from kubeflow_tpu.analysis import core as analysis_core
 from kubeflow_tpu.analysis import rules_contracts
 from kubeflow_tpu.core.headers import (
-    DEADLINE_HEADER, FORWARD_HEADERS, QOS_HEADER, TRACE_HEADER,
-    USER_HEADER,
+    DEADLINE_HEADER, DECODE_BACKEND_HEADER, FORWARD_HEADERS, QOS_HEADER,
+    TRACE_HEADER, USER_HEADER,
 )
 from kubeflow_tpu.core.serving import BatchingSpec
 from kubeflow_tpu.models.config import preset
@@ -116,7 +116,8 @@ class TestHeaderModule:
 
     def test_forward_list_covers_the_serving_path(self):
         assert set(FORWARD_HEADERS) == {
-            DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER}
+            DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
+            DECODE_BACKEND_HEADER}
 
     def test_chaos_proxy_forwards_the_whole_list(self):
         """The ChaosProxy's forward-list is DERIVED from core/headers —
@@ -158,6 +159,7 @@ class TestHeaderModule:
                 headers={"Content-Type": "application/json",
                          DEADLINE_HEADER: "1000",
                          QOS_HEADER: "interactive",
+                         DECODE_BACKEND_HEADER: "http://127.0.0.1:1",
                          TRACE_HEADER: "ab" * 16 + "-" + "cd" * 8})
             with urllib.request.urlopen(req, timeout=10) as r:
                 r.read()

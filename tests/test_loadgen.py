@@ -196,11 +196,39 @@ class TestPrompts:
     def test_standard_matrix_shape(self):
         m = standard_matrix(num_requests=8)
         assert [s.name for s in m] == ["uniform", "bursty_qos",
-                                       "shared_prefix"]
+                                       "shared_prefix",
+                                       "mixed_interference"]
         assert m[2].prefix_overlap == 0.75
         assert dict(m[1].qos_mix).keys() == {"interactive", "batch"}
         for s in m:
             s.validate()
+
+    def test_mixed_interference_correlates_class_and_shape(self):
+        """The head-of-line-blocking probe: batch requests carry LONG
+        prompts, interactive ones short — per request, not just on
+        average (class_profiles correlation)."""
+        sc = standard_matrix(num_requests=64, prompt_len=48)[3]
+        sched = build_schedule(sc, vocab_size=256, max_prompt_len=400)
+        by_cls = {}
+        for r in sched:
+            by_cls.setdefault(r.qos, []).append(len(r.prompt_tokens))
+        assert set(by_cls) == {"interactive", "batch"}
+        assert max(by_cls["interactive"]) < min(by_cls["batch"]), \
+            "class/shape correlation lost"
+        # Determinism holds with profiles active.
+        again = build_schedule(sc, vocab_size=256, max_prompt_len=400)
+        assert [(r.prompt_tokens, r.qos, r.max_new_tokens)
+                for r in sched] == \
+               [(r.prompt_tokens, r.qos, r.max_new_tokens)
+                for r in again]
+
+    def test_class_profiles_validation(self):
+        from kubeflow_tpu.loadgen import LengthDist, Scenario
+
+        bad = Scenario(name="x", class_profiles=(
+            ("gold", LengthDist(), LengthDist()),))
+        with pytest.raises(ValueError, match="gold"):
+            bad.validate()
 
 
 # -- the threshold gate --------------------------------------------------------

@@ -162,6 +162,7 @@ def test_moe_sharded_matches_unsharded_expert_parallel():
     np.testing.assert_allclose(float(ref_loss), float(loss), rtol=5e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
 def test_chunked_ce_matches_full():
     """loss_chunk_size must be numerics-identical (loss, accuracy, grads) to
     the full-logits path — it's a memory optimization, not an approximation."""

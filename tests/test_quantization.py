@@ -68,6 +68,7 @@ def test_quantize_params_layout():
     assert packed_param_bytes(qp) < packed_param_bytes(params) * 0.55
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
 def test_forward_parity_tiny():
     """Dequant-in-matmul forward stays close to the fp32 forward, and the
     quality gate reports a high greedy match on a fixed prompt set."""

@@ -35,6 +35,7 @@ class TestTrainingClient:
         with pytest.raises(RuntimeError, match="failed"):
             client.wait_for_job_conditions("boom", timeout=60)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_train_high_level(self, client):
         job = client.train("mini", model="tiny",
                            model_overrides={"max_seq_len": 64},

@@ -120,7 +120,10 @@ def fire(url: str, n: int, concurrency: int = 4, *,
 
 def audit_quiescent(*servers, deadline_s: float = 20.0) -> None:
     """Post-scenario refcount audit: cancel anything stranded (the operator
-    analog of process teardown), drive the reaper, assert zero page leaks."""
+    analog of process teardown), drive the reaper, assert zero page leaks.
+    Handoff holds (pages backing an exported-but-never-acked payload)
+    count as stranded state too — their requests cancel and the reaper
+    must free them."""
     for srv in servers:
         eng = srv.engine
         for s in eng.slots:
@@ -131,8 +134,10 @@ def audit_quiescent(*servers, deadline_s: float = 20.0) -> None:
                 req.cancel()
         for ch in list(eng._chunkings):
             ch.request.cancel()
+        for hreq, _pages in list(eng._handoff_holds.values()):
+            hreq.cancel()
         deadline = time.monotonic() + deadline_s
-        while eng.kv_pages_in_use() > 0:
+        while eng.kv_pages_in_use() > 0 or eng._handoff_holds:
             eng.step()
             assert time.monotonic() < deadline, \
                 f"{srv.name}: KV pages leaked after scenario"
@@ -368,6 +373,91 @@ def test_chaos_refcount_sanitizer_kill_mid_traffic(monkeypatch):
     finally:
         router.stop()
         for s in (a, b):
+            try:
+                s.stop()
+            except OSError:
+                pass
+
+
+def test_chaos_prefill_kill_mid_handoff_unified_fallback(monkeypatch):
+    """ISSUE 12: SIGKILL the PREFILL replica of a disaggregated fleet
+    mid-handoff, under ``KFTPU_SANITIZE=refcount``. Invariants:
+
+    - a handoff hold stranded by the kill (pages exported, decode side
+      never acked) reaps refcount-balanced — ``assert_quiescent`` holds
+      on BOTH pools and the per-owner report names ZERO leaks;
+    - continuing traffic requeues onto the surviving pool: the router's
+      token-aware placement falls back to the decode replica serving
+      whole requests locally (unified fallback), explicitly — no hangs."""
+    monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+    cfg = preset("tiny", vocab_size=512)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+
+    def mk(name, role):
+        eng = LLMEngine(
+            cfg,
+            BatchingSpec(max_batch_size=2, max_seq_len=96,
+                         prefill_buckets=[32], paged=True, page_size=16,
+                         chunked_prefill_tokens=16, decode_steps=4,
+                         role=role),
+            params=params)
+        srv = ModelServer(name, eng, port=0)
+        srv.start()
+        return srv
+
+    pre, dec = mk("pre-a", "prefill"), mk("dec-b", "decode")
+    assert pre.engine._allocator.refcount_debug
+    proxy = ChaosProxy(pre.url)   # the prefill replica's "process"
+    proxy.start()
+    router = Router(queue_timeout=5.0, eject_threshold=2, eject_period=5.0,
+                    max_retries=2, upstream_timeout=30.0)
+    router.scrape_interval = 0.1
+    router.set_pools({"prefill": [proxy.url], "decode": [dec.url]})
+    router.start()
+    try:
+        # Disaggregated traffic flows: prefill → handoff → decode.
+        results = fire(router.url, 6, timeout_s=10.0)
+        assert set(results) <= EXPLICIT_STATUSES
+        assert results.count(200) >= 4, results
+        assert pre.engine.metrics.snapshot()["handoffs_exported"] >= 1
+        assert dec.engine.metrics.snapshot()["handoffs_adopted"] >= 1
+        # Strand a MID-handoff state: exported (pages in the ack hold),
+        # decode side never told — exactly where a SIGKILL lands between
+        # export and ack.
+        from kubeflow_tpu.serve.engine import SamplingParams as SP
+
+        orphan = pre.engine.submit([7] * 24, SP(max_new_tokens=8),
+                                   handoff=True)
+        assert orphan.done.wait(20.0)
+        assert orphan.finish_reason == "handoff"
+        assert pre.engine._handoff_holds, "no hold backing the payload"
+        held_pages = pre.engine.kv_pages_in_use()
+        assert held_pages > 0
+        # SIGKILL the prefill replica mid-handoff.
+        proxy.drop()
+        kill_model_server(pre)
+        time.sleep(0.5)     # scrape loop ejects the corpse from the pool
+        # Continuing traffic lands on the SURVIVING pool (the decode
+        # replica serving whole requests locally — unified fallback).
+        results = fire(router.url, 8, timeout_s=10.0)
+        assert set(results) <= EXPLICIT_STATUSES
+        assert results.count(200) >= 4, results
+        assert router.snapshot()["disagg_fallbacks"] >= 1
+        # Recovery audit: BOTH pools balance their books; in refcount
+        # mode the per-owner report must be EMPTY, not merely small.
+        audit_quiescent(pre, dec)
+        for srv in (pre, dec):
+            alloc = srv.engine._allocator
+            assert alloc.stats["stamped_allocs"] > 0
+            report = alloc.leak_report_by_owner()
+            assert report == {}, \
+                f"{srv.name}: per-owner leaks after mid-handoff kill: " \
+                f"{report}"
+            alloc.assert_quiescent()
+    finally:
+        proxy.stop()
+        router.stop()
+        for s in (pre, dec):
             try:
                 s.stop()
             except OSError:

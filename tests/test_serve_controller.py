@@ -686,3 +686,169 @@ def test_slo_scale_down_goes_through_drain(cp):
     cp.probe.load[url1] = 0
     recon()
     assert len(replicas(cp)) == 1, "drained replica not torn down"
+
+
+# -- disaggregated prefill/decode pools (ISSUE 12) ----------------------------
+
+def mkisvc_pools(name="svc", prefill=1, decode=1, *, max_prefill=None,
+                 max_decode=None, slo=None):
+    from kubeflow_tpu.core.serving import PoolSplitSpec
+
+    return InferenceService(
+        metadata=ObjectMeta(name=name),
+        spec=InferenceServiceSpec(predictor=PredictorSpec(
+            model=ModelSpec(config={"preset": "tiny"}),
+            pools=PoolSplitSpec(prefill=prefill, decode=decode,
+                                max_prefill=max_prefill,
+                                max_decode=max_decode),
+            slo=slo)))
+
+
+def _pool_slo(**kw):
+    from kubeflow_tpu.core.serving import SLOPolicy
+
+    base = dict(target_ttft_ms=100.0, target_queue_delay_ms=100.0,
+                cooldown_s=10.0)
+    base.update(kw)
+    return SLOPolicy(**base)
+
+
+def roles_of(cp, name="svc"):
+    out = {}
+    for w in replicas(cp, name):
+        role = w.metadata.labels.get("serving.tpu.kubeflow.dev/role")
+        out.setdefault(role, []).append(w)
+    return out
+
+
+def test_pool_split_creates_role_labeled_replicas(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_pools(prefill=2, decode=1))
+    recon()
+    by_role = roles_of(cp)
+    assert len(by_role.get("prefill", [])) == 2
+    assert len(by_role.get("decode", [])) == 1
+    # Pool membership rides into each replica's engine config.
+    for role, ws in by_role.items():
+        for w in ws:
+            assert w.spec.template.config["batching"]["role"] == role
+    mark_running(cp, replicas(cp))
+    recon()
+    isvc = get_isvc(cp)
+    assert isvc.status.ready_replicas == 3
+    assert isvc.status.desired_pool_replicas == {"prefill": 2, "decode": 1}
+    assert isvc.status.has_condition("Ready")
+    # The router carries both pools for token-aware placement.
+    router = cp.isvc_reconciler._routers["default/svc"]
+    assert router.has_pools
+
+
+def test_pool_split_degraded_when_one_pool_empty(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_pools(prefill=1, decode=1))
+    recon()
+    by_role = roles_of(cp)
+    mark_running(cp, by_role["prefill"])     # decode pool never comes up
+    recon()
+    isvc = get_isvc(cp)
+    assert not isvc.status.has_condition("Ready")
+
+
+def test_pool_split_replaces_crashed_replica(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_pools(prefill=1, decode=1))
+    recon()
+    mark_running(cp, replicas(cp))
+    recon()
+    w = roles_of(cp)["decode"][0]
+    w = cp.store.get(Worker, w.metadata.name, w.metadata.namespace)
+    w.status.phase = WorkerPhase.FAILED
+    w.status.exit_code = 137
+    cp.store.update_status(w)
+    recon()
+    recon()
+    by_role = roles_of(cp)
+    assert len(by_role["decode"]) == 1
+    assert by_role["decode"][0].status.phase != WorkerPhase.FAILED
+
+
+def test_pool_autoscaler_scales_each_pool_on_its_own_signal(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_pools(prefill=1, decode=1, max_prefill=3,
+                           max_decode=3, slo=_pool_slo()))
+    recon()
+    mark_running(cp, replicas(cp))
+    by_role = roles_of(cp)
+    pre_url = f"http://127.0.0.1:{by_role['prefill'][0].spec.template.config['port']}"
+    dec_url = f"http://127.0.0.1:{by_role['decode'][0].spec.template.config['port']}"
+    # Prefill backlog (queue delay over target), decode healthy: only the
+    # prefill pool grows.
+    cp.probe.signals[pre_url] = {"queue_delay_p95_ms": 500.0,
+                                 "ttft_p95_ms": 50.0}
+    cp.probe.signals[dec_url] = {"queue_delay_p95_ms": 10.0,
+                                 "ttft_p95_ms": 60.0}
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_pool_replicas == \
+        {"prefill": 2, "decode": 1}
+    mark_running(cp, replicas(cp))
+    # Decode TTFT over target: the decode pool grows too.
+    for w in roles_of(cp)["prefill"]:
+        u = f"http://127.0.0.1:{w.spec.template.config['port']}"
+        cp.probe.signals[u] = {"queue_delay_p95_ms": 80.0,
+                               "ttft_p95_ms": 50.0}
+    cp.probe.signals[dec_url] = {"queue_delay_p95_ms": 10.0,
+                                 "ttft_p95_ms": 400.0}
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_pool_replicas == \
+        {"prefill": 2, "decode": 2}
+    events = [e.reason for e in cp.recorder.for_object(get_isvc(cp))]
+    assert events.count("ScaledUp") >= 2
+
+
+def test_pool_autoscaler_holds_when_pool_blind(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_pools(prefill=1, decode=1, max_prefill=3,
+                           max_decode=3, slo=_pool_slo()))
+    recon()
+    mark_running(cp, replicas(cp))
+    by_role = roles_of(cp)
+    pre_url = f"http://127.0.0.1:{by_role['prefill'][0].spec.template.config['port']}"
+    dec_url = f"http://127.0.0.1:{by_role['decode'][0].spec.template.config['port']}"
+    cp.probe.signals[pre_url] = {"queue_delay_p95_ms": 500.0}
+    cp.probe.fail.add(dec_url)          # one replica unprobeable: blind
+    _backdate(cp)
+    recon()
+    assert get_isvc(cp).status.desired_pool_replicas == \
+        {"prefill": 1, "decode": 1}, "resized while a probe was failing"
+
+
+def test_pool_autoscaler_scales_down_to_spec_floor(cp):
+    recon = lambda: cp.isvc_reconciler.reconcile("default/svc")
+    cp.submit(mkisvc_pools(prefill=2, decode=1, max_prefill=3,
+                           max_decode=3, slo=_pool_slo()))
+    recon()
+    mark_running(cp, replicas(cp))
+    for w in replicas(cp):
+        u = f"http://127.0.0.1:{w.spec.template.config['port']}"
+        cp.probe.signals[u] = {"queue_delay_p95_ms": 1.0,
+                               "ttft_p95_ms": 1.0}
+    _backdate(cp)
+    recon()
+    # Far under target on both signals, but prefill=2 is the SPEC floor.
+    assert get_isvc(cp).status.desired_pool_replicas == \
+        {"prefill": 2, "decode": 1}
+
+
+def test_pools_reject_canary_and_roles():
+    from kubeflow_tpu.core.serving import BatchingSpec, PoolSplitSpec
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        PredictorSpec(model=ModelSpec(), canary_traffic_percent=50,
+                      pools=PoolSplitSpec())
+    with pytest.raises(ValueError, match="role"):
+        PredictorSpec(model=ModelSpec(), pools=PoolSplitSpec(),
+                      batching=BatchingSpec(role="prefill"))
+    with pytest.raises(ValueError, match="max_prefill"):
+        PoolSplitSpec(prefill=2, max_prefill=1)

@@ -42,6 +42,7 @@ def reference_greedy(params, cfg, prompt, n_new):
     return toks[len(prompt):]
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
 def test_single_request_matches_full_forward(engine, params, cfg):
     prompt = [5, 17, 3, 99, 42]
     got = engine.generate(prompt, SamplingParams(max_new_tokens=12))
@@ -49,6 +50,7 @@ def test_single_request_matches_full_forward(engine, params, cfg):
     assert got == want
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
 def test_interleaved_requests_match_solo(engine, params, cfg):
     """Requests admitted mid-decode of others must not perturb each other."""
     prompts = [[1, 2, 3], [7] * 20, [9, 8, 7, 6, 5, 4], [30, 31]]
@@ -67,6 +69,7 @@ def test_interleaved_requests_match_solo(engine, params, cfg):
         assert r.output_tokens == w
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
 def test_slot_reuse_is_clean(engine, params, cfg):
     """A slot freed by a long request must serve a short one untainted."""
     long = engine.generate([2] * 40, SamplingParams(max_new_tokens=10))

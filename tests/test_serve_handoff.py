@@ -1,0 +1,191 @@
+"""Disaggregated prefill/decode handoff (ISSUE 12 tentpole): greedy
+token identity across the prefill→handoff→decode boundary on dense AND
+paged backends, the page-ownership protocol (holds released only on
+ack, failure/reap paths refcount-balanced), and the wire format."""
+
+import time
+
+import numpy as np
+import pytest
+import jax
+
+from kubeflow_tpu.core.serving import BatchingSpec
+from kubeflow_tpu.models.config import preset
+from kubeflow_tpu.models.decoder import init_decoder_params
+from kubeflow_tpu.serve.engine import LLMEngine, SamplingParams
+from kubeflow_tpu.serve.handoff import HandoffPayload
+
+CFG = preset("tiny", vocab_size=512)
+PARAMS = init_decoder_params(jax.random.PRNGKey(0), CFG)
+
+
+def spec(role="unified", paged=False, **kw):
+    base = dict(max_batch_size=2, max_seq_len=96, prefill_buckets=[32],
+                chunked_prefill_tokens=16, decode_steps=4, role=role)
+    if paged:
+        base.update(paged=True, page_size=16)
+    base.update(kw)
+    return BatchingSpec(**base)
+
+
+def engine(role="unified", paged=False, **kw):
+    return LLMEngine(CFG, spec(role=role, paged=paged, **kw), params=PARAMS)
+
+
+def drive(eng, req, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while not req.done.is_set():
+        eng.step()
+        assert time.monotonic() < deadline, "request never finished"
+    return req
+
+
+def drain(eng, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while (eng.kv_pages_in_use() > 0 or eng._rounds
+           or eng._handoff_holds):
+        eng.step()
+        assert time.monotonic() < deadline, "engine did not quiesce"
+
+
+PROMPTS = [list(range(3, 23)), [7, 9, 11] * 9, list(range(40, 45))]
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_greedy_token_identity_across_handoff(paged):
+    """The acceptance pin: unified output == prefill→handoff→decode
+    output, token for token, on both KV backends."""
+    uni = engine(paged=paged)
+    pre = engine(role="prefill", paged=paged)
+    dec = engine(role="decode", paged=paged)
+    params = SamplingParams(max_new_tokens=12, temperature=0.0)
+    for prompt in PROMPTS:
+        want = uni.generate(prompt, params)
+        p_req = drive(pre, pre.submit(prompt, params))
+        assert p_req.finish_reason == "handoff"
+        payload = p_req.handoff
+        assert payload is not None
+        assert payload.first_token == want[0]
+        assert payload.kv_len == len(prompt)
+        # Round-trip the wire format — the HTTP path ships exactly this.
+        payload = HandoffPayload.from_wire(payload.to_wire())
+        d_req = drive(dec, dec.submit_handoff(payload))
+        assert d_req.finish_reason in ("stop", "length")
+        got = [payload.first_token] + d_req.output_tokens
+        assert got == want, (prompt, got, want)
+        pre.complete_handoff(p_req.id)
+    drain(pre)
+    drain(dec)
+    if paged:
+        pre._allocator.assert_quiescent()
+        dec._allocator.assert_quiescent()
+
+
+def test_handoff_hold_released_only_on_ack():
+    """Paged ownership: exported pages stay referenced (backing the
+    payload) until complete_handoff, then free refcount-balanced."""
+    pre = engine(role="prefill", paged=True)
+    req = drive(pre, pre.submit(PROMPTS[0], SamplingParams(max_new_tokens=8)))
+    assert req.finish_reason == "handoff"
+    assert pre.kv_pages_in_use() > 0, "hold should still reference pages"
+    assert req.id in pre._handoff_holds
+    pre.complete_handoff(req.id)
+    drain(pre)
+    pre._allocator.assert_quiescent()
+
+
+def test_handoff_failure_and_reap_paths_free_pages():
+    pre = engine(role="prefill", paged=True)
+    # fail_handoff (decode side never acked): freed + counted failed.
+    r1 = drive(pre, pre.submit(PROMPTS[0], SamplingParams(max_new_tokens=8)))
+    pre.fail_handoff(r1.id)
+    drain(pre)
+    assert pre.metrics.snapshot()["handoffs_failed"] == 1
+    # Abandoned hold (server died before any ack): the reaper frees it.
+    r2 = drive(pre, pre.submit(PROMPTS[1], SamplingParams(max_new_tokens=8)))
+    assert pre.kv_pages_in_use() > 0
+    r2.cancel()
+    drain(pre)
+    assert pre.metrics.snapshot()["handoffs_failed"] == 2
+    pre._allocator.assert_quiescent()
+
+
+def test_prefill_role_finishes_short_requests_locally():
+    """A request finished AT the first token (budget 1) never hands off
+    — there is nothing to decode."""
+    pre = engine(role="prefill", paged=True)
+    req = drive(pre, pre.submit(PROMPTS[0], SamplingParams(max_new_tokens=1)))
+    assert req.finish_reason == "length"
+    assert req.handoff is None
+    assert len(req.output_tokens) == 1
+    drain(pre)
+    pre._allocator.assert_quiescent()
+
+
+def test_unified_fallback_submit_on_prefill_engine():
+    """handoff=False on a prefill-role engine = full local decode (the
+    router's unified-fallback path when the decode pool is unhealthy)."""
+    uni = engine()
+    pre = engine(role="prefill")
+    params = SamplingParams(max_new_tokens=10, temperature=0.0)
+    want = uni.generate(PROMPTS[0], params)
+    req = drive(pre, pre.submit(PROMPTS[0], params, handoff=False))
+    assert req.finish_reason in ("stop", "length")
+    assert req.output_tokens == want
+
+
+def test_adopted_pages_register_prefix_for_reuse():
+    """Handed-off KV becomes prefix-cache content on the decode engine:
+    a same-prefix adoption hits the cached pages."""
+    pre = engine(role="prefill", paged=True)
+    dec = engine(role="decode", paged=True)
+    prompt = list(range(1, 33))          # two full 16-token pages
+    params = SamplingParams(max_new_tokens=6, temperature=0.0)
+    p1 = drive(pre, pre.submit(prompt, params))
+    drive(dec, dec.submit_handoff(HandoffPayload.from_wire(
+        p1.handoff.to_wire())))
+    pre.complete_handoff(p1.id)
+    hits_before = dec._allocator.stats["prefix_hits"]
+    p2 = drive(pre, pre.submit(prompt, params, request_id="again"))
+    drive(dec, dec.submit_handoff(p2.handoff))
+    pre.complete_handoff(p2.id)
+    assert dec._allocator.stats["prefix_hits"] > hits_before
+    drain(pre)
+    drain(dec)
+    dec._allocator.assert_quiescent()
+
+
+def test_adoption_rejects_shape_and_budget_mismatch():
+    dec = engine(role="decode", paged=True)
+    good = HandoffPayload(
+        request_id="x", prompt_tokens=[1, 2, 3], first_token=4,
+        max_new_tokens=4, temperature=0.0, top_k=0, top_p=1.0,
+        stop_token=None, qos="standard",
+        kv_k=np.zeros((CFG.n_layers, 3, CFG.n_kv_heads, CFG.head_dim),
+                      np.float32),
+        kv_v=np.zeros((CFG.n_layers, 3, CFG.n_kv_heads, CFG.head_dim),
+                      np.float32))
+    import dataclasses
+
+    bad_budget = dataclasses.replace(good, max_new_tokens=0)
+    with pytest.raises(ValueError, match="budget"):
+        dec.submit_handoff(bad_budget)
+    bad_shape = dataclasses.replace(
+        good, kv_k=good.kv_k[:, :, :1], kv_v=good.kv_v[:, :, :1])
+    with pytest.raises(ValueError, match="shape"):
+        dec.submit_handoff(bad_shape)
+
+
+def test_wire_format_rejects_truncation():
+    payload = HandoffPayload(
+        request_id="w", prompt_tokens=[1, 2], first_token=3,
+        max_new_tokens=2, temperature=0.0, top_k=0, top_p=1.0,
+        stop_token=None, qos="standard",
+        kv_k=np.ones((1, 2, 1, 4), np.float32),
+        kv_v=np.ones((1, 2, 1, 4), np.float32))
+    wire = payload.to_wire()
+    back = HandoffPayload.from_wire(wire)
+    assert back.prompt_tokens == [1, 2]
+    assert np.array_equal(back.kv_k, payload.kv_k)
+    with pytest.raises(ValueError, match="truncated"):
+        HandoffPayload.from_wire(wire[:-3])

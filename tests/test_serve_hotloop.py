@@ -87,6 +87,7 @@ class TestTokenIdentity:
         assert gen_all(on, PROMPTS) == want
         assert on.kv_pages_in_use() == 0
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_spec_ngram(self, cfg, params, want):
         spec = SpeculativeSpec(mode="ngram", k=4)
         off = make_engine(cfg, params, pipelined=False, spec=spec)
@@ -101,6 +102,7 @@ class TestTokenIdentity:
         assert gen_all(eng, PROMPTS) == want
         assert eng.kv_pages_in_use() == 0
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_staggered_admissions(self, cfg, params):
         """Requests joining while rounds are in flight (the one-round-late
         admission path) still decode exactly."""
@@ -125,6 +127,7 @@ class TestDeviceResidentState:
     everything after is per-slot deltas — and decode-only rounds sync
     nothing at all."""
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_full_uploads_stay_at_construction(self, cfg, params):
         for paged in (False, True):
             eng = make_engine(cfg, params, pipelined=True, paged=paged)
@@ -309,6 +312,7 @@ class TestTransferGuard:
         self._steady_state_under_guard(
             make_engine(cfg, params, pipelined=True, spec=spec))
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_sanitize_mode_token_identity(self, cfg, params, monkeypatch):
         """KFTPU_SANITIZE=1 engines guard every decode pass themselves and
         still produce reference greedy outputs on every flavor."""

@@ -52,6 +52,7 @@ class TestMoEServingImpls:
         assert eng._cfg_prefill.moe_impl == "dispatch"
         assert eng._cfg_decode.moe_impl == "dense"
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_prefill_dispatch_token_exact_vs_dense(self, cfg, params):
         dense = _engine(cfg, params, moe_prefill_impl="dense")
         disp = _engine(cfg, params, moe_prefill_impl="dispatch")

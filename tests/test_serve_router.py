@@ -296,3 +296,123 @@ def test_router_metrics_endpoint(router, backends):
         text = r.read().decode()
     assert "kftpu_router_picks" in text
     assert "kftpu_router_ejected" in text
+
+
+# -- disaggregated pools: token-aware placement (ISSUE 12) --------------------
+
+def test_pool_placement_follows_token_signals(router, backends):
+    """Prefills place on least-pending-prefill-tokens, decodes on
+    least-resident-KV-pages (in-flight breaks ties) — from injected
+    signals, no scrape needed."""
+    a, b = backends
+    c = EchoBackend("c")
+    d = EchoBackend("d")
+    try:
+        router.set_pools({"prefill": [a.url, b.url],
+                          "decode": [c.url, d.url]}, scrape=False)
+        router.note_signals(a.url, {"pending_prefill_tokens": 500,
+                                    "in_flight": 1})
+        router.note_signals(b.url, {"pending_prefill_tokens": 20,
+                                    "in_flight": 1})
+        router.note_signals(c.url, {"kv_pages_resident": 90,
+                                    "in_flight": 0})
+        router.note_signals(d.url, {"kv_pages_resident": 3,
+                                    "in_flight": 0})
+        for _ in range(4):
+            backend, decode = router.pick_disaggregated()
+            assert backend == b.url, "prefill pick ignored pending tokens"
+            assert decode == d.url, "decode pick ignored resident pages"
+        assert router.snapshot()["disagg_picks"] >= 4
+    finally:
+        c.stop()
+        d.stop()
+
+
+def test_pool_placement_round_robins_equal_signals(router, backends):
+    a, b = backends
+    router.set_pools({"prefill": [a.url, b.url], "decode": [a.url]},
+                     scrape=False)
+    picks = {router.pick_disaggregated()[0] for _ in range(8)}
+    assert picks == {a.url, b.url}, "equal signals pinned one backend"
+
+
+def test_pool_fallback_when_decode_pool_unhealthy(router, backends):
+    """No healthy decode member → unified fallback: a healthy backend
+    carries the request WITHOUT a handoff target."""
+    a, b = backends
+    dead = dead_url()
+    router.set_pools({"prefill": [a.url], "decode": [dead]}, scrape=False)
+    router.note_backend_failure(dead, connect=True)
+    router.note_backend_failure(dead, connect=True)   # threshold=2: eject
+    backend, decode = router.pick_disaggregated()
+    assert backend == a.url
+    assert decode is None
+    assert router.snapshot()["disagg_fallbacks"] >= 1
+
+
+def test_pool_proxy_stamps_decode_backend_header(router, backends):
+    """Through the HTTP proxy, a disaggregated pick forwards the decode
+    target on X-Kftpu-Decode-Backend; fallback omits it."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubeflow_tpu.core.headers import DECODE_BACKEND_HEADER
+
+    seen = {}
+
+    class Capture(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            seen["decode"] = self.headers.get(DECODE_BACKEND_HEADER)
+            n = int(self.headers.get("Content-Length", 0))
+            if n:
+                self.rfile.read(n)
+            data = _json.dumps({"backend": "capture"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Capture)
+    httpd.daemon_threads = True
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    cap_url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    a, b = backends
+    try:
+        router.set_pools({"prefill": [cap_url], "decode": [b.url]},
+                         scrape=False)
+        status, _ = ask(router.url)
+        assert status == 200
+        assert seen["decode"] == b.url
+        # Decode pool gone → fallback carries no handoff header.
+        router.set_pools({"prefill": [cap_url], "decode": []},
+                         scrape=False)
+        seen.clear()
+        status, _ = ask(router.url)
+        assert status == 200
+        assert seen["decode"] is None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_scrape_failure_ejects_pool_member(router, backends):
+    """The signal scrape doubles as a health probe: a pool member that
+    stops answering /metrics is ejected from placement even though it
+    takes no proxied traffic."""
+    a, b = backends
+    dead = dead_url()
+    router.set_pools({"prefill": [a.url], "decode": [dead, b.url]},
+                     scrape=False)
+    for _ in range(router.eject_threshold):
+        router.scrape_signals()
+    backend, decode = router.pick_disaggregated()
+    assert backend == a.url
+    assert decode == b.url, "dead decode member still picked"
+    assert router.snapshot()["ejections"] >= 1

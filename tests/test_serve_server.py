@@ -243,6 +243,7 @@ class TestMultiModel:
                                 "/v2/repository/index")[1]["models"]}
         assert states["alpha"] == "READY"
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_lru_eviction_on_second_model(self, repo_server):
         http(repo_server, "POST", "/v1/models/alpha:predict",
              {"instances": ["hi"], "max_tokens": 4})

@@ -160,6 +160,7 @@ class TestSpecExactMatch:
         assert req.output_tokens == want_req.output_tokens
         assert req.finish_reason == want_req.finish_reason
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_budget_exact_mid_round(self, cfg, params):
         """max_new_tokens falling inside a round's emission truncates it
         exactly (never over-generates)."""
@@ -201,6 +202,7 @@ class TestPagedRollback:
         assert gen_all(eng, PROMPTS) == want
         self._assert_balanced(eng)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_rollback_truncates_table(self, cfg, params):
         """Mid-flight: after any spec round, a slot's page list covers
         exactly ceil(length/page) pages — rejected-tail pages were freed."""
@@ -240,6 +242,7 @@ class TestPagedRollback:
 
     @pytest.mark.parametrize("spec", [
         SpeculativeSpec(mode="ngram", k=4), DRAFT], ids=["ngram", "draft"])
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_pool_pressure_with_spec_still_exact(self, cfg, params, spec):
         """A pool too small for all slots: recompute preemption + spec
         coexist (including the draft-cache reset on re-admission) and

@@ -70,6 +70,7 @@ class TestViT:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_sharded_matches_single(self):
         params = init_vit_params(jax.random.PRNGKey(0), TINY)
         batch = jax.tree.map(jnp.asarray, vit_batch(TINY, 8, 0))
@@ -98,6 +99,7 @@ class TestCLIP:
         assert abs(float(loss) - jnp.log(4)) < 1.5
         assert 0.0 < float(metrics["temperature"]) < 1.0
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_clip_learns(self):
         mesh = build_mesh({"data": 4, "model": 2})
         task = setup_clip_train(TINY_CLIP, OptimizerConfig(

@@ -65,6 +65,7 @@ class TestNotebookSession:
                 time.sleep(0.1)
         raise TimeoutError(f"session at {sock} never answered")
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 12): >10s on the gate host
     def test_spawn_exec_cull_wake(self, cp):
         cp.submit(PodDefault(
             metadata=ObjectMeta(name="inject"),
