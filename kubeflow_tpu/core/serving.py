@@ -184,6 +184,25 @@ class BatchingSpec(BaseModel):
     page_size: int = 128             # KV cache page (tokens)
     max_pages: Optional[int] = None  # default: slots × max_seq_len / page
     enable_prefix_caching: bool = True
+    # Prefix-cache index (serve/kvtier.py). "radix" (default): token-block
+    # radix tree over the page pool — live copy-on-write sharing of ref>0
+    # prefix pages between in-flight requests, sub-page tail reuse (a
+    # divergence allocates a fresh page and device-copies only the shared
+    # partial block), and conversation re-use (a finished request's
+    # prompt+output pages stay matchable). "flat" keeps the legacy
+    # full-prompt chained-hash cache in PageAllocator (the A/B baseline).
+    prefix_index: str = "radix"      # radix | flat
+    # Host-RAM overflow tier (radix index only): cold sharer-free prefix
+    # pages migrate device→host as raw page bytes on a background
+    # migration thread and promote back on a radix hit before prefill
+    # admits — long-idle conversations stop pinning HBM without losing
+    # their recompute savings. Page budget of the host tier; 0 = off.
+    host_kv_pages: int = 0
+    # A cached (sharer-free) device page idle this long is demotion-
+    # eligible; batched transfers move at most kv_migrate_batch_pages
+    # per migration pass.
+    kv_demote_after_s: float = 2.0
+    kv_migrate_batch_pages: int = 32
     # Paged decode attention: "gather" (materialize pages, XLA attention —
     # 2× KV read), "pallas" (direct page reads via the paged-attention
     # kernel), or "auto" (pallas on TPU, gather elsewhere).
@@ -303,6 +322,22 @@ class BatchingSpec(BaseModel):
             raise ValueError(
                 "disaggregated roles require kv_cache_dtype=None "
                 "(handoff transfers raw-dtype KV pages)")
+        if self.prefix_index not in ("radix", "flat"):
+            raise ValueError(
+                f"unknown prefix_index {self.prefix_index!r}; "
+                "one of radix|flat")
+        if self.host_kv_pages:
+            if self.prefix_index != "radix":
+                raise ValueError(
+                    "host_kv_pages requires prefix_index='radix' (the "
+                    "flat hash has no tier lifecycle)")
+            if self.kv_cache_dtype is not None:
+                # Host blobs carry raw cache-dtype page bytes; a
+                # quantized pool would need scale blobs alongside — not
+                # wired. Same constraint as handoff payloads.
+                raise ValueError(
+                    "host_kv_pages requires kv_cache_dtype=None "
+                    "(the host tier stores raw-dtype page bytes)")
         return self
 
 

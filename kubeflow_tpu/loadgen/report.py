@@ -55,11 +55,23 @@ ATTRIBUTION_SERIES = (
     "kftpu_serving_qos_preemptions_total",
     "kftpu_serving_qos_ttft_p95_ms",
     "kftpu_serving_qos_queue_delay_p95_ms",
+    # Tiered KV cache (serve/kvtier.py): the prefix-hit / COW /
+    # device↔host migration attribution block — a shared-prefix or
+    # multi-turn regression names the tier, not just the latency.
+    "kftpu_engine_kv_pages_resident",
+    "kftpu_engine_kv_pages_cached",
+    "kftpu_engine_kv_pages_host",
+    "kftpu_engine_kv_prefix_hits_total",
+    "kftpu_engine_kv_prefix_tokens_reused_total",
+    "kftpu_engine_kv_cow_copies_total",
+    "kftpu_engine_kv_pages_demoted_total",
+    "kftpu_engine_kv_pages_promoted_total",
 )
 
 #: Engine span-name prefix → report phase keys (obs.trace owns the
 #: span names; phase_durations owns the extraction).
-PHASE_KEYS = ("queued_ms", "prefill_ms", "handoff_ms", "decode_ms")
+PHASE_KEYS = ("queued_ms", "kv_migrate_ms", "prefill_ms", "handoff_ms",
+              "decode_ms")
 
 
 def engine_attribution(metrics_text: str) -> dict:
@@ -90,6 +102,12 @@ def engine_attribution(metrics_text: str) -> dict:
             out["host_gap_p99_ms"] = round(value, 3)
         elif name == "kftpu_engine_dispatch_depth":
             out["dispatch_depth"] = int(value)
+        elif name.startswith("kftpu_engine_kv_"):
+            key = name[len("kftpu_engine_kv_"):]
+            if key.endswith("_total"):
+                key = key[:-len("_total")]
+            tier = out.setdefault("kv_tier", {})
+            tier[key] = tier.get(key, 0) + int(value)
         elif name.startswith("kftpu_serving_qos_"):
             cls = labels.get("qos")
             if cls is None:

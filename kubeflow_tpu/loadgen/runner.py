@@ -32,7 +32,7 @@ import http.client
 import json
 import threading
 import time
-from typing import Optional
+from typing import Any, Optional
 from urllib.parse import urlparse
 
 from kubeflow_tpu.core.headers import QOS_HEADER, TRACE_HEADER
@@ -55,6 +55,14 @@ class RequestOutcome:
     tokens: int
     status: str                 # ok | shed | timeout | error
     trace_id: str = ""
+    #: Generated output in the target's native space (token tuple for
+    #: EngineTarget, text for ServerTarget) — what session mode
+    #: prepends to the next turn's prompt. Empty outside session runs.
+    gen: Any = ()
+    #: Length of the prompt actually sent (session mode: the COMPOSED
+    #: conversation, not just the new turn) — the offered-prefill-work
+    #: denominator perf gates divide by.
+    prompt_len: int = 0
 
     @property
     def ok(self) -> bool:
@@ -74,16 +82,27 @@ class EngineTarget:
     def __init__(self, engine):
         self.engine = engine
 
-    def issue(self, sr: ScheduledRequest, root,
-              timeout_s: float) -> RequestOutcome:
+    def base_prompt(self, sr: ScheduledRequest):
+        return list(sr.prompt_tokens)
+
+    def compose(self, prev_prompt, prev_gen, sr: ScheduledRequest):
+        """Session mode: this turn's prompt = the conversation so far
+        (previous resolved prompt + its ACTUAL output) + new tokens —
+        the exact re-arrival shape the radix prefix index matches."""
+        return list(prev_prompt) + list(prev_gen) + list(sr.prompt_tokens)
+
+    def issue(self, sr: ScheduledRequest, root, timeout_s: float,
+              prompt=None) -> RequestOutcome:
         from kubeflow_tpu.serve.engine import (
             EngineOverloaded, SamplingParams,
         )
 
+        prompt_tokens = (list(sr.prompt_tokens) if prompt is None
+                         else list(prompt))
         t0 = time.perf_counter()
         try:
             req = self.engine.submit(
-                list(sr.prompt_tokens),
+                prompt_tokens,
                 SamplingParams(max_new_tokens=sr.max_new_tokens,
                                temperature=0.0),
                 deadline=time.monotonic() + timeout_s,
@@ -92,9 +111,9 @@ class EngineTarget:
             return RequestOutcome(
                 idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
                 ttft_s=None, latency_s=time.perf_counter() - t0,
-                tokens=0, status="shed")
+                tokens=0, status="shed", prompt_len=len(prompt_tokens))
         ttft = None
-        tokens = 0
+        out_tokens: list[int] = []
         status = "ok"
         deadline = t0 + timeout_s + 1.0
         while True:
@@ -107,7 +126,7 @@ class EngineTarget:
                 break
             if tok is None:
                 break
-            tokens += 1
+            out_tokens.append(tok)
             if ttft is None:
                 ttft = time.perf_counter() - t0
         if status == "ok" and req.finish_reason not in ("stop", "length"):
@@ -115,7 +134,8 @@ class EngineTarget:
         return RequestOutcome(
             idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
             ttft_s=ttft, latency_s=time.perf_counter() - t0,
-            tokens=tokens, status=status)
+            tokens=len(out_tokens), status=status,
+            gen=tuple(out_tokens), prompt_len=len(prompt_tokens))
 
 
 def tokens_to_text(tokens) -> str:
@@ -134,10 +154,22 @@ class ServerTarget:
         self.port = parsed.port or 80
         self.model = model
 
-    def issue(self, sr: ScheduledRequest, root,
-              timeout_s: float) -> RequestOutcome:
+    def base_prompt(self, sr: ScheduledRequest):
+        return tokens_to_text(sr.prompt_tokens)
+
+    def compose(self, prev_prompt, prev_gen, sr: ScheduledRequest):
+        """Session mode in TEXT space: the server re-tokenizes the
+        composed prompt, so prefix structure survives the round-trip
+        (tokens_to_text is deterministic per token)."""
+        return str(prev_prompt) + str(prev_gen) \
+            + tokens_to_text(sr.prompt_tokens)
+
+    def issue(self, sr: ScheduledRequest, root, timeout_s: float,
+              prompt=None) -> RequestOutcome:
         t0 = time.perf_counter()
-        body = {"prompt": tokens_to_text(sr.prompt_tokens),
+        prompt_text = (self.base_prompt(sr) if prompt is None
+                       else str(prompt))
+        body = {"prompt": prompt_text,
                 "max_tokens": sr.max_new_tokens, "temperature": 0.0,
                 "stream": True, "timeout": timeout_s}
         if self.model:
@@ -151,6 +183,7 @@ class ServerTarget:
                                           timeout=timeout_s + 5.0)
         ttft = None
         tokens = 0
+        pieces: list[str] = []
         status = "ok"
         try:
             conn.request("POST", "/v1/completions", body=payload,
@@ -178,6 +211,12 @@ class ServerTarget:
                     tokens += 1
                     if ttft is None:
                         ttft = time.perf_counter() - t0
+                    try:
+                        chunk = json.loads(data)
+                        pieces.append(
+                            chunk["choices"][0].get("text", ""))
+                    except (ValueError, KeyError, IndexError):
+                        pass        # non-JSON chunk: no text to carry
         except (OSError, http.client.HTTPException):
             status = "timeout" if time.perf_counter() - t0 >= timeout_s \
                 else "error"
@@ -186,7 +225,8 @@ class ServerTarget:
         return RequestOutcome(
             idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
             ttft_s=ttft, latency_s=time.perf_counter() - t0,
-            tokens=tokens, status=status)
+            tokens=tokens, status=status, gen="".join(pieces),
+            prompt_len=len(prompt_text))
 
 
 @dataclasses.dataclass
@@ -213,12 +253,41 @@ def run_scenario(target, scenario: Scenario, *, vocab_size: int,
                               max_prompt_len=max_prompt_len)
     outcomes: list[RequestOutcome] = []
     lock = threading.Lock()
+    # Session mode (multi-turn conversations): each turn waits for its
+    # predecessor, thinks, then fires with the composed conversation
+    # prompt. The maps below are the cross-turn handoff state.
+    turn_done: dict[int, threading.Event] = (
+        {sr.idx: threading.Event() for sr in schedule}
+        if scenario.turns > 1 else {})
+    resolved: dict[int, object] = {}       # idx -> prompt actually sent
+    gen_of: dict[int, object] = {}         # idx -> actual output
+    done_at: dict[int, float] = {}         # idx -> completion perf time
 
     def fire(sr: ScheduledRequest, lag: float) -> None:
+        prompt = None
+        if sr.prev_idx is not None:
+            # Closed-loop WITHIN the session (a user types after
+            # reading), open-loop across sessions. A predecessor that
+            # never completes bounds the wait — the turn then fires
+            # with whatever the conversation produced so far.
+            ev = turn_done.get(sr.prev_idx)
+            if ev is not None:
+                ev.wait(timeout=scenario.request_timeout_s + 30.0)
+            with lock:
+                prev_prompt = resolved.get(sr.prev_idx,
+                                           target.base_prompt(sr))
+                prev_gen = gen_of.get(sr.prev_idx, ())
+                prev_t = done_at.get(sr.prev_idx)
+            if sr.think_s and prev_t is not None:
+                gap = prev_t + sr.think_s - time.perf_counter()
+                if gap > 0:
+                    time.sleep(gap)
+            prompt = target.compose(prev_prompt, prev_gen, sr)
         root = tracer.start_span("loadgen.request", scenario=scenario.name,
                                  request_idx=sr.idx, qos=sr.qos)
         try:
-            out = target.issue(sr, root, scenario.request_timeout_s)
+            out = target.issue(sr, root, scenario.request_timeout_s,
+                               prompt=prompt)
         except Exception as exc:  # a client bug must not hang the join
             root.set_attrs(error=f"{type(exc).__name__}: {exc}")
             out = RequestOutcome(
@@ -229,6 +298,13 @@ def run_scenario(target, scenario: Scenario, *, vocab_size: int,
         root.end("ok" if out.ok else out.status)
         with lock:
             outcomes.append(out)
+            resolved[sr.idx] = (prompt if prompt is not None
+                                else target.base_prompt(sr))
+            gen_of[sr.idx] = out.gen
+            done_at[sr.idx] = time.perf_counter()
+        ev = turn_done.get(sr.idx)
+        if ev is not None:
+            ev.set()
 
     threads: list[threading.Thread] = []
     t0 = time.perf_counter()
@@ -242,7 +318,10 @@ def run_scenario(target, scenario: Scenario, *, vocab_size: int,
                               daemon=True)
         th.start()
         threads.append(th)
-    join_deadline = time.perf_counter() + scenario.request_timeout_s + 30.0
+    # Session turns serialize behind their predecessors: the no-hang
+    # bound scales with the conversation depth.
+    join_deadline = time.perf_counter() + 30.0 + scenario.turns * (
+        scenario.request_timeout_s + scenario.think_time_s)
     for th in threads:
         th.join(timeout=max(join_deadline - time.perf_counter(), 0.1))
     wall = time.perf_counter() - t0

@@ -150,12 +150,26 @@ class Scenario:
     slo_classes: tuple = ()
     #: Client-side per-request give-up budget (seconds).
     request_timeout_s: float = 120.0
+    #: Multi-turn sessions (> 1 switches to session mode): requests
+    #: group into conversations of this many turns. Turn 0 carries a
+    #: normal prompt; each later turn carries only its NEW tokens and
+    #: fires ``think_time_s`` after the previous turn completes, with
+    #: the runner composing prompt = previous prompt + previous ACTUAL
+    #: output + new tokens — the conversation-re-arrival shape the
+    #: tiered KV cache monetizes (think-time gaps long enough force
+    #: device→host demotion between turns, the tier-lifecycle probe).
+    turns: int = 1
+    think_time_s: float = 0.0
 
     def validate(self) -> None:
         if self.num_requests < 1:
             raise ValueError("num_requests must be >= 1")
         if not 0.0 <= self.prefix_overlap <= 1.0:
             raise ValueError("prefix_overlap must be in [0, 1]")
+        if self.turns < 1:
+            raise ValueError("turns must be >= 1")
+        if self.think_time_s < 0:
+            raise ValueError("think_time_s must be >= 0")
         self.arrival.validate()
         self.prompt_len.validate()
         self.output_len.validate()
@@ -191,13 +205,21 @@ class Scenario:
 @dataclasses.dataclass(frozen=True)
 class ScheduledRequest:
     """One concrete request in a built schedule: fire at ``t`` seconds
-    after the run starts."""
+    after the run starts. Session mode (``Scenario.turns > 1``):
+    ``prev_idx`` names the previous turn whose resolved prompt + actual
+    output prefix this request's prompt (``prompt_tokens`` then carries
+    only the NEW turn's tokens), and the runner fires it ``think_s``
+    after that turn completes."""
 
     idx: int
     t: float
     prompt_tokens: tuple
     max_new_tokens: int
     qos: str
+    session: int = -1
+    turn: int = 0
+    prev_idx: Optional[int] = None
+    think_s: float = 0.0
 
 
 def arrival_times(arrival: Arrival, n: int,
@@ -241,6 +263,10 @@ def build_schedule(scenario: Scenario, *, vocab_size: int,
     if max_prompt_len < 1:
         raise ValueError("max_prompt_len must be >= 1")
     rng = np.random.default_rng(scenario.seed)
+    if scenario.turns > 1:
+        return _build_session_schedule(scenario, rng,
+                                       vocab_size=vocab_size,
+                                       max_prompt_len=max_prompt_len)
     times = arrival_times(scenario.arrival, scenario.num_requests, rng)
     # The shared pool every prompt's prefix comes from: drawn once per
     # scenario, so overlapping prompts share ACTUAL token content (the
@@ -280,12 +306,62 @@ def build_schedule(scenario: Scenario, *, vocab_size: int,
     return out
 
 
+def _build_session_schedule(scenario: Scenario, rng: np.random.Generator,
+                            *, vocab_size: int,
+                            max_prompt_len: int) -> list[ScheduledRequest]:
+    """Session-mode expansion (``turns > 1``): the arrival process
+    places SESSION starts; each session is ``turns`` chained requests.
+    Turn 0 draws a normal (possibly shared-prefix) prompt; later turns
+    draw only their new tokens — the runner prepends the conversation
+    so far (previous resolved prompt + ACTUAL generated output). One
+    QoS class per session (a conversation does not change tenants
+    mid-flight). Same seed → byte-identical schedule, like the flat
+    path — only the composed prompts depend on runtime outputs."""
+    turns = scenario.turns
+    think = scenario.think_time_s
+    n_sessions = max(1, scenario.num_requests // turns)
+    times = arrival_times(scenario.arrival, n_sessions, rng)
+    shared = rng.integers(1, vocab_size, size=max_prompt_len)
+    classes = [cls for cls, _ in scenario.qos_mix] or [QOS_DEFAULT]
+    weights = np.asarray([w for _, w in scenario.qos_mix] or [1.0], float)
+    weights = weights / weights.sum()
+    out: list[ScheduledRequest] = []
+    idx = 0
+    for s_i in range(n_sessions):
+        qos = str(rng.choice(classes, p=weights))
+        for t_i in range(turns):
+            if t_i == 0:
+                plen = scenario.prompt_len.sample(rng, max_prompt_len)
+                k = int(round(scenario.prefix_overlap * plen))
+                tail = rng.integers(1, vocab_size, size=plen - k)
+                prompt = tuple(int(x) for x in shared[:k]) \
+                    + tuple(int(x) for x in tail)
+            else:
+                # A new turn is SHORT relative to the history it rides
+                # on — a quarter of the opening-prompt distribution.
+                plen = max(1, scenario.prompt_len.sample(
+                    rng, max_prompt_len) // 4)
+                prompt = tuple(int(x) for x in
+                               rng.integers(1, vocab_size, size=plen))
+            out.append(ScheduledRequest(
+                idx=idx, t=float(times[s_i]) + t_i * think,
+                prompt_tokens=prompt,
+                max_new_tokens=scenario.output_len.sample(rng, 100_000),
+                qos=qos, session=s_i, turn=t_i,
+                prev_idx=(idx - 1 if t_i else None),
+                think_s=(think if t_i else 0.0)))
+            idx += 1
+    return out
+
+
 def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
                     prompt_len: int = 48, max_new: int = 16,
                     slo_ttft_ms: float = 2000.0,
                     mixed_slo_tpot_ms: Optional[float] = None,
+                    shared_prefix_overlap: float = 0.75,
+                    multi_turn_think_s: float = 0.35,
                     seed: int = 0) -> list[Scenario]:
-    """The canonical 4-scenario serving matrix the perf gate and
+    """The canonical 5-scenario serving matrix the perf gate and
     ``bench_serve.py --workload scenarios`` both replay:
 
     - ``uniform`` — Poisson arrivals, fixed lengths, one QoS class: the
@@ -300,7 +376,16 @@ def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
       interleaved with short interactive requests (class-correlated
       shapes via ``class_profiles``): makes prefill→decode head-of-line
       blocking measurable — the disaggregated prefill/decode split
-      proves its goodput win through this shape (ROADMAP item 2).
+      proves its goodput win through this shape (ROADMAP item 2);
+    - ``multi_turn`` — conversation sessions re-arriving with their
+      prior prefix plus one new turn, think-time gaps between turns
+      (long enough to force tier demotion when the host tier is on):
+      the tiered-KV-cache regime — prefix reuse across slot release,
+      COW tails, and the device↔host migration lifecycle
+      (``scripts/prefix_cache_smoke.py`` gates through this shape).
+
+    ``shared_prefix_overlap`` sweeps the shared-prefix scenario's
+    overlap fraction (the 0.5–0.95 axis the prefix-cache gate walks).
     """
     return [
         Scenario(
@@ -326,7 +411,8 @@ def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
                                   sigma=0.4, low=max(8, prompt_len // 4),
                                   high=2 * prompt_len),
             output_len=LengthDist(kind="fixed", value=max_new),
-            prefix_overlap=0.75, slo_ttft_ms=slo_ttft_ms),
+            prefix_overlap=shared_prefix_overlap,
+            slo_ttft_ms=slo_ttft_ms),
         Scenario(
             name="mixed_interference", num_requests=num_requests,
             seed=seed + 3,
@@ -345,6 +431,16 @@ def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
             ),
             slo_ttft_ms=slo_ttft_ms, slo_tpot_ms=mixed_slo_tpot_ms,
             slo_classes=("interactive",)),
+        Scenario(
+            name="multi_turn", num_requests=num_requests, seed=seed + 4,
+            # Sessions arrive slower than single-shot requests — each
+            # one carries `turns` requests of offered load.
+            arrival=Arrival(process="poisson",
+                            rate_rps=max(rate_rps / 3.0, 0.5)),
+            prompt_len=LengthDist(kind="fixed", value=prompt_len),
+            output_len=LengthDist(kind="fixed", value=max_new),
+            turns=3, think_time_s=multi_turn_think_s,
+            prefix_overlap=0.5, slo_ttft_ms=slo_ttft_ms),
     ]
 
 

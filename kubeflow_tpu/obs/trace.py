@@ -369,7 +369,7 @@ class Tracer:
 #: requests: the prefill model server opens it around KV export + POST
 #: + ack, and the adopting engine's queued/decode spans continue the
 #: SAME trace on the decode side.
-ENGINE_PHASES = ("queued", "prefill", "handoff", "decode")
+ENGINE_PHASES = ("queued", "kv_migrate", "prefill", "handoff", "decode")
 
 
 def phase_durations(spans: list[dict]) -> dict:

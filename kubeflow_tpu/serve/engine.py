@@ -853,6 +853,7 @@ class LLMEngine:
         self.paged = bool(b.paged)
         self.page_size = int(b.page_size)
         self._allocator = None
+        self._kvtier = None          # lockfree: scheduler-confined
         if self.paged:
             from kubeflow_tpu.serve.paged import PageAllocator
 
@@ -970,10 +971,10 @@ class LLMEngine:
                     "kv_cache_dtype=int8 requires paged_attn_impl=gather "
                     "(the paged-attention kernel reads bf16 pages)")
             self._paged_chunk = jax.jit(
-                lambda p, c, t, tr, st, cp, vl, ncp: _pin2(paged_chunk_prefill(
-                    p, c, t, tr, st, cp, cfg_prefill, context_pages=ncp,
-                    valid_len=vl), self._pin),
-                static_argnums=(7,), donate_argnums=(1,))
+                lambda p, c, t, tr, st, vl, ncp: _pin2(paged_chunk_prefill(
+                    p, c, t, tr, st, vl, cfg_prefill, context_pages=ncp),
+                    self._pin),
+                static_argnums=(6,), donate_argnums=(1,))
 
             def _paged_decode_fn(p, c, st, tbl, key, n, m, _impl=pattn):
                 # The device-resident state dict + page table ride in as
@@ -1035,6 +1036,35 @@ class LLMEngine:
                        "v": c["v"].at[:, slot, :k.shape[1]].set(v)}
                 return self._pin(out)
         self._adopt_upload = jax.jit(_adopt_paged_fn, donate_argnums=(0,))
+        if self.paged and b.enable_prefix_caching \
+                and b.prefix_index == "radix":
+            # Tiered KV cache (serve/kvtier.py): token-block radix index
+            # with live copy-on-write page sharing + optional host-RAM
+            # overflow tier. The index is scheduler-confined like the
+            # allocator it extends; device work rides the closures below
+            # (all enqueue on the scheduler thread, in program order
+            # with the dispatches that read their results).
+            from kubeflow_tpu.serve.kvtier import RadixPrefixIndex
+            from kubeflow_tpu.serve.paged import copy_pages
+
+            self._kv_copy = jax.jit(
+                lambda c, s, d: self._pin(copy_pages(c, s, d)),
+                donate_argnums=(0,))
+            self._kvtier = RadixPrefixIndex(
+                self._allocator, self.page_size,
+                host_pages=int(b.host_kv_pages),
+                demote_after_s=float(b.kv_demote_after_s),
+                migrate_batch_pages=int(b.kv_migrate_batch_pages),
+                copy_pages_fn=self._kv_copy_pages,
+                upload_pages_fn=self._kv_upload_pages,
+                fetch_pages_fn=self._kv_fetch_pages)
+            # Pre-warm the COW-copy trace (a tail copy is always one
+            # pow2-padded pair, so this ONE trace covers every live
+            # COW): the first mid-traffic divergence must not show up
+            # as a steady-state recompile (the F6xx fixed-trace
+            # contract the recompile sanitizer audits). The OOB dst
+            # drops the write — a no-op dispatch.
+            self._kv_copy_pages([0], [-1])
         self._sampler = jax.jit(_sample_batch, static_argnums=(5,))
         # K decode steps per dispatch amortizes host round-trip latency
         # (sampling happens on-device; the while_loop exits early when every
@@ -1242,10 +1272,33 @@ class LLMEngine:
                    for r in list(self.waiting.queue) + list(self._backlog))
 
     def kv_pages_in_use(self) -> int:
-        """Referenced paged-KV pages (0 for the contiguous cache). The
-        chaos-suite invariant: quiescent engine -> 0 — every reap/finish
-        path freed exactly what admission allocated."""
+        """RESIDENT-REFERENCED paged-KV pages — pages live requests hold
+        references to right now (0 for the contiguous cache). Cached
+        ref-0 prefix content is deliberately excluded: it is freely
+        evictable, so it is capacity, not load (the decode router's
+        placement signal must not count it). The chaos-suite invariant:
+        quiescent engine -> 0 — every reap/finish path freed exactly
+        what admission allocated."""
         return 0 if self._allocator is None else self._allocator.in_use()
+
+    def kv_pages_cached(self) -> int:
+        """Ref-0 pages still holding reusable prefix content (the
+        reclaimable LRU) — the freely-evictable half of the old
+        ``resident`` notion, split out so dashboards and the router can
+        tell load from cache."""
+        return 0 if self._allocator is None else self._allocator.cached()
+
+    def kv_pages_host(self) -> int:
+        """Pages resident in the host-RAM overflow tier (0 when the
+        tier is off)."""
+        return 0 if self._kvtier is None else \
+            self._kvtier.host_pages_resident()
+
+    def kv_tier_stats(self) -> dict:
+        """Radix/tier counters (empty dict on flat/contiguous engines):
+        hits, matched/COW token counts, demotions/promotions, host
+        occupancy — the /metrics tier series' source."""
+        return {} if self._kvtier is None else self._kvtier.snapshot()
 
     def submit(self, prompt_tokens: list[int],
                params: Optional[SamplingParams] = None,
@@ -1475,26 +1528,27 @@ class LLMEngine:
                 ch.stalls += 1
                 if ch.stalls >= 3:
                     self._chunkings.remove(ch)
+                    # Chunks already written are real prefix KV — index
+                    # them before the pages release, so the resume's
+                    # match skips straight back here.
+                    self._kv_register(req.prompt_tokens, slot_idx, ch.pos)
                     self._release_slot_pages(slot_idx)
                     self._preempted.append(req)
                     self.metrics.note_preempted(req.qos)
                 return 0    # otherwise retry next scheduler step
             ch.stalls = 0
-            pg = self.page_size
-            ids = np.full((C // pg,), self._num_pages, np.int32)   # OOB pad
-            first = ch.pos // pg
-            last = (ch.pos + real - 1) // pg
-            ids[:last - first + 1] = self._table[slot_idx, first:last + 1]
             # Static context bucket (next power of two covering the pages
             # this chunk can see): chunk cost tracks ch.pos, not max_len,
-            # with a log-bounded trace set.
+            # with a log-bounded trace set. The chunk's writes address
+            # per token off the table row, so ch.pos may sit mid-page
+            # (the radix COW tail resume).
             from kubeflow_tpu.serve.paged import context_bucket
 
-            ctx = context_bucket(ch.pos, C, pg, self._mpp)
+            ctx = context_bucket(ch.pos, C, self.page_size, self._mpp)
             logits, self.cache = self._paged_chunk(
                 self.params, self.cache, jnp.asarray(chunk),
                 jnp.asarray(self._table[slot_idx]), jnp.int32(ch.pos),
-                jnp.asarray(ids), jnp.int32(real), ctx)
+                jnp.int32(real), ctx)
         else:
             logits, self.cache = self._prefill_chunk(
                 self.params, self.cache, jnp.asarray(chunk),
@@ -1502,12 +1556,12 @@ class LLMEngine:
         ch.pos += real
         if ch.pos >= plen:
             self._chunkings.remove(ch)
-            if self.paged and self._allocator is not None:
-                # Hash the FULL prompt pages for cross-request reuse
-                # (decode writes never touch them — they start at plen).
-                self._allocator.register_prefix(
-                    req.prompt_tokens,
-                    self._slot_pages[slot_idx][:plen // self.page_size])
+            if self.paged:
+                # Index the prompt's KV for cross-request reuse — LIVE:
+                # the owner keeps decoding while sharers match through
+                # these pages (decode writes start at plen, past every
+                # claimed position — COW by construction).
+                self._kv_register(req.prompt_tokens, slot_idx, plen)
             # Logits index of the prompt's true last token in this chunk.
             self._start_first_token(req, slot_idx, plen, logits[real - 1])
         return 1
@@ -1563,6 +1617,13 @@ class LLMEngine:
                 continue
             reason = s.request.abandon_reason(now)
             if reason:
+                if self._kvtier is not None:
+                    # A cancelled conversation's computed KV is still
+                    # valid prefix content — index it before release
+                    # (the retry/next-turn usually re-sends the same
+                    # prefix; cancel-while-shared keeps co-sharers'
+                    # references intact either way).
+                    self._kv_register(self._context_tokens(s), i, s.length)
                 self._release_slot_pages(i)
                 self.slots[i] = None
                 # Host-only decision (cancel/deadline): the device still
@@ -1703,6 +1764,34 @@ class LLMEngine:
                 self._adopt_handoff(req, slot_idx)
                 n += 1
                 continue
+            if self.paged:
+                # Paged admission is always chunked; the prefix index
+                # trims the work to the uncached tail (radix: live COW
+                # sharing, host-tier promotion, sub-page resume).
+                pages, covered = self._kv_match(req)
+                if req.trace_parent is not None:
+                    _span_close(req)       # queued →
+                    tier = self._kvtier
+                    if tier is not None and (tier.last_promoted
+                                             or tier.last_cow_tokens):
+                        # Promotion/COW rode this admission: surface it
+                        # as a first-class (near-instant — the transfers
+                        # are async-enqueued) phase on the trace.
+                        _span_open(req, "engine.kv_migrate",
+                                   promoted_pages=tier.last_promoted,
+                                   cow_tokens=tier.last_cow_tokens)
+                        _span_close(req)
+                    _span_open(req, "engine.prefill",
+                               cached_tokens=covered)
+                self._release_slot_pages(slot_idx)
+                self._slot_pages[slot_idx] = list(pages)
+                self._table[slot_idx, :] = -1
+                self._table[slot_idx, :len(pages)] = pages
+                self._dstate.mark_row(slot_idx)
+                ch = _Chunking(req, slot_idx, covered)
+                self._chunkings.append(ch)
+                n += self._advance_one(ch)
+                continue
             if req.trace_parent is not None:
                 # queued → prefill (covers both fresh admissions and
                 # preempted-lane resumes, which skip _note_admitted).
@@ -1710,20 +1799,6 @@ class LLMEngine:
                 _span_open(req, "engine.prefill")
             plen = len(req.prompt_tokens)
             C = self.chunk_size
-            if self.paged:
-                # Paged admission is always chunked; the prefix cache
-                # trims the work to the uncached tail.
-                hit = self._allocator.match_prefix(req.prompt_tokens,
-                                                   owner=req.id)
-                self._release_slot_pages(slot_idx)
-                self._slot_pages[slot_idx] = list(hit)
-                self._table[slot_idx, :] = -1
-                self._table[slot_idx, :len(hit)] = hit
-                self._dstate.mark_row(slot_idx)
-                ch = _Chunking(req, slot_idx, len(hit) * self.page_size)
-                self._chunkings.append(ch)
-                n += self._advance_one(ch)
-                continue
             if C and plen > C and -(-plen // C) * C <= self.max_len \
                     and len(self._chunkings) < self.max_concurrent_prefills:
                 # Long prompt: chunked path — _free_slot holds this slot
@@ -1898,15 +1973,13 @@ class LLMEngine:
             self._release_slot_pages(slot_idx)
             # Cross-request reuse ACROSS the handoff boundary: pages this
             # decode pool already holds for the prompt's prefix are
-            # adopted by reference (incref) — only the uncovered tail
-            # uploads. match_prefix caps itself one token short, so the
-            # tail is never empty.
-            hit = self._allocator.match_prefix(p.prompt_tokens,
-                                               owner=req.id)
+            # adopted by reference — only the uncovered tail uploads.
+            # Page-aligned match (no COW tail): the upload below is
+            # page-granular.
+            hit, start = self._kv_match(req, allow_cow=False)
             fresh = self._allocator.alloc(need - len(hit), owner=req.id)
             try:
                 pages = list(hit) + fresh
-                start = len(hit) * pg        # tokens the hits cover
                 n2 = 1
                 while n2 < len(fresh):
                     n2 *= 2
@@ -1932,11 +2005,10 @@ class LLMEngine:
             self._table[slot_idx, :] = -1
             self._table[slot_idx, :need] = pages
             self._dstate.mark_row(slot_idx)
-            # The adopted pages hold full-prefix KV — register them so
+            # The adopted pages hold full-prefix KV — index them so
             # same-prefix traffic landing on this decode engine reuses
             # them (decode writes start at plen, never touching these).
-            self._allocator.register_prefix(
-                p.prompt_tokens, pages[:plen // pg])
+            self._kv_register(p.prompt_tokens, slot_idx, plen)
         else:
             width = 1
             while width < plen:
@@ -1989,6 +2061,91 @@ class LLMEngine:
                        for ch in list(self._chunkings))
         return waiting + backlog + chunking
 
+    # -- tiered KV cache (serve/kvtier.py device closures) ---------------------
+
+    def _kv_copy_pages(self, src, dst) -> None:
+        """COW tail copy: pool pages ``dst[i] <- src[i]`` in one donated
+        dispatch (power-of-two padded; OOB dst ids drop)."""
+        n = len(src)
+        n2 = 1
+        while n2 < n:
+            n2 *= 2
+        s = np.zeros((n2,), np.int32)
+        d = np.full((n2,), -1, np.int32)
+        s[:n] = src
+        d[:n] = dst
+        self.cache = self._kv_copy(self.cache, jnp.asarray(s),
+                                   jnp.asarray(d))
+
+    def _kv_upload_pages(self, page_ids, k_blocks, v_blocks) -> None:
+        """Host→device promotion: per-page ``[L, pg, KV, Dh]`` blocks
+        into ``page_ids`` through the same scatter handoff adoption
+        uses — enqueued before the admit's chunk prefill, so program
+        order guarantees the prefill's gather reads promoted content.
+        One host copy: blobs pack straight into the pow2-padded buffer
+        (pad columns stay uninitialized — their OOB ids drop the
+        write)."""
+        cfg = self.cfg
+        pg = self.page_size
+        dt = self.cache["k"].dtype
+        n = len(page_ids)
+        n2 = 1
+        while n2 < n:
+            n2 *= 2
+        buf_k = np.empty((cfg.n_layers, n2, pg, cfg.n_kv_heads,
+                          cfg.head_dim), dt)
+        buf_v = np.empty_like(buf_k)
+        for j in range(n):
+            buf_k[:, j] = k_blocks[j]
+            buf_v[:, j] = v_blocks[j]
+        pidx = np.full((n2,), self._num_pages, np.int32)
+        pidx[:n] = page_ids
+        self.cache = self._adopt_upload(
+            self.cache, jnp.asarray(buf_k), jnp.asarray(buf_v),
+            jnp.asarray(pidx))
+
+    def _kv_fetch_pages(self, page_ids):
+        """Demotion batch: device-side gather of the pages' planes —
+        independent buffers in program order, so the pages can free
+        immediately (the handoff-export pattern); the migration thread
+        does the blocking ``device_get``. Power-of-two padded (repeat
+        the last id) so the gather's trace set stays log-bounded — an
+        unpadded per-batch-size gather would retrace on the scheduler
+        thread and spike TTFT."""
+        n = len(page_ids)
+        n2 = 1
+        while n2 < n:
+            n2 *= 2
+        padded = list(page_ids) + [page_ids[-1]] * (n2 - n)
+        ids = jnp.asarray(np.asarray(padded, np.int32))
+        return self.cache["k"][:, ids], self.cache["v"][:, ids]
+
+    def _kv_register(self, tokens, slot_idx: int, n_tokens: int) -> None:
+        """Index ``tokens[:n_tokens]``'s written KV for cross-request
+        reuse (radix) or hash the full-page prompt prefix (flat)."""
+        if self._allocator is None or n_tokens <= 0:
+            return
+        if self._kvtier is not None:
+            self._kvtier.insert(tokens, self._slot_pages[slot_idx],
+                                n_tokens)
+        else:
+            self._allocator.register_prefix(
+                list(tokens)[:n_tokens],
+                self._slot_pages[slot_idx][:n_tokens // self.page_size])
+
+    def _kv_match(self, req: Request, *, allow_cow: bool = True
+                  ) -> tuple[list[int], int]:
+        """Longest reusable prefix of ``req``'s prompt: (pages now owned
+        by the request, tokens covered). Radix: live COW sharing +
+        host-tier promotion, possibly sub-page. Flat: the legacy
+        full-page chained-hash hit."""
+        if self._kvtier is not None:
+            pages, covered = self._kvtier.match_and_acquire(
+                req.prompt_tokens, owner=req.id, allow_cow=allow_cow)
+            return pages, covered
+        hit = self._allocator.match_prefix(req.prompt_tokens, owner=req.id)
+        return list(hit), len(hit) * self.page_size
+
     # -- paged bookkeeping -----------------------------------------------------
 
     def _slot_owner(self, slot_idx: int) -> Optional[str]:
@@ -2023,7 +2180,11 @@ class LLMEngine:
 
     def _release_slot_pages(self, idx: int) -> None:
         if self._allocator is not None and self._slot_pages[idx]:
-            self._allocator.free(self._slot_pages[idx])
+            # Leaf-first (reversed) release: indexed pages enter the
+            # reclaimable LRU children-before-parents, so pool-pressure
+            # eviction trims cached subtrees from the leaves instead of
+            # beheading a whole conversation at its root.
+            self._allocator.free(list(reversed(self._slot_pages[idx])))
             self._slot_pages[idx] = []
             self._table[idx, :] = -1
             self._dstate.mark_row(idx)
@@ -2040,6 +2201,11 @@ class LLMEngine:
             _span_close(req, preempted=True,
                         tokens=len(req.output_tokens))
             _span_open(req, "engine.queued", requeued=True)
+        if self._kvtier is not None:
+            # The victim's computed KV (prompt + generated so far) stays
+            # matchable — its re-admission usually matches straight back
+            # to where it stopped instead of recomputing from token 0.
+            self._kv_register(self._context_tokens(s), idx, s.length)
         req.prompt_tokens = list(req.prompt_tokens) \
             + req.output_tokens[req.resumed_from:]
         req.resumed_from = len(req.output_tokens)
@@ -2097,12 +2263,11 @@ class LLMEngine:
             _span_close(req, preempted=True, chunked=True)
             _span_open(req, "engine.queued", requeued=True)
         if self.paged and self._allocator is not None and ch.pos:
-            # The written chunks hold real full-page prefix KV — hash
-            # them so the resume's match_prefix skips the rework (freed
-            # pages linger reclaimable until the pool needs them).
-            self._allocator.register_prefix(
-                req.prompt_tokens[:ch.pos],
-                self._slot_pages[ch.slot][:ch.pos // self.page_size])
+            # The written chunks hold real prefix KV — index them so
+            # the resume's match skips the rework (freed pages linger
+            # reclaimable until the pool needs them; the radix index
+            # keeps the sub-page tail too).
+            self._kv_register(req.prompt_tokens, ch.slot, ch.pos)
         self._chunkings.remove(ch)
         self._release_slot_pages(ch.slot)
         self._preempted.append(req)
@@ -2154,6 +2319,13 @@ class LLMEngine:
         req.done.set()
         self.metrics.observe(req)
         if self.paged:
+            if self._kvtier is not None:
+                # Conversation reuse: index prompt + generated tokens
+                # (the last emitted token's KV is not written — valid
+                # content is ctx[:s.length]) before the pages release,
+                # so the next turn of this conversation matches straight
+                # through prompt AND history, partial tail included.
+                self._kv_register(self._context_tokens(s), idx, s.length)
             self._release_slot_pages(idx)
         self.slots[idx] = None
         return True
@@ -2563,6 +2735,15 @@ class LLMEngine:
         static device-hygiene rules."""
         n = self._reap_abandoned() + self._enforce_queue_bound() \
             + self._drain_handoff_releases() + self._admit()
+        if self._kvtier is not None:
+            # Demotion scan (host tier): cold sharer-free prefix pages
+            # hand off to the background migration thread in batches.
+            # Interval-gated inside tick — idle 50 ms polls drive it —
+            # and it yields to foreground traffic unless pool pressure
+            # says demoting NOW is what saves the cached content.
+            busy = bool(self._backlog) or bool(self._chunkings) \
+                or any(s is not None for s in self.slots)
+            self._kvtier.tick(busy=busy)
         with self._transfer_guard():
             n += self._decode_once()
         if n == 0:
@@ -2608,6 +2789,8 @@ class LLMEngine:
                           for e in rep["steady"]))
         self._stop.set()
         self._wake.set()
+        if self._kvtier is not None:
+            self._kvtier.close()
         self.stopped_clean = True
         if self._thread is not None:
             self._thread.join(timeout=timeout)

@@ -159,6 +159,38 @@ class HandoffPayload:
         return payload
 
 
+def pages_to_wire(kv_k: np.ndarray, kv_v: np.ndarray) -> bytes:
+    """Raw page-byte encoding shared with the KV host tier
+    (serve/kvtier.py): the same JSON-metadata-line + little-endian raw
+    K/V layout ``to_wire`` ships over ``POST /v1/handoff``, minus the
+    request identity — a demoted page block is content, not a request.
+    ``kv_*`` are any equal-shape arrays (host-tier use: ``[L, pg, KV,
+    Dh]`` per page block)."""
+    k = np.ascontiguousarray(kv_k)
+    v = np.ascontiguousarray(kv_v)
+    meta = {"dtype": str(k.dtype), "shape": list(k.shape)}
+    return json.dumps(meta).encode() + b"\n" + k.tobytes() + v.tobytes()
+
+
+def pages_from_wire(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Decode ``pages_to_wire`` bytes back into (k, v) views — zero-copy
+    ``frombuffer``, so host→device promotion pays one upload, not an
+    extra host memcpy."""
+    head, sep, raw = data.partition(b"\n")
+    if not sep:
+        raise ValueError("page wire blob missing metadata line")
+    meta = json.loads(head)
+    dtype = _np_dtype(meta["dtype"])
+    shape = tuple(int(x) for x in meta["shape"])
+    n = int(np.prod(shape)) * dtype.itemsize
+    if len(raw) != 2 * n:
+        raise ValueError(
+            f"page wire blob truncated: {len(raw)} bytes, expected {2 * n}")
+    kv_k = np.frombuffer(raw[:n], dtype=dtype).reshape(shape)
+    kv_v = np.frombuffer(raw[n:], dtype=dtype).reshape(shape)
+    return kv_k, kv_v
+
+
 def payload_from_export(req, kv_k: np.ndarray, kv_v: np.ndarray,
                         plen: int) -> HandoffPayload:
     """Build the payload at flush time: ``kv_*`` are the fetched host

@@ -1,0 +1,614 @@
+"""Tiered KV cache: radix prefix index with copy-on-write page sharing +
+host-RAM overflow tier with async device↔host page migration (ROADMAP
+item 1 — the cross-request prefix-caching layer between the scheduler
+and the page pool).
+
+Why the flat hash wasn't enough: ``PageAllocator``'s chained full-prompt
+hash only ever matches FULL pages of a prompt registered at prefill
+completion, keys cached content by exact block chains, and loses every
+decode-grown token at slot release — so an idle conversation re-arriving
+(the dominant shape at millions-of-users traffic: same system prompt,
+same history, one new turn) prefills almost everything again, while its
+dead pages pin HBM until LRU eviction destroys exactly the content the
+next turn needed.
+
+**Radix prefix index.** One tree over the paged pool; a node is one
+page-sized token block (partial leaves hold the sub-page tail of a
+registered sequence). ``match_and_acquire`` walks the query and returns
+the longest shared path — WHILE the original owner is still decoding
+(live sharing: node pages carry one allocator ref per sharer, so
+``KFTPU_SANITIZE=refcount`` attributes every reference to its request
+and ``assert_quiescent`` stays exact per owner). Divergence inside a
+block is copy-on-write: the new request gets a fresh page and ONE device
+dispatch copies only the shared partial tail (serve/paged.copy_pages);
+prefill then resumes mid-page (the per-token scatter in
+``paged_chunk_prefill`` removed the page-alignment restriction). Shared
+pages are never written: decode and chunk writes always land at
+positions past the claimed content, and the partial tail is privately
+owned after the copy — COW by construction, enforced rather than
+checked. Registration happens at prefill completion (prompt blocks,
+live), at slot release (prompt + generated tokens — conversations
+survive), at chunking preemption, and at handoff adoption.
+
+**Ownership model** (extends, never replaces, the allocator's): the
+tree itself holds NO references. A node page's refcount is exactly its
+sharer count; at ref==0 the page parks on the allocator's reclaimable
+LRU (``PageAllocator.retained`` keeps it there without a flat-hash
+key), still indexed and matchable. Pool pressure evicts reclaimable
+pages LRU as before; the ``on_evict`` callback drops the node and
+cascades its now-unreachable subtree back to the free list (a
+descendant of a ref-0 page is provably ref-0 itself: any sharer of a
+deep node holds references to every ancestor on its path).
+
+**Host-RAM overflow tier.** Cold prefix subtrees — sharer-free device
+pages idle past ``demote_after_s`` — migrate device→host in batches:
+the scheduler enqueues ONE device-side gather per batch (program order
+makes the immediate page free safe, exactly like the handoff export)
+and the background migration thread does the blocking ``device_get``
+plus the wire encode (``serve/handoff.pages_to_wire`` — the same
+JSON-meta + raw little-endian byte layout the handoff POST ships), so
+the scheduler never blocks on a demotion. A radix hit on a host node
+promotes BEFORE prefill admits: decode the blob (zero-copy
+``frombuffer``), allocate device pages, and enqueue one batched upload
+— JAX program order guarantees the subsequent chunk prefill's gather
+reads the promoted content, so admission proceeds the same step with
+no wait state. Long-idle conversations stop pinning HBM and still skip
+their recompute.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.serve.handoff import pages_from_wire, pages_to_wire
+
+logger = logging.getLogger("kubeflow_tpu.serve.kvtier")
+
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+TIER_MIGRATING = "migrating"   # gather enqueued, blob not installed yet
+TIER_DEAD = "dead"             # evicted; structure detached
+
+#: Partial (sub-page) leaves kept per parent: enough to hold a few
+#: divergent continuations of one prefix without making the tail scan a
+#: per-admission hot spot.
+MAX_PARTIALS = 4
+
+
+class _Node:
+    """One page-sized token block. ``block`` is the claimed content
+    (len == page_size for full blocks; shorter for partial leaves —
+    positions past ``len(block)`` in the page are unclaimed). Exactly one
+    of: ``page`` set (device/migrating) or ``blob`` set (host)."""
+
+    __slots__ = ("block", "page", "tier", "blob", "children", "partials",
+                 "parent", "last_used")
+
+    def __init__(self, block: tuple, page: Optional[int], parent):
+        self.block = block
+        self.page = page
+        self.tier = TIER_DEVICE
+        self.blob: Optional[bytes] = None
+        self.children: dict = {}     # full-block tuple -> _Node
+        self.partials: list = []     # sub-page leaves
+        self.parent = parent
+        self.last_used = time.monotonic()
+
+    def full(self, page_size: int) -> bool:
+        return len(self.block) == page_size
+
+
+def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+class RadixPrefixIndex:
+    """Radix tree + tier lifecycle over one ``PageAllocator``.
+
+    Tree structure (children/partials/by_page) and every public method
+    are SCHEDULER-CONFINED — the engine calls them from its scheduler
+    thread only, like the allocator itself. The one cross-thread seam is
+    the migration thread installing host blobs; ``_lock`` guards the
+    tier/blob/host-count transitions it shares with the scheduler.
+
+    Device operations are injected as closures (the engine owns the
+    cache pytree and its jitted programs):
+
+    - ``copy_pages_fn(src_ids, dst_ids)`` — pool page copy (COW tails);
+    - ``upload_pages_fn(page_ids, k, v)`` — host→device promotion
+      (``k``/``v`` are ``[L, n, pg, KV, Dh]`` numpy);
+    - ``fetch_pages_fn(page_ids)`` — device-side gather returning device
+      arrays (the demotion batch; the migration thread device_gets them).
+    """
+
+    def __init__(self, allocator, page_size: int, *,
+                 host_pages: int = 0,
+                 demote_after_s: float = 2.0,
+                 migrate_batch_pages: int = 32,
+                 scan_interval_s: Optional[float] = None,
+                 copy_pages_fn: Optional[Callable] = None,
+                 upload_pages_fn: Optional[Callable] = None,
+                 fetch_pages_fn: Optional[Callable] = None):
+        self._allocator = allocator
+        self.page_size = int(page_size)
+        self.host_pages = max(0, int(host_pages))
+        self.demote_after_s = float(demote_after_s)
+        self.migrate_batch_pages = max(1, int(migrate_batch_pages))
+        self._scan_interval = (float(scan_interval_s)
+                               if scan_interval_s is not None
+                               else max(self.demote_after_s / 4, 0.05))
+        self._copy_pages = copy_pages_fn
+        self._upload_pages = upload_pages_fn
+        self._fetch_pages = fetch_pages_fn
+        self._root = _Node((), None, None)
+        self._by_page: dict[int, _Node] = {}  # lockfree: scheduler-confined
+        # Tier transitions + host accounting cross the migration-thread
+        # seam; everything below shares one reentrant lock (reentrant:
+        # an alloc inside match can fire on_evict back into the index).
+        self._lock = threading.RLock()
+        self._host_count = 0          # guarded_by: _lock
+        self._migrating = 0           # guarded_by: _lock
+        self.stats = {                # guarded_by: _lock
+            "prefix_queries": 0, "prefix_hits": 0,
+            "tokens_matched": 0, "tokens_cow": 0,
+            "cow_copies": 0, "nodes": 0,
+            "pages_demoted": 0, "pages_promoted": 0,
+            "demote_batches": 0, "demote_dropped": 0,
+            "host_evictions": 0, "evictions": 0,
+        }
+        self._last_scan = 0.0         # lockfree: scheduler-confined
+        self.last_promoted = 0        # lockfree: scheduler-confined
+        self.last_cow_tokens = 0      # lockfree: scheduler-confined
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        allocator.on_evict = self._on_evict
+        if self.host_pages > 0:
+            self._thread = threading.Thread(
+                target=self._migrate_loop, daemon=True, name="kv-migrate")
+            self._thread.start()
+
+    # -- observability -------------------------------------------------------
+
+    def host_pages_resident(self) -> int:
+        with self._lock:
+            return self._host_count
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+            out["host_pages_resident"] = self._host_count
+            out["migrating_pages"] = self._migrating
+        return out
+
+    # -- match (admission path) ----------------------------------------------
+
+    def match_and_acquire(self, tokens: Sequence[int],
+                          owner: Optional[str] = None, *,
+                          allow_cow: bool = True) -> tuple[list[int], int]:
+        """Longest shared prefix of ``tokens``, capped one token short
+        (the first sampled token needs real last-token logits — the same
+        cap the flat ``match_prefix`` applies). Returns ``(pages,
+        covered_tokens)``: device pages the caller now owns one
+        reference to each, in table order. Full-block hits share by
+        incref (live, ref>0 — copy-on-write discipline: nobody ever
+        writes claimed positions); host blocks promote in one batched
+        upload; a sub-page divergence allocates a fresh private page and
+        device-copies only the shared tail (``allow_cow=False`` keeps
+        the match page-aligned — the handoff-adoption path needs that).
+        Pool exhaustion mid-walk truncates the match rather than
+        failing the admission."""
+        pg = self.page_size
+        cap = len(tokens) - 1
+        pages: list[int] = []
+        promote: list[tuple[int, bytes]] = []
+        # Per-match attribution the engine reads right back (scheduler-
+        # confined, like the caller): how much of this hit rode a
+        # host-tier promotion or a COW tail copy.
+        self.last_promoted = 0
+        self.last_cow_tokens = 0
+        try:
+            return self._match_locked(tokens, owner, allow_cow, pg, cap,
+                                      pages, promote)
+        except Exception as exc:
+            # Balance the books and miss: every acquired page holds
+            # exactly one of our references, and a promoted node whose
+            # upload may not have landed must not stay matchable.
+            with self._lock:
+                for pid, _ in promote:
+                    node = self._by_page.get(pid)
+                    if node is not None:
+                        self._drop_subtree(node)
+                if pages:
+                    self._allocator.free(pages)
+            logger.error("radix match failed; recomputing prefix: %s", exc)
+            return [], 0
+
+    def _match_locked(self, tokens, owner, allow_cow, pg, cap,
+                      pages, promote) -> tuple[list[int], int]:
+        from kubeflow_tpu.serve.paged import PagePoolExhausted
+
+        with self._lock:
+            self.stats["prefix_queries"] += 1
+            # Mirror into the allocator's historical counters — one
+            # hit/query surface whichever index is active.
+            self._allocator.stats["prefix_queries"] += 1
+            now = time.monotonic()
+            covered = 0
+            node = self._root
+            while covered + pg <= cap:
+                child = node.children.get(tuple(tokens[covered:covered + pg]))
+                if child is None or child.tier == TIER_MIGRATING \
+                        or child.tier == TIER_DEAD:
+                    break
+                if child.tier == TIER_HOST:
+                    try:
+                        pid = self._allocator.alloc(1, owner=owner)[0]
+                    except PagePoolExhausted:
+                        break
+                    # Promotion: the node returns to the device tier; the
+                    # fresh ref (alloc) is the matcher's sharer ref, and
+                    # ``retained`` keeps the page cached after release.
+                    child.page = pid
+                    child.tier = TIER_DEVICE
+                    blob, child.blob = child.blob, None
+                    self._host_count -= 1
+                    self._by_page[pid] = child
+                    self._allocator.retained.add(pid)
+                    promote.append((pid, blob))
+                    self.stats["pages_promoted"] += 1
+                else:
+                    # Device hit (possibly still owned by a decoding
+                    # request): one more sharer, stamped per owner.
+                    self._allocator.incref([child.page], owner=owner)
+                child.last_used = now
+                pages.append(child.page)
+                covered += pg
+                node = child
+            # Sub-page tail: the query continues into (or diverges
+            # inside) a cached block — copy only the shared part.
+            rem = cap - covered
+            if allow_cow and rem > 0 and self._copy_pages is not None:
+                window = tuple(tokens[covered:covered + pg])
+                best, best_len = None, 0
+                for cand in list(node.children.values()) + node.partials:
+                    if cand.tier == TIER_DEAD:
+                        continue
+                    n = min(_lcp(cand.block, window), rem)
+                    if n > best_len:
+                        best, best_len = cand, n
+                if best is not None and best_len > 0:
+                    cow = self._cow_tail(best, owner)
+                    if cow is not None:
+                        pages.append(cow)
+                        covered += best_len
+                        best.last_used = now
+                        self.stats["tokens_cow"] += best_len
+                        self.last_cow_tokens = best_len
+            if covered:
+                self.stats["prefix_hits"] += 1
+                self._allocator.stats["prefix_hits"] += 1
+                self.stats["tokens_matched"] += covered
+        if promote:
+            self._upload_blobs(promote)
+            self.last_promoted = len(promote)
+        return pages, covered
+
+    def _cow_tail(self, src: _Node, owner) -> Optional[int]:
+        """Fresh private page holding ``src``'s claimed content: device
+        copy for a device source, blob upload for a host one. Returns
+        the page id, or None when the pool is dry / the source is
+        mid-migration."""
+        from kubeflow_tpu.serve.paged import PagePoolExhausted
+
+        if src.tier not in (TIER_DEVICE, TIER_HOST):
+            return None
+        try:
+            fresh = self._allocator.alloc(1, owner=owner)[0]
+        except PagePoolExhausted:
+            return None
+        try:
+            if src.tier == TIER_DEVICE:
+                self._copy_pages([src.page], [fresh])
+            else:
+                self._upload_blobs([(fresh, src.blob)])
+            self.stats["cow_copies"] += 1
+            return fresh
+        except Exception:
+            # The fresh ref must not strand on a failed device call.
+            self._allocator.free([fresh])
+            raise
+
+    def _upload_blobs(self, items: list) -> None:
+        """ONE batched host→device upload for ``items`` of
+        ``(page_id, wire_blob)``. Blobs decode zero-copy; the engine's
+        upload closure packs them into its padded buffer directly (one
+        host copy total on the admission path)."""
+        ids = [pid for pid, _ in items]
+        ks, vs = [], []
+        for _, blob in items:
+            k, v = pages_from_wire(blob)
+            ks.append(k)
+            vs.append(v)
+        self._upload_pages(ids, ks, vs)
+
+    # -- registration --------------------------------------------------------
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int],
+               n_tokens: Optional[int] = None) -> None:
+        """Index ``tokens[:n_tokens]``'s KV: full blocks become (or
+        confirm) tree nodes pointing at the registering slot's pages, a
+        sub-page remainder becomes (or extends) a partial leaf. Existing
+        nodes keep their page (first writer wins — the duplicate page
+        stays slot-owned and frees at release, exactly like the flat
+        cache). Pages referenced here may still be LIVE (the owner keeps
+        decoding past the claimed content) — that is the live-sharing
+        contract, not a hazard."""
+        pg = self.page_size
+        n_tokens = len(tokens) if n_tokens is None else min(n_tokens,
+                                                            len(tokens))
+        with self._lock:
+            now = time.monotonic()
+            node = self._root
+            nfull = n_tokens // pg
+            for i in range(min(nfull, len(pages))):
+                blk = tuple(tokens[i * pg:(i + 1) * pg])
+                child = node.children.get(blk)
+                if child is None:
+                    page = pages[i]
+                    if page in self._by_page:
+                        break      # already indexed on another path
+                    child = _Node(blk, page, node)
+                    node.children[blk] = child
+                    self._by_page[page] = child
+                    self._allocator.retained.add(page)
+                    self.stats["nodes"] += 1
+                    # A full block subsumes any partial leaf it extends.
+                    for pn in list(node.partials):
+                        if blk[:len(pn.block)] == pn.block:
+                            self._drop_subtree(pn)
+                elif child.tier == TIER_DEAD:
+                    break
+                child.last_used = now
+                node = child
+            tail = tuple(tokens[nfull * pg:n_tokens])
+            if tail and nfull < len(pages):
+                self._insert_partial(node, tail, pages[nfull], now)
+
+    def _insert_partial(self, parent: _Node, tail: tuple, page: int,
+                        now: float) -> None:
+        if any(blk[:len(tail)] == tail for blk in parent.children):
+            return                       # a full block already covers it
+        for pn in parent.partials:
+            if pn.page == page:
+                # Same page re-registered with more content (a finished
+                # request upgrading its prompt tail with generated
+                # tokens): extend the claim in place.
+                if len(tail) > len(pn.block) \
+                        and tail[:len(pn.block)] == pn.block:
+                    pn.block = tail
+                pn.last_used = now
+                return
+            if len(tail) <= len(pn.block) \
+                    and pn.block[:len(tail)] == tail:
+                pn.last_used = now
+                return                   # existing partial covers more
+        if page in self._by_page:
+            return
+        # Longer content on a different page replaces the covered leaf.
+        for pn in list(parent.partials):
+            if len(pn.block) < len(tail) \
+                    and tail[:len(pn.block)] == pn.block:
+                self._drop_subtree(pn)
+        if len(parent.partials) >= MAX_PARTIALS:
+            self._drop_subtree(min(parent.partials,
+                                   key=lambda n: n.last_used))
+        leaf = _Node(tail, page, parent)
+        parent.partials.append(leaf)
+        self._by_page[page] = leaf
+        self._allocator.retained.add(page)
+        self.stats["nodes"] += 1
+
+    # -- eviction (allocator callback + host capacity) -----------------------
+
+    def _on_evict(self, page: int) -> None:
+        """The allocator reclaimed a ref-0 indexed page for a fresh
+        alloc: drop the node; its subtree is unreachable now and
+        cascades back to the pool/host-free state."""
+        with self._lock:
+            node = self._by_page.pop(page, None)
+            if node is None:
+                return
+            self.stats["evictions"] += 1
+            node.page = None             # the allocator owns it again
+            self._drop_subtree(node)
+
+    def _drop_subtree(self, node: _Node) -> None:
+        # requires_lock: _lock
+        parent = node.parent
+        if parent is not None:
+            parent.children.pop(node.block, None)
+            if node in parent.partials:
+                parent.partials.remove(node)
+        stack, drop_pages = [node], []
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            stack.extend(n.partials)
+            if n.tier == TIER_DEVICE and n.page is not None:
+                self._by_page.pop(n.page, None)
+                if self._allocator.ref(n.page) == 0:
+                    drop_pages.append(n.page)
+                else:
+                    # Still shared by a live request: the sharer keeps
+                    # its reference; the page just stops being indexed.
+                    self._allocator.retained.discard(n.page)
+            elif n.tier == TIER_HOST:
+                n.blob = None
+                self._host_count -= 1
+            n.tier = TIER_DEAD       # a mid-migration install discards
+            n.page = None
+            n.children = {}
+            n.partials = []
+            self.stats["nodes"] -= 1
+        if drop_pages:
+            self._allocator.drop_cached(drop_pages)
+
+    def _evict_host_lru(self, n: int) -> None:
+        # requires_lock: _lock
+        while n > 0:
+            hosts = [node for node in self._iter_nodes()
+                     if node.tier == TIER_HOST]
+            if not hosts:
+                return
+            victim = min(hosts, key=lambda nd: nd.last_used)
+            before = self._host_count
+            self._drop_subtree(victim)
+            self.stats["host_evictions"] += 1
+            n -= max(before - self._host_count, 1)
+
+    def _iter_nodes(self):
+        # requires_lock: _lock
+        stack = list(self._root.children.values()) + self._root.partials
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+            stack.extend(n.partials)
+
+    # -- demotion (scheduler side) + migration thread ------------------------
+
+    def tick(self, now: Optional[float] = None, *,
+             busy: bool = False) -> int:
+        """Periodic demotion scan (called from the engine's scheduler
+        step): pick cold sharer-free device pages LRU, enqueue ONE
+        batched device-side gather, free the device pages (program
+        order makes that safe — the gather reads pre-free values), and
+        hand the fetch to the migration thread. Returns pages demoted
+        this pass.
+
+        ``busy`` = the scheduler has foreground work this step.
+        Migration then YIELDS unless the pool is actually under
+        pressure (free+cached running low): think-time gaps and
+        inter-session idle provide ample demotion windows, and an
+        admission must never queue behind cold-page bookkeeping — but
+        when the pool is nearly exhausted, demoting now is what saves
+        the cached content from lossy LRU eviction, so it runs anyway."""
+        if self.host_pages <= 0 or self._fetch_pages is None:
+            return 0
+        now = time.monotonic() if now is None else now
+        if now - self._last_scan < self._scan_interval:
+            return 0
+        # Pressure demotion: when free+cached pages run low, the LRU
+        # eviction path is about to DESTROY cached content — demote it
+        # to host first, age threshold be damned. Otherwise only
+        # genuinely cold pages move, and never while foreground work
+        # would queue behind the bookkeeping.
+        urgent = self._allocator.available() \
+            <= self._allocator.num_pages // 4
+        if busy and not urgent:
+            return 0
+        self._last_scan = now
+        with self._lock:
+            cands: list[_Node] = []
+            # Urgent mode still protects HOT pages (used within two
+            # scan windows): demoting a shared prefix the very next
+            # arrival will match would buy one free page at the cost of
+            # a promotion round-trip under an already-dry pool — the
+            # churn spiral, not a rescue.
+            floor = (2 * self._scan_interval if urgent
+                     else self.demote_after_s)
+            for p in self._allocator.reclaimable_lru():
+                node = self._by_page.get(p)
+                if node is None or node.tier != TIER_DEVICE:
+                    continue
+                if now - node.last_used < floor:
+                    continue
+                cands.append(node)
+                if len(cands) >= self.migrate_batch_pages:
+                    break
+            if not cands:
+                return 0
+            room = self.host_pages - self._host_count - self._migrating
+            if len(cands) > room:
+                self._evict_host_lru(len(cands) - room)
+                room = self.host_pages - self._host_count - self._migrating
+                cands = cands[:max(room, 0)]
+            if not cands:
+                return 0
+            ids = [n.page for n in cands]
+            k_dev, v_dev = self._fetch_pages(ids)
+            for n in cands:
+                self._by_page.pop(n.page, None)
+                n.page = None
+                n.tier = TIER_MIGRATING
+                self._migrating += 1
+            self._allocator.drop_cached(ids)
+            self.stats["demote_batches"] += 1
+        self._queue.put((cands, k_dev, v_dev))
+        return len(ids)
+
+    def _migrate_loop(self) -> None:
+        import jax
+
+        from kubeflow_tpu.obs.trace import get_tracer
+
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                return
+            nodes, k_dev, v_dev = item
+            span = get_tracer().start_span(
+                "engine.kv_migrate", direction="demote", pages=len(nodes))
+            try:
+                fetched = jax.device_get((k_dev, v_dev))  # sync-point: the migration thread owns this blocking fetch, never the scheduler
+                k = np.asarray(fetched[0])
+                v = np.asarray(fetched[1])
+                with self._lock:
+                    for j, n in enumerate(nodes):
+                        self._migrating -= 1
+                        if n.tier != TIER_MIGRATING:
+                            # Evicted while the bytes were in flight:
+                            # the content is unreachable — discard.
+                            self.stats["demote_dropped"] += 1
+                            continue
+                        n.blob = pages_to_wire(k[:, j], v[:, j])
+                        n.tier = TIER_HOST
+                        self._host_count += 1
+                        self.stats["pages_demoted"] += 1
+                span.end("ok")
+            except Exception as exc:
+                # A failed migration batch loses cached content (it was
+                # already freed device-side) but never correctness — the
+                # nodes stay MIGRATING/DEAD and simply miss on match.
+                logger.error("kv migration batch failed: %s", exc)
+                span.end("error")
+
+    def drain_migrations(self, timeout_s: float = 5.0) -> None:
+        """Test/audit hook: wait until no demotion batch is in flight."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._migrating == 0:
+                    return
+            time.sleep(0.005)
+        raise TimeoutError("kv migration batches still in flight")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if getattr(self._allocator, "on_evict", None) is self._on_evict:
+            self._allocator.on_evict = None

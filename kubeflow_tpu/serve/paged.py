@@ -86,6 +86,13 @@ class PageAllocator:
         self._key_of: dict[int, tuple] = {}
         # ref==0 pages that still hold cached content, LRU order
         self._reclaimable: "OrderedDict[int, None]" = OrderedDict()
+        # Radix-index integration (serve/kvtier.py): pages the index wants
+        # kept reclaimable at ref==0 even without a flat-hash key, and the
+        # callback the LRU eviction path fires so the index can drop the
+        # node (and cascade its now-unreachable subtree) when the pool
+        # reclaims one of them.
+        self.retained: set[int] = set()
+        self.on_evict = None
         self.stats = {"prefix_hits": 0, "prefix_queries": 0, "evictions": 0,
                       "stamped_allocs": 0}
         # KFTPU_SANITIZE=refcount (runtime/sanitize.py): stamp every
@@ -127,6 +134,33 @@ class PageAllocator:
 
     def available(self) -> int:
         return len(self._free) + len(self._reclaimable)
+
+    def cached(self) -> int:
+        """Pages holding reusable prefix content at ref==0 — freely
+        evictable, so NOT load (the decode router's split gauge)."""
+        return len(self._reclaimable)
+
+    def ref(self, page: int) -> int:
+        return int(self._ref[page])
+
+    def reclaimable_lru(self) -> list[int]:
+        """Ref-0 cached pages, least-recently-released first — the
+        demotion scan's candidate order (serve/kvtier.py)."""
+        return list(self._reclaimable)
+
+    def drop_cached(self, pages: Sequence[int]) -> None:
+        """Discard ref-0 cached pages outright (content no longer
+        reachable — an evicted radix subtree, or pages whose bytes just
+        migrated to the host tier): straight to the free list."""
+        for p in pages:
+            assert self._ref[p] == 0, f"drop_cached of referenced page {p}"
+            key = self._key_of.pop(p, None)
+            if key is not None:
+                self._by_key.pop(key, None)
+            self.retained.discard(p)
+            if p in self._reclaimable:       # values are None: test by key
+                del self._reclaimable[p]
+                self._free.append(p)
 
     def in_use(self) -> int:
         """Pages currently referenced by at least one slot. The speculative
@@ -172,6 +206,13 @@ class PageAllocator:
                 key = self._key_of.pop(p, None)
                 if key is not None:
                     self._by_key.pop(key, None)
+                if p in self.retained:
+                    self.retained.discard(p)
+                    if self.on_evict is not None:
+                        # The radix index drops the node; its subtree's
+                        # cached pages cascade to the free list via
+                        # drop_cached, which this loop then consumes.
+                        self.on_evict(p)
                 self.stats["evictions"] += 1
             self._ref[p] = 1
             if self.refcount_debug:
@@ -198,7 +239,7 @@ class PageAllocator:
             if self.refcount_debug:
                 self._unstamp(p)
             if self._ref[p] == 0:
-                if p in self._key_of:
+                if p in self._key_of or p in self.retained:
                     self._reclaimable[p] = None    # keep content, LRU
                 else:
                     self._free.append(p)
@@ -426,6 +467,25 @@ def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
     return out, cache, tokens, lengths, live, budgets
 
 
+def copy_pages(cache: dict, src: jax.Array, dst: jax.Array) -> dict:  # traced
+    """Page-to-page pool copy: ``dst[i] <- src[i]`` for every pool plane
+    (k/v and, when quantized, their scales) — the radix index's
+    copy-on-write primitive (serve/kvtier.py): a request diverging inside
+    a shared block gets a private copy of the partial tail in ONE
+    dispatch instead of recomputing it. Out-of-range ``dst`` ids (the
+    power-of-two pad) drop their writes."""
+    out = dict(cache)
+    for name in ("k", "v", "ks", "vs"):
+        pool = cache.get(name)
+        if pool is None:
+            continue
+        npages = pool.shape[1]
+        d = jnp.where((dst >= 0) & (dst < npages), dst, npages)
+        out[name] = pool.at[:, d].set(
+            pool[:, jnp.clip(src, 0, npages - 1)], mode="drop")
+    return out
+
+
 def context_bucket(pos: int, chunk: int, page_size: int, mpp: int) -> int:
     """Static context-page bucket for a chunk prefill at ``pos``: the next
     power of two covering ceil((pos + chunk) / page_size), clamped to the
@@ -441,28 +501,30 @@ def context_bucket(pos: int, chunk: int, page_size: int, mpp: int) -> int:
 
 def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,  # traced
                         table_row: jax.Array, start: jax.Array,
-                        chunk_pages: jax.Array, cfg: DecoderConfig,
+                        valid_len: jax.Array, cfg: DecoderConfig,
                         attn_impl: str = "xla",
-                        context_pages: Optional[int] = None,
-                        valid_len: Optional[jax.Array] = None):
+                        context_pages: Optional[int] = None):
     """Prefill ONE chunk (``tokens`` [1,C], positions [start, start+C)) of a
-    slot whose pages are ``table_row`` [mpp]; write the chunk's K/V into
-    ``chunk_pages`` [C//pg] (OOB-padded ids → dropped writes for the pages a
-    short tail doesn't reach).
+    slot whose pages are ``table_row`` [mpp]; the chunk's K/V scatters back
+    per token as (page, offset) writes off the table row — exactly the
+    decode write's addressing — so ``start`` needs NO page alignment.
+    Sub-page prefix reuse (the radix index's copy-on-write tail,
+    serve/kvtier.py) resumes prefill mid-page through this path; only the
+    first ``valid_len`` positions write (the padded tail and any unmapped
+    page aim out of bounds and DROP).
 
     The chunk attends to the slot's earlier KV by gathering the page table
     into the contiguous layout decoder_forward's cache path expects, then
-    scatters only the chunk's pages back. ``context_pages`` (STATIC) bounds
-    the gather to the pages actually covering [0, start+C): chunk cost then
-    tracks the resident context, not max_len — without it a long prompt
-    pays O(max_len²/C) in gathers (round-2 weak #4). The caller buckets the
-    count (powers of two) so the trace set stays logarithmic. Returns
-    ([C,V] logits, cache)."""
+    scatters only the chunk's tokens back. ``context_pages`` (STATIC)
+    bounds the gather to the pages actually covering [0, start+C): chunk
+    cost then tracks the resident context, not max_len — without it a long
+    prompt pays O(max_len²/C) in gathers (round-2 weak #4). The caller
+    buckets the count (powers of two) so the trace set stays logarithmic.
+    Returns ([C,V] logits, cache)."""
     from kubeflow_tpu.models.decoder import decoder_forward
 
     pg = cache["k"].shape[2]
     c = tokens.shape[1]
-    npages = c // pg
     kv_quant = "ks" in cache
     if context_pages is not None:
         # Static slice: the bucket must cover the chunk's own pages too
@@ -495,23 +557,28 @@ def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,  # trace
     logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=caches,
                                         attn_impl=attn_impl,
                                         valid_len=valid_len)
-    # Scatter the chunk's pages back into the pool: the chunk occupies
-    # positions [start, start+C) = page slots start//pg .. +npages.
-    written_k = jax.lax.dynamic_slice_in_dim(filled["k"], start, c, axis=2)
-    written_v = jax.lax.dynamic_slice_in_dim(filled["v"], start, c, axis=2)
-    # [L,1,C,K,D] -> [L, npages, pg, K, D]
-    written_k = written_k.reshape(cfg.n_layers, npages, pg,
-                                  *written_k.shape[3:])
-    written_v = written_v.reshape(cfg.n_layers, npages, pg,
-                                  *written_v.shape[3:])
-    pidx = jnp.where((chunk_pages >= 0) & (chunk_pages < cache["k"].shape[1]),
-                     chunk_pages, cache["k"].shape[1])
+    # Scatter the chunk's tokens back into the pool per (page, offset):
+    # position start+i lands on table_row[(start+i)//pg] at offset
+    # (start+i)%pg. Invalid rows (past valid_len, or an unmapped/-1 page)
+    # aim out of bounds and drop.
+    written_k = jax.lax.dynamic_slice_in_dim(filled["k"], start, c,
+                                             axis=2)[:, 0]     # [L,C,K,D]
+    written_v = jax.lax.dynamic_slice_in_dim(filled["v"], start, c,
+                                             axis=2)[:, 0]
+    pos = start + jnp.arange(c, dtype=jnp.int32)
+    pslot = pos // pg
+    page_id = table_row[jnp.clip(pslot, 0, table_row.shape[0] - 1)]
+    ok = (jnp.arange(c, dtype=jnp.int32) < valid_len) & (page_id >= 0) \
+        & (pslot < table_row.shape[0])
+    npages_pool = cache["k"].shape[1]
+    pidx = jnp.where(ok & (page_id < npages_pool), page_id, npages_pool)
+    off = pos % pg
     out = {}
     if kv_quant:
         written_k, wks = quantize_kv(written_k)
         written_v, wvs = quantize_kv(written_v)
-        out["ks"] = cache["ks"].at[:, pidx].set(wks, mode="drop")
-        out["vs"] = cache["vs"].at[:, pidx].set(wvs, mode="drop")
-    out["k"] = cache["k"].at[:, pidx].set(written_k, mode="drop")
-    out["v"] = cache["v"].at[:, pidx].set(written_v, mode="drop")
+        out["ks"] = cache["ks"].at[:, pidx, off].set(wks, mode="drop")
+        out["vs"] = cache["vs"].at[:, pidx, off].set(wvs, mode="drop")
+    out["k"] = cache["k"].at[:, pidx, off].set(written_k, mode="drop")
+    out["v"] = cache["v"].at[:, pidx, off].set(written_v, mode="drop")
     return logits[0], out

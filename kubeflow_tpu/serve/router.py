@@ -64,12 +64,18 @@ from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
 #: backend's /metrics for placement — the router's half of the
 #: engine↔router metrics contract (X7xx two-sided, like the
 #: autoscaler's ``_PROBE_SERIES``): prefills place on
-#: least-pending-prefill-tokens, decodes on least-resident-KV-pages,
+#: least-pending-prefill-tokens, decodes on least-REFERENCED-KV-pages,
 #: in-flight breaks ties (and stands in for pages on dense engines,
-#: which always report zero resident pages).
+#: which always report zero resident pages). ``kv_pages_resident`` is
+#: the resident-REFERENCED gauge (tiered KV cache split): ref-0 cached
+#: prefix content is freely evictable and must not read as decode
+#: load, so the router also scrapes ``kv_pages_cached`` and prefers —
+#: between equally-loaded decode backends — the one holding MORE
+#: cached prefix content (its prefix-hit odds are higher).
 ROUTER_SCRAPE_SERIES = (
     "kftpu_engine_pending_prefill_tokens",
     "kftpu_engine_kv_pages_resident",
+    "kftpu_engine_kv_pages_cached",
     "kftpu_serving_in_flight",
 )
 
@@ -254,7 +260,7 @@ class Router:
     @staticmethod
     def _parse_signals(text: str) -> Optional[dict]:
         out = {"pending_prefill_tokens": 0.0, "kv_pages_resident": 0.0,
-               "in_flight": 0.0}
+               "kv_pages_cached": 0.0, "in_flight": 0.0}
         try:
             samples = parse_exposition(text)
         except ValueError:
@@ -268,6 +274,8 @@ class Router:
                 out["pending_prefill_tokens"] += value
             elif name == "kftpu_engine_kv_pages_resident":
                 out["kv_pages_resident"] += value
+            elif name == "kftpu_engine_kv_pages_cached":
+                out["kv_pages_cached"] += value
             elif name == "kftpu_serving_in_flight":
                 out["in_flight"] += value
         return out
@@ -311,9 +319,14 @@ class Router:
                         key=lambda u: (sig(u).get("pending_prefill_tokens",
                                                   0.0),
                                        sig(u).get("in_flight", 0.0)))
+                # Referenced pages are load; cached pages are an asset
+                # (more cached prefix content = better hit odds), so
+                # among equally-loaded decode backends prefer the
+                # warmer cache (negated in the ascending-min key).
                 d = min(decodes,
                         key=lambda u: (sig(u).get("kv_pages_resident", 0.0),
-                                       sig(u).get("in_flight", 0.0)))
+                                       sig(u).get("in_flight", 0.0),
+                                       -sig(u).get("kv_pages_cached", 0.0)))
                 self.stats["disagg_picks"] += 1
                 return p, d
             for pool in ("unified", "decode", "prefill"):

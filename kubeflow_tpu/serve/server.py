@@ -481,7 +481,19 @@ def serving_metrics_registry(engines: list, *,
     # (pending prefill tokens → prefill pool, resident KV pages → decode
     # pool) plus the handoff lifecycle counters.
     pending_prefill = reg.gauge("kftpu_engine_pending_prefill_tokens")
+    # Tiered KV cache: resident is split REFERENCED (live requests'
+    # pages — real load, the decode router's placement signal) vs
+    # CACHED (ref-0 reclaimable prefix content — freely evictable, so
+    # capacity, not load), plus the host-RAM overflow tier's occupancy
+    # and the radix/tier lifecycle counters (serve/kvtier.py).
     pages_resident = reg.gauge("kftpu_engine_kv_pages_resident")
+    pages_cached = reg.gauge("kftpu_engine_kv_pages_cached")
+    pages_host = reg.gauge("kftpu_engine_kv_pages_host")
+    prefix_hits = reg.counter("kftpu_engine_kv_prefix_hits_total")
+    prefix_tokens = reg.counter("kftpu_engine_kv_prefix_tokens_reused_total")
+    cow_copies = reg.counter("kftpu_engine_kv_cow_copies_total")
+    pages_demoted = reg.counter("kftpu_engine_kv_pages_demoted_total")
+    pages_promoted = reg.counter("kftpu_engine_kv_pages_promoted_total")
     handoffs_out = reg.counter("kftpu_engine_handoffs_exported_total")
     handoffs_in = reg.counter("kftpu_engine_handoffs_adopted_total")
     handoffs_bad = reg.counter("kftpu_engine_handoffs_failed_total")
@@ -524,6 +536,14 @@ def serving_metrics_registry(engines: list, *,
         depth.set(snap.get("dispatch_depth", 0), model=name)
         pending_prefill.set(engine.pending_prefill_tokens(), model=name)
         pages_resident.set(engine.kv_pages_in_use(), model=name)
+        pages_cached.set(engine.kv_pages_cached(), model=name)
+        pages_host.set(engine.kv_pages_host(), model=name)
+        tier = engine.kv_tier_stats()
+        prefix_hits.inc(tier.get("prefix_hits", 0), model=name)
+        prefix_tokens.inc(tier.get("tokens_matched", 0), model=name)
+        cow_copies.inc(tier.get("cow_copies", 0), model=name)
+        pages_demoted.inc(tier.get("pages_demoted", 0), model=name)
+        pages_promoted.inc(tier.get("pages_promoted", 0), model=name)
         handoffs_out.inc(snap.get("handoffs_exported", 0), model=name)
         handoffs_in.inc(snap.get("handoffs_adopted", 0), model=name)
         handoffs_bad.inc(snap.get("handoffs_failed", 0), model=name)

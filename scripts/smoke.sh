@@ -139,6 +139,21 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 disagg_rc=${PIPESTATUS[0]}
 grep -q '"disagg_smoke": "ok"' /tmp/_smoke_disagg.json || disagg_rc=1
 
+echo "== prefix cache smoke (tiered KV: radix+host tier vs flat A/B) =="
+# Tiered-KV-cache gate (ISSUE 13): multi-turn conversations + the
+# shared-prefix overlap sweep on a small paged engine under pool
+# pressure. Greedy output must be token-identical with sharing+tiering
+# on vs off; the radix+host-tier engine must beat the flat-cache
+# baseline on prefill tok/s AND TTFT p95 on the multi-turn shape; the
+# tier must actually cycle (demote on idle, promote on the radix hit);
+# a seeded migration wedge must be flagged with the kv_tier
+# attribution; per-owner refcounts must balance on device and host
+# tiers. Writes BENCH_SERVE_r03.json (the tiered-KV bench round).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/prefix_cache_smoke.py | tee /tmp/_smoke_prefix_cache.json
+prefix_cache_rc=${PIPESTATUS[0]}
+grep -q '"prefix_cache_smoke": "ok"' /tmp/_smoke_prefix_cache.json || prefix_cache_rc=1
+
 echo "== contract smoke (static name-contract table vs a real serve run) =="
 # Cross-component contract gate (ISSUE 10): the kftpu lint --contracts-json
 # manifest must round-trip, and a serve run under KFTPU_SANITIZE=contract
@@ -149,5 +164,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 contract_rc=${PIPESTATUS[0]}
 grep -q '"contract_smoke": "ok"' /tmp/_smoke_contract.json || contract_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc contract rc=$contract_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc prefix_cache rc=$prefix_cache_rc contract rc=$contract_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$prefix_cache_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]

@@ -6,6 +6,7 @@ with the full attribution join (client percentiles + /metrics scrape +
 per-phase span breakdowns) and a quiescent trace ring."""
 
 import math
+import time
 
 import numpy as np
 import pytest
@@ -197,11 +198,20 @@ class TestPrompts:
         m = standard_matrix(num_requests=8)
         assert [s.name for s in m] == ["uniform", "bursty_qos",
                                        "shared_prefix",
-                                       "mixed_interference"]
+                                       "mixed_interference", "multi_turn"]
         assert m[2].prefix_overlap == 0.75
         assert dict(m[1].qos_mix).keys() == {"interactive", "batch"}
+        assert m[4].turns == 3 and m[4].think_time_s > 0
         for s in m:
             s.validate()
+
+    def test_shared_prefix_overlap_knob(self):
+        """The 0.5–0.95 overlap sweep axis: the knob must land on the
+        shared_prefix scenario verbatim."""
+        for f in (0.5, 0.75, 0.95):
+            m = standard_matrix(num_requests=8, shared_prefix_overlap=f)
+            sc = next(s for s in m if s.name == "shared_prefix")
+            assert sc.prefix_overlap == f
 
     def test_mixed_interference_correlates_class_and_shape(self):
         """The head-of-line-blocking probe: batch requests carry LONG
@@ -229,6 +239,96 @@ class TestPrompts:
             ("gold", LengthDist(), LengthDist()),))
         with pytest.raises(ValueError, match="gold"):
             bad.validate()
+
+
+class TestMultiTurn:
+    """Session-mode schedules (Scenario.turns > 1): conversations
+    re-arriving with their prior prefix + one new turn — the
+    tiered-KV-cache traffic shape."""
+
+    def _sc(self, **kw):
+        base = dict(name="mt", num_requests=12, turns=3, think_time_s=0.1,
+                    arrival=Arrival(process="poisson", rate_rps=4.0),
+                    prompt_len=LengthDist(kind="fixed", value=24),
+                    output_len=LengthDist(kind="fixed", value=4), seed=3)
+        base.update(kw)
+        return Scenario(**base)
+
+    def test_session_structure(self):
+        sched = build_schedule(self._sc(), vocab_size=256,
+                               max_prompt_len=64)
+        assert len(sched) == 12            # 4 sessions x 3 turns
+        by_session: dict = {}
+        for sr in sched:
+            by_session.setdefault(sr.session, []).append(sr)
+        assert len(by_session) == 4
+        for turns in by_session.values():
+            turns.sort(key=lambda r: r.turn)
+            assert [r.turn for r in turns] == [0, 1, 2]
+            assert turns[0].prev_idx is None and turns[0].think_s == 0.0
+            for prev, cur in zip(turns, turns[1:]):
+                assert cur.prev_idx == prev.idx
+                assert cur.think_s == 0.1
+                assert cur.t >= prev.t
+                # one QoS class per conversation
+                assert cur.qos == prev.qos
+
+    def test_new_turns_are_short(self):
+        sched = build_schedule(self._sc(), vocab_size=256,
+                               max_prompt_len=64)
+        first = [len(r.prompt_tokens) for r in sched if r.turn == 0]
+        later = [len(r.prompt_tokens) for r in sched if r.turn > 0]
+        assert max(later) < min(first)
+
+    def test_session_schedule_deterministic(self):
+        a = build_schedule(self._sc(), vocab_size=256, max_prompt_len=64)
+        b = build_schedule(self._sc(), vocab_size=256, max_prompt_len=64)
+        assert [(r.t, r.prompt_tokens, r.session, r.turn, r.prev_idx)
+                for r in a] == \
+               [(r.t, r.prompt_tokens, r.session, r.turn, r.prev_idx)
+                for r in b]
+
+    def test_think_validation(self):
+        with pytest.raises(ValueError, match="turns"):
+            self._sc(turns=0).validate()
+        with pytest.raises(ValueError, match="think"):
+            self._sc(think_time_s=-1.0).validate()
+
+    def test_engine_run_composes_conversation(self):
+        """E2E on a paged radix engine: every turn past the first must
+        ride the conversation prefix — the radix index reports reused
+        tokens, and all turns complete."""
+        from kubeflow_tpu.core.serving import BatchingSpec
+        from kubeflow_tpu.models.config import preset
+        from kubeflow_tpu.models.decoder import init_decoder_params
+        from kubeflow_tpu.serve.engine import LLMEngine
+
+        cfg = preset("tiny", vocab_size=512)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        engine = LLMEngine(
+            cfg, BatchingSpec(max_batch_size=4, max_seq_len=128,
+                              paged=True, page_size=16,
+                              chunked_prefill_tokens=16, decode_steps=4),
+            params=params)
+        engine.start()
+        try:
+            sc = self._sc(num_requests=6, turns=3, think_time_s=0.01,
+                          prompt_len=LengthDist(kind="fixed", value=20),
+                          request_timeout_s=60.0)
+            run = run_scenario(EngineTarget(engine), sc, vocab_size=256,
+                               max_prompt_len=64)
+            assert all(o.ok for o in run.outcomes), \
+                [(o.idx, o.status) for o in run.outcomes]
+            tier = engine.kv_tier_stats()
+            assert tier["prefix_hits"] >= 4      # every later turn hits
+            assert tier["tokens_matched"] > 0
+            deadline = time.time() + 20.0
+            while engine.kv_pages_in_use() > 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert engine.kv_pages_in_use() == 0
+            engine._allocator.assert_quiescent()
+        finally:
+            engine.stop()
 
 
 # -- the threshold gate --------------------------------------------------------

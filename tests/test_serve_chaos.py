@@ -464,6 +464,134 @@ def test_chaos_prefill_kill_mid_handoff_unified_fallback(monkeypatch):
                 pass
 
 
+def test_chaos_cancel_while_shared(monkeypatch):
+    """Tiered KV cache (ISSUE 13): cancel a request whose prefix pages
+    are SHARED ref>0 with another in-flight request. The co-sharer must
+    finish with correct greedy output (its references pin the pages),
+    and after it completes the per-owner report must name ZERO leaks on
+    the device tier — the cancel freed exactly the victim's own
+    references, never the shared content."""
+    monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+    cfg = preset("tiny", vocab_size=512)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+    mk = lambda prefix: LLMEngine(  # noqa: E731
+        cfg, BatchingSpec(max_batch_size=2, max_seq_len=96, paged=True,
+                          page_size=16, chunked_prefill_tokens=16,
+                          decode_steps=4,
+                          enable_prefix_caching=prefix),
+        params=params)
+    eng, base = mk(True), mk(False)
+    assert eng._allocator.refcount_debug
+    sp = SamplingParams(max_new_tokens=24)
+    prompt = [9, 2, 9, 4, 9, 6, 9, 8] * 4
+    victim = eng.submit(list(prompt), sp)
+    for _ in range(4):
+        eng.step()                      # victim prefills + registers
+    sharer = eng.submit(list(prompt), sp)
+    for _ in range(3):
+        eng.step()                      # sharer matches ref>0 pages
+    assert eng.kv_tier_stats()["prefix_hits"] >= 1
+    victim.cancel()                     # mid-decode, pages shared
+    deadline = time.monotonic() + 30.0
+    while not sharer.done.is_set():
+        eng.step()
+        assert time.monotonic() < deadline, "sharer hung after cancel"
+    assert victim.finish_reason == "cancelled"
+    b = base.submit(list(prompt), sp)
+    while not b.done.is_set():
+        base.step()
+    assert list(sharer.output_tokens) == list(b.output_tokens)
+    while eng.kv_pages_in_use() > 0:
+        eng.step()
+        assert time.monotonic() < deadline
+    assert eng._allocator.leak_report_by_owner() == {}
+    eng._allocator.assert_quiescent()
+
+
+@pytest.mark.slow
+def test_chaos_kill_mid_migration(monkeypatch):
+    """Tiered KV cache (ISSUE 13): SIGKILL a replica while a device→host
+    demotion batch is IN FLIGHT on its migration thread. Invariants:
+    traffic keeps resolving explicitly on the survivor; the per-owner
+    refcount audit names ZERO leaks on BOTH replicas' device pools (the
+    demoted pages were freed scheduler-side before the kill — a dead
+    migration thread can strand host blobs, never device pages); and
+    the host tier stays within budget with no phantom occupancy."""
+    monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+    import kubeflow_tpu.serve.kvtier as kvtier
+
+    real_wire = kvtier.pages_to_wire
+
+    def slow_wire(k, v):
+        time.sleep(0.25)                # widen the mid-migration window
+        return real_wire(k, v)
+
+    monkeypatch.setattr(kvtier, "pages_to_wire", slow_wire)
+    cfg = preset("tiny", vocab_size=512)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+
+    def mk(name):
+        eng = LLMEngine(
+            cfg,
+            BatchingSpec(max_batch_size=2, max_seq_len=96,
+                         prefill_buckets=[32], paged=True, page_size=16,
+                         chunked_prefill_tokens=16, decode_steps=4,
+                         host_kv_pages=48, kv_demote_after_s=0.05),
+            params=params)
+        srv = ModelServer(name, eng, port=0)
+        srv.start()
+        return srv
+
+    a, b = mk("mig-a"), mk("mig-b")
+    router = Router(queue_timeout=5.0, eject_threshold=2, eject_period=0.4,
+                    max_retries=2, upstream_timeout=30.0)
+    router.set_backends({"latest": [b.url, a.url]})
+    router.start()
+    try:
+        results = fire(router.url, 8, timeout_s=6.0)
+        assert set(results) <= EXPLICIT_STATUSES
+        # Wait for a migration batch to be in flight (or already
+        # landed) on b, then kill it mid-flight.
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            with b.engine._kvtier._lock:
+                migrating = b.engine._kvtier._migrating
+            if migrating > 0 or b.engine.kv_pages_host() > 0:
+                break
+            time.sleep(0.01)
+        assert migrating > 0 or b.engine.kv_pages_host() > 0, \
+            "no demotion ever started on b"
+        kill_model_server(b)
+        # Survivor keeps serving explicitly.
+        results = fire(router.url, 8, timeout_s=6.0)
+        assert set(results) <= EXPLICIT_STATUSES
+        assert results.count(200) >= 4, results
+        audit_quiescent(a, b)
+        for srv in (a, b):
+            alloc = srv.engine._allocator
+            assert alloc.stats["stamped_allocs"] > 0
+            report = alloc.leak_report_by_owner()
+            assert report == {}, \
+                f"{srv.name}: per-owner leaks after mid-migration kill: " \
+                f"{report}"
+            alloc.assert_quiescent()
+            # Host-tier books: in-flight batches drain (the daemon
+            # thread survives the server kill) and occupancy stays
+            # consistent with the budget — no phantom pages.
+            tier = srv.engine._kvtier
+            tier.drain_migrations(timeout_s=10.0)
+            snap = tier.snapshot()
+            assert 0 <= snap["host_pages_resident"] <= 48
+            assert snap["migrating_pages"] == 0
+    finally:
+        router.stop()
+        for s in (a, b):
+            try:
+                s.stop()
+            except OSError:
+                pass
+
+
 def test_chaos_zz_replica_kill_mid_traffic(stack):
     """SIGKILL analog mid-traffic (runs last: b never comes back). Requests
     racing the kill resolve explicitly; the router ejects the corpse and

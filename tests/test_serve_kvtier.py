@@ -1,0 +1,439 @@
+"""Tiered KV cache (serve/kvtier.py): radix prefix index with live
+copy-on-write sharing + host-RAM overflow tier.
+
+Unit level drives the index against a real ``PageAllocator`` with fake
+device closures; engine level pins the acceptance contracts — greedy
+output token-identical with sharing+tiering on vs. off, conversation
+reuse across slot release, COW on sub-page divergence, demote→promote
+round trips, and per-owner refcount balance after everything."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.core.serving import BatchingSpec
+from kubeflow_tpu.models.config import preset
+from kubeflow_tpu.models.decoder import init_decoder_params
+from kubeflow_tpu.serve.engine import LLMEngine, SamplingParams
+from kubeflow_tpu.serve.handoff import pages_from_wire, pages_to_wire
+from kubeflow_tpu.serve.kvtier import RadixPrefixIndex
+from kubeflow_tpu.serve.paged import PageAllocator
+
+PG = 4
+
+
+class FakeDevice:
+    """Records the device traffic the index would have enqueued."""
+
+    def __init__(self, layers=2, kv=1, dh=2):
+        self.shape = (layers, PG, kv, dh)
+        self.copies: list = []
+        self.uploads: list = []
+        self.fetches: list = []
+
+    def page_block(self, page: int) -> np.ndarray:
+        return np.full(self.shape, float(page), np.float32)
+
+    def copy_pages(self, src, dst):
+        self.copies.append((list(src), list(dst)))
+
+    def upload_pages(self, ids, k, v):
+        self.uploads.append((list(ids), k, v))
+
+    def fetch_pages(self, ids):
+        self.fetches.append(list(ids))
+        k = np.stack([self.page_block(p) for p in ids], axis=1)
+        return k, k.copy()
+
+
+def mk_index(num_pages=16, **kw):
+    alloc = PageAllocator(num_pages, PG, enable_prefix_caching=True)
+    dev = FakeDevice()
+    idx = RadixPrefixIndex(
+        alloc, PG, copy_pages_fn=dev.copy_pages,
+        upload_pages_fn=dev.upload_pages, fetch_pages_fn=dev.fetch_pages,
+        **kw)
+    return idx, alloc, dev
+
+
+class TestRadixIndex:
+    def test_full_block_match_capped_one_short(self):
+        idx, alloc, dev = mk_index()
+        toks = list(range(1, 13))             # 3 full pages of content
+        pages = alloc.alloc(3, owner="a")
+        idx.insert(toks, pages, 12)
+        # Identical prompt: cap keeps >= 1 token to prefill — with
+        # 12 tokens that caps the FULL-block walk at 2 pages, then the
+        # COW tail picks up 3 of the last block's tokens (11 total).
+        hit, covered = idx.match_and_acquire(toks, owner="b")
+        assert hit[:2] == pages[:2]
+        assert covered == 11
+        assert len(hit) == 3 and hit[2] not in pages     # COW tail page
+        assert dev.copies == [([pages[2]], [hit[2]])]
+        # Live sharing: owner a never released; refs are per sharer.
+        assert alloc.ref(pages[0]) == 2
+        alloc.free(hit)
+        alloc.free(pages)
+        assert alloc.in_use() == 0
+        alloc.assert_quiescent()
+
+    def test_cap_excludes_last_token_exactly(self):
+        idx, alloc, _ = mk_index()
+        toks = list(range(1, 9))              # 2 full pages
+        pages = alloc.alloc(2, owner="a")
+        idx.insert(toks, pages, 8)
+        # Page-aligned query: one token short -> only 1 full block +
+        # 3-token COW; a query one token LONGER shares both full pages.
+        _, covered = idx.match_and_acquire(toks, owner="b")
+        assert covered == 7
+        _, covered2 = idx.match_and_acquire(toks + [99], owner="c")
+        assert covered2 == 8
+
+    def test_divergence_cow_copies_partial_tail(self):
+        idx, alloc, dev = mk_index()
+        toks = [1, 2, 3, 4, 5, 6, 7, 8]
+        pages = alloc.alloc(2, owner="a")
+        idx.insert(toks, pages, 8)
+        alloc.free(pages)                      # a released: cached now
+        # Diverges 2 tokens into the second block.
+        q = [1, 2, 3, 4, 5, 6, 99, 98, 97]
+        hit, covered = idx.match_and_acquire(q, owner="b")
+        assert covered == PG + 2
+        assert hit[0] == pages[0] and hit[1] != pages[1]
+        assert dev.copies[-1] == ([pages[1]], [hit[1]])
+        assert alloc.ref(pages[1]) == 0        # source stays cached
+
+    def test_partial_leaf_upgrade_in_place(self):
+        idx, alloc, _ = mk_index()
+        toks = [1, 2, 3, 4, 5, 6]
+        pages = alloc.alloc(2, owner="a")
+        idx.insert(toks, pages, 6)             # partial leaf: (5, 6)
+        idx.insert(toks + [7], pages, 7)       # same page, more content
+        hit, covered = idx.match_and_acquire(toks + [7, 8, 9], owner="b")
+        assert covered == PG + 3               # upgraded claim matched
+        assert len(hit) == 2
+        assert hit[1] != pages[1]              # tail rode a COW copy
+
+    def test_eviction_cascades_subtree(self):
+        idx, alloc, _ = mk_index(num_pages=4)
+        toks = list(range(1, 17))              # 4 full pages
+        pages = alloc.alloc(4, owner="a")
+        idx.insert(toks, pages, 16)
+        alloc.free(pages)                      # all cached, LRU order
+        assert alloc.cached() == 4
+        # Pool pressure: allocating everything must evict the cached
+        # chain; the on_evict callback drops nodes + cascades children.
+        fresh = alloc.alloc(4, owner="b")
+        assert len(fresh) == 4
+        assert idx.stats["evictions"] >= 1
+        assert idx.stats["nodes"] == 0
+        hit, covered = idx.match_and_acquire(toks + [99], owner="c")
+        assert hit == [] and covered == 0
+        alloc.free(fresh)
+        alloc.assert_quiescent()
+
+    def test_leaf_first_release_evicts_leaves_first(self):
+        idx, alloc, _ = mk_index(num_pages=5)
+        toks = list(range(1, 17))
+        pages = alloc.alloc(4, owner="a")
+        idx.insert(toks, pages, 16)
+        alloc.free(list(reversed(pages)))      # engine's release order
+        alloc.alloc(1, owner="b")              # evicts ONE page: a leaf
+        # The root chain must survive: prefix of 2 blocks still matches.
+        hit, covered = idx.match_and_acquire(toks[:8] + [99], owner="c")
+        assert covered == 8 and hit[0] == pages[0]
+
+
+class TestHostTier:
+    def test_demote_then_promote_roundtrip(self):
+        idx, alloc, dev = mk_index(host_pages=8, demote_after_s=0.01,
+                                   scan_interval_s=0.0)
+        try:
+            toks = list(range(1, 13))
+            pages = alloc.alloc(3, owner="a")
+            idx.insert(toks, pages, 12)
+            alloc.free(list(reversed(pages)))
+            time.sleep(0.05)
+            n = idx.tick(now=time.monotonic())
+            assert n == 3
+            idx.drain_migrations()
+            assert idx.host_pages_resident() == 3
+            assert alloc.cached() == 0         # device pages freed
+            assert sorted(dev.fetches[0]) == sorted(pages)
+            # Promotion on a radix hit: fresh device pages, batched
+            # upload carrying the EXACT demoted bytes (wire roundtrip).
+            hit, covered = idx.match_and_acquire(toks + [99], owner="b")
+            assert covered == 12 and len(hit) == 3
+            assert idx.host_pages_resident() == 0
+            assert idx.stats["pages_promoted"] == 3
+            ids, k, v = dev.uploads[-1]
+            assert ids == hit
+            # Per-page blocks in path order; content survives the wire
+            # roundtrip bit-exactly.
+            np.testing.assert_array_equal(k[0], dev.page_block(pages[0]))
+            alloc.free(hit)
+            alloc.assert_quiescent()
+        finally:
+            idx.close()
+
+    def test_host_capacity_evicts_lru(self):
+        idx, alloc, _ = mk_index(host_pages=2, demote_after_s=0.0,
+                                 scan_interval_s=0.0)
+        try:
+            a = alloc.alloc(2, owner="a")
+            idx.insert([1, 2, 3, 4, 5, 6, 7, 8], a, 8)
+            alloc.free(list(reversed(a)))
+            assert idx.tick(now=time.monotonic() + 1) == 2
+            idx.drain_migrations()
+            assert idx.host_pages_resident() == 2
+            b = alloc.alloc(2, owner="b")
+            idx.insert([9, 10, 11, 12, 13, 14, 15, 16], b, 8)
+            alloc.free(list(reversed(b)))
+            assert idx.tick(now=time.monotonic() + 10) == 2
+            idx.drain_migrations()
+            # The older conversation was evicted to make room.
+            assert idx.host_pages_resident() == 2
+            assert idx.stats["host_evictions"] >= 1
+        finally:
+            idx.close()
+
+    def test_wire_roundtrip(self):
+        k = np.arange(24, dtype=np.float32).reshape(2, 3, 2, 2)
+        v = k * 2
+        k2, v2 = pages_from_wire(pages_to_wire(k, v))
+        np.testing.assert_array_equal(k, k2)
+        np.testing.assert_array_equal(v, v2)
+
+
+# -- engine level --------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cfg():
+    return preset("tiny", vocab_size=512)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_decoder_params(jax.random.PRNGKey(0), cfg)
+
+
+def mk_engine(cfg, params, *, prefix_index="radix", prefix=True,
+              host_pages=0, demote_after_s=2.0, slots=4, page=16,
+              chunk=32, max_pages=None):
+    return LLMEngine(cfg, BatchingSpec(
+        max_batch_size=slots, max_seq_len=128, paged=True, page_size=page,
+        max_pages=max_pages, enable_prefix_caching=prefix,
+        prefix_index=prefix_index, host_kv_pages=host_pages,
+        kv_demote_after_s=demote_after_s,
+        chunked_prefill_tokens=chunk, max_concurrent_prefills=2),
+        params=params)
+
+
+def run_all(eng, reqs, max_steps=800):
+    for _ in range(max_steps):
+        eng.step()
+        if all(r.done.is_set() for r in reqs):
+            return
+    raise AssertionError("requests did not finish")
+
+
+def quiesce(eng, deadline_s=10.0):
+    deadline = time.monotonic() + deadline_s
+    while eng.kv_pages_in_use() > 0:
+        eng.step()
+        assert time.monotonic() < deadline, "KV pages leaked"
+    eng._allocator.assert_quiescent()
+
+
+class TestEngineRadix:
+    PROMPTS = [
+        [7, 1, 9, 2, 4, 4, 8, 3] * 3,                     # 24 tokens
+        [7, 1, 9, 2, 4, 4, 8, 3] * 2 + [5, 6, 7, 8],      # diverges @16
+        [2] * 40,
+    ]
+
+    def _outputs(self, eng):
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        outs = []
+        for p in self.PROMPTS:
+            # Sequential: later submissions see earlier registrations —
+            # maximal sharing on the radix engine.
+            r = eng.submit(list(p), sp)
+            run_all(eng, [r])
+            outs.append(list(r.output_tokens))
+        # Re-arrivals of the first prompt: the conversation-reuse path.
+        r = eng.submit(list(self.PROMPTS[0]), sp)
+        run_all(eng, [r])
+        outs.append(list(r.output_tokens))
+        return outs
+
+    def test_token_identity_sharing_on_vs_off(self, cfg, params):
+        base = mk_engine(cfg, params, prefix=False)
+        radix = mk_engine(cfg, params, prefix_index="radix")
+        flat = mk_engine(cfg, params, prefix_index="flat")
+        want = self._outputs(base)
+        got_radix = self._outputs(radix)
+        got_flat = self._outputs(flat)
+        assert got_radix == want
+        assert got_flat == want
+        tier = radix.kv_tier_stats()
+        assert tier["prefix_hits"] >= 2
+        assert tier["cow_copies"] >= 1       # the @16+ divergence
+        for eng in (base, radix, flat):
+            quiesce(eng)
+
+    def test_conversation_reuse_after_release(self, cfg, params):
+        """Multi-turn: turn 2 = turn 1's prompt + ACTUAL output + new
+        tokens must match through the released conversation's pages —
+        including the decode-grown ones the flat cache always lost."""
+        eng = mk_engine(cfg, params)
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        r1 = eng.submit([3, 1, 4, 1, 5, 9, 2, 6] * 3, sp)
+        run_all(eng, [r1])
+        turn2 = list(r1.prompt_tokens) + list(r1.output_tokens) \
+            + [8, 8, 4, 2]
+        before = eng.kv_tier_stats()["tokens_matched"]
+        r2 = eng.submit(turn2, sp)
+        run_all(eng, [r2])
+        matched = eng.kv_tier_stats()["tokens_matched"] - before
+        # 24 prompt + 7 of 8 generated tokens have reusable KV; the
+        # match must cover nearly the whole history (>= 24 proves the
+        # decode-grown page rode along; flat caching would cap at 16).
+        assert matched >= 24, matched
+        base = mk_engine(cfg, params, prefix=False)
+        rb1 = base.submit([3, 1, 4, 1, 5, 9, 2, 6] * 3, sp)
+        run_all(base, [rb1])
+        rb2 = base.submit(list(turn2), sp)
+        run_all(base, [rb2])
+        assert list(r2.output_tokens) == list(rb2.output_tokens)
+        quiesce(eng)
+        quiesce(base)
+
+    def test_live_sharing_two_inflight(self, cfg, params):
+        """Two requests with one prompt IN FLIGHT together: the second
+        shares ref>0 pages while the first still decodes; both finish
+        with identical greedy output and the pool balances per owner."""
+        eng = mk_engine(cfg, params)
+        sp = SamplingParams(max_new_tokens=16, temperature=0.0)
+        p = [9, 8, 7, 6, 5, 4, 3, 2] * 4
+        r1 = eng.submit(list(p), sp)
+        # A few steps: r1 prefills + registers, keeps decoding.
+        for _ in range(6):
+            eng.step()
+        r2 = eng.submit(list(p), sp)
+        run_all(eng, [r1, r2])
+        assert list(r1.output_tokens) == list(r2.output_tokens)
+        assert eng.kv_tier_stats()["prefix_hits"] >= 1
+        quiesce(eng)
+
+    def test_spec_rollback_with_shared_pages(self, cfg, params):
+        """Speculative rollback truncation must never free a shared
+        prefix page out from under a co-sharer (satellite: spec-decode
+        rollback interacting with shared pages)."""
+        from kubeflow_tpu.core.serving import SpeculativeSpec
+
+        eng = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=4, max_seq_len=128, paged=True, page_size=16,
+            chunked_prefill_tokens=16,
+            speculative=SpeculativeSpec(mode="ngram", k=3)),
+            params=params)
+        base = mk_engine(cfg, params, prefix=False)
+        sp = SamplingParams(max_new_tokens=12, temperature=0.0)
+        p = [5, 3, 5, 3, 5, 3, 1, 2] * 3
+        r1 = eng.submit(list(p), sp)
+        for _ in range(6):
+            eng.step()
+        r2 = eng.submit(list(p) + [4, 4], sp)
+        run_all(eng, [r1, r2])
+        b1 = base.submit(list(p), sp)
+        run_all(base, [b1])
+        b2 = base.submit(list(p) + [4, 4], sp)
+        run_all(base, [b2])
+        assert list(r1.output_tokens) == list(b1.output_tokens)
+        assert list(r2.output_tokens) == list(b2.output_tokens)
+        quiesce(eng)
+        quiesce(base)
+
+    @pytest.mark.slow
+    def test_chunked_resume_mid_page_after_preemption(self, cfg, params):
+        """Chunking-preemption resume through the radix index: the
+        victim's written chunks (full pages + sub-page tail) must match
+        back, and the mid-page COW resume must produce identical greedy
+        output (satellite: chunked-prefill page-alignment resume)."""
+        eng = mk_engine(cfg, params, chunk=16, max_pages=24, slots=2)
+        base = mk_engine(cfg, params, prefix=False, slots=2)
+        sp = SamplingParams(max_new_tokens=6, temperature=0.0)
+        long_p = [11, 13, 17, 19] * 14 + [1, 2, 3]     # 59 tokens
+        r1 = eng.submit(list(long_p), sp)
+        for _ in range(2):
+            eng.step()                  # a couple of chunks land
+        # Pool-pressure the chunking into the preempted lane, then let
+        # it resume: its re-admission matches the registered chunks
+        # (incl. the partial tail — a mid-page resume).
+        r2 = eng.submit([2, 4, 6, 8] * 8, sp)
+        run_all(eng, [r1, r2])
+        b1 = base.submit(list(long_p), sp)
+        b2 = base.submit([2, 4, 6, 8] * 8, sp)
+        run_all(base, [b1, b2])
+        assert list(r1.output_tokens) == list(b1.output_tokens)
+        assert list(r2.output_tokens) == list(b2.output_tokens)
+        quiesce(eng)
+        quiesce(base)
+
+
+class TestEngineHostTier:
+    @pytest.mark.slow
+    def test_idle_conversation_demotes_then_promotes(self, cfg, params):
+        eng = mk_engine(cfg, params, host_pages=32, demote_after_s=0.05)
+        base = mk_engine(cfg, params, prefix=False)
+        try:
+            sp = SamplingParams(max_new_tokens=6, temperature=0.0)
+            p = [6, 2, 8, 1, 8, 2, 8, 4] * 4
+            r1 = eng.submit(list(p), sp)
+            run_all(eng, [r1])
+            # Idle: the background thread + scheduler tick demote the
+            # released conversation to host RAM.
+            deadline = time.monotonic() + 10.0
+            while eng.kv_pages_host() == 0:
+                eng.step()
+                time.sleep(0.01)
+                assert time.monotonic() < deadline, "no demotion happened"
+            assert eng.kv_pages_cached() == 0 or eng.kv_pages_host() > 0
+            # Re-arrival: radix hit promotes BEFORE prefill admits;
+            # output identical to the uncached engine.
+            r2 = eng.submit(list(p), sp)
+            run_all(eng, [r2])
+            b = base.submit(list(p), sp)
+            run_all(base, [b])
+            assert list(r2.output_tokens) == list(b.output_tokens)
+            tier = eng.kv_tier_stats()
+            assert tier["pages_demoted"] > 0
+            assert tier["pages_promoted"] > 0
+            quiesce(eng)
+            quiesce(base)
+        finally:
+            eng.stop()
+            base.stop()
+
+    def test_tier_gauges_split_resident_vs_cached_vs_host(self, cfg,
+                                                         params):
+        from kubeflow_tpu.obs.registry import parse_exposition
+        from kubeflow_tpu.serve.server import serving_metrics_registry
+
+        eng = mk_engine(cfg, params, host_pages=16, demote_after_s=0.05)
+        try:
+            sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+            r = eng.submit([4] * 20, sp)
+            run_all(eng, [r])
+            assert eng.kv_pages_in_use() == 0      # released: not load
+            assert eng.kv_pages_cached() > 0       # but still cached
+            text = serving_metrics_registry([("m", eng)]).render()
+            vals = {n: v for n, labels, v in parse_exposition(text)}
+            assert vals["kftpu_engine_kv_pages_resident"] == 0
+            assert vals["kftpu_engine_kv_pages_cached"] > 0
+            assert "kftpu_engine_kv_pages_host" in vals
+            assert vals["kftpu_engine_kv_prefix_hits_total"] >= 0
+        finally:
+            eng.stop()

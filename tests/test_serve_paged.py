@@ -27,10 +27,11 @@ def params(cfg):
 
 
 def make_paged(cfg, params, *, max_pages=None, page=16, chunk=32, slots=4,
-               prefix=True, prefills=2):
+               prefix=True, prefills=2, prefix_index="radix"):
     return LLMEngine(cfg, BatchingSpec(
         max_batch_size=slots, max_seq_len=128, paged=True, page_size=page,
         max_pages=max_pages, enable_prefix_caching=prefix,
+        prefix_index=prefix_index,
         chunked_prefill_tokens=chunk, max_concurrent_prefills=prefills),
         params=params)
 
@@ -90,6 +91,39 @@ class TestPagedAllocator:
         a.register_prefix(toks, pages)
         hit = a.match_prefix(toks)           # same 8-token prompt
         assert len(hit) <= 1                 # (8-1)//4 = 1 page max
+
+    def test_match_cap_edges(self):
+        """The one-token-short cap, walked across the page boundary —
+        the contract the radix index must preserve (its cap is the same
+        ``len(tokens) - 1``): a page-multiple prompt reuses all but the
+        last page; one extra token unlocks it."""
+        a = PageAllocator(8, 4)
+        toks = list(range(1, 13))            # 3 full pages
+        pages = a.alloc(3)
+        a.register_prefix(toks, pages)
+        a.free(pages)
+        assert len(a.match_prefix(toks)) == 2          # (12-1)//4
+        for h in (a.match_prefix(toks + [99]),):       # 13 tokens
+            assert len(h) == 3
+            a.free(h)
+        assert len(a.match_prefix(toks[:5])) == 1      # (5-1)//4
+        assert len(a.match_prefix(toks[:4])) == 0      # (4-1)//4 = 0
+
+    def test_match_partial_chain_break(self):
+        """A chain whose middle page was evicted must stop at the break
+        (never skip-match disjoint pages)."""
+        a = PageAllocator(4, 4)
+        toks = list(range(1, 13))
+        pages = a.alloc(3)
+        a.register_prefix(toks, pages)
+        a.free(pages)
+        # Evict the middle page's content by dropping its hash entry
+        # the way LRU eviction does.
+        key = a._key_of.pop(pages[1])
+        a._by_key.pop(key)
+        hit = a.match_prefix(toks + [99])
+        assert hit == [pages[0]]
+        a.free(hit)
 
 
 class TestPagedExactMatch:
@@ -269,6 +303,70 @@ class TestReviewRegressions:
         run_all(solo, [sa, sb])
         assert list(ra.output_tokens) == list(sa.output_tokens)
         assert list(rb.output_tokens) == list(sb.output_tokens)
+
+
+class TestFlatIndexPreserved:
+    """The legacy flat chained-hash path (prefix_index='flat') must keep
+    its exact behavior after the radix swap — the match_prefix edges the
+    new subsystem must preserve, exercised through the engine."""
+
+    @pytest.mark.slow
+    def test_chunking_preempt_resume_page_aligned_flat(self, cfg, params):
+        """Cross-class chunking preemption registers written chunks and
+        the resume's match_prefix lands page-aligned (the engine's
+        chunking-preemption path), with output identical to a cold
+        engine."""
+        from kubeflow_tpu.core.serving import QoSSpec
+
+        sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+        long_p = list(range(1, 70))          # 69 tokens: 4 full 16-pages
+        eng = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=2, max_seq_len=128, paged=True, page_size=16,
+            prefix_index="flat", chunked_prefill_tokens=16,
+            max_concurrent_prefills=1, qos=QoSSpec(preemption=True)),
+            params=params)
+        r1 = eng.submit(long_p, sp, qos="batch")
+        for _ in range(2):
+            eng.step()                       # a couple of chunks land
+        r2 = eng.submit([5, 6, 7, 8] * 3, sp, qos="interactive")
+        run_all(eng, [r1, r2])
+        assert eng.metrics.snapshot()["preemptions"] >= 1
+        assert eng._allocator.stats["prefix_hits"] >= 1   # the resume
+        cold = make_paged(cfg, params, prefix=False, chunk=16)
+        c1 = cold.submit(long_p, sp)
+        c2 = cold.submit([5, 6, 7, 8] * 3, sp)
+        run_all(cold, [c1, c2])
+        assert list(r1.output_tokens) == list(c1.output_tokens)
+        assert list(r2.output_tokens) == list(c2.output_tokens)
+        assert eng.kv_pages_in_use() == 0
+
+    @pytest.mark.slow
+    def test_spec_rollback_with_shared_pages_flat(self, cfg, params):
+        """Speculative rollback truncation never frees a shared
+        (registered, ref>0) prefix page on the flat index either."""
+        from kubeflow_tpu.core.serving import SpeculativeSpec
+
+        eng = LLMEngine(cfg, BatchingSpec(
+            max_batch_size=4, max_seq_len=128, paged=True, page_size=16,
+            prefix_index="flat", chunked_prefill_tokens=16,
+            speculative=SpeculativeSpec(mode="ngram", k=3)),
+            params=params)
+        sp = SamplingParams(max_new_tokens=12, temperature=0.0)
+        p = [5, 3, 5, 3, 5, 3, 1, 2] * 3
+        r1 = eng.submit(list(p), sp)
+        for _ in range(6):
+            eng.step()
+        r2 = eng.submit(list(p) + [4, 4], sp)
+        run_all(eng, [r1, r2])
+        base = make_paged(cfg, params, prefix=False)
+        b1 = base.submit(list(p), sp)
+        run_all(base, [b1])
+        b2 = base.submit(list(p) + [4, 4], sp)
+        run_all(base, [b2])
+        assert list(r1.output_tokens) == list(b1.output_tokens)
+        assert list(r2.output_tokens) == list(b2.output_tokens)
+        assert eng.kv_pages_in_use() == 0
+        eng._allocator.assert_quiescent()
 
 
 class TestPagedAttentionKernel:
