@@ -33,15 +33,28 @@ import time
 
 
 def _mk_engine(cfg, *, paged: bool, slots: int, buckets, max_pages=None,
-               on_tpu: bool):
-    from kubeflow_tpu.core.serving import BatchingSpec
+               on_tpu: bool, adapters=()):
+    from kubeflow_tpu.core.serving import BatchingSpec, LoRASpec
     from kubeflow_tpu.serve.engine import LLMEngine
 
-    return LLMEngine(cfg, BatchingSpec(
+    lora = (LoRASpec(max_adapters=max(4, min(len(adapters), 16)), rank=8)
+            if adapters else LoRASpec())
+    engine = LLMEngine(cfg, BatchingSpec(
         max_batch_size=slots, max_seq_len=cfg.max_seq_len,
         prefill_buckets=list(buckets),
         paged=paged, page_size=128, max_pages=max_pages,
-        weights_dtype="bfloat16" if on_tpu else None))
+        weights_dtype="bfloat16" if on_tpu else None, lora=lora))
+    if adapters:
+        import jax
+
+        from kubeflow_tpu.serve.lora import AdapterSpec, init_adapter_weights
+
+        for i, name in enumerate(adapters):
+            engine._lora.register(AdapterSpec(
+                name, rank=8,
+                weights=init_adapter_weights(jax.random.PRNGKey(100 + i),
+                                             cfg, 8)))
+    return engine
 
 
 def _drive(engine, prompts, params, concurrency):
@@ -693,7 +706,8 @@ def run_scenarios(requests: int, rate_rps: float, prompt_len: int,
         buckets = sorted({min(_p2(prompt_len), cap), min(2 * prompt_len, cap)})
         engine = _mk_engine(cfg, paged=paged, slots=slots, buckets=buckets,
                             max_pages=(slots * cfg.max_seq_len // 128
-                                       if paged else None), on_tpu=on_tpu)
+                                       if paged else None), on_tpu=on_tpu,
+                            adapters=sc.adapter_ids)
         engine.start()
         try:
             tracer.reset()

@@ -33,6 +33,16 @@ QOS_HEADER = "X-Kftpu-Qos"
 #: checks). Client-side only — never forwarded onto the serving path.
 USER_HEADER = "X-Kftpu-User"
 
+#: Multi-tenant model routing: the model id (base model or registered
+#: LoRA adapter, serve/lora.py) a request targets. Stamped by clients /
+#: the loadgen (the OpenAI ``"model"`` body field is the headerless
+#: fallback), read by the fleet router — which prefers a backend that
+#: already has the adapter HOT (scraped off the
+#: ``kftpu_engine_adapters_resident`` series) — and by the model
+#: server, which resolves it to a repository model or an engine
+#: adapter; unknown ids are 404s, never silent base-model fallthrough.
+MODEL_HEADER = "X-Kftpu-Model"
+
 #: Disaggregated prefill/decode serving: the URL of the decode-pool
 #: backend a prefill replica must hand its KV off to. Stamped by the
 #: token-aware router (which picked it on least-resident-KV-pages) onto
@@ -48,4 +58,4 @@ DECODE_BACKEND_HEADER = "X-Kftpu-Decode-Backend"
 #: ``kftpu lint`` X703 checks that every header exchanged on the
 #: serving path appears here.
 FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
-                   DECODE_BACKEND_HEADER)
+                   DECODE_BACKEND_HEADER, MODEL_HEADER)

@@ -90,6 +90,39 @@ class SpeculativeSpec(BaseModel):
         return self
 
 
+class LoRASpec(BaseModel):
+    """Multi-tenant LoRA serving knobs (serve/lora.py): one engine
+    serves up to ``max_adapters`` rank-``rank`` adapters over shared
+    base weights, hot-loading/evicting through the adapter registry.
+
+    ``max_adapters`` sizes the PACKED device buffer (the slot count —
+    also the fixed dispatch shape, so adapter churn never retraces);
+    ``rank`` is the per-slot rank cap lower-rank adapters zero-pad to;
+    ``targets`` names the attention projections the low-rank update
+    applies to (wq/wk/wv/wo). ``max_adapters=0`` disables the subsystem
+    — the engine then runs byte-for-byte the pre-LoRA dispatches."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    max_adapters: int = 0
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple = ("wq", "wv")
+
+    @model_validator(mode="after")
+    def _check(self) -> "LoRASpec":
+        if self.max_adapters < 0:
+            raise ValueError("max_adapters must be >= 0")
+        if self.max_adapters and not (1 <= self.rank <= 64):
+            raise ValueError("lora.rank must be in [1, 64]")
+        bad = set(self.targets) - {"wq", "wk", "wv", "wo"}
+        if self.max_adapters and (bad or not self.targets):
+            raise ValueError(
+                f"lora.targets must be a non-empty subset of "
+                f"wq/wk/wv/wo; got {self.targets}")
+        return self
+
+
 #: Multi-tenant QoS classes, highest priority first. The order IS the
 #: policy: admission dequeues strictly by it, overload sheds from the
 #: BACK of it (batch 429s before interactive ever does), and cross-class
@@ -304,10 +337,15 @@ class BatchingSpec(BaseModel):
     queue_delay_budget: Optional[float] = None
     # Multi-tenant QoS: per-class admission quotas/queue-delay budgets,
     # strict-priority dequeue, shed-lowest-first under overload, and
-    # cross-class recompute preemption. The defaults keep single-class
+    # cross-class preemption. The defaults keep single-class
     # traffic byte-for-byte on the pre-QoS behavior (everything is
     # "standard" unless a request declares otherwise).
     qos: QoSSpec = Field(default_factory=QoSSpec)
+    # Multi-tenant LoRA adapters over shared base weights (serve/lora.py):
+    # requests carrying a registered model id decode through their
+    # adapter's packed low-rank slices in the SAME batched dispatch as
+    # base traffic. max_adapters=0 (default) = off.
+    lora: LoRASpec = Field(default_factory=LoRASpec)
 
     @model_validator(mode="after")
     def _check_role(self) -> "BatchingSpec":
@@ -338,6 +376,19 @@ class BatchingSpec(BaseModel):
                 raise ValueError(
                     "host_kv_pages requires kv_cache_dtype=None "
                     "(the host tier stores raw-dtype page bytes)")
+        if self.lora.max_adapters:
+            if self.role != "unified":
+                # Handoff payloads carry KV only — the adopting engine
+                # would need the SAME adapter hot to continue decoding,
+                # a placement contract the fleet router doesn't speak
+                # yet. Multi-adapter engines serve whole requests.
+                raise ValueError(
+                    "lora.max_adapters requires role='unified' "
+                    "(adapter KV cannot ride a handoff)")
+            if self.speculative.mode != "off":
+                raise ValueError(
+                    "lora.max_adapters requires speculative.mode='off' "
+                    "(the verify dispatch has no adapter lane yet)")
         return self
 
 

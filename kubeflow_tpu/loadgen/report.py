@@ -66,12 +66,18 @@ ATTRIBUTION_SERIES = (
     "kftpu_engine_kv_cow_copies_total",
     "kftpu_engine_kv_pages_demoted_total",
     "kftpu_engine_kv_pages_promoted_total",
+    # Multi-tenant LoRA (serve/lora.py): adapter residency + hot-load/
+    # evict lifecycle — a multi_adapter regression names adapter churn
+    # (loads/evictions climbing) instead of just the latency.
+    "kftpu_engine_adapters_resident",
+    "kftpu_engine_adapter_loads_total",
+    "kftpu_engine_adapter_evictions_total",
 )
 
 #: Engine span-name prefix → report phase keys (obs.trace owns the
 #: span names; phase_durations owns the extraction).
-PHASE_KEYS = ("queued_ms", "kv_migrate_ms", "prefill_ms", "handoff_ms",
-              "decode_ms")
+PHASE_KEYS = ("queued_ms", "adapter_load_ms", "kv_migrate_ms",
+              "prefill_ms", "handoff_ms", "decode_ms")
 
 
 def engine_attribution(metrics_text: str) -> dict:
@@ -102,6 +108,15 @@ def engine_attribution(metrics_text: str) -> dict:
             out["host_gap_p99_ms"] = round(value, 3)
         elif name == "kftpu_engine_dispatch_depth":
             out["dispatch_depth"] = int(value)
+        elif name == "kftpu_engine_adapters_resident":
+            ad = out.setdefault("adapters", {})
+            ad["resident"] = ad.get("resident", 0) + int(value)
+        elif name == "kftpu_engine_adapter_loads_total":
+            ad = out.setdefault("adapters", {})
+            ad["loads"] = ad.get("loads", 0) + int(value)
+        elif name == "kftpu_engine_adapter_evictions_total":
+            ad = out.setdefault("adapters", {})
+            ad["evictions"] = ad.get("evictions", 0) + int(value)
         elif name.startswith("kftpu_engine_kv_"):
             key = name[len("kftpu_engine_kv_"):]
             if key.endswith("_total"):
@@ -218,6 +233,25 @@ def build_report(run: ScenarioRun, *, metrics_text: Optional[str] = None,
             report["goodput"]["slo_tpot_ms"] = sc.slo_tpot_ms
         if sc.slo_classes:
             report["goodput"]["slo_classes"] = list(sc.slo_classes)
+    adapters = sorted({o.adapter for o in outs if o.adapter})
+    if adapters:
+        # Per-adapter TTFT/TPOT attribution: the split that shows ONE
+        # tenant degrading (its adapter thrashing the hot set) while
+        # the aggregate still looks healthy.
+        ad_out: dict = {}
+        for aid in adapters:
+            a_ok = [o for o in ok if o.adapter == aid]
+            a_all = [o for o in outs if o.adapter == aid]
+            ad_out[aid] = {
+                "requests": len(a_all), "completed": len(a_ok),
+                "ttft_ms": stats.quantiles_ms(
+                    [o.ttft_s for o in a_ok if o.ttft_s is not None],
+                    qs=(0.5, 0.95)),
+                "tpot_ms": stats.quantiles_ms(
+                    [t for t in (o.tpot_s() for o in a_ok)
+                     if t is not None], qs=(0.5, 0.95)),
+            }
+        report["adapters"] = ad_out
     qos_out: dict = {}
     for cls in sorted({o.qos for o in outs}):
         cls_ok = [o for o in ok if o.qos == cls]

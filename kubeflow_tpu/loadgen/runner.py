@@ -35,7 +35,9 @@ import time
 from typing import Any, Optional
 from urllib.parse import urlparse
 
-from kubeflow_tpu.core.headers import QOS_HEADER, TRACE_HEADER
+from kubeflow_tpu.core.headers import (
+    MODEL_HEADER, QOS_HEADER, TRACE_HEADER,
+)
 from kubeflow_tpu.obs.trace import Tracer, get_tracer
 from kubeflow_tpu.loadgen.scenario import (
     Scenario, ScheduledRequest, build_schedule,
@@ -54,6 +56,9 @@ class RequestOutcome:
     latency_s: float            # submit → terminal
     tokens: int
     status: str                 # ok | shed | timeout | error
+    #: Model id the request targeted (None = base model) — the
+    #: per-adapter TTFT/TPOT split key in the attribution report.
+    adapter: Optional[str] = None
     trace_id: str = ""
     #: Generated output in the target's native space (token tuple for
     #: EngineTarget, text for ServerTarget) — what session mode
@@ -106,12 +111,20 @@ class EngineTarget:
                 SamplingParams(max_new_tokens=sr.max_new_tokens,
                                temperature=0.0),
                 deadline=time.monotonic() + timeout_s,
-                trace_parent=root, qos=sr.qos)
+                trace_parent=root, qos=sr.qos, adapter=sr.adapter)
+        except KeyError:
+            # Unknown model id: the engine 404s it at the door.
+            return RequestOutcome(
+                idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
+                ttft_s=None, latency_s=time.perf_counter() - t0,
+                tokens=0, status="error", adapter=sr.adapter,
+                prompt_len=len(prompt_tokens))
         except EngineOverloaded:
             return RequestOutcome(
                 idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
                 ttft_s=None, latency_s=time.perf_counter() - t0,
-                tokens=0, status="shed", prompt_len=len(prompt_tokens))
+                tokens=0, status="shed", adapter=sr.adapter,
+                prompt_len=len(prompt_tokens))
         ttft = None
         out_tokens: list[int] = []
         status = "ok"
@@ -134,7 +147,7 @@ class EngineTarget:
         return RequestOutcome(
             idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
             ttft_s=ttft, latency_s=time.perf_counter() - t0,
-            tokens=len(out_tokens), status=status,
+            tokens=len(out_tokens), status=status, adapter=sr.adapter,
             gen=tuple(out_tokens), prompt_len=len(prompt_tokens))
 
 
@@ -169,14 +182,19 @@ class ServerTarget:
         t0 = time.perf_counter()
         prompt_text = (self.base_prompt(sr) if prompt is None
                        else str(prompt))
+        model = sr.adapter or self.model
         body = {"prompt": prompt_text,
                 "max_tokens": sr.max_new_tokens, "temperature": 0.0,
                 "stream": True, "timeout": timeout_s}
-        if self.model:
-            body["model"] = self.model
+        if model:
+            body["model"] = model
         payload = json.dumps(body)
         headers = {"Content-Type": "application/json",
                    QOS_HEADER: sr.qos}
+        if model:
+            # The fleet router's model-id routing key (the body field
+            # is the headerless fallback the replica reads).
+            headers[MODEL_HEADER] = model
         if root is not None and getattr(root, "context", None) is not None:
             headers[TRACE_HEADER] = root.context.header_value()
         conn = http.client.HTTPConnection(self.host, self.port,
@@ -225,8 +243,8 @@ class ServerTarget:
         return RequestOutcome(
             idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
             ttft_s=ttft, latency_s=time.perf_counter() - t0,
-            tokens=tokens, status=status, gen="".join(pieces),
-            prompt_len=len(prompt_text))
+            tokens=tokens, status=status, adapter=sr.adapter,
+            gen="".join(pieces), prompt_len=len(prompt_text))
 
 
 @dataclasses.dataclass
@@ -292,7 +310,8 @@ def run_scenario(target, scenario: Scenario, *, vocab_size: int,
             root.set_attrs(error=f"{type(exc).__name__}: {exc}")
             out = RequestOutcome(
                 idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=lag,
-                ttft_s=None, latency_s=0.0, tokens=0, status="error")
+                ttft_s=None, latency_s=0.0, tokens=0, status="error",
+                adapter=sr.adapter)
         out.lag_s = lag
         out.trace_id = getattr(root, "trace_id", "") or ""
         root.end("ok" if out.ok else out.status)
@@ -336,7 +355,7 @@ def run_scenario(target, scenario: Scenario, *, vocab_size: int,
                 done.append(RequestOutcome(
                     idx=sr.idx, qos=sr.qos, scheduled_t=sr.t, lag_s=0.0,
                     ttft_s=None, latency_s=wall, tokens=0,
-                    status="timeout"))
+                    status="timeout", adapter=sr.adapter))
     done.sort(key=lambda o: o.idx)
     return ScenarioRun(scenario=scenario, outcomes=done, wall_s=wall,
                        schedule=schedule)

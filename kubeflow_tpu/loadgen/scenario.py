@@ -150,6 +150,15 @@ class Scenario:
     slo_classes: tuple = ()
     #: Client-side per-request give-up budget (seconds).
     request_timeout_s: float = 120.0
+    #: Multi-tenant LoRA (serve/lora.py): model ids stamped per request
+    #: (the ``X-Kftpu-Model`` header / ``"model"`` body field). Empty =
+    #: base-model traffic. Non-empty draws each request's adapter from
+    #: this tuple with a zipf-skewed popularity law: weight(i) ∝
+    #: (i+1)^-adapter_skew over the tuple order, so adapter_ids[0] is
+    #: the hottest tenant — the churn/residency shape multi-adapter
+    #: serving must absorb. ``adapter_skew=0`` = uniform.
+    adapter_ids: tuple = ()
+    adapter_skew: float = 1.0
     #: Multi-turn sessions (> 1 switches to session mode): requests
     #: group into conversations of this many turns. Turn 0 carries a
     #: normal prompt; each later turn carries only its NEW tokens and
@@ -168,6 +177,10 @@ class Scenario:
             raise ValueError("prefix_overlap must be in [0, 1]")
         if self.turns < 1:
             raise ValueError("turns must be >= 1")
+        if self.adapter_skew < 0:
+            raise ValueError("adapter_skew must be >= 0")
+        if len(set(self.adapter_ids)) != len(self.adapter_ids):
+            raise ValueError("adapter_ids must be unique")
         if self.think_time_s < 0:
             raise ValueError("think_time_s must be >= 0")
         self.arrival.validate()
@@ -220,6 +233,21 @@ class ScheduledRequest:
     turn: int = 0
     prev_idx: Optional[int] = None
     think_s: float = 0.0
+    #: Model id this request targets (None = base model).
+    adapter: Optional[str] = None
+
+
+def _adapter_draw(scenario: Scenario,
+                  rng: np.random.Generator) -> Optional[str]:
+    """One zipf-skewed adapter draw (None when the scenario carries no
+    adapter mix). Drawn LAST per request/session so adapter-free
+    scenarios keep their historical byte-identical schedules."""
+    if not scenario.adapter_ids:
+        return None
+    ranks = np.arange(1, len(scenario.adapter_ids) + 1, dtype=float)
+    w = ranks ** -scenario.adapter_skew
+    w = w / w.sum()
+    return str(rng.choice(np.asarray(scenario.adapter_ids, object), p=w))
 
 
 def arrival_times(arrival: Arrival, n: int,
@@ -292,7 +320,8 @@ def build_schedule(scenario: Scenario, *, vocab_size: int,
                 + tuple(int(x) for x in tail)
             out.append(ScheduledRequest(
                 idx=i, t=float(times[i]), prompt_tokens=prompt,
-                max_new_tokens=odist.sample(rng, 100_000), qos=qos))
+                max_new_tokens=odist.sample(rng, 100_000), qos=qos,
+                adapter=_adapter_draw(scenario, rng)))
             continue
         plen = scenario.prompt_len.sample(rng, max_prompt_len)
         k = int(round(scenario.prefix_overlap * plen))
@@ -302,7 +331,8 @@ def build_schedule(scenario: Scenario, *, vocab_size: int,
         out.append(ScheduledRequest(
             idx=i, t=float(times[i]), prompt_tokens=prompt,
             max_new_tokens=scenario.output_len.sample(rng, 100_000),
-            qos=str(rng.choice(classes, p=weights))))
+            qos=str(rng.choice(classes, p=weights)),
+            adapter=_adapter_draw(scenario, rng)))
     return out
 
 
@@ -329,6 +359,7 @@ def _build_session_schedule(scenario: Scenario, rng: np.random.Generator,
     idx = 0
     for s_i in range(n_sessions):
         qos = str(rng.choice(classes, p=weights))
+        adapter = _adapter_draw(scenario, rng)
         for t_i in range(turns):
             if t_i == 0:
                 plen = scenario.prompt_len.sample(rng, max_prompt_len)
@@ -349,7 +380,7 @@ def _build_session_schedule(scenario: Scenario, rng: np.random.Generator,
                 max_new_tokens=scenario.output_len.sample(rng, 100_000),
                 qos=qos, session=s_i, turn=t_i,
                 prev_idx=(idx - 1 if t_i else None),
-                think_s=(think if t_i else 0.0)))
+                think_s=(think if t_i else 0.0), adapter=adapter))
             idx += 1
     return out
 
@@ -360,8 +391,11 @@ def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
                     mixed_slo_tpot_ms: Optional[float] = None,
                     shared_prefix_overlap: float = 0.75,
                     multi_turn_think_s: float = 0.35,
+                    adapter_ids: tuple = ("adpt-0", "adpt-1", "adpt-2",
+                                          "adpt-3"),
+                    adapter_skew: float = 1.0,
                     seed: int = 0) -> list[Scenario]:
-    """The canonical 5-scenario serving matrix the perf gate and
+    """The canonical 6-scenario serving matrix the perf gate and
     ``bench_serve.py --workload scenarios`` both replay:
 
     - ``uniform`` — Poisson arrivals, fixed lengths, one QoS class: the
@@ -384,8 +418,17 @@ def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
       COW tails, and the device↔host migration lifecycle
       (``scripts/prefix_cache_smoke.py`` gates through this shape).
 
+    - ``multi_adapter`` — Poisson arrivals with every request stamped a
+      model id drawn zipf-skewed from ``adapter_ids`` (a few hot
+      tenants, a long cold tail): the multi-tenant LoRA regime —
+      batched multi-adapter decode, hot-load/evict churn, and model-id
+      routing prove their degradation bounds through this shape
+      (``scripts/lora_smoke.py`` gates it; ROADMAP item 4).
+
     ``shared_prefix_overlap`` sweeps the shared-prefix scenario's
-    overlap fraction (the 0.5–0.95 axis the prefix-cache gate walks).
+    overlap fraction (the 0.5–0.95 axis the prefix-cache gate walks);
+    ``adapter_ids``/``adapter_skew`` parameterize the multi_adapter
+    mix (the 8/32/64-concurrent-adapter axis the LoRA gate walks).
     """
     return [
         Scenario(
@@ -431,6 +474,13 @@ def standard_matrix(*, num_requests: int = 24, rate_rps: float = 8.0,
             ),
             slo_ttft_ms=slo_ttft_ms, slo_tpot_ms=mixed_slo_tpot_ms,
             slo_classes=("interactive",)),
+        Scenario(
+            name="multi_adapter", num_requests=num_requests, seed=seed + 5,
+            arrival=Arrival(process="poisson", rate_rps=rate_rps),
+            prompt_len=LengthDist(kind="fixed", value=prompt_len),
+            output_len=LengthDist(kind="fixed", value=max_new),
+            adapter_ids=tuple(adapter_ids), adapter_skew=adapter_skew,
+            slo_ttft_ms=slo_ttft_ms),
         Scenario(
             name="multi_turn", num_requests=num_requests, seed=seed + 4,
             # Sessions arrive slower than single-shot requests — each

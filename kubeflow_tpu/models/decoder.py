@@ -104,12 +104,12 @@ def _block_forward(block_params, x, positions, cfg: DecoderConfig,
                    kv_cache=None, attn_impl="xla", mesh=None,
                    rules=DEFAULT_RULES, prefill=False,
                    expert_axis=None, seq_axis=None, tp_axis=None,
-                   valid_len=None):
+                   valid_len=None, lora=None):
     h = L.rmsnorm(x, block_params["ln1"], cfg)
     attn_out, new_cache = L.attention_block(
         block_params["attn"], h, positions, cfg,
         kv_cache=kv_cache, attn_impl=attn_impl, mesh=mesh, prefill=prefill,
-        tp_axis=tp_axis)
+        tp_axis=tp_axis, lora=lora)
     x = x + attn_out
     h = L.rmsnorm(x, block_params["ln2"], cfg)
     if cfg.is_moe:
@@ -178,6 +178,7 @@ def decoder_forward(
     skip_head: bool = False,
     valid_len: Optional[jax.Array] = None,
     inputs_embeds: Optional[jax.Array] = None,
+    lora: Optional[dict] = None,
 ):
     """Returns (logits [B,S,V] float32, new_kv_caches|None, aux_loss).
     With ``skip_head``, returns the final-norm hidden states [B,S,D] instead
@@ -187,7 +188,10 @@ def decoder_forward(
     layers.moe_block. ``inputs_embeds`` [B,S,D] replaces the embedding
     lookup (pre-scale) — the differentiable-input path attribution
     explainers need (serve/explain.py); ``tokens`` still supplies shapes
-    and positions."""
+    and positions. ``lora`` (multi-tenant serving, serve/lora.py):
+    ``{"targets": {t: (a [L,S,din,r], b [L,S,r,dout])}, "aidx": [B],
+    "scale": [S]}`` — each row's adapter delta applies inside every
+    attention block (rows with aidx = -1 add exact zero)."""
     custom_positions = positions is not None
     if positions is None:
         # Decode with a cache: absolute positions continue from the cache
@@ -235,49 +239,60 @@ def decoder_forward(
         x, aux_total = _pipeline_layers(params["layers"], x, positions, cfg,
                                         mesh, attn_impl)
     elif cfg.scan_layers:
+        # Per-layer adapter slices ride the scan xs alongside the layer
+        # params (leading L axis); aidx/scale are loop invariants the
+        # body closes over (layers.layer_view).
+        lora_xs = L.slice_layers(lora)
+
         def scan_body(carry, scan_in):
             x = carry
-            block_params, cache = scan_in
+            block_params, cache, lora_sl = scan_in
             out, new_cache, aux = _block_forward(
                 block_params, x, positions, cfg,
                 kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules,
-                prefill=prefill, valid_len=valid_len)
+                prefill=prefill, valid_len=valid_len,
+                lora=L.layer_view(lora, lora_sl))
             return out, (new_cache, aux)
 
         body = _remat(scan_body, cfg.remat_policy)
         if kv_caches is not None:
             # scan consumes the stacked [L, ...] cache leaves alongside params
             def scan_with_cache(carry, scan_in):
-                block_params, (ck, cv) = scan_in
+                block_params, (ck, cv), lora_sl = scan_in
                 cache = {"k": ck, "v": cv, "len": kv_caches["len"]}
-                out, (new_cache, aux) = body(carry, (block_params, cache))
+                out, (new_cache, aux) = body(
+                    carry, (block_params, cache, lora_sl))
                 return out, ((new_cache["k"], new_cache["v"]), aux)
             x, ((nk, nv), auxs) = jax.lax.scan(
                 scan_with_cache, x,
-                (params["layers"], (kv_caches["k"], kv_caches["v"])))
+                (params["layers"], (kv_caches["k"], kv_caches["v"]),
+                 lora_xs))
             new_caches = {"k": nk, "v": nv,
                           "len": kv_caches["len"] + tokens.shape[1]}
         else:
-            def scan_no_cache(carry, block_params):
-                out, (_, aux) = body(carry, (block_params, None))
+            def scan_no_cache(carry, scan_in):
+                block_params, lora_sl = scan_in
+                out, (_, aux) = body(carry, (block_params, None, lora_sl))
                 return out, aux
-            x, auxs = jax.lax.scan(scan_no_cache, x, params["layers"])
+            x, auxs = jax.lax.scan(scan_no_cache, x,
+                                   (params["layers"], lora_xs))
         aux_total = jnp.sum(auxs)
     else:
         per_layer_aux = []
         new_k, new_v = [], []
         block_fn = _remat(
-            lambda bp, x, cache: _block_forward(
+            lambda bp, x, cache, lr: _block_forward(
                 bp, x, positions, cfg,
                 kv_cache=cache, attn_impl=attn_impl, mesh=mesh, rules=rules,
-                prefill=prefill, valid_len=valid_len),
+                prefill=prefill, valid_len=valid_len, lora=lr),
             cfg.remat_policy)
         for i, block_params in enumerate(params["layers"]):
             cache = None
             if kv_caches is not None:
                 cache = {"k": kv_caches["k"][i], "v": kv_caches["v"][i],
                          "len": kv_caches["len"]}
-            x, new_cache, aux = block_fn(block_params, x, cache)
+            x, new_cache, aux = block_fn(block_params, x, cache,
+                                         L.index_layer(lora, i))
             per_layer_aux.append(aux)
             if new_cache is not None:
                 new_k.append(new_cache["k"])

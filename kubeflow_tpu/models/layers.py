@@ -58,6 +58,76 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+# -- LoRA (multi-tenant adapters; serve/lora.py owns the registry) -------------
+
+def lora_contrib(h: jax.Array, a_l: jax.Array, b_l: jax.Array,  # traced
+                 aidx: jax.Array, scale: jax.Array) -> jax.Array:
+    """Batched per-row low-rank update: one gather + two einsums.
+
+    ``h`` [B, S, d_in] (the SAME hidden the base projection consumes);
+    ``a_l`` [S_adapters, d_in, r] / ``b_l`` [S_adapters, r, d_out] —
+    ONE layer's packed adapter slices; ``aidx`` [B] adapter slot per
+    row; ``scale`` [S_adapters]. Rows with ``aidx < 0`` (base traffic)
+    multiply by an exact 0.0, so their output is bit-unchanged when the
+    result adds onto the base projection. Shapes are fixed by the
+    packed buffer, so adapter churn never retraces (the F6xx fixed-
+    trace contract)."""
+    nslots = a_l.shape[0]
+    safe = jnp.clip(aidx, 0, nslots - 1)
+    a = a_l[safe]                                 # [B, d_in, r]
+    b = b_l[safe]                                 # [B, r, d_out]
+    s = scale[safe] * (aidx >= 0)
+    t = jnp.einsum("bsd,bdr->bsr", h, a)
+    return jnp.einsum("bsr,bro->bso", t, b) * s[:, None, None]
+
+
+def apply_lora_layer(lora_layer: Optional[dict], target: str,
+                     h: jax.Array, base: jax.Array) -> jax.Array:  # traced
+    """``base + delta`` for one projection (identity when the layer
+    dict is None or the target isn't packed). ``lora_layer`` is
+    ``{"targets": {t: (a_l, b_l)}, "aidx": [B], "scale": [S]}`` with
+    per-LAYER [S, ...] slices; ``base`` is the projection output in its
+    headed shape [B, S, H, Dh] (or [B, S, D] for wo) — the contrib
+    reshapes to match."""
+    if lora_layer is None or target not in lora_layer["targets"]:
+        return base
+    a_l, b_l = lora_layer["targets"][target]
+    delta = lora_contrib(h, a_l, b_l, lora_layer["aidx"],
+                         lora_layer["scale"])
+    # The f32 scale promotes the delta; cast back so the cache write /
+    # residual keep the activation dtype.
+    return base + delta.reshape(base.shape).astype(base.dtype)
+
+
+def slice_layers(lora: Optional[dict]) -> Optional[dict]:
+    """The per-layer scan pytree of a packed-buffer dict: target ->
+    (a [L,S,din,r], b [L,S,r,dout]) with the L axis leading, ready to
+    be scanned alongside ``params['layers']``. None passes through."""
+    if lora is None:
+        return None
+    return {t: (lora["targets"][t][0], lora["targets"][t][1])
+            for t in lora["targets"]}
+
+
+def layer_view(lora: Optional[dict], scanned_targets: Optional[dict],
+               ) -> Optional[dict]:  # traced
+    """Rebind one scan step's [S, ...] target slices to the invariant
+    aidx/scale operands (closed over by the scan body)."""
+    if lora is None:
+        return None
+    return {"targets": scanned_targets, "aidx": lora["aidx"],
+            "scale": lora["scale"]}
+
+
+def index_layer(lora: Optional[dict], i: int) -> Optional[dict]:
+    """Per-layer view for the non-scanned (list-of-blocks) forward."""
+    if lora is None:
+        return None
+    return {"targets": {t: (a[i], b[i])
+                        for t, (a, b) in lora["targets"].items()},
+            "aidx": lora["aidx"], "scale": lora["scale"]}
+
+
 # -- Attention block -----------------------------------------------------------
 
 def init_attention(key, cfg: DecoderConfig):
@@ -90,6 +160,7 @@ def attention_block(
     mesh=None,
     prefill: bool = False,              # static: cache start is known to be 0
     tp_axis: Optional[str] = None,      # inside shard_map: heads sharded here
+    lora: Optional[dict] = None,        # per-layer adapter view (apply_lora_layer)
 ):
     """Returns (out [B,S,D], new_kv_cache|None).
 
@@ -102,6 +173,13 @@ def attention_block(
     q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(dt))
+    if lora is not None:
+        # Multi-tenant adapters: each row's low-rank delta adds onto the
+        # shared base projection (gather + two einsums per target; rows
+        # with adapter_idx = -1 add an exact zero).
+        q = apply_lora_layer(lora, "wq", x, q)
+        k = apply_lora_layer(lora, "wk", x, k)
+        v = apply_lora_layer(lora, "wv", x, v)
     # Names feed the "block_outs" remat policy: saving post-rope Q/K/V plus
     # the block outputs skips reprojecting + re-rotating in the backward
     # while staying far under dots_no_batch's save footprint.
@@ -182,10 +260,13 @@ def attention_block(
         out = flash_sharded_or_xla(q, k, v, mesh, causal=True)
     else:
         out = multi_head_attention(q, k, v, causal=True, impl=attn_impl)
-    out = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))
+    proj = jnp.einsum("bshk,hkd->bsd", out, p["wo"].astype(dt))
+    if lora is not None and "wo" in lora["targets"]:
+        b, s = out.shape[0], out.shape[1]
+        proj = apply_lora_layer(lora, "wo", out.reshape(b, s, -1), proj)
     if tp_axis is not None:
-        out = jax.lax.psum(out, tp_axis)
-    return checkpoint_name(out, "attn_out"), new_cache
+        proj = jax.lax.psum(proj, tp_axis)
+    return checkpoint_name(proj, "attn_out"), new_cache
 
 
 # -- MLP -----------------------------------------------------------------------

@@ -368,8 +368,12 @@ class Tracer:
 #: ``engine.decode``). ``handoff`` appears only on disaggregated
 #: requests: the prefill model server opens it around KV export + POST
 #: + ack, and the adopting engine's queued/decode spans continue the
-#: SAME trace on the decode side.
-ENGINE_PHASES = ("queued", "kv_migrate", "prefill", "handoff", "decode")
+#: SAME trace on the decode side. ``adapter_load`` appears when an
+#: admission had to hot-load its LoRA adapter into the packed buffers
+#: (serve/lora.py) — the phase a multi-tenant churn regression shows
+#: up under.
+ENGINE_PHASES = ("queued", "adapter_load", "kv_migrate", "prefill",
+                 "handoff", "decode")
 
 
 def phase_durations(spans: list[dict]) -> dict:

@@ -47,19 +47,19 @@ import numpy as np
 #: ``lengths`` = its KV write position; ``live`` masks dead rows; the rest
 #: are per-slot sampling params and the remaining token budget.
 STATE_FIELDS = ("tokens", "lengths", "live", "temps", "top_k", "top_p",
-                "stops", "budgets")
+                "stops", "budgets", "adapter")
 
 _DTYPES = {"tokens": jnp.int32, "lengths": jnp.int32, "live": jnp.bool_,
            "temps": jnp.float32, "top_k": jnp.int32, "top_p": jnp.float32,
-           "stops": jnp.int32, "budgets": jnp.int32}
+           "stops": jnp.int32, "budgets": jnp.int32, "adapter": jnp.int32}
 
 #: Values a freed slot scatters back to (live=False is the one that
 #: matters — a dead row's other fields are never read by the dispatch).
-DEAD_SLOT = (0, 0, False, 0.0, 0, 1.0, -1, 0)
+DEAD_SLOT = (0, 0, False, 0.0, 0, 1.0, -1, 0, -1)
 
 
 def _scatter_slot(arrays: dict, idx, tok, length, live, temp, tk, tp,
-                  stop, budget) -> dict:
+                  stop, budget, adapter) -> dict:
     """One slot's state delta as a scatter at ``idx`` (donated in/out)."""
     return {
         "tokens": arrays["tokens"].at[idx].set(tok),
@@ -70,6 +70,7 @@ def _scatter_slot(arrays: dict, idx, tok, length, live, temp, tk, tp,
         "top_p": arrays["top_p"].at[idx].set(tp),
         "stops": arrays["stops"].at[idx].set(stop),
         "budgets": arrays["budgets"].at[idx].set(budget),
+        "adapter": arrays["adapter"].at[idx].set(adapter),
     }
 
 
@@ -94,6 +95,9 @@ class DecodeState:
             "top_p": jnp.ones((num_slots,), jnp.float32),
             "stops": jnp.full((num_slots,), -1, jnp.int32),
             "budgets": jnp.zeros((num_slots,), jnp.int32),
+            # Multi-tenant LoRA (serve/lora.py): the packed-buffer slot
+            # whose low-rank delta applies to this row; -1 = base model.
+            "adapter": jnp.full((num_slots,), -1, jnp.int32),
         }
         self.table: Optional[jax.Array] = None
         if mpp is not None:
@@ -139,13 +143,15 @@ class DecodeState:
         only ``device_put`` is unconditionally explicit.)"""
         put = jax.device_put
         for idx in sorted(self.dirty_slots):
-            tok, length, live, temp, tk, tp, stop, budget = values_for(idx)
+            (tok, length, live, temp, tk, tp, stop, budget,
+             adapter) = values_for(idx)
             self.arrays = self._scatter(
                 self.arrays, put(np.int32(idx)),
                 put(np.int32(tok)), put(np.int32(length)),
                 put(np.bool_(live)), put(np.float32(temp)),
                 put(np.int32(tk)), put(np.float32(tp)),
-                put(np.int32(stop)), put(np.int32(budget)))
+                put(np.int32(stop)), put(np.int32(budget)),
+                put(np.int32(adapter)))
             self.stats["slot_syncs"] += 1
         self.dirty_slots.clear()
 

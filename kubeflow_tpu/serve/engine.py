@@ -175,7 +175,8 @@ def _decode_attention(q, ck, cv, lengths, cfg: DecoderConfig):  # traced
     return out.reshape(b, 1, cfg.n_heads, cfg.head_dim)
 
 
-def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg):  # traced
+def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg,  # traced
+                  lora=None):
     """One transformer block for a [B,1] decode step against slot caches.
     Returns (x, new_k_cache, new_v_cache)."""
     dt = cfg.activation_dtype
@@ -183,6 +184,13 @@ def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg):  # tr
     q = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wv"].astype(dt))
+    if lora is not None:
+        # Multi-adapter decode (serve/lora.py): each row's low-rank
+        # delta adds onto the shared base projection — one gather + two
+        # einsums per target; adapter_idx = -1 rows add an exact zero.
+        q = L.apply_lora_layer(lora, "wq", h, q)
+        k = L.apply_lora_layer(lora, "wk", h, k)
+        v = L.apply_lora_layer(lora, "wv", h, v)
     q = L.rope(q, positions, cfg.rope_theta)
     k = L.rope(k, positions, cfg.rope_theta)
     bidx = jnp.arange(x.shape[0])
@@ -194,7 +202,11 @@ def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg):  # tr
     ck = cache_k.at[bidx, widx].set(k[:, 0], mode="drop")
     cv = cache_v.at[bidx, widx].set(v[:, 0], mode="drop")
     attn = _decode_attention(q, ck, cv, lengths, cfg)
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
+    proj = jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
+    if lora is not None and "wo" in lora["targets"]:
+        proj = L.apply_lora_layer(
+            lora, "wo", attn.reshape(attn.shape[0], 1, -1), proj)
+    x = x + proj
     h = L.rmsnorm(x, bp["ln2"], cfg)
     if cfg.is_moe:
         mlp_out, _ = L.moe_block(bp["mlp"], h, cfg)
@@ -204,7 +216,8 @@ def _decode_block(bp, x, positions, lengths, live, cache_k, cache_v, cfg):  # tr
 
 
 def _decode_step(params: Params, cache: dict, tokens: jax.Array,  # traced
-                 lengths: jax.Array, live: jax.Array, cfg: DecoderConfig):
+                 lengths: jax.Array, live: jax.Array, cfg: DecoderConfig,
+                 lora=None):
     """tokens [B] (last sampled), lengths [B] (their positions), live [B]
     (rows whose KV write is real). Returns (logits [B,V] fp32, new cache)."""
     dt = cfg.activation_dtype
@@ -212,15 +225,16 @@ def _decode_step(params: Params, cache: dict, tokens: jax.Array,  # traced
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.hidden ** 0.5, dt)
     positions = lengths[:, None]
+    lora_xs = L.slice_layers(lora)
 
     def body(x, scan_in):
-        bp, ck, cv = scan_in
+        bp, ck, cv, lsl = scan_in
         x, nk, nv = _decode_block(bp, x, positions, lengths, live, ck, cv,
-                                  cfg)
+                                  cfg, lora=L.layer_view(lora, lsl))
         return x, (nk, nv)
 
     x, (nk, nv) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+                                         cache["k"], cache["v"], lora_xs))
     x = L.rmsnorm(x, params["final_norm"], cfg)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dt),
@@ -234,7 +248,8 @@ def _decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
                   lengths: jax.Array, live: jax.Array, temps: jax.Array,
                   top_k: jax.Array, top_p: jax.Array, stop_tokens: jax.Array,
                   budgets: jax.Array, key: jax.Array, cfg: DecoderConfig,
-                  num_steps: int, sample_mode: str = "full"):
+                  num_steps: int, sample_mode: str = "full",
+                  lora=None, adapter_idx=None):
     """Up to ``num_steps`` decode+sample steps in ONE device dispatch.
 
     The single-step loop pays one host round-trip per token — on a tunneled
@@ -256,6 +271,7 @@ def _decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
     b = tokens.shape[0]
     max_len = cache["k"].shape[2]
     out0 = jnp.full((b, num_steps), -1, jnp.int32)
+    lr = None if lora is None else {**lora, "aidx": adapter_idx}
 
     def cond(carry):
         i, _, _, _, live, _, _, _ = carry
@@ -264,7 +280,7 @@ def _decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
     def body(carry):
         i, cache, tokens, lengths, live, budgets, key, out = carry
         logits, cache = _decode_step(params, cache, tokens, lengths, live,
-                                     cfg)
+                                     cfg, lora=lr)
         key, sub = jax.random.split(key)
         sampled = _sample_batch(logits, sub, temps, top_k, top_p,
                                 mode=sample_mode)
@@ -287,7 +303,8 @@ def _decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
 def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                         slot: jax.Array, start: jax.Array,
                         cfg: DecoderConfig,
-                        valid_len: Optional[jax.Array] = None):
+                        valid_len: Optional[jax.Array] = None,
+                        lora=None, adapter_idx=None):
     """Prefill ONE chunk of a prompt into slot ``slot`` at position ``start``.
 
     Chunked prefill (SURVEY.md §5 long-context serving): long prompts are
@@ -299,8 +316,9 @@ def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,  # trace
     ck = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1)
     cv = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1)
     caches = {"k": ck, "v": cv, "len": start}
+    lr = None if lora is None else {**lora, "aidx": adapter_idx}
     logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=caches,
-                                        valid_len=valid_len)
+                                        valid_len=valid_len, lora=lr)
     nk = jax.lax.dynamic_update_slice_in_dim(cache["k"], filled["k"], slot,
                                              axis=1)
     nv = jax.lax.dynamic_update_slice_in_dim(cache["v"], filled["v"], slot,
@@ -311,7 +329,8 @@ def _chunk_prefill_step(params: Params, cache: dict, tokens: jax.Array,  # trace
 def _prefill_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                   slots: jax.Array, lengths: jax.Array,
                   cfg: DecoderConfig, attn_impl: str = "xla",
-                  mesh: Optional[Mesh] = None):
+                  mesh: Optional[Mesh] = None,
+                  lora=None, adapter_idx=None):
     """Prefill N same-bucket prompts in ONE dispatch (tokens [N, bucket],
     slots/lengths [N]); returns ([N, V] last-real-token logits, cache).
     N=1 is the classic per-request path — one function serves both, so the
@@ -340,10 +359,11 @@ def _prefill_step(params: Params, cache: dict, tokens: jax.Array,  # traced
         # statically 0 on this path).
         "prefill": True,
     }
+    lr = None if lora is None else {**lora, "aidx": adapter_idx}
     logits, filled, _ = decoder_forward(params, tokens, cfg,
                                         kv_caches=scratch,
                                         attn_impl=attn_impl, mesh=mesh,
-                                        valid_len=lengths)
+                                        valid_len=lengths, lora=lr)
     ck = cache["k"].at[:, slots, :bucket].set(filled["k"])
     cv = cache["v"].at[:, slots, :bucket].set(filled["v"])
     last = logits[jnp.arange(n), lengths - 1]
@@ -369,6 +389,12 @@ class Request:
     # quotas, strict-priority dequeue, shed order under overload, and
     # cross-class preemption. Rides end-to-end on the X-Kftpu-Qos header.
     qos: str = QOS_DEFAULT
+    # Multi-tenant LoRA (serve/lora.py): the registered adapter this
+    # request decodes through (None = base model). Rides the request's
+    # model id end-to-end ("model" body field / X-Kftpu-Model header);
+    # admission acquires a packed-buffer slot (hot-loading on miss) and
+    # every release path returns the reference.
+    adapter: Optional[str] = None
     # Recompute-preemption bookkeeping (paged engine): output tokens already
     # folded back into prompt_tokens when the slot was preempted.
     resumed_from: int = 0
@@ -904,7 +930,7 @@ class LLMEngine:
         # Compiled programs: donate the cache so it mutates in place in HBM.
         on_tpu = jax.default_backend() == "tpu"
 
-        def _prefill_fn(p, c, t, s, ln):
+        def _prefill_fn(p, c, t, s, ln, lr=None, ai=None):
             # Per-bucket impl choice (shape is static per trace): measured on
             # v5e, the flash kernel overtakes fused XLA attention in the full
             # model around S≈2k (XLA wins below — matmul-dominated regime).
@@ -917,7 +943,8 @@ class LLMEngine:
                 impl = ("pallas" if on_tpu and t.shape[1] >= 2048
                         and t.shape[1] % 128 == 0 else "xla")
             out, cache = _prefill_step(p, c, t, s, ln, cfg_prefill, impl,
-                                       mesh=self.mesh)
+                                       mesh=self.mesh, lora=lr,
+                                       adapter_idx=ai)
             return out, self._pin(cache)
 
         # One jitted program serves every group size (N is a trace dim:
@@ -944,8 +971,9 @@ class LLMEngine:
                            or self.chunk_size % self.page_size):
             self.chunk_size = self.page_size
         self._prefill_chunk = jax.jit(
-            lambda p, c, t, s, st, vl: _pin2(
-                _chunk_prefill_step(p, c, t, s, st, cfg_prefill, vl),
+            lambda p, c, t, s, st, vl, lr=None, ai=None: _pin2(
+                _chunk_prefill_step(p, c, t, s, st, cfg_prefill, vl,
+                                    lora=lr, adapter_idx=ai),
                 self._pin),
             donate_argnums=(1,))
         self._chunkings: list[_Chunking] = []   # lockfree: scheduler-confined
@@ -971,12 +999,15 @@ class LLMEngine:
                     "kv_cache_dtype=int8 requires paged_attn_impl=gather "
                     "(the paged-attention kernel reads bf16 pages)")
             self._paged_chunk = jax.jit(
-                lambda p, c, t, tr, st, vl, ncp: _pin2(paged_chunk_prefill(
-                    p, c, t, tr, st, vl, cfg_prefill, context_pages=ncp),
+                lambda p, c, t, tr, st, vl, ncp, lr=None, ai=None: _pin2(
+                    paged_chunk_prefill(
+                        p, c, t, tr, st, vl, cfg_prefill, context_pages=ncp,
+                        lora=lr, adapter_idx=ai),
                     self._pin),
                 static_argnums=(6,), donate_argnums=(1,))
 
-            def _paged_decode_fn(p, c, st, tbl, key, n, m, _impl=pattn):
+            def _paged_decode_fn(p, c, st, tbl, key, n, m, lr=None,
+                                 _impl=pattn):
                 # The device-resident state dict + page table ride in as
                 # donated buffers and return advanced — the scheduler never
                 # re-uploads them (serve/device_state.py).
@@ -986,7 +1017,8 @@ class LLMEngine:
                         p, cache_in, st["tokens"], st["lengths"],
                         st["live"], st["temps"], st["top_k"], st["top_p"],
                         st["stops"], st["budgets"], key, cfg_decode, n,
-                        sample_mode=m, attn_impl=_impl)
+                        sample_mode=m, attn_impl=_impl,
+                        lora=lr, adapter_idx=st["adapter"])
                 table = cache.pop("table")
                 st = {**st, "tokens": tokens, "lengths": lengths,
                       "live": live, "budgets": budgets}
@@ -1057,7 +1089,8 @@ class LLMEngine:
                 migrate_batch_pages=int(b.kv_migrate_batch_pages),
                 copy_pages_fn=self._kv_copy_pages,
                 upload_pages_fn=self._kv_upload_pages,
-                fetch_pages_fn=self._kv_fetch_pages)
+                fetch_pages_fn=self._kv_fetch_pages,
+                pressure_fn=self._kv_pressure)
             # Pre-warm the COW-copy trace (a tail copy is always one
             # pow2-padded pair, so this ONE trace covers every live
             # COW): the first mid-traffic divergence must not show up
@@ -1073,11 +1106,12 @@ class LLMEngine:
         self.decode_steps = max(1, int(b.decode_steps))
         self.prefill_interleave_steps = max(1, int(b.prefill_interleave_steps))
 
-        def _decode_fn(p, c, st, key, n, m):
+        def _decode_fn(p, c, st, key, n, m, lr=None):
             out, cache, tokens, lengths, live, budgets = _decode_multi(
                 p, c, st["tokens"], st["lengths"], st["live"], st["temps"],
                 st["top_k"], st["top_p"], st["stops"], st["budgets"], key,
-                cfg_decode, n, sample_mode=m)
+                cfg_decode, n, sample_mode=m,
+                lora=lr, adapter_idx=st["adapter"])
             st = {**st, "tokens": tokens, "lengths": lengths, "live": live,
                   "budgets": budgets}
             return out, self._pin(cache), st
@@ -1171,6 +1205,24 @@ class LLMEngine:
                 c //= 2
             self._draft_chunk = max(c, 1)
 
+        # Multi-tenant LoRA adapters (serve/lora.py): the registry owns
+        # the packed per-target A/B device buffers and the LRU hot-load/
+        # evict slot lifecycle; per-engine-slot assignments below map each
+        # running request to its packed slot for the batched dispatch.
+        self._lora = None            # lockfree: scheduler-confined (buffers)
+        self._slot_aidx = [-1] * self.num_slots    # lockfree: scheduler-confined
+        self._slot_aname: list[Optional[str]] = [  # lockfree: scheduler-confined
+            None] * self.num_slots
+        if b.lora.max_adapters:
+            if self.mesh is not None:
+                raise ValueError(
+                    "lora.max_adapters is not supported in mesh "
+                    "(tensor-parallel) mode yet")
+            from kubeflow_tpu.serve.lora import AdapterRegistry
+
+            self._lora = AdapterRegistry(
+                cfg, max_adapters=int(b.lora.max_adapters),
+                rank=int(b.lora.rank), targets=tuple(b.lora.targets))
         self.slots: list[Optional[_Slot]] = [None] * self.num_slots  # lockfree: scheduler-confined
         # Device-resident scheduler state (serve/device_state.py): the
         # decode dispatch's [B] carries and the paged page table live on
@@ -1305,7 +1357,8 @@ class LLMEngine:
                request_id: Optional[str] = None, *,
                deadline: Optional[float] = None,
                trace_parent=None, qos: str = QOS_DEFAULT,
-               handoff: Optional[bool] = None) -> Request:
+               handoff: Optional[bool] = None,
+               adapter: Optional[str] = None) -> Request:
         if not prompt_tokens:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.max_len:
@@ -1314,6 +1367,22 @@ class LLMEngine:
         if qos not in QOS_PRIORITY:
             raise ValueError(
                 f"unknown QoS class {qos!r}; known: {sorted(QOS_PRIORITY)}")
+        if adapter is not None:
+            # Unknown model ids fail HERE, at the door (the protocol
+            # layers map KeyError to HTTP 404 / gRPC NOT_FOUND) — the
+            # scheduler only ever sees registered adapters. Hot-loading
+            # happens at admission, on the scheduler thread.
+            if self._lora is None:
+                raise KeyError(
+                    f"unknown model {adapter!r}: this engine serves no "
+                    "adapters (lora.max_adapters=0)")
+            if not self._lora.known(adapter):
+                raise KeyError(
+                    f"unknown model {adapter!r}: adapter not registered")
+            if handoff:
+                raise ValueError(
+                    "adapter requests cannot hand off (adapter KV has "
+                    "no cross-engine placement contract)")
         pol = self.qos_policies.get(qos)
         if pol is not None and pol.max_queue \
                 and self.class_queue_depth(qos) >= pol.max_queue:
@@ -1347,7 +1416,7 @@ class LLMEngine:
                       params=params or SamplingParams(),
                       id=request_id or f"req-{next(self._id_gen)}",
                       deadline=deadline, trace_parent=trace_parent, qos=qos,
-                      handoff_requested=wants_handoff)
+                      handoff_requested=wants_handoff, adapter=adapter)
         _span_open(req, "engine.queued", prompt_tokens=len(prompt_tokens),
                    qos=qos)
         self.waiting.put(req)
@@ -1533,6 +1602,7 @@ class LLMEngine:
                     # match skips straight back here.
                     self._kv_register(req.prompt_tokens, slot_idx, ch.pos)
                     self._release_slot_pages(slot_idx)
+                    self._release_slot_adapter(slot_idx)
                     self._preempted.append(req)
                     self.metrics.note_preempted(req.qos)
                 return 0    # otherwise retry next scheduler step
@@ -1545,14 +1615,30 @@ class LLMEngine:
             from kubeflow_tpu.serve.paged import context_bucket
 
             ctx = context_bucket(ch.pos, C, self.page_size, self._mpp)
-            logits, self.cache = self._paged_chunk(
-                self.params, self.cache, jnp.asarray(chunk),
-                jnp.asarray(self._table[slot_idx]), jnp.int32(ch.pos),
-                jnp.int32(real), ctx)
+            if self._lora is not None:
+                logits, self.cache = self._paged_chunk(
+                    self.params, self.cache, jnp.asarray(chunk),
+                    jnp.asarray(self._table[slot_idx]), jnp.int32(ch.pos),
+                    jnp.int32(real), ctx, self._lora.buffers,
+                    jnp.asarray(np.asarray([self._slot_aidx[slot_idx]],
+                                           np.int32)))
+            else:
+                logits, self.cache = self._paged_chunk(
+                    self.params, self.cache, jnp.asarray(chunk),
+                    jnp.asarray(self._table[slot_idx]), jnp.int32(ch.pos),
+                    jnp.int32(real), ctx)
         else:
-            logits, self.cache = self._prefill_chunk(
-                self.params, self.cache, jnp.asarray(chunk),
-                jnp.int32(slot_idx), jnp.int32(ch.pos), jnp.int32(real))
+            if self._lora is not None:
+                logits, self.cache = self._prefill_chunk(
+                    self.params, self.cache, jnp.asarray(chunk),
+                    jnp.int32(slot_idx), jnp.int32(ch.pos),
+                    jnp.int32(real), self._lora.buffers,
+                    jnp.asarray(np.asarray([self._slot_aidx[slot_idx]],
+                                           np.int32)))
+            else:
+                logits, self.cache = self._prefill_chunk(
+                    self.params, self.cache, jnp.asarray(chunk),
+                    jnp.int32(slot_idx), jnp.int32(ch.pos), jnp.int32(real))
         ch.pos += real
         if ch.pos >= plen:
             self._chunkings.remove(ch)
@@ -1625,6 +1711,7 @@ class LLMEngine:
                     # references intact either way).
                     self._kv_register(self._context_tokens(s), i, s.length)
                 self._release_slot_pages(i)
+                self._release_slot_adapter(i)
                 self.slots[i] = None
                 # Host-only decision (cancel/deadline): the device still
                 # thinks the row is live — sync live=False next dispatch;
@@ -1637,6 +1724,7 @@ class LLMEngine:
             if reason:
                 self._chunkings.remove(ch)
                 self._release_slot_pages(ch.slot)
+                self._release_slot_adapter(ch.slot)
                 self._fail_request(ch.request, reason)
                 n += 1
         # Handoff holds: pages backing an exported payload whose request
@@ -1764,6 +1852,13 @@ class LLMEngine:
                 self._adopt_handoff(req, slot_idx)
                 n += 1
                 continue
+            adapter_hot = self._assign_adapter(req, slot_idx)
+            if adapter_hot is None:
+                # Adapter-slot backpressure: every packed slot is
+                # referenced by a live request — requeue at the FRONT
+                # and stop admitting until one drains (the page-
+                # exhaustion discipline, for the adapter buffer).
+                break
             if self.paged:
                 # Paged admission is always chunked; the prefix index
                 # trims the work to the uncached tail (radix: live COW
@@ -1771,6 +1866,13 @@ class LLMEngine:
                 pages, covered = self._kv_match(req)
                 if req.trace_parent is not None:
                     _span_close(req)       # queued →
+                    if adapter_hot:
+                        # The admission hot-loaded its adapter: surface
+                        # the registry pull + packed-buffer scatter as a
+                        # first-class phase on the trace.
+                        _span_open(req, "engine.adapter_load",
+                                   adapter=req.adapter)
+                        _span_close(req)
                     tier = self._kvtier
                     if tier is not None and (tier.last_promoted
                                              or tier.last_cow_tokens):
@@ -1796,6 +1898,10 @@ class LLMEngine:
                 # queued → prefill (covers both fresh admissions and
                 # preempted-lane resumes, which skip _note_admitted).
                 _span_close(req)
+                if adapter_hot:
+                    _span_open(req, "engine.adapter_load",
+                               adapter=req.adapter)
+                    _span_close(req)
                 _span_open(req, "engine.prefill")
             plen = len(req.prompt_tokens)
             C = self.chunk_size
@@ -1860,14 +1966,22 @@ class LLMEngine:
                 toks = np.zeros((take, bucket), np.int32)
                 slots = np.zeros((take,), np.int32)
                 plens = np.zeros((take,), np.int32)
+                aidxs = np.full((take,), -1, np.int32)
                 for j, (req, slot_idx, plen, _) in enumerate(group):
                     toks[j, :plen] = req.prompt_tokens
                     slots[j] = slot_idx
                     plens[j] = plen
+                    aidxs[j] = self._slot_aidx[slot_idx]
                 try:
-                    last_logits, self.cache = self._prefill(
-                        self.params, self.cache, jnp.asarray(toks),
-                        jnp.asarray(slots), jnp.asarray(plens))
+                    if self._lora is not None:
+                        last_logits, self.cache = self._prefill(
+                            self.params, self.cache, jnp.asarray(toks),
+                            jnp.asarray(slots), jnp.asarray(plens),
+                            self._lora.buffers, jnp.asarray(aidxs))
+                    else:
+                        last_logits, self.cache = self._prefill(
+                            self.params, self.cache, jnp.asarray(toks),
+                            jnp.asarray(slots), jnp.asarray(plens))
                     self._sample_first_batch(
                         [(req, slot_idx, plen, None)
                          for req, slot_idx, plen, _ in group],
@@ -1886,10 +2000,14 @@ class LLMEngine:
         """Mid-flush failure cleanup: fail the dispatched-but-broken group's
         requests (their engine-side state is unknown — retrying could
         double-write KV) and requeue everything never dispatched."""
-        for req, _, _, _ in failed_group:
+        for req, slot_idx, _, _ in failed_group:
+            self._release_slot_adapter(slot_idx)
             self._fail_request(req, "error")
         # FRONT of the backlog, original arrival order: they were admitted
-        # once already — nothing may overtake them now.
+        # once already — nothing may overtake them now (re-admission
+        # re-acquires their adapter references, released here).
+        for item in requeue_items:
+            self._release_slot_adapter(item[1])
         self._backlog[:0] = [item[0] for item in requeue_items]
 
     # -- disaggregated handoff (serve/handoff.py) ------------------------------
@@ -2122,16 +2240,20 @@ class LLMEngine:
 
     def _kv_register(self, tokens, slot_idx: int, n_tokens: int) -> None:
         """Index ``tokens[:n_tokens]``'s written KV for cross-request
-        reuse (radix) or hash the full-page prompt prefix (flat)."""
+        reuse (radix) or hash the full-page prompt prefix (flat) — in
+        the slot occupant's adapter NAMESPACE: KV content is a function
+        of (tokens, model variant), so tenants never share pages."""
         if self._allocator is None or n_tokens <= 0:
             return
+        ns = self._slot_namespace(slot_idx)
         if self._kvtier is not None:
             self._kvtier.insert(tokens, self._slot_pages[slot_idx],
-                                n_tokens)
+                                n_tokens, namespace=ns)
         else:
             self._allocator.register_prefix(
                 list(tokens)[:n_tokens],
-                self._slot_pages[slot_idx][:n_tokens // self.page_size])
+                self._slot_pages[slot_idx][:n_tokens // self.page_size],
+                namespace=ns)
 
     def _kv_match(self, req: Request, *, allow_cow: bool = True
                   ) -> tuple[list[int], int]:
@@ -2139,12 +2261,88 @@ class LLMEngine:
         by the request, tokens covered). Radix: live COW sharing +
         host-tier promotion, possibly sub-page. Flat: the legacy
         full-page chained-hash hit."""
+        ns = req.adapter or ""
         if self._kvtier is not None:
             pages, covered = self._kvtier.match_and_acquire(
-                req.prompt_tokens, owner=req.id, allow_cow=allow_cow)
+                req.prompt_tokens, owner=req.id, allow_cow=allow_cow,
+                namespace=ns)
             return pages, covered
-        hit = self._allocator.match_prefix(req.prompt_tokens, owner=req.id)
+        hit = self._allocator.match_prefix(req.prompt_tokens, owner=req.id,
+                                           namespace=ns)
         return list(hit), len(hit) * self.page_size
+
+    # -- multi-tenant LoRA bookkeeping (serve/lora.py) -------------------------
+
+    def _assign_adapter(self, req: Request,
+                        slot_idx: int) -> Optional[bool]:
+        """Bind ``req``'s adapter (if any) to the engine slot: acquire a
+        packed-buffer slot reference, hot-loading on miss. Returns the
+        hot-load flag (False = already resident, or base traffic), or
+        None when every adapter slot is referenced — the caller requeues
+        the request at the backlog FRONT (admission backpressure)."""
+        if req.adapter is None or self._lora is None:
+            self._slot_aidx[slot_idx] = -1
+            self._slot_aname[slot_idx] = None
+            return False
+        from kubeflow_tpu.serve.lora import AdapterSlotsExhausted
+
+        try:
+            aidx, hot = self._lora.acquire(req.adapter, owner=req.id)
+        except AdapterSlotsExhausted:
+            self._backlog.insert(0, req)
+            return None
+        self._slot_aidx[slot_idx] = aidx
+        self._slot_aname[slot_idx] = req.adapter
+        return hot
+
+    def _release_slot_adapter(self, slot_idx: int) -> None:
+        """Return the engine slot's adapter reference (every slot-free
+        path calls this exactly once — the refcount sanitizer audits the
+        balance per owner)."""
+        name = self._slot_aname[slot_idx]
+        if name is None:
+            return
+        self._lora.release(name)
+        self._slot_aname[slot_idx] = None
+        self._slot_aidx[slot_idx] = -1
+
+    def _slot_namespace(self, slot_idx: int) -> str:
+        """KV-content namespace of the slot's occupant ("" = base): the
+        prefix index keys each adapter's KV apart — same prompt under
+        two adapters must never share pages."""
+        return self._slot_aname[slot_idx] or ""
+
+    def _kv_pressure(self) -> float:
+        """Demotion-urgency ratio for the KV tier (>= 1.0 = urgent).
+        Folds the classic pool-occupancy rule with the queue-delay-vs-
+        budget ratio (the SAME p95 the SLO autoscaler scrapes off
+        /metrics) and adapter hot-load backpressure — when a new tenant
+        is waiting on an adapter slot, or admissions already run past
+        their delay budget, cold KV should spill to host NOW rather
+        than fight the hot-load for HBM headroom."""
+        alloc = self._allocator
+        quarter = alloc.num_pages // 4
+        pool = quarter / max(alloc.available(), 1)
+        qd = 0.0
+        if self.queue_delay_budget:
+            snap = self.metrics.snapshot()
+            qd = (snap.get("queue_delay_p95_ms", 0.0) / 1e3
+                  / self.queue_delay_budget)
+        lora = getattr(self, "_lora", None)
+        adapter = 1.0 if (lora is not None and self._backlog
+                          and lora.pending_pressure()) else 0.0
+        return max(pool, qd, adapter)
+
+    def adapters_resident(self) -> list[str]:
+        """Adapters currently hot in the packed buffers — the
+        ``kftpu_engine_adapters_resident`` gauge's label set (the
+        model-id router's placement signal)."""
+        return [] if self._lora is None else self._lora.resident()
+
+    def adapter_stats(self) -> dict:
+        """Registry lifecycle counters (empty dict on LoRA-free
+        engines) — the /metrics adapter series' source."""
+        return {} if self._lora is None else self._lora.snapshot()
 
     # -- paged bookkeeping -----------------------------------------------------
 
@@ -2210,6 +2408,7 @@ class LLMEngine:
             + req.output_tokens[req.resumed_from:]
         req.resumed_from = len(req.output_tokens)
         self._release_slot_pages(idx)
+        self._release_slot_adapter(idx)
         self.slots[idx] = None
         self._dstate.mark_slot(idx)
         self._preempted.append(req)
@@ -2270,6 +2469,7 @@ class LLMEngine:
             self._kv_register(req.prompt_tokens, ch.slot, ch.pos)
         self._chunkings.remove(ch)
         self._release_slot_pages(ch.slot)
+        self._release_slot_adapter(ch.slot)
         self._preempted.append(req)
         self.metrics.note_preempted(req.qos)
         return True
@@ -2327,6 +2527,7 @@ class LLMEngine:
                 # through prompt AND history, partial tail included.
                 self._kv_register(self._context_tokens(s), idx, s.length)
             self._release_slot_pages(idx)
+        self._release_slot_adapter(idx)
         self.slots[idx] = None
         return True
 
@@ -2370,7 +2571,7 @@ class LLMEngine:
         budget = max(p.max_new_tokens - s.generated, 0)
         return (s.last_token, s.length, budget > 0, p.temperature, p.top_k,
                 p.top_p, -1 if p.stop_token is None else p.stop_token,
-                budget)
+                budget, self._slot_aidx[idx])
 
     def _sync_decode_state(self) -> None:  # hot-loop
         """Flush host scheduler deltas (admissions, reaps, preemptions,
@@ -2433,14 +2634,25 @@ class LLMEngine:
         self.metrics.note_dispatch_depth(len(self._rounds))
         key = self._next_key()
         if self.paged:
-            out, self.cache, st, tbl = self._paged_decode_n(
-                self.params, self.cache, self._dstate.arrays,
-                self._dstate.table, key, k_steps, mode)
+            if self._lora is not None:
+                out, self.cache, st, tbl = self._paged_decode_n(
+                    self.params, self.cache, self._dstate.arrays,
+                    self._dstate.table, key, k_steps, mode,
+                    self._lora.buffers)
+            else:
+                out, self.cache, st, tbl = self._paged_decode_n(
+                    self.params, self.cache, self._dstate.arrays,
+                    self._dstate.table, key, k_steps, mode)
             self._dstate.adopt(st, tbl)
         else:
-            out, self.cache, st = self._decode_n(
-                self.params, self.cache, self._dstate.arrays, key, k_steps,
-                mode)
+            if self._lora is not None:
+                out, self.cache, st = self._decode_n(
+                    self.params, self.cache, self._dstate.arrays, key,
+                    k_steps, mode, self._lora.buffers)
+            else:
+                out, self.cache, st = self._decode_n(
+                    self.params, self.cache, self._dstate.arrays, key, k_steps,
+                    mode)
             self._dstate.adopt(st)
         self.decode_rounds += 1
         self._rounds.append(_InflightRound(

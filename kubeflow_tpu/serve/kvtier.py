@@ -138,7 +138,8 @@ class RadixPrefixIndex:
                  scan_interval_s: Optional[float] = None,
                  copy_pages_fn: Optional[Callable] = None,
                  upload_pages_fn: Optional[Callable] = None,
-                 fetch_pages_fn: Optional[Callable] = None):
+                 fetch_pages_fn: Optional[Callable] = None,
+                 pressure_fn: Optional[Callable[[], float]] = None):
         self._allocator = allocator
         self.page_size = int(page_size)
         self.host_pages = max(0, int(host_pages))
@@ -150,7 +151,17 @@ class RadixPrefixIndex:
         self._copy_pages = copy_pages_fn
         self._upload_pages = upload_pages_fn
         self._fetch_pages = fetch_pages_fn
-        self._root = _Node((), None, None)
+        # Demotion-urgency signal (ROADMAP item 1 remaining upside → the
+        # ISSUE 14 en-passant fix): a callable returning a pressure ratio
+        # — >= 1.0 means "memory is about to be reclaimed destructively,
+        # demote NOW even while foreground work runs". The engine folds
+        # pool occupancy, its queue-delay-vs-budget ratio (the SAME
+        # signal the SLO autoscaler scrapes off /metrics), and adapter
+        # hot-load backpressure into it, so KV demotion and adapter
+        # loads stop fighting over the same HBM headroom under pressure.
+        # None = the classic pool-occupancy-only rule.
+        self._pressure_fn = pressure_fn
+        self._roots: dict[str, _Node] = {"": _Node((), None, None)}
         self._by_page: dict[int, _Node] = {}  # lockfree: scheduler-confined
         # Tier transitions + host accounting cross the migration-thread
         # seam; everything below shares one reentrant lock (reentrant:
@@ -180,6 +191,15 @@ class RadixPrefixIndex:
 
     # -- observability -------------------------------------------------------
 
+    def pressure(self) -> float:
+        """Current demotion-urgency ratio (>= 1.0 = urgent). The default
+        reproduces the classic rule exactly: pressure hits 1.0 when
+        free+cached pages fall to a quarter of the pool."""
+        if self._pressure_fn is not None:
+            return float(self._pressure_fn())
+        quarter = self._allocator.num_pages // 4
+        return quarter / max(self._allocator.available(), 1)
+
     def host_pages_resident(self) -> int:
         with self._lock:
             return self._host_count
@@ -193,9 +213,21 @@ class RadixPrefixIndex:
 
     # -- match (admission path) ----------------------------------------------
 
+    def root(self, namespace: str = "") -> _Node:
+        """The radix root of one KV namespace. Multi-tenant LoRA serving
+        namespaces the index per adapter (serve/lora.py): KV content is a
+        function of (tokens, model variant), so the same prompt under two
+        adapters must never share pages — separate roots make the
+        isolation structural rather than checked."""
+        node = self._roots.get(namespace)
+        if node is None:
+            node = self._roots[namespace] = _Node((), None, None)
+        return node
+
     def match_and_acquire(self, tokens: Sequence[int],
                           owner: Optional[str] = None, *,
-                          allow_cow: bool = True) -> tuple[list[int], int]:
+                          allow_cow: bool = True,
+                          namespace: str = "") -> tuple[list[int], int]:
         """Longest shared prefix of ``tokens``, capped one token short
         (the first sampled token needs real last-token logits — the same
         cap the flat ``match_prefix`` applies). Returns ``(pages,
@@ -219,7 +251,7 @@ class RadixPrefixIndex:
         self.last_cow_tokens = 0
         try:
             return self._match_locked(tokens, owner, allow_cow, pg, cap,
-                                      pages, promote)
+                                      pages, promote, namespace)
         except Exception as exc:
             # Balance the books and miss: every acquired page holds
             # exactly one of our references, and a promoted node whose
@@ -235,7 +267,7 @@ class RadixPrefixIndex:
             return [], 0
 
     def _match_locked(self, tokens, owner, allow_cow, pg, cap,
-                      pages, promote) -> tuple[list[int], int]:
+                      pages, promote, namespace="") -> tuple[list[int], int]:
         from kubeflow_tpu.serve.paged import PagePoolExhausted
 
         with self._lock:
@@ -245,7 +277,7 @@ class RadixPrefixIndex:
             self._allocator.stats["prefix_queries"] += 1
             now = time.monotonic()
             covered = 0
-            node = self._root
+            node = self.root(namespace)
             while covered + pg <= cap:
                 child = node.children.get(tuple(tokens[covered:covered + pg]))
                 if child is None or child.tier == TIER_MIGRATING \
@@ -345,7 +377,8 @@ class RadixPrefixIndex:
     # -- registration --------------------------------------------------------
 
     def insert(self, tokens: Sequence[int], pages: Sequence[int],
-               n_tokens: Optional[int] = None) -> None:
+               n_tokens: Optional[int] = None, *,
+               namespace: str = "") -> None:
         """Index ``tokens[:n_tokens]``'s KV: full blocks become (or
         confirm) tree nodes pointing at the registering slot's pages, a
         sub-page remainder becomes (or extends) a partial leaf. Existing
@@ -359,7 +392,7 @@ class RadixPrefixIndex:
                                                             len(tokens))
         with self._lock:
             now = time.monotonic()
-            node = self._root
+            node = self.root(namespace)
             nfull = n_tokens // pg
             for i in range(min(nfull, len(pages))):
                 blk = tuple(tokens[i * pg:(i + 1) * pg])
@@ -479,7 +512,8 @@ class RadixPrefixIndex:
 
     def _iter_nodes(self):
         # requires_lock: _lock
-        stack = list(self._root.children.values()) + self._root.partials
+        stack = [n for r in self._roots.values()
+                 for n in list(r.children.values()) + r.partials]
         while stack:
             n = stack.pop()
             yield n
@@ -509,13 +543,17 @@ class RadixPrefixIndex:
         now = time.monotonic() if now is None else now
         if now - self._last_scan < self._scan_interval:
             return 0
-        # Pressure demotion: when free+cached pages run low, the LRU
-        # eviction path is about to DESTROY cached content — demote it
-        # to host first, age threshold be damned. Otherwise only
-        # genuinely cold pages move, and never while foreground work
-        # would queue behind the bookkeeping.
-        urgent = self._allocator.available() \
-            <= self._allocator.num_pages // 4
+        # Pressure demotion: when memory is about to be reclaimed
+        # destructively (LRU eviction would DESTROY cached content),
+        # demote to host first, age threshold be damned. The pressure
+        # signal is pluggable (pressure_fn >= 1.0 = urgent): the engine
+        # folds pool occupancy with its queue-delay-vs-budget ratio and
+        # adapter hot-load backpressure, so demotion urgency tracks the
+        # same signals the SLO autoscaler acts on instead of only the
+        # free-list level. Otherwise only genuinely cold pages move,
+        # and never while foreground work would queue behind the
+        # bookkeeping.
+        urgent = self.pressure() >= 1.0
         if busy and not urgent:
             return 0
         self._last_scan = now

@@ -247,16 +247,22 @@ class PageAllocator:
     # -- prefix caching ----------------------------------------------------
 
     @staticmethod
-    def chain_keys(tokens: Sequence[int], page_size: int) -> list[tuple]:
-        """Chained content keys for every FULL page of ``tokens``."""
-        keys, parent = [], ()
+    def chain_keys(tokens: Sequence[int], page_size: int,
+                   namespace: str = "") -> list[tuple]:
+        """Chained content keys for every FULL page of ``tokens``.
+        ``namespace`` salts the chain root: KV content depends on the
+        model VARIANT that computed it, so multi-tenant LoRA serving
+        keys each adapter's pages apart (same prompt, different
+        adapter → different KV → must never cross-match)."""
+        keys, parent = [], (namespace,) if namespace else ()
         for i in range(len(tokens) // page_size):
             parent = (hash((parent, tuple(tokens[i * page_size:(i + 1) * page_size]))),)
             keys.append(parent)
         return keys
 
     def match_prefix(self, tokens: Sequence[int],
-                     owner: Optional[str] = None) -> list[int]:
+                     owner: Optional[str] = None,
+                     namespace: str = "") -> list[int]:
         """Longest run of cached pages for ``tokens``' full-page prefix
         (capped so at least one prompt token remains to prefill — the first
         sampled token needs real last-token logits). Bumps refs on the hit
@@ -266,7 +272,8 @@ class PageAllocator:
         self.stats["prefix_queries"] += 1
         max_reuse = (len(tokens) - 1) // self.page_size
         hit: list[int] = []
-        for key in self.chain_keys(tokens, self.page_size)[:max_reuse]:
+        for key in self.chain_keys(tokens, self.page_size,
+                                   namespace)[:max_reuse]:
             page = self._by_key.get(key)
             if page is None:
                 break
@@ -277,12 +284,14 @@ class PageAllocator:
         return hit
 
     def register_prefix(self, tokens: Sequence[int],
-                        pages: Sequence[int]) -> None:
+                        pages: Sequence[int],
+                        namespace: str = "") -> None:
         """Hash ``pages`` as holding ``tokens``' full-page prefixes (called
         after the KV is actually written)."""
         if not self.prefix_caching:
             return
-        for key, page in zip(self.chain_keys(tokens, self.page_size), pages):
+        for key, page in zip(self.chain_keys(tokens, self.page_size,
+                                             namespace), pages):
             old = self._by_key.get(key)
             if old is not None and old != page:
                 continue     # first writer wins; duplicates just aren't hashed
@@ -306,7 +315,7 @@ def paged_gather(pool: jax.Array, table: jax.Array) -> jax.Array:  # traced
 
 def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,  # traced
                         table, cfg: DecoderConfig, attn_impl: str = "gather",
-                        pool_ks=None, pool_vs=None):
+                        pool_ks=None, pool_vs=None, lora=None):
     """One transformer block for a [B,1] decode step against the page pool.
     Mirrors engine._decode_block; only the KV residency differs.
 
@@ -328,6 +337,12 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,  # trac
     q = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", h, bp["attn"]["wv"].astype(dt))
+    if lora is not None:
+        # Multi-adapter decode (serve/lora.py): per-row low-rank deltas
+        # on the shared projections; adapter_idx = -1 rows add exact 0.
+        q = L.apply_lora_layer(lora, "wq", h, q)
+        k = L.apply_lora_layer(lora, "wk", h, k)
+        v = L.apply_lora_layer(lora, "wv", h, v)
     q = L.rope(q, positions, cfg.rope_theta)
     k = L.rope(k, positions, cfg.rope_theta)
     # Write position -> (page, offset); dead rows (and unmapped pages) aim
@@ -366,7 +381,11 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,  # trac
             ck = paged_gather(nk, table)
             cv = paged_gather(nv, table)
             attn = _decode_attention(q, ck, cv, lengths, cfg)
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
+    proj = jnp.einsum("bshk,hkd->bsd", attn, bp["attn"]["wo"].astype(dt))
+    if lora is not None and "wo" in lora["targets"]:
+        proj = L.apply_lora_layer(
+            lora, "wo", attn.reshape(attn.shape[0], 1, -1), proj)
+    x = x + proj
     h = L.rmsnorm(x, bp["ln2"], cfg)
     if cfg.is_moe:
         mlp_out, _ = L.moe_block(bp["mlp"], h, cfg)
@@ -377,7 +396,8 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,  # trac
 
 def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,  # traced
                        lengths: jax.Array, live: jax.Array,
-                       cfg: DecoderConfig, attn_impl: str = "gather"):
+                       cfg: DecoderConfig, attn_impl: str = "gather",
+                       lora=None):
     """One [B,1] decode step over the page pool (≈ engine._decode_step)."""
     dt = cfg.activation_dtype
     kv_quant = "ks" in cache
@@ -386,28 +406,30 @@ def _paged_decode_step(params: Params, cache: dict, tokens: jax.Array,  # traced
         x = x * jnp.asarray(cfg.hidden ** 0.5, dt)
     positions = lengths[:, None]
     table = cache["table"]
+    lora_xs = L.slice_layers(lora)
 
     if kv_quant:
         def body(x, scan_in):
-            bp, pk, pv, pks, pvs = scan_in
+            bp, pk, pv, pks, pvs, lsl = scan_in
             x, nk, nv, nks, nvs = _paged_decode_block(
                 bp, x, positions, lengths, live, pk, pv, table, cfg,
-                attn_impl=attn_impl, pool_ks=pks, pool_vs=pvs)
+                attn_impl=attn_impl, pool_ks=pks, pool_vs=pvs,
+                lora=L.layer_view(lora, lsl))
             return x, (nk, nv, nks, nvs)
 
         x, scanned = jax.lax.scan(
             body, x, (params["layers"], cache["k"], cache["v"],
-                      cache["ks"], cache["vs"]))
+                      cache["ks"], cache["vs"], lora_xs))
     else:
         def body(x, scan_in):
-            bp, pk, pv = scan_in
+            bp, pk, pv, lsl = scan_in
             x, nk, nv, _, _ = _paged_decode_block(
                 bp, x, positions, lengths, live, pk, pv, table, cfg,
-                attn_impl=attn_impl)
+                attn_impl=attn_impl, lora=L.layer_view(lora, lsl))
             return x, (nk, nv)
 
         x, scanned = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+            body, x, (params["layers"], cache["k"], cache["v"], lora_xs))
     nk, nv = scanned[0], scanned[1]
     x = L.rmsnorm(x, params["final_norm"], cfg)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
@@ -426,7 +448,8 @@ def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
                        top_k: jax.Array, top_p: jax.Array,
                        stop_tokens: jax.Array, budgets: jax.Array,
                        key: jax.Array, cfg: DecoderConfig, num_steps: int,
-                       sample_mode: str = "full", attn_impl: str = "gather"):
+                       sample_mode: str = "full", attn_impl: str = "gather",
+                       lora=None, adapter_idx=None):
     """Up to ``num_steps`` decode+sample steps in ONE dispatch over the page
     pool (≈ engine._decode_multi; the host pre-allocates pages covering
     ``lengths + num_steps`` so mid-dispatch page-boundary crossings always
@@ -441,6 +464,8 @@ def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
     pg = cache["k"].shape[2]
     max_len = mpp * pg
     out0 = jnp.full((b, num_steps), -1, jnp.int32)
+    lr = (None if lora is None
+          else {**lora, "aidx": adapter_idx})
 
     def cond(carry):
         i, _, _, _, live, _, _, _ = carry
@@ -449,7 +474,8 @@ def paged_decode_multi(params: Params, cache: dict, tokens: jax.Array,  # traced
     def body(carry):
         i, cache, tokens, lengths, live, budgets, key, out = carry
         logits, cache = _paged_decode_step(params, cache, tokens, lengths,
-                                           live, cfg, attn_impl=attn_impl)
+                                           live, cfg, attn_impl=attn_impl,
+                                           lora=lr)
         key, sub = jax.random.split(key)
         sampled = _sample_batch(logits, sub, temps, top_k, top_p,
                                 mode=sample_mode)
@@ -503,7 +529,8 @@ def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,  # trace
                         table_row: jax.Array, start: jax.Array,
                         valid_len: jax.Array, cfg: DecoderConfig,
                         attn_impl: str = "xla",
-                        context_pages: Optional[int] = None):
+                        context_pages: Optional[int] = None,
+                        lora=None, adapter_idx=None):
     """Prefill ONE chunk (``tokens`` [1,C], positions [start, start+C)) of a
     slot whose pages are ``table_row`` [mpp]; the chunk's K/V scatters back
     per token as (page, offset) writes off the table row — exactly the
@@ -554,9 +581,10 @@ def paged_chunk_prefill(params: Params, cache: dict, tokens: jax.Array,  # trace
     pad = [(0, 0), (0, 0), (0, c), (0, 0), (0, 0)]
     caches = {"k": jnp.pad(row_k, pad), "v": jnp.pad(row_v, pad),
               "len": start}
+    lr = None if lora is None else {**lora, "aidx": adapter_idx}
     logits, filled, _ = decoder_forward(params, tokens, cfg, kv_caches=caches,
                                         attn_impl=attn_impl,
-                                        valid_len=valid_len)
+                                        valid_len=valid_len, lora=lr)
     # Scatter the chunk's tokens back into the pool per (page, offset):
     # position start+i lands on table_row[(start+i)//pg] at offset
     # (start+i)%pg. Invalid rows (past valid_len, or an unmapped/-1 page)

@@ -28,14 +28,27 @@ logger = logging.getLogger("kubeflow_tpu.serve")
 
 def estimate_model_bytes(cfg: DecoderConfig, batching=None) -> int:
     """Weights (param dtype) + the engine's slot KV cache (often dominant
-    for small models at long max_seq_len)."""
+    for small models at long max_seq_len) + the packed LoRA adapter
+    buffers when the engine serves multi-tenant adapters (serve/lora.py
+    — max_adapters slots of rank-r A/B factors per target)."""
     param_bytes = cfg.num_params() * cfg.weight_dtype.itemsize
     kv_bytes = 0
+    lora_bytes = 0
     if batching is not None:
         kv_bytes = (2 * cfg.n_layers * batching.max_batch_size
                     * batching.max_seq_len * cfg.n_kv_heads * cfg.head_dim
                     * cfg.activation_dtype.itemsize)
-    return int(param_bytes * 1.1) + kv_bytes
+        lora = getattr(batching, "lora", None)
+        if lora is not None and lora.max_adapters:
+            from kubeflow_tpu.serve.lora import target_dims
+
+            per_slot = sum(
+                (din + dout) * lora.rank
+                for din, dout in (target_dims(cfg, t)
+                                  for t in lora.targets))
+            lora_bytes = (cfg.n_layers * lora.max_adapters * per_slot
+                          * cfg.activation_dtype.itemsize)
+    return int(param_bytes * 1.1) + kv_bytes + lora_bytes
 
 
 @dataclasses.dataclass
@@ -95,8 +108,20 @@ class ModelRepository:
 
     def index(self) -> list[dict[str, Any]]:
         with self._lock:
-            return [{"name": e.name, "state": e.state,
-                     "bytes": e.bytes} for e in self._entries.values()]
+            entries = list(self._entries.values())
+        out = []
+        for e in entries:
+            row: dict[str, Any] = {"name": e.name, "state": e.state,
+                                   "bytes": e.bytes}
+            # Multi-tenant LoRA: surface the loaded engine's hot
+            # adapters so the repository index shows which VARIANTS
+            # this replica can serve without a hot-load.
+            engine = e.engine
+            if engine is not None and getattr(engine, "_lora", None) \
+                    is not None:
+                row["adapters_resident"] = engine.adapters_resident()
+            out.append(row)
+        return out
 
     def peek(self, name: str) -> Optional[ModelEntry]:
         """Entry without loading or touching LRU recency (metadata/metrics)."""

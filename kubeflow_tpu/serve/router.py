@@ -52,7 +52,8 @@ from typing import Optional
 # (core/headers.py); DEADLINE_HEADER/QOS_HEADER are re-exported here for
 # the router's historical importers (scripts, tests, grpc_server).
 from kubeflow_tpu.core.headers import (
-    DEADLINE_HEADER, DECODE_BACKEND_HEADER, QOS_HEADER, TRACE_HEADER,
+    DEADLINE_HEADER, DECODE_BACKEND_HEADER, MODEL_HEADER, QOS_HEADER,
+    TRACE_HEADER,
 )
 from kubeflow_tpu.obs.registry import (
     MetricsRegistry, contract_note_header, contract_note_series,
@@ -76,6 +77,7 @@ ROUTER_SCRAPE_SERIES = (
     "kftpu_engine_pending_prefill_tokens",
     "kftpu_engine_kv_pages_resident",
     "kftpu_engine_kv_pages_cached",
+    "kftpu_engine_adapters_resident",
     "kftpu_serving_in_flight",
 )
 
@@ -260,7 +262,9 @@ class Router:
     @staticmethod
     def _parse_signals(text: str) -> Optional[dict]:
         out = {"pending_prefill_tokens": 0.0, "kv_pages_resident": 0.0,
-               "kv_pages_cached": 0.0, "in_flight": 0.0}
+               "kv_pages_cached": 0.0, "in_flight": 0.0,
+               "adapters": frozenset()}
+        adapters: set[str] = set()
         try:
             samples = parse_exposition(text)
         except ValueError:
@@ -276,8 +280,16 @@ class Router:
                 out["kv_pages_resident"] += value
             elif name == "kftpu_engine_kv_pages_cached":
                 out["kv_pages_cached"] += value
+            elif name == "kftpu_engine_adapters_resident":
+                # Which LoRA adapters are HOT on this backend: the
+                # model-id routing signal (one adapter-labeled sample
+                # per resident adapter; the 0 sample has no label).
+                a = _labels.get("adapter")
+                if a and value > 0:
+                    adapters.add(a)
             elif name == "kftpu_serving_in_flight":
                 out["in_flight"] += value
+        out["adapters"] = frozenset(adapters)
         return out
 
     def _healthy_locked(self, urls, exclude: frozenset,
@@ -418,7 +430,8 @@ class Router:
                 out[g] = ok
         return out
 
-    def _pick_locked(self, exclude: frozenset = frozenset()) -> Optional[str]:
+    def _pick_locked(self, exclude: frozenset = frozenset(),
+                     model: Optional[str] = None) -> Optional[str]:
         now = time.monotonic()
         eligible = self._eligible_locked(exclude, now)
         if not eligible:
@@ -444,6 +457,19 @@ class Router:
                 chosen = g
                 break
         urls = eligible[chosen]
+        if model is not None:
+            # Model-id routing (multi-tenant LoRA): prefer a backend
+            # that already has the adapter HOT — a cold pick pays a
+            # hot-load (and possibly an eviction) before its prefill.
+            # Falls back to the whole rotation when nobody has it (the
+            # pick itself warms that backend). Round-robin WITHIN the
+            # warm set keeps one popular adapter from pinning a single
+            # replica.
+            warm = [u for u in urls
+                    if model in self._signals.get(u, {}).get(
+                        "adapters", ())]
+            if warm:
+                urls = warm
         url = urls[next(self._rr) % len(urls)]
         if url in self._ejected_until:
             # Expired ejection window: this pick IS the half-open probe.
@@ -455,19 +481,21 @@ class Router:
             self.stats["probe_total"] += 1
         return url
 
-    def pick(self, exclude: frozenset = frozenset()) -> Optional[str]:
+    def pick(self, exclude: frozenset = frozenset(),
+             model: Optional[str] = None) -> Optional[str]:
         with self._lock:
-            return self._pick_locked(exclude)
+            return self._pick_locked(exclude, model=model)
 
     def pick_or_wait(self, timeout: Optional[float] = None,
-                     exclude: frozenset = frozenset()) -> Optional[str]:
+                     exclude: frozenset = frozenset(),
+                     model: Optional[str] = None) -> Optional[str]:
         """Pick a backend, queueing until one registers (scale-from-zero
         path). Returns None only after ``timeout`` (default: the router's
         queue_timeout) with still no backend."""
         deadline = time.monotonic() + (
             timeout if timeout is not None else self.queue_timeout)
         with self._cond:
-            backend = self._pick_locked(exclude)
+            backend = self._pick_locked(exclude, model=model)
             if backend is not None:
                 return backend
             self._pending += 1
@@ -477,7 +505,7 @@ class Router:
                     if remaining <= 0:
                         return None
                     self._cond.wait(remaining)
-                    backend = self._pick_locked(exclude)
+                    backend = self._pick_locked(exclude, model=model)
                     if backend is not None:
                         return backend
                 return None   # router torn down: fail fast, don't hold 120s
@@ -583,6 +611,11 @@ def _make_handler(router: Router):
             deadline = time.monotonic() + self._budget_s()
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n) if n else None
+            # Model-id routing key (multi-tenant LoRA): requests naming
+            # a model prefer backends already serving it hot.
+            contract_note_header(MODEL_HEADER, direction="read")
+            model_id = (self.headers.get(MODEL_HEADER) or "").strip() \
+                or None
             tried: set[str] = set()
             first_attempt = True
             while True:
@@ -605,9 +638,10 @@ def _make_handler(router: Router):
                     # blocking wait would just burn the client's budget.
                     backend = router.pick_or_wait(
                         timeout=min(remaining, router.queue_timeout),
-                        exclude=frozenset(tried))
+                        exclude=frozenset(tried), model=model_id)
                 else:
-                    backend = router.pick(exclude=frozenset(tried))
+                    backend = router.pick(exclude=frozenset(tried),
+                                          model=model_id)
                 if backend is None:
                     if tried:
                         # Retried through the whole rotation: every backend
@@ -643,6 +677,10 @@ def _make_handler(router: Router):
                     # Handoff placement: the prefill replica POSTs its
                     # KV to exactly this decode-pool member.
                     fwd_headers[DECODE_BACKEND_HEADER] = decode_target
+                if model_id:
+                    # The replica resolves the model id itself (adapter
+                    # hot-load on miss, 404 on unknown).
+                    fwd_headers[MODEL_HEADER] = model_id
                 trace_hdr = get_tracer().inject(sp)
                 if trace_hdr:
                     fwd_headers[TRACE_HEADER] = trace_hdr

@@ -39,7 +39,8 @@ from typing import Any, Optional
 from urllib.parse import urlparse
 
 from kubeflow_tpu.core.headers import (
-    DEADLINE_HEADER, DECODE_BACKEND_HEADER, QOS_HEADER, TRACE_HEADER,
+    DEADLINE_HEADER, DECODE_BACKEND_HEADER, MODEL_HEADER, QOS_HEADER,
+    TRACE_HEADER,
 )
 from kubeflow_tpu.obs.registry import MetricsRegistry, contract_note_header
 from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
@@ -191,8 +192,31 @@ class ModelServer:
 
     def model_names(self) -> list[str]:
         if self.repository is None:
+            lora = getattr(self.engine, "_lora", None)
+            if lora is not None:
+                # Multi-tenant LoRA: every registered adapter is a
+                # servable model id on this engine.
+                return [self.name] + lora.names()
             return [self.name]
         return self.repository.names()
+
+    def resolve_adapter(self, name: Optional[str]) -> Optional[str]:
+        """Map a request's model id onto this server's LoRA surface:
+        None/base name = base weights; a registered adapter name decodes
+        through its packed slot; anything else on a LoRA-enabled engine
+        is a 404 (KeyError) — multi-tenant serving must never silently
+        fall a tenant through to the base model. LoRA-free servers
+        return None (the pre-LoRA lease semantics apply)."""
+        if self.repository is not None or name in (None, self.name):
+            return None
+        lora = getattr(self.engine, "_lora", None)
+        if lora is None:
+            return None
+        if not lora.known(name):
+            raise KeyError(
+                f"unknown model {name!r}: not a registered adapter "
+                f"(serving {self.name})")
+        return name
 
     def lease(self, name: Optional[str], *, strict: bool = False):
         """Context manager: (engine, tokenizer, resolved_name) pinned for the
@@ -228,6 +252,10 @@ class ModelServer:
         """Model metadata without forcing a load."""
         if self.repository is None:
             if name != self.name:
+                lora = getattr(self.engine, "_lora", None)
+                if lora is not None and lora.known(name):
+                    # An adapter id serves the base architecture.
+                    return self.engine.cfg
                 raise KeyError(name)
             return self.engine.cfg
         entry = self.repository.peek(name)
@@ -299,7 +327,12 @@ class ModelServer:
             prompt = self.transformer(prompt, "pre")
         timeout = self.request_timeout(body, deadline_s)
         tracer = get_tracer()
-        with self.lease(model, strict=strict) as (engine, tokenizer, _):
+        # Multi-tenant LoRA: an adapter id leases the BASE engine and
+        # decodes through the adapter's packed slot (resolve_adapter
+        # 404s unknown ids on LoRA-enabled engines).
+        adapter = self.resolve_adapter(model)
+        with self.lease(None if adapter else model,
+                        strict=strict) as (engine, tokenizer, _):
             toks = tokenizer.encode(prompt)
             # Disaggregated placement: on a prefill-role engine with a
             # router-stamped decode backend, stop at the first token and
@@ -312,7 +345,7 @@ class ModelServer:
             req = engine.submit(toks, self.sampling_from(body, tokenizer),
                                 deadline=time.monotonic() + timeout,
                                 trace_parent=tracer.current(), qos=qos,
-                                handoff=handoff_flag)
+                                handoff=handoff_flag, adapter=adapter)
             try:
                 out = req.result(timeout=timeout + 1.0)
             except TimeoutError:
@@ -497,6 +530,13 @@ def serving_metrics_registry(engines: list, *,
     handoffs_out = reg.counter("kftpu_engine_handoffs_exported_total")
     handoffs_in = reg.counter("kftpu_engine_handoffs_adopted_total")
     handoffs_bad = reg.counter("kftpu_engine_handoffs_failed_total")
+    # Multi-tenant LoRA (serve/lora.py): which adapters are HOT on this
+    # engine (one ``adapter=``-labeled sample per resident adapter — the
+    # model-id router's placement signal; a 0 sample without the label
+    # when none are) plus the hot-load/evict lifecycle counters.
+    adapters_resident = reg.gauge("kftpu_engine_adapters_resident")
+    adapter_loads = reg.counter("kftpu_engine_adapter_loads_total")
+    adapter_evictions = reg.counter("kftpu_engine_adapter_evictions_total")
     for name, engine in engines:
         snap = engine.metrics.snapshot()
         requests_total.inc(snap["requests_completed"], model=name)
@@ -547,6 +587,14 @@ def serving_metrics_registry(engines: list, *,
         handoffs_out.inc(snap.get("handoffs_exported", 0), model=name)
         handoffs_in.inc(snap.get("handoffs_adopted", 0), model=name)
         handoffs_bad.inc(snap.get("handoffs_failed", 0), model=name)
+        resident = engine.adapters_resident()
+        for a in resident:
+            adapters_resident.set(1, model=name, adapter=a)
+        if not resident:
+            adapters_resident.set(0, model=name)
+        astats = engine.adapter_stats()
+        adapter_loads.inc(astats.get("loads", 0), model=name)
+        adapter_evictions.inc(astats.get("evictions", 0), model=name)
     return reg
 
 
@@ -757,8 +805,16 @@ def _make_handler(server: ModelServer):
                              "shape": [len(texts)], "data": texts}],
             })
 
+        def _model_id(self, body: dict) -> Optional[str]:
+            """Requested model id: the X-Kftpu-Model header (the fleet
+            router's routing key) wins; the OpenAI ``"model"`` body
+            field is the headerless fallback."""
+            contract_note_header(MODEL_HEADER, direction="read")
+            hdr = self.headers.get(MODEL_HEADER)
+            return hdr.strip() if hdr else body.get("model")
+
         def _completions(self, body: dict, *, chat: bool) -> None:
-            model = body.get("model")
+            model = self._model_id(body)
             if chat:
                 msgs = body.get("messages", [])
                 prompt = "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}"
@@ -857,7 +913,9 @@ def _make_handler(server: ModelServer):
             if server.transformer is not None:
                 prompt = server.transformer(prompt, "pre")
             timeout = server.request_timeout(body, self._deadline_s())
-            with server.lease(model) as (engine, tokenizer, _):
+            adapter = server.resolve_adapter(model)
+            with server.lease(None if adapter else model) \
+                    as (engine, tokenizer, _):
                 toks = tokenizer.encode(prompt)
                 decode_url = self._decode_backend()
                 wants_handoff = engine.role == "prefill" and decode_url
@@ -869,7 +927,7 @@ def _make_handler(server: ModelServer):
                                     deadline=time.monotonic() + timeout,
                                     trace_parent=get_tracer().current(),
                                     qos=self._qos(body),
-                                    handoff=handoff_flag)
+                                    handoff=handoff_flag, adapter=adapter)
                 if wants_handoff:
                     return self._stream_disaggregated(
                         engine, tokenizer, req, toks, body, decode_url,

@@ -149,10 +149,10 @@ def _seeded_regressions() -> list[str]:
     # (a fresh compile-cache entry per scalar source) — the cycle
     # KFTPU_SANITIZE=recompile would catch at runtime.
     _DECODE_CALL = (
-        "            out, self.cache, st = self._decode_n(\n"
-        "                self.params, self.cache, self._dstate.arrays,"
+        "                out, self.cache, st = self._decode_n(\n"
+        "                    self.params, self.cache, self._dstate.arrays,"
         " key, k_steps,\n"
-        "                mode)")
+        "                    mode)")
     new_findings(
         "kubeflow_tpu/serve/engine.py",
         (_DECODE_CALL,

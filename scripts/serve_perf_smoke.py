@@ -168,12 +168,14 @@ def main() -> int:
         return 1
 
     # multi_turn gates in scripts/prefix_cache_smoke.py (the tiered-KV
-    # stage, on a radix+host-tier engine) — excluded here to keep this
-    # stage inside its wall-time budget.
+    # stage, on a radix+host-tier engine) and multi_adapter in
+    # scripts/lora_smoke.py (on a LoRA-enabled engine with registered
+    # adapters) — excluded here to keep this stage inside its wall-time
+    # budget and its engines adapter-free.
     matrix = [s for s in standard_matrix(
         num_requests=args.requests, rate_rps=args.rate,
         prompt_len=PROMPT_LEN, max_new=MAX_NEW, slo_ttft_ms=5000.0)
-        if s.name != "multi_turn"]
+        if s.name not in ("multi_turn", "multi_adapter")]
 
     # 1) Measure: per scenario, warm + two measured segments. The
     #    shared-prefix scenario runs on the paged prefix-cache engine

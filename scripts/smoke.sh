@@ -154,6 +154,22 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 prefix_cache_rc=${PIPESTATUS[0]}
 grep -q '"prefix_cache_smoke": "ok"' /tmp/_smoke_prefix_cache.json || prefix_cache_rc=1
 
+echo "== lora smoke (multi-tenant adapters: identity + churn + chaos) =="
+# Multi-tenant LoRA gate (ISSUE 14): greedy decode under every adapter
+# must be token-identical to the merged-weights single-model reference
+# (dense + paged); the multi_adapter scenario at 8/32/64 concurrent
+# adapters must stay inside the declared tok/s + TTFT p95 degradation
+# band vs single-model with ZERO steady-state recompiles across the
+# hot-load/evict churn (KFTPU_SANITIZE=refcount,recompile is on for the
+# whole stage); a seeded slow-hot-load wedge must be flagged with the
+# adapter_load attribution; SIGKILL mid-hot-load behind the model-id
+# router must strand nothing (per-owner zero leaks: pages AND adapter
+# slots). Writes BENCH_SERVE_r04.json (the multi-adapter bench round).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/lora_smoke.py | tee /tmp/_smoke_lora.json
+lora_rc=${PIPESTATUS[0]}
+grep -q '"lora_smoke": "ok"' /tmp/_smoke_lora.json || lora_rc=1
+
 echo "== contract smoke (static name-contract table vs a real serve run) =="
 # Cross-component contract gate (ISSUE 10): the kftpu lint --contracts-json
 # manifest must round-trip, and a serve run under KFTPU_SANITIZE=contract
@@ -164,5 +180,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 contract_rc=${PIPESTATUS[0]}
 grep -q '"contract_smoke": "ok"' /tmp/_smoke_contract.json || contract_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc prefix_cache rc=$prefix_cache_rc contract rc=$contract_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$prefix_cache_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc prefix_cache rc=$prefix_cache_rc lora rc=$lora_rc contract rc=$contract_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$prefix_cache_rc" -eq 0 ] && [ "$lora_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]

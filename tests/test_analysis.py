@@ -1904,14 +1904,14 @@ class TestSeededRegressions:
         exactly one F602."""
         fresh = _new_findings(
             "kubeflow_tpu/serve/engine.py",
-            "            out, self.cache, st = self._decode_n(\n"
-            "                self.params, self.cache, self._dstate.arrays,"
+            "                out, self.cache, st = self._decode_n(\n"
+            "                    self.params, self.cache, self._dstate.arrays,"
             " key, k_steps,\n"
-            "                mode)",
-            "            out, self.cache, st = self._decode_n(\n"
-            "                self.params, self.cache, self._dstate.arrays,"
+            "                    mode)",
+            "                out, self.cache, st = self._decode_n(\n"
+            "                    self.params, self.cache, self._dstate.arrays,"
             " 0.5, k_steps,\n"
-            "                mode)")
+            "                    mode)")
         assert len(fresh) == 1
         f = fresh[0]
         assert f.rule == "F602" and "self._decode_n" in f.message
@@ -1921,14 +1921,14 @@ class TestSeededRegressions:
         per-call tuple produces exactly one F604."""
         fresh = _new_findings(
             "kubeflow_tpu/serve/engine.py",
-            "            out, self.cache, st = self._decode_n(\n"
-            "                self.params, self.cache, self._dstate.arrays,"
+            "                out, self.cache, st = self._decode_n(\n"
+            "                    self.params, self.cache, self._dstate.arrays,"
             " key, k_steps,\n"
-            "                mode)",
-            "            out, self.cache, st = self._decode_n(\n"
-            "                self.params, self.cache, self._dstate.arrays,"
+            "                    mode)",
+            "                out, self.cache, st = self._decode_n(\n"
+            "                    self.params, self.cache, self._dstate.arrays,"
             " key, (k_steps,),\n"
-            "                mode)")
+            "                    mode)")
         assert len(fresh) == 1
         f = fresh[0]
         assert f.rule == "F604" and "self._decode_n" in f.message
@@ -1997,9 +1997,9 @@ class TestContractSeededRegressions:
         fresh = _new_findings_prog(
             "kubeflow_tpu/core/headers.py",
             "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,\n"
-            "                   DECODE_BACKEND_HEADER)",
+            "                   DECODE_BACKEND_HEADER, MODEL_HEADER)",
             "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER,\n"
-            "                   DECODE_BACKEND_HEADER)")
+            "                   DECODE_BACKEND_HEADER, MODEL_HEADER)")
         assert len(fresh) == 1
         f = fresh[0]
         assert f.rule == "X703" and "X-Kftpu-Trace" in f.message

@@ -115,9 +115,11 @@ class TestHeaderModule:
         assert USER_HEADER == "X-Kftpu-User"
 
     def test_forward_list_covers_the_serving_path(self):
+        from kubeflow_tpu.core.headers import MODEL_HEADER
+
         assert set(FORWARD_HEADERS) == {
             DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
-            DECODE_BACKEND_HEADER}
+            DECODE_BACKEND_HEADER, MODEL_HEADER}
 
     def test_chaos_proxy_forwards_the_whole_list(self):
         """The ChaosProxy's forward-list is DERIVED from core/headers —
@@ -160,6 +162,7 @@ class TestHeaderModule:
                          DEADLINE_HEADER: "1000",
                          QOS_HEADER: "interactive",
                          DECODE_BACKEND_HEADER: "http://127.0.0.1:1",
+                         "X-Kftpu-Model": "tenant-a",
                          TRACE_HEADER: "ab" * 16 + "-" + "cd" * 8})
             with urllib.request.urlopen(req, timeout=10) as r:
                 r.read()

@@ -198,10 +198,12 @@ class TestPrompts:
         m = standard_matrix(num_requests=8)
         assert [s.name for s in m] == ["uniform", "bursty_qos",
                                        "shared_prefix",
-                                       "mixed_interference", "multi_turn"]
+                                       "mixed_interference",
+                                       "multi_adapter", "multi_turn"]
         assert m[2].prefix_overlap == 0.75
         assert dict(m[1].qos_mix).keys() == {"interactive", "batch"}
-        assert m[4].turns == 3 and m[4].think_time_s > 0
+        assert m[4].adapter_ids and m[4].adapter_skew == 1.0
+        assert m[5].turns == 3 and m[5].think_time_s > 0
         for s in m:
             s.validate()
 
@@ -239,6 +241,72 @@ class TestPrompts:
             ("gold", LengthDist(), LengthDist()),))
         with pytest.raises(ValueError, match="gold"):
             bad.validate()
+
+
+class TestMultiAdapter:
+    def test_zipf_skew_orders_popularity(self):
+        """adapter_ids[0] is the hottest tenant under skew > 0; skew 0
+        is uniform-ish; every request in an adapter scenario carries an
+        id from the declared set; same seed → identical schedule."""
+        ids = tuple(f"a{i}" for i in range(8))
+        sc = Scenario(name="ma", num_requests=400, adapter_ids=ids,
+                      adapter_skew=1.0, seed=3)
+        sched = build_schedule(sc, vocab_size=256, max_prompt_len=64)
+        counts = {}
+        for r in sched:
+            assert r.adapter in ids
+            counts[r.adapter] = counts.get(r.adapter, 0) + 1
+        assert counts["a0"] > counts["a7"] * 2, counts
+        again = build_schedule(sc, vocab_size=256, max_prompt_len=64)
+        assert [(r.prompt_tokens, r.adapter) for r in sched] == \
+               [(r.prompt_tokens, r.adapter) for r in again]
+
+    def test_adapter_free_schedules_unchanged(self):
+        """Appending the adapter draw must not perturb historical
+        adapter-free schedules (drawn only when adapter_ids is set)."""
+        sc = Scenario(name="plain", num_requests=16, seed=5)
+        sched = build_schedule(sc, vocab_size=256, max_prompt_len=64)
+        assert all(r.adapter is None for r in sched)
+
+    def test_session_mode_pins_adapter_per_session(self):
+        sc = Scenario(name="s", num_requests=24, turns=3,
+                      adapter_ids=("a0", "a1", "a2"), seed=1)
+        sched = build_schedule(sc, vocab_size=256, max_prompt_len=64)
+        by_session = {}
+        for r in sched:
+            by_session.setdefault(r.session, set()).add(r.adapter)
+        assert all(len(s) == 1 for s in by_session.values()), \
+            "a conversation must not switch tenants mid-flight"
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unique"):
+            Scenario(name="x", adapter_ids=("a", "a")).validate()
+        with pytest.raises(ValueError, match="adapter_skew"):
+            Scenario(name="x", adapter_ids=("a",),
+                     adapter_skew=-1.0).validate()
+
+    def test_per_adapter_report_split(self):
+        """Outcomes carrying adapter ids aggregate into the per-adapter
+        TTFT/TPOT block (the one-tenant-degrading attribution)."""
+        from kubeflow_tpu.loadgen.runner import RequestOutcome, ScenarioRun
+        from kubeflow_tpu.loadgen.report import build_report
+
+        outs = []
+        for i in range(8):
+            aid = f"a{i % 2}"
+            outs.append(RequestOutcome(
+                idx=i, qos="standard", scheduled_t=0.0, lag_s=0.0,
+                ttft_s=0.010 if aid == "a0" else 0.050,
+                latency_s=0.1, tokens=8, status="ok", adapter=aid))
+        run = ScenarioRun(
+            scenario=Scenario(name="ma", num_requests=8,
+                              adapter_ids=("a0", "a1")),
+            outcomes=outs, wall_s=1.0, schedule=[])
+        rep = build_report(run)
+        assert set(rep["adapters"]) == {"a0", "a1"}
+        assert rep["adapters"]["a0"]["ttft_ms"]["p50"] < \
+            rep["adapters"]["a1"]["ttft_ms"]["p50"]
+        assert rep["adapters"]["a0"]["requests"] == 4
 
 
 class TestMultiTurn:

@@ -52,6 +52,7 @@ def job_of(entrypoint, config=None, *, name="e2e", replicas=2,
     return j
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
 def test_noop_job_succeeds(cp):
     job = cp.submit(job_of("noop"))
     done = cp.wait_for(job, "Succeeded", timeout=30)

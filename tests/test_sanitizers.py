@@ -357,6 +357,7 @@ class TestSteadyStateZeroRecompiles:
         self._drive(eng, recompile_wd)
         eng._allocator.assert_quiescent()
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
     def test_warmed_train_step(self, recompile_wd):
         jax = pytest.importorskip("jax")
         import numpy as np

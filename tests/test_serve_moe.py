@@ -64,6 +64,7 @@ class TestMoEServingImpls:
         assert zd._cfg_decode.moe_impl == "dispatch"
         assert _generate_all(dense) == _generate_all(zd)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
     def test_trained_capacity_prefill_is_batch_independent(self, params):
         """At the TRAINING capacity factor (drops possible within a
         request), co-batched traffic must still not change any request's

@@ -202,6 +202,7 @@ class TestPrefixCaching:
 
 
 class TestConcurrentChunkedPrefills:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
     def test_two_long_prompts_chunk_concurrently(self, cfg, params):
         """Two long prompts admitted together must BOTH be mid-chunking at
         once (no head-of-line blocking) and finish with exact outputs."""
@@ -285,6 +286,7 @@ class TestReviewRegressions:
         run_all(eng, [r])
         assert len(r.output_tokens) == 4
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
     def test_concurrent_prefills_starved_pool_does_not_deadlock(
             self, cfg, params):
         """Two long prompts whose combined prefills exceed the pool: the

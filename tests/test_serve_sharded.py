@@ -64,12 +64,14 @@ def run_all(engine, sampling=None):
     return [r.output_tokens for r in reqs]
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
 def test_tp2_matches_single_device_greedy(cfg, params):
     want = run_all(mk_engine(cfg, params))
     got = run_all(mk_engine(cfg, params, tp=2))
     assert got == want
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
 def test_tp4_matches_single_device_greedy(cfg, params):
     want = run_all(mk_engine(cfg, params))
     got = run_all(mk_engine(cfg, params, tp=4))
@@ -91,6 +93,7 @@ def test_tp2_weights_and_cache_are_distributed(cfg, params):
     assert len(out) == 6
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
 def test_tp2_sampled_matches_single_device(cfg, params):
     """Same PRNG seed => identical sampled streams: sharding must not change
     sampling semantics (threefry values are placement-invariant)."""
@@ -149,6 +152,7 @@ def test_gqa_nondivisible_kv_replicates(params):
     assert got == want
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
 def test_tp2_flash_prefill_matches(cfg, params):
     """Forced pallas prefill under the TP mesh: the flash kernel runs
     per-shard via shard_map (Mosaic can't be GSPMD-partitioned) and must

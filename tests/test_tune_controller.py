@@ -119,6 +119,7 @@ class TestExperimentLifecycle:
         jobs = cp.store.list(JAXJob)
         assert len(jobs) == 2  # never more than parallel_trial_count at once
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
     def test_goal_finishes_early(self, cp):
         # Any trial beats a goal of 10 → finish after the first wave.
         cp.submit(experiment_of(goal=10.0, max_trial_count=12))

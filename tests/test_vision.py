@@ -59,6 +59,7 @@ class TestViT:
         b = vit_forward(loop_params, imgs, loop_cfg)
         assert jnp.allclose(a, b, atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 14): slowest fast tests re-marked
     def test_vit_learns(self):
         mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
         task = setup_vit_train(TINY, OptimizerConfig(
