@@ -33,11 +33,49 @@ an in-process spread can.
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import os
 import sys
 import time
 
 ROUND1_TOKS_PER_SEC_CHIP = 13673.23
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainKnobs:
+    """The headline training-knob set — ONE struct shared by bench.py,
+    scripts/bench_configs.py and scripts/mfu_sweep.py so the sweep rows
+    and the headline number can never drift apart (they used to hardcode
+    ``attn_impl="pallas" if on_tpu else "xla"`` and the remat policy
+    inline, independently). Values are the measured round-4/6 winners;
+    change them HERE and every measurement follows."""
+
+    remat_policy: str = "dots_flash"
+    attn_impl_tpu: str = "pallas"
+    attn_impl_off_tpu: str = "xla"   # interpret-mode kernels are CI-only
+    fused_kernels: str = "auto"      # ops/fused_xent.py + ops/fused_norm.py
+    mu_dtype_tpu: str = "bfloat16"
+
+    def attn_impl(self, on_tpu: bool) -> str:
+        return self.attn_impl_tpu if on_tpu else self.attn_impl_off_tpu
+
+    def mu_dtype(self, on_tpu: bool):
+        return self.mu_dtype_tpu if on_tpu else None
+
+
+HEADLINE_KNOBS = TrainKnobs()
+
+
+def apply_perf_flags_if_tpu() -> None:
+    """Latency-hiding XLA flag set (runtime/xla_flags.py) ahead of backend
+    init — skipped when the platform is forced to CPU (the flags are
+    TPU-only)."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        return
+    from kubeflow_tpu.runtime.xla_flags import apply_xla_perf_flags
+
+    apply_xla_perf_flags()
 
 
 def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
@@ -53,12 +91,14 @@ def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
     runs, so the round-trip is the only reliable fence. Returns
     {tok_s_chip, step_ms, mfu, loss, segments, spread_pct}."""
     import jax
-    import numpy as np
 
     from kubeflow_tpu.runtime.mesh import build_mesh
     from kubeflow_tpu.runtime.topology import detect_local_cluster
-    from kubeflow_tpu.train.data import DataConfig, make_data_source
+    from kubeflow_tpu.train.data import (
+        DataConfig, make_data_source, stacked_batches,
+    )
     from kubeflow_tpu.train.optim import OptimizerConfig
+    from kubeflow_tpu.train.staging import DeviceBatchStager
     from kubeflow_tpu.train.step import setup_train
 
     devices = jax.devices()
@@ -77,26 +117,31 @@ def measure_train_rate(cfg, per_chip_batch, *, k_dispatch, warm_disp, disp,
                              **opt_kw),
         mesh, attn_impl=attn_impl)
 
-    def dispatch(i0, state):
-        batch = np.stack([source.batch_at(i0 + j) for j in range(k_dispatch)])
-        batch = jax.device_put(batch, task.multi_batch_sharding)
-        state, metrics = task.multi_step_fn(state, batch)
-        return state, float(metrics["loss"])   # host fetch = the fence
+    def fetch(di):
+        # Build + upload for dispatch ``di`` — runs on the stager's
+        # background thread so the host work overlaps device compute.
+        batch = stacked_batches(source, di * k_dispatch, k_dispatch)
+        return jax.device_put(batch, task.multi_batch_sharding)
 
     state = task.state
-    for i in range(warm_disp):
-        state, loss = dispatch(i * k_dispatch, state)
-    steps = disp * k_dispatch
-    tokens_per_seg = data_cfg.global_batch * data_cfg.seq_len * steps
-    seg_rates = []
-    i0 = warm_disp
-    for _ in range(max(1, segments)):
-        t0 = time.perf_counter()
-        for i in range(i0, i0 + disp):
-            state, loss = dispatch(i * k_dispatch, state)
-        dt = time.perf_counter() - t0
-        seg_rates.append(tokens_per_seg / dt / n)
-        i0 += disp
+    with DeviceBatchStager(fetch, depth=2, name="bench-stager") as stager:
+        def dispatch(di, state):
+            state, metrics = task.multi_step_fn(state, stager.get(di))
+            return state, float(metrics["loss"])   # host fetch = the fence
+
+        for i in range(warm_disp):
+            state, loss = dispatch(i, state)
+        steps = disp * k_dispatch
+        tokens_per_seg = data_cfg.global_batch * data_cfg.seq_len * steps
+        seg_rates = []
+        i0 = warm_disp
+        for _ in range(max(1, segments)):
+            t0 = time.perf_counter()
+            for i in range(i0, i0 + disp):
+                state, loss = dispatch(i, state)
+            dt = time.perf_counter() - t0
+            seg_rates.append(tokens_per_seg / dt / n)
+            i0 += disp
 
     tps_chip = sum(seg_rates) / len(seg_rates)
     gen = detect_local_cluster().slices[0].gen
@@ -160,7 +205,15 @@ def probe_chip_tflops(n: int = 8192, k1: int = 32, k2: int = 64):
     return round(2 * n**3 * (k2 - k1) / dt / 1e12, 1)
 
 
+def _fused_resolved(cfg) -> bool:
+    from kubeflow_tpu.models.layers import fused_kernels_on
+
+    return fused_kernels_on(cfg)
+
+
 def run_bench():
+    apply_perf_flags_if_tpu()    # before the backend initializes
+
     import jax
 
     from kubeflow_tpu.models.config import preset
@@ -175,27 +228,29 @@ def run_bench():
     n = len(devices)
     probe_tflops = probe_chip_tflops() if on_tpu else None
 
+    knobs = HEADLINE_KNOBS
     if on_tpu:
         # Llama-3 architecture sized to fit one v5e chip's HBM with fp32
         # Adam state (~0.6B params): the per-chip unit of the 8B recipe.
-        # Round-4 winners (A/B'd on-chip, see module docstring).
+        # Knob values are the measured winners (TrainKnobs docstring).
         cfg = preset(
             "llama3-8b",
             n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
             mlp_dim=8192, vocab_size=32000, max_seq_len=2048,
-            remat_policy="dots_flash",
+            remat_policy=knobs.remat_policy,
+            fused_kernels=knobs.fused_kernels,
         )
         model_tag = "llama3-0.6b"
         per_chip_batch, k_dispatch, warm_disp, disp = 5, 32, 3, 2
     else:
-        cfg = preset("tiny")
+        cfg = preset("tiny", fused_kernels=knobs.fused_kernels)
         model_tag = "tiny"
         per_chip_batch, k_dispatch, warm_disp, disp = 8, 4, 1, 3
 
     out = measure_train_rate(
         cfg, per_chip_batch, k_dispatch=k_dispatch, warm_disp=warm_disp,
-        disp=disp, mu_dtype="bfloat16" if on_tpu else None,
-        attn_impl="pallas" if on_tpu else "xla")
+        disp=disp, mu_dtype=knobs.mu_dtype(on_tpu),
+        attn_impl=knobs.attn_impl(on_tpu))
 
     return {
         "metric": f"jaxjob_train_tokens_per_sec_per_chip[{model_tag},"
@@ -208,6 +263,12 @@ def run_bench():
             "step_time_ms": out["step_ms"],
             "mfu_vs_v5e_peak": out["mfu"] if on_tpu else None,
             "steps_per_dispatch": k_dispatch,
+            # The fused-kernel knob as configured AND as resolved for this
+            # backend (layers.fused_kernels_on) — the A/B axis of the
+            # r05→r06 trajectory.
+            "fused_kernels": cfg.fused_kernels,
+            "fused_resolved": _fused_resolved(cfg),
+            "remat_policy": cfg.remat_policy,
             "loss": out["loss"],
             "params": cfg.num_params(),
             "segments": out["segments"],

@@ -52,6 +52,14 @@ class DecoderConfig:
     # Sequence-chunked cross-entropy: never materialize [B,S,V] logits
     # (0 = off). Big win at large vocab; numerics identical.
     loss_chunk_size: int = 0
+    # Fused Pallas kernels for the non-attention hot ops (ops/fused_xent.py
+    # blockwise vocab-chunked CE, ops/fused_norm.py RMSNorm(+residual) and
+    # SwiGLU): "auto" = on when the backend is TPU (resolved the same way
+    # bench.py resolves attn_impl="pallas"), "on" forces them (interpret
+    # mode off-TPU — the CPU parity-test path), "off" keeps the XLA ops.
+    # Single-device / per-shard only: under a multi-device GSPMD mesh the
+    # kernels fall back (Mosaic can't be auto-partitioned).
+    fused_kernels: str = "auto"
     dtype: str = "bfloat16"        # activation/compute dtype
     param_dtype: str = "float32"
 

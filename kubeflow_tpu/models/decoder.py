@@ -105,20 +105,22 @@ def _block_forward(block_params, x, positions, cfg: DecoderConfig,
                    rules=DEFAULT_RULES, prefill=False,
                    expert_axis=None, seq_axis=None, tp_axis=None,
                    valid_len=None, lora=None):
-    h = L.rmsnorm(x, block_params["ln1"], cfg)
+    h = L.rmsnorm(x, block_params["ln1"], cfg, mesh=mesh)
     attn_out, new_cache = L.attention_block(
         block_params["attn"], h, positions, cfg,
         kv_cache=kv_cache, attn_impl=attn_impl, mesh=mesh, prefill=prefill,
         tp_axis=tp_axis, lora=lora)
-    x = x + attn_out
-    h = L.rmsnorm(x, block_params["ln2"], cfg)
+    # Residual add + second norm as ONE op: fused kernels run it in a
+    # single pass over the stream (layers.add_rmsnorm).
+    x, h = L.add_rmsnorm(x, attn_out, block_params["ln2"], cfg, mesh=mesh)
     if cfg.is_moe:
         mlp_out, aux = L.moe_block(block_params["mlp"], h, cfg,
                                    expert_axis=expert_axis, seq_axis=seq_axis,
                                    valid_len=valid_len, tp_axis=tp_axis)
     else:
         mlp_out, aux = (L.mlp_block(block_params["mlp"], h, cfg,
-                                    tp_axis=tp_axis), jnp.float32(0))
+                                    tp_axis=tp_axis, mesh=mesh),
+                        jnp.float32(0))
     x = x + mlp_out
     if mesh is not None:
         x = with_logical_constraint(x, ("batch", "act_seq", "act_embed"), mesh, rules)
@@ -302,7 +304,7 @@ def decoder_forward(
             new_caches = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
                           "len": kv_caches["len"] + tokens.shape[1]}
 
-    x = L.rmsnorm(x, params["final_norm"], cfg)
+    x = L.rmsnorm(x, params["final_norm"], cfg, mesh=mesh)
     if skip_head:
         return x, new_caches, aux_total
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
@@ -480,12 +482,30 @@ def decoder_loss(
 ):
     """Next-token cross-entropy in fp32. Returns (loss, metrics).
 
-    When ``cfg.loss_chunk_size`` is set, the [B,S,V] logits tensor is never
-    materialized: hidden states stream through the head + softmax in
-    sequence chunks (HBM traffic drops by O(S·V) — the usual LLM-training
-    memory hog at large vocab)."""
+    Loss-path selection, cheapest first: with fused kernels on
+    (``cfg.fused_kernels``, layers.fused_kernels_on) the blockwise Pallas
+    kernel (ops/fused_xent.py) fuses the output projection, log-softmax
+    and NLL — the [B,S,V] logits tensor never exists in HBM, forward OR
+    backward. Otherwise ``cfg.loss_chunk_size`` streams the head in
+    sequence chunks ([B,chunk,V] live at once), and the dense fallback
+    materializes full logits (the usual LLM-training memory hog at large
+    vocab)."""
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    if cfg.loss_chunk_size:
+    fused = L.fused_kernels_on(cfg, mesh)
+    if fused:
+        from kubeflow_tpu.ops import fused_xent
+
+        fused = fused_xent.supported(
+            inputs.shape[0] * inputs.shape[1], cfg.hidden, cfg.vocab_size)
+    if fused:
+        hidden, _, aux = decoder_forward(
+            params, inputs, cfg, attn_impl=attn_impl, mesh=mesh, rules=rules,
+            skip_head=True)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        nll, correct = fused_xent.fused_cross_entropy(
+            hidden, head.astype(hidden.dtype), targets,
+            logits_softcap=cfg.logits_softcap)
+    elif cfg.loss_chunk_size:
         hidden, _, aux = decoder_forward(
             params, inputs, cfg, attn_impl=attn_impl, mesh=mesh, rules=rules,
             skip_head=True)

@@ -28,6 +28,27 @@ def _init(key, shape, dtype, scale: Optional[float] = None):
             * scale).astype(dtype)
 
 
+# -- Fused-kernel resolution ---------------------------------------------------
+
+def fused_kernels_on(cfg: DecoderConfig, mesh=None) -> bool:
+    """Resolve ``cfg.fused_kernels`` ("auto"|"on"|"off") to a static bool.
+    "auto" follows the backend (TPU → Pallas kernels, elsewhere → XLA ops),
+    the same resolution rule bench.py applies to ``attn_impl``. A
+    multi-device GSPMD mesh disables them: Mosaic kernels cannot be
+    auto-partitioned (the flash kernel goes through shard_map instead;
+    these run per-shard only where the caller is already inside one)."""
+    if mesh is not None and mesh.size > 1:
+        return False
+    fk = cfg.fused_kernels
+    if fk == "on":
+        return True
+    if fk == "off":
+        return False
+    if fk != "auto":
+        raise ValueError(f"unknown fused_kernels {fk!r} (auto|on|off)")
+    return jax.default_backend() == "tpu"
+
+
 # -- RMSNorm -------------------------------------------------------------------
 
 def init_rmsnorm(cfg: DecoderConfig):
@@ -36,12 +57,35 @@ def init_rmsnorm(cfg: DecoderConfig):
     return w, ("norm",)
 
 
-def rmsnorm(x: jax.Array, w: jax.Array, cfg: DecoderConfig) -> jax.Array:
+def rmsnorm(x: jax.Array, w: jax.Array, cfg: DecoderConfig,
+            mesh=None) -> jax.Array:
+    if fused_kernels_on(cfg, mesh):
+        from kubeflow_tpu.ops import fused_norm
+
+        if fused_norm.norm_supported(x.size // x.shape[-1], x.shape[-1]):
+            return fused_norm.rmsnorm_fused(
+                x, w, eps=cfg.norm_eps, plus_one=cfg.norm_plus_one)
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     xf = xf * jax.lax.rsqrt(var + cfg.norm_eps)
     wf = (1.0 + w.astype(jnp.float32)) if cfg.norm_plus_one else w.astype(jnp.float32)
     return (xf * wf).astype(x.dtype)
+
+
+def add_rmsnorm(x: jax.Array, res: jax.Array, w: jax.Array,
+                cfg: DecoderConfig, mesh=None):
+    """The decoder-block residual idiom ``y = x + res; h = rmsnorm(y)``
+    as one op — fused into a single Pallas pass when the kernels are on
+    (the stream is read/written once), the two XLA ops otherwise.
+    Returns ``(y, h)``."""
+    if fused_kernels_on(cfg, mesh):
+        from kubeflow_tpu.ops import fused_norm
+
+        if fused_norm.norm_supported(x.size // x.shape[-1], x.shape[-1]):
+            return fused_norm.add_rmsnorm_fused(
+                x, res, w, eps=cfg.norm_eps, plus_one=cfg.norm_plus_one)
+    y = x + res
+    return y, rmsnorm(y, w, cfg, mesh)
 
 
 # -- RoPE ----------------------------------------------------------------------
@@ -292,14 +336,25 @@ def _act(x: jax.Array, name: str) -> jax.Array:
 
 
 def mlp_block(p: dict, x: jax.Array, cfg: DecoderConfig,
-              tp_axis: Optional[str] = None) -> jax.Array:
+              tp_axis: Optional[str] = None, mesh=None) -> jax.Array:
     """``tp_axis``: gate/up hold this device's slice of the mlp dim and
     down's partial products psum over the axis (Megatron MLP split, manual
     form for inside shard_map)."""
     dt = cfg.activation_dtype
-    gate = _act(jnp.einsum("bsd,dm->bsm", x, p["gate"].astype(dt)), cfg.hidden_act)
+    gate_pre = jnp.einsum("bsd,dm->bsm", x, p["gate"].astype(dt))
     up = jnp.einsum("bsd,dm->bsm", x, p["up"].astype(dt))
-    out = jnp.einsum("bsm,md->bsd", gate * up, p["down"].astype(dt))
+    h = None
+    if fused_kernels_on(cfg, mesh) and cfg.hidden_act in ("silu", "gelu"):
+        from kubeflow_tpu.ops import fused_norm
+
+        if fused_norm.norm_supported(up.size // up.shape[-1], up.shape[-1]):
+            # One VMEM pass for act(gate) * up; the custom VJP recomputes
+            # the activation derivative from (gate, up) instead of stashing
+            # act(gate)/sigmoid(gate) intermediates for the backward.
+            h = fused_norm.swiglu_fused(gate_pre, up, act=cfg.hidden_act)
+    if h is None:
+        h = _act(gate_pre, cfg.hidden_act) * up
+    out = jnp.einsum("bsm,md->bsd", h, p["down"].astype(dt))
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     return checkpoint_name(out, "mlp_out")

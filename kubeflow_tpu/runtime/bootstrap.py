@@ -158,8 +158,14 @@ def bootstrap_worker(wenv: Optional[WorkerEnv] = None):
         # var alone cannot override it (see memory: axon-jax-env-facts).
         jax.config.update("jax_platforms", "cpu")
     else:
-        # Hardware workers share compiled programs across gang attempts
-        # and restarts (an elastic resize re-compiles the same shapes).
+        # Hardware workers: latency-hiding XLA flag set (async collective
+        # fusion + collective matmul for the fsdp axis — runtime/
+        # xla_flags.py, KFTPU_XLA_PERF_FLAGS=off escape hatch) before the
+        # backend initializes, and a shared compilation cache so gang
+        # attempts and elastic resizes re-use compiled programs.
+        from kubeflow_tpu.runtime.xla_flags import apply_xla_perf_flags
+
+        apply_xla_perf_flags()
         enable_compilation_cache()
 
     if wenv.num_processes > 1:

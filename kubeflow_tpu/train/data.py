@@ -63,6 +63,14 @@ class SyntheticLM:
             step += 1
 
 
+def stacked_batches(source, step0: int, k: int) -> np.ndarray:
+    """[K, local_batch, seq_len+1] — the K-step dispatch's host-side batch
+    stack (bench.py / scripts/mfu_sweep.py), a pure function of
+    ``(source, step0)`` so the double-buffered stager
+    (train/staging.py::DeviceBatchStager) can build it ahead of time."""
+    return np.stack([source.batch_at(step0 + j) for j in range(k)])
+
+
 def make_data_source(cfg: DataConfig, shard: int = 0, num_shards: int = 1):
     if cfg.kind == "synthetic":
         return SyntheticLM(cfg, shard, num_shards)

@@ -14,9 +14,97 @@ pipelines→training seam: ``train(dataset_uri="artifact://corpus@1")``."""
 
 from __future__ import annotations
 
+import logging
 import os
+import queue
 import shutil
-from typing import Optional
+import threading
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger("kubeflow_tpu.train")
+
+
+class DeviceBatchStager:
+    """Double-buffered host→device input staging for the train loop.
+
+    The K-step scanned dispatch hides the host round-trip *inside* a
+    dispatch, but between dispatches the host still synchronously builds
+    the next stacked batch (the synthetic source alone walks seq_len numpy
+    steps per sample) and uploads it — dead time the device spends idle.
+    This stager runs ``fetch(index)`` (build + ``jax.device_put``) on a
+    background thread, staying up to ``depth`` batches ahead, so by the
+    time dispatch N retires, batch N+1 is already on the device: the
+    inter-dispatch host gap goes to the cost of a queue pop.
+
+    ``fetch`` must be a pure function of the index (the data-source
+    fast-forward contract), which is what makes prefetching
+    restart-transparent. Consumption is strictly sequential from
+    ``start`` — ``get`` asserts the index to catch drift. Always
+    ``close()`` (or use as a context manager): the thread is daemonic but
+    an abandoned stager would keep fetching forever.
+    """
+
+    def __init__(self, fetch: Callable[[int], Any], *, start: int = 0,
+                 depth: int = 2, name: str = "batch-stager"):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._fetch = fetch
+        self._start = start
+        # Queue is the only cross-thread channel (items + errors); the
+        # stop event is the only other shared state — both thread-safe
+        # primitives, no locking discipline needed.
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def _run(self) -> None:
+        i = self._start
+        while not self._stop.is_set():
+            try:
+                item = ("ok", i, self._fetch(i))
+            except BaseException as exc:
+                # Logged here AND forwarded through the queue: get() raises
+                # it on the consumer thread, so the loop fails loudly.
+                logger.warning("batch staging failed at index %d: %s", i, exc)
+                item = ("err", i, exc)
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if item[0] == "err":
+                return
+            i += 1
+
+    def get(self, index: int, timeout: Optional[float] = None) -> Any:
+        """The staged batch for ``index`` (must be consumed in order)."""
+        kind, i, payload = self._q.get(timeout=timeout)
+        if kind == "err":
+            raise RuntimeError(
+                f"batch staging failed at index {i}") from payload
+        if i != index:
+            raise RuntimeError(
+                f"batch stager is at index {i} but caller asked for "
+                f"{index}; consumption must be sequential from start")
+        return payload
+
+    def close(self) -> None:
+        self._stop.set()
+        # Unblock a put()-blocked producer so the thread exits promptly.
+        try:
+            self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "DeviceBatchStager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def _resolve(uri: str) -> str:

@@ -273,6 +273,16 @@ class Trainer:
                 startup_grace_seconds=self.cfg.watchdog_startup_grace_seconds)
             watchdog.start()
         prev_sigterm = self._install_preemption_handler()
+        # Double-buffered host→device staging (train/staging.py): batch
+        # N+1 is built and uploaded on a background thread while step N
+        # runs, so the device never idles on the host's input work.
+        # batch_at is a pure function of the step (the fast-forward
+        # contract), which keeps prefetching restart-transparent.
+        from kubeflow_tpu.train.staging import DeviceBatchStager
+
+        stager = DeviceBatchStager(
+            lambda s: self.make_global_batch(self.data.batch_at(s)),
+            start=start, name="train-batch-stager")
         # try/finally so ANY exit from the loop — exception mid-window,
         # preemption SystemExit — still stops an open jax.profiler trace,
         # drains the async checkpoint managers (an in-flight save must not
@@ -289,7 +299,7 @@ class Trainer:
                     elif tracing and step >= prof + self.cfg.profile_num_steps:
                         jax.profiler.stop_trace()
                         tracing = False
-                batch = self.make_global_batch(self.data.batch_at(step))
+                batch = stager.get(step)
                 self.task.state, metrics = self.task.step_fn(self.task.state, batch)
                 if step == start:
                     # Training shapes are fixed: everything compiles on the
@@ -349,6 +359,7 @@ class Trainer:
             if self.ckpt is not None and self.ckpt.latest_step() != self.cfg.steps:
                 self.save(self.cfg.steps, force=True)
         finally:
+            stager.close()
             if prev_sigterm is not None:
                 signal.signal(signal.SIGTERM, prev_sigterm)
             if watchdog is not None:

@@ -31,15 +31,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _train_rate(cfg, per_chip_batch, *, k_dispatch=8, disp=3, warm=2,
-                mu="bfloat16", lr=None, attn_impl="pallas"):
+                mu="bfloat16", lr=None, attn_impl=None):
     """Thin wrapper over bench.measure_train_rate — ONE measurement
     methodology for every training-throughput row (same dispatch loop,
-    fencing, and MFU accounting as the headline bench)."""
-    from bench import measure_train_rate
+    fencing, MFU accounting AND knob defaults as the headline bench,
+    via bench.TrainKnobs)."""
+    from bench import HEADLINE_KNOBS, measure_train_rate
 
     import jax
 
-    if jax.default_backend() != "tpu":
+    if attn_impl is None:
+        attn_impl = HEADLINE_KNOBS.attn_impl(jax.default_backend() == "tpu")
+    elif jax.default_backend() != "tpu":
         attn_impl = "xla"          # interpret-mode kernels are CI-only
     return measure_train_rate(cfg, per_chip_batch, k_dispatch=k_dispatch,
                               warm_disp=warm, disp=disp, mu_dtype=mu,

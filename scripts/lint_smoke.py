@@ -165,6 +165,21 @@ def _seeded_regressions() -> list[str]:
         (_DECODE_CALL,
          _DECODE_CALL.replace(" key, k_steps,", " key, (k_steps,),")),
         "F604", "self._decode_n")
+    # Family F on the FUSED-KERNEL dispatch surface (ISSUE 15): the paged
+    # decode dispatch now runs the fused RMSNorm Pallas kernel inside it
+    # (layers.rmsnorm) — a weak Python scalar replacing its key would be
+    # one fresh compile-cache entry per scalar source, exactly the
+    # steady-state recompile the warmed-fused-step sanitizer test pins to
+    # zero. Prove the analyzer guards the new path too.
+    _PAGED_CALL = (
+        "                out, self.cache, st, tbl = self._paged_decode_n(\n"
+        "                    self.params, self.cache, self._dstate.arrays,\n"
+        "                    self._dstate.table, key, k_steps, mode)")
+    new_findings(
+        "kubeflow_tpu/serve/engine.py",
+        (_PAGED_CALL,
+         _PAGED_CALL.replace(" key, k_steps, mode)", " 0.5, k_steps, mode)")),
+        "F602", "self._paged_decode_n")
 
     def new_findings_prog(path: str, old: str, new: str, rule: str,
                           needle: str) -> None:

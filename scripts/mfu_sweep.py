@@ -1,13 +1,17 @@
 """On-chip MFU sweep: time the full train step across remat / attention /
-batch / steps-per-dispatch / Adam-mu-dtype grids.
+batch / steps-per-dispatch / Adam-mu-dtype / fused-kernel grids.
 
 Each config runs in a subprocess (the axon compile helper can 500 on big
 programs; isolation keeps one failure from killing the sweep). Prints one
-JSON line per config.
+JSON line per config. Rows run through ``bench.measure_train_rate`` — the
+SAME dispatch loop, fencing, two-segment spread and MFU accounting as the
+headline bench (and the same ``TrainKnobs`` defaults), so a sweep row and
+the headline number can never measure different things.
 
 Usage:
-    python scripts/mfu_sweep.py                                  # grid
-    python scripts/mfu_sweep.py --one <remat> <attn> <batch> [k] [mu]
+    python scripts/mfu_sweep.py                                   # grid
+    python scripts/mfu_sweep.py --one <remat> <attn> <batch> [k] [mu] [fused]
+    python scripts/mfu_sweep.py --fused on                        # A/B half
 """
 
 from __future__ import annotations
@@ -21,75 +25,51 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 GRID = [
-    # (remat_policy, attn_impl, per_chip_batch, k_dispatch, mu_dtype)
-    ("nothing_saveable", "xla", 4, 1, "none"),      # round-1 baseline
-    ("nothing_saveable", "xla", 4, 16, "none"),     # dispatch amortization
-    ("block_outs", "xla", 4, 16, "none"),           # round-2 headline
-    ("block_outs", "xla", 4, 16, "bfloat16"),
-    ("block_outs", "pallas", 4, 16, "bfloat16"),
-    ("dots_no_batch", "xla", 4, 16, "bfloat16"),
-    ("none", "pallas", 4, 16, "bfloat16"),
+    # (remat_policy, attn_impl, per_chip_batch, k_dispatch, mu_dtype, fused)
+    ("nothing_saveable", "xla", 4, 1, "none", "off"),   # round-1 baseline
+    ("nothing_saveable", "xla", 4, 16, "none", "off"),  # dispatch amortization
+    ("block_outs", "xla", 4, 16, "none", "off"),        # round-2 headline
+    ("block_outs", "xla", 4, 16, "bfloat16", "off"),
+    ("block_outs", "pallas", 4, 16, "bfloat16", "off"),
+    ("dots_no_batch", "xla", 4, 16, "bfloat16", "off"),
+    ("none", "pallas", 4, 16, "bfloat16", "off"),
+    # The round-6 A/B: headline knobs with the fused Pallas kernels
+    # (blockwise CE + RMSNorm/SwiGLU) off vs on.
+    ("dots_flash", "pallas", 5, 32, "bfloat16", "off"),
+    ("dots_flash", "pallas", 5, 32, "bfloat16", "on"),
 ]
 
 
 def run_one(remat: str, attn: str, batch: int, kd: int = 1,
-            mu: str = "none", steps: int = 16, warmup_disp: int = 2):
+            mu: str = "none", fused: str = "auto", disp: int = 2,
+            warm_disp: int = 2):
+    from bench import apply_perf_flags_if_tpu, measure_train_rate
+
+    apply_perf_flags_if_tpu()
+
     import jax
-    import numpy as np
 
     from kubeflow_tpu.models.config import preset
-    from kubeflow_tpu.runtime.mesh import build_mesh
-    from kubeflow_tpu.runtime.topology import detect_local_cluster
-    from kubeflow_tpu.train.data import DataConfig, make_data_source
-    from kubeflow_tpu.train.optim import OptimizerConfig
-    from kubeflow_tpu.train.step import setup_train
 
-    devices = jax.devices()
-    n = len(devices)
+    if jax.default_backend() != "tpu":
+        attn = "xla"               # interpret-mode kernels are CI-only
     cfg = preset(
         "llama3-8b",
         n_layers=8, hidden=2048, n_heads=32, n_kv_heads=8, head_dim=64,
         mlp_dim=8192, vocab_size=32000, max_seq_len=2048,
-        remat_policy=remat,
+        remat_policy=remat, fused_kernels=fused,
     )
-    mesh = build_mesh({"fsdp": n}, devices)
-    data_cfg = DataConfig(vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
-                          global_batch=batch * n)
-    source = make_data_source(data_cfg)
-    opt_cfg = OptimizerConfig(total_steps=10_000,
-                              mu_dtype=None if mu == "none" else mu)
-    task = setup_train(cfg, opt_cfg, mesh, attn_impl=attn)
-
-    def dispatch(i0, state):
-        b = np.stack([source.batch_at(i0 + j) for j in range(kd)])
-        b = jax.device_put(b, task.multi_batch_sharding)
-        state, metrics = task.multi_step_fn(state, b)
-        # Host fetch of the loss = the only reliable fence on the tunnel.
-        return state, float(metrics["loss"])
-
-    state = task.state
     t_c0 = time.perf_counter()
-    for w in range(warmup_disp):
-        state, loss = dispatch(w * kd, state)
-    compile_s = time.perf_counter() - t_c0
-
-    n_disp = max(steps // kd, 1)
-    t0 = time.perf_counter()
-    for di in range(n_disp):
-        state, loss = dispatch((warmup_disp + di) * kd, state)
-    dt = time.perf_counter() - t0
-
-    tokens = data_cfg.global_batch * data_cfg.seq_len * kd * n_disp
-    tps_chip = tokens / dt / n
-    gen = detect_local_cluster().slices[0].gen
-    mfu = (cfg.flops_per_token() * tps_chip) / (gen.bf16_tflops * 1e12)
+    out = measure_train_rate(
+        cfg, batch, k_dispatch=kd, warm_disp=warm_disp, disp=disp,
+        mu_dtype=None if mu == "none" else mu, attn_impl=attn)
+    wall = time.perf_counter() - t_c0
     return {
         "remat": remat, "attn": attn, "batch": batch, "k": kd, "mu": mu,
-        "tok_s_chip": round(tps_chip, 1),
-        "step_ms": round(dt / (kd * n_disp) * 1e3, 2),
-        "mfu": round(mfu, 4),
-        "loss": round(loss, 4),
-        "compile_s": round(compile_s, 1),
+        "fused": fused,
+        **{k: out[k] for k in ("tok_s_chip", "step_ms", "mfu", "loss",
+                               "segments", "spread_pct")},
+        "wall_s": round(wall, 1),
     }
 
 
@@ -98,19 +78,28 @@ def main():
         remat, attn, batch = sys.argv[2], sys.argv[3], int(sys.argv[4])
         kd = int(sys.argv[5]) if len(sys.argv) > 5 else 1
         mu = sys.argv[6] if len(sys.argv) > 6 else "none"
-        print(json.dumps(run_one(remat, attn, batch, kd, mu)))
+        fused = sys.argv[7] if len(sys.argv) > 7 else "auto"
+        print(json.dumps(run_one(remat, attn, batch, kd, mu, fused)))
         return
 
-    for remat, attn, batch, kd, mu in GRID:
+    grid = GRID
+    if len(sys.argv) >= 3 and sys.argv[1] == "--fused":
+        # Just the fused A/B half of the grid, one side: the quick re-check
+        # after touching the kernels.
+        grid = [row for row in GRID if row[5] == sys.argv[2]
+                and row[0] == "dots_flash"]
+
+    for remat, attn, batch, kd, mu, fused in grid:
         cmd = [sys.executable, __file__, "--one", remat, attn, str(batch),
-               str(kd), mu]
+               str(kd), mu, fused]
         t0 = time.perf_counter()
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=900)
         except subprocess.TimeoutExpired:
             print(json.dumps({"remat": remat, "attn": attn, "batch": batch,
-                              "k": kd, "failed": True, "err": "timeout 900s"}),
+                              "k": kd, "fused": fused, "failed": True,
+                              "err": "timeout 900s"}),
                   flush=True)
             continue
         wall = round(time.perf_counter() - t0, 1)
@@ -119,8 +108,9 @@ def main():
         else:
             err = (proc.stderr or "")[-300:].replace("\n", " | ")
             print(json.dumps({"remat": remat, "attn": attn, "batch": batch,
-                              "k": kd, "mu": mu, "failed": True,
-                              "wall_s": wall, "err": err}), flush=True)
+                              "k": kd, "mu": mu, "fused": fused,
+                              "failed": True, "wall_s": wall, "err": err}),
+                  flush=True)
 
 
 if __name__ == "__main__":

@@ -142,6 +142,8 @@ class TestPagedExactMatch:
         got = [list(r.output_tokens) for r in reqs]
         assert got == want
 
+    @pytest.mark.slow   # ~7s: capacity/slot decoupling; pool accounting
+    # stays fast-covered by the allocator units + TestPagedExactMatch
     def test_hbm_decoupled_from_slots(self, cfg, params):
         """A pool far below slots × max_len still serves mixed traffic: the
         whole point of paging on v5e."""
@@ -235,6 +237,7 @@ class TestConcurrentChunkedPrefills:
 
 
 class TestPreemption:
+    @pytest.mark.slow   # ~7s: preempt/resume also chaos-covered
     def test_pool_pressure_preempts_and_resumes(self, cfg, params):
         """A pool too small for all slots forces recompute preemption; every
         request still finishes with the exact greedy output."""
@@ -416,6 +419,8 @@ class TestPagedAttentionKernel:
                                      lengths)
         assert float(jnp.abs(out - base).max()) == 0.0
 
+    @pytest.mark.slow   # ~12s e2e; the kernel-level pallas-vs-gather
+    # equivalence tests above stay fast
     def test_engine_pallas_matches_gather_end_to_end(self):
         """The whole paged engine under attn_impl=pallas (interpret mode)
         must reproduce the gather path's greedy outputs. float32 config:

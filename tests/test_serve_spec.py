@@ -194,6 +194,8 @@ class TestPagedRollback:
             assert int(alloc._ref.sum()) == 0
             assert alloc.available() == alloc.num_pages
 
+    @pytest.mark.slow   # ~7s: refcount balance also pinned by the
+    # sanitizer + chaos suites
     def test_rejection_heavy_refcounts_balance(self, cfg, params):
         """A deliberately-bad draft model rejects nearly every round —
         maximal rollback traffic — and the pool must come back whole."""

@@ -159,6 +159,7 @@ class TestExperimentLifecycle:
 
 
 class TestMaximize:
+    @pytest.mark.slow   # ~9s: the minimize loop covers the machinery
     def test_maximize_objective(self, cp):
         cp.submit(experiment_of(objective_type="maximize"))
         exp = pump(cp, value_fn=lambda p: -quad(p))
