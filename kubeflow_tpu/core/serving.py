@@ -295,9 +295,11 @@ class BatchingSpec(BaseModel):
     quantize: Optional[str] = None
     # KV cache storage dtype for the PAGED pool: "int8" stores K/V int8
     # with per-token-per-head dynamic scales — doubles the pool's resident
-    # tokens at the same HBM. Requires paged=True and the "gather" paged
-    # attention impl (the direct-page-read kernel reads bf16 pages).
-    # None = the model activation dtype.
+    # tokens at the same HBM. Requires paged=True; composes with both
+    # paged-attention impls (the direct-page-read kernel dequantizes
+    # in VMEM), with disaggregated roles (scale blobs ride the v2 wire
+    # format), and with the host tier (demote/promote batches carry
+    # scale rows). None = the model activation dtype.
     kv_cache_dtype: Optional[str] = None
     # "auto": Pallas flash kernel on TPU (forward-only prefill is where it
     # wins), XLA elsewhere; or force "pallas"/"xla".
@@ -352,30 +354,14 @@ class BatchingSpec(BaseModel):
         if self.role not in ENGINE_ROLES:
             raise ValueError(
                 f"unknown engine role {self.role!r}; one of {ENGINE_ROLES}")
-        if self.role != "unified" and self.kv_cache_dtype is not None:
-            # Handoff payloads carry raw cache-dtype KV; a quantized pool
-            # would need a requantize round-trip whose per-token scales
-            # are not guaranteed to reproduce the unified path's bits —
-            # and token identity across the boundary is the contract.
-            raise ValueError(
-                "disaggregated roles require kv_cache_dtype=None "
-                "(handoff transfers raw-dtype KV pages)")
         if self.prefix_index not in ("radix", "flat"):
             raise ValueError(
                 f"unknown prefix_index {self.prefix_index!r}; "
                 "one of radix|flat")
-        if self.host_kv_pages:
-            if self.prefix_index != "radix":
-                raise ValueError(
-                    "host_kv_pages requires prefix_index='radix' (the "
-                    "flat hash has no tier lifecycle)")
-            if self.kv_cache_dtype is not None:
-                # Host blobs carry raw cache-dtype page bytes; a
-                # quantized pool would need scale blobs alongside — not
-                # wired. Same constraint as handoff payloads.
-                raise ValueError(
-                    "host_kv_pages requires kv_cache_dtype=None "
-                    "(the host tier stores raw-dtype page bytes)")
+        if self.host_kv_pages and self.prefix_index != "radix":
+            raise ValueError(
+                "host_kv_pages requires prefix_index='radix' (the "
+                "flat hash has no tier lifecycle)")
         if self.lora.max_adapters:
             if self.role != "unified":
                 # Handoff payloads carry KV only — the adopting engine

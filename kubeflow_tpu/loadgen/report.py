@@ -66,6 +66,16 @@ ATTRIBUTION_SERIES = (
     "kftpu_engine_kv_cow_copies_total",
     "kftpu_engine_kv_pages_demoted_total",
     "kftpu_engine_kv_pages_promoted_total",
+    # Quantized KV fabric (ops/quantization.py kv path): pool dtype +
+    # token density, and the wire bytes the handoff/tier paths actually
+    # moved — an int8 regression names halved-wire-savings gone missing
+    # (bytes back at full-dtype) or density collapsing to the bf16 pool.
+    "kftpu_engine_kv_quant_enabled",
+    "kftpu_engine_kv_quant_tokens_per_mib",
+    "kftpu_engine_kv_handoff_bytes_exported_total",
+    "kftpu_engine_kv_handoff_bytes_adopted_total",
+    "kftpu_engine_kv_wire_bytes_demoted_total",
+    "kftpu_engine_kv_wire_bytes_promoted_total",
     # Multi-tenant LoRA (serve/lora.py): adapter residency + hot-load/
     # evict lifecycle — a multi_adapter regression names adapter churn
     # (loads/evictions climbing) instead of just the latency.

@@ -161,6 +161,33 @@ def quantize_params_int8(params: dict, cfg) -> dict:
     return out
 
 
+def packed_param_bytes_estimate(cfg, weight_itemsize: int = None) -> int:
+    """``packed_param_bytes`` from the config alone — the repository's
+    placement estimate for an engine it has NOT built yet (no param
+    pytree exists before load). Prices exactly the leaves
+    ``quantize_params_int8`` packs (attention + MLP matmuls, lm_head) at
+    1 byte/param + f32 per-output-channel scales, and everything else
+    (embed, norms, MoE router) at the weight dtype — the same layout the
+    density test pins against real packed params."""
+    itemsize = (cfg.weight_dtype.itemsize if weight_itemsize is None
+                else weight_itemsize)
+    L = cfg.n_layers
+    h, q_out = cfg.hidden, cfg.n_heads * cfg.head_dim
+    kv_out = cfg.n_kv_heads * cfg.head_dim
+    # Per-layer attention matmuls + their per-output-channel scale rows.
+    quant = L * (h * q_out + 2 * h * kv_out + q_out * h)
+    scales = L * (q_out + 2 * kv_out + h)
+    experts = cfg.num_experts if cfg.is_moe else 1
+    quant += L * experts * 3 * h * cfg.mlp_dim
+    scales += L * experts * (2 * cfg.mlp_dim + h)
+    if not cfg.tie_embeddings:
+        quant += h * cfg.vocab_size
+        scales += cfg.vocab_size
+    total = cfg.num_params()
+    other = max(total - quant, 0)
+    return quant + scales * 4 + other * itemsize
+
+
 def packed_param_bytes(params: dict) -> int:
     """Stored parameter bytes with quantization accounted (the number the
     AOT density proof checks against HBM)."""

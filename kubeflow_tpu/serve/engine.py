@@ -558,6 +558,11 @@ class EngineMetrics:
         self.handoffs_exported = 0      # guarded_by: _lock
         self.handoffs_adopted = 0       # guarded_by: _lock
         self.handoffs_failed = 0        # guarded_by: _lock
+        # KV bytes shipped/received over the handoff wire (pages + scale
+        # blobs) — with int8 pools these run at ~half the full-dtype
+        # rate, the r05 wire-bytes claim's measured series.
+        self.handoff_bytes_exported = 0  # guarded_by: _lock
+        self.handoff_bytes_adopted = 0   # guarded_by: _lock
         self._qd_counts = [0] * (len(QUEUE_DELAY_BUCKETS) + 1)  # guarded_by: _lock
         self._qd_sum = 0.0              # guarded_by: _lock
         self._qd_n = 0                  # guarded_by: _lock
@@ -618,14 +623,17 @@ class EngineMetrics:
             self.preemptions += 1
             self._qos_entry(qos)["preempted"] += 1
 
-    def note_handoff(self, event: str) -> None:
+    def note_handoff(self, event: str, wire_bytes: int = 0) -> None:
         """One handoff lifecycle event: ``exported`` | ``adopted`` |
-        ``failed``."""
+        ``failed`` — exports/adoptions also account their payload's KV
+        wire bytes."""
         with self._lock:
             if event == "exported":
                 self.handoffs_exported += 1
+                self.handoff_bytes_exported += wire_bytes
             elif event == "adopted":
                 self.handoffs_adopted += 1
+                self.handoff_bytes_adopted += wire_bytes
             else:
                 self.handoffs_failed += 1
 
@@ -723,6 +731,8 @@ class EngineMetrics:
                 "handoffs_exported": self.handoffs_exported,
                 "handoffs_adopted": self.handoffs_adopted,
                 "handoffs_failed": self.handoffs_failed,
+                "handoff_bytes_exported": self.handoff_bytes_exported,
+                "handoff_bytes_adopted": self.handoff_bytes_adopted,
             }
             if self._qd_n:
                 out["queue_delay_avg_ms"] = self._qd_sum / self._qd_n * 1e3
@@ -987,17 +997,15 @@ class LLMEngine:
             if pattn == "auto":
                 # Mesh mode: gather (pure XLA ops — GSPMD-partitionable);
                 # the direct-page-read kernel would need a shard_map.
-                # int8 pool: gather (the kernel DMAs bf16 pages).
+                # int8 pools ride the kernel too: it reads int8 pages +
+                # scale rows and dequantizes in VMEM.
                 pattn = ("pallas" if on_tpu and self.mesh is None
-                         and not self.kv_quant else "gather")
+                         else "gather")
             if pattn not in ("gather", "pallas"):
                 raise ValueError(
                     f"unknown paged_attn_impl {b.paged_attn_impl!r}; "
                     "one of auto|gather|pallas")
-            if self.kv_quant and pattn == "pallas":
-                raise ValueError(
-                    "kv_cache_dtype=int8 requires paged_attn_impl=gather "
-                    "(the paged-attention kernel reads bf16 pages)")
+            self.paged_attn_impl = pattn    # resolved (post-auto) impl
             self._paged_chunk = jax.jit(
                 lambda p, c, t, tr, st, vl, ncp, lr=None, ai=None: _pin2(
                     paged_chunk_prefill(
@@ -1051,7 +1059,18 @@ class LLMEngine:
         # ``_handoff_release`` and free on the next step.
         self._handoff_holds: dict[str, tuple] = {}  # lockfree: scheduler-confined
         self._handoff_release: "queue.Queue[tuple[str, bool]]" = queue.Queue()
-        if self.paged:
+        if self.paged and self.kv_quant:
+            def _adopt_paged_fn(c, k, v, ks, vs, pidx):
+                # int8 pool: the scale planes scatter alongside their
+                # pages — a page without its scales is garbage content.
+                npages = c["k"].shape[1]
+                pi = jnp.where((pidx >= 0) & (pidx < npages), pidx, npages)
+                out = {**c, "k": c["k"].at[:, pi].set(k, mode="drop"),
+                       "v": c["v"].at[:, pi].set(v, mode="drop"),
+                       "ks": c["ks"].at[:, pi].set(ks, mode="drop"),
+                       "vs": c["vs"].at[:, pi].set(vs, mode="drop")}
+                return self._pin(out)
+        elif self.paged:
             def _adopt_paged_fn(c, k, v, pidx):
                 # OOB page ids (the power-of-two pad) drop their writes —
                 # one trace per padded page-count, log-bounded.
@@ -1352,6 +1371,26 @@ class LLMEngine:
         occupancy — the /metrics tier series' source."""
         return {} if self._kvtier is None else self._kvtier.snapshot()
 
+    def kv_pool_density(self) -> dict:
+        """Paged-pool capacity accounting (empty dict on contiguous
+        engines): token capacity, pool HBM bytes (int8 payload + scale
+        rows when quantized), and tokens-per-MiB — the density series
+        the int8-KV HBM claim (~1.9x resident tokens at equal HBM) is
+        measured from."""
+        if not self.paged:
+            return {}
+        pool_bytes = self.cache["k"].nbytes + self.cache["v"].nbytes
+        if self.kv_quant:
+            pool_bytes += (self.cache["ks"].nbytes
+                           + self.cache["vs"].nbytes)
+        tokens = self._num_pages * self.page_size
+        return {
+            "quant": int(self.kv_quant),
+            "pool_bytes": int(pool_bytes),
+            "token_capacity": int(tokens),
+            "tokens_per_mib": tokens / (pool_bytes / 2**20),
+        }
+
     def submit(self, prompt_tokens: list[int],
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None, *,
@@ -1410,8 +1449,6 @@ class LLMEngine:
         # the unified-fallback local decode).
         wants_handoff = (self.role == "prefill" if handoff is None
                          else bool(handoff))
-        if wants_handoff and self.kv_quant:
-            raise ValueError("handoff requires kv_cache_dtype=None")
         req = Request(prompt_tokens=list(prompt_tokens),
                       params=params or SamplingParams(),
                       id=request_id or f"req-{next(self._id_gen)}",
@@ -1436,8 +1473,14 @@ class LLMEngine:
         uploads the KV into this engine's own pool instead of running
         prefill; the emitted stream starts at the SECOND token."""
         payload.validate()
-        if self.kv_quant:
-            raise ValueError("handoff adoption requires kv_cache_dtype=None")
+        want = "int8" if self.kv_quant else None
+        if payload.cache_dtype != want:
+            # Mixed-dtype fleets fail loudly at the boundary (the caller
+            # recomputes locally) instead of misreading page bytes.
+            raise ValueError(
+                f"handoff cache-dtype mismatch: payload carries "
+                f"{payload.cache_dtype or 'full-dtype'} KV, engine pool is "
+                f"{want or 'full-dtype'}")
         plen = payload.kv_len
         if plen + 1 >= self.max_len:
             raise ValueError(
@@ -2021,6 +2064,7 @@ class LLMEngine:
         s = self.slots[slot_idx]
         req = s.request
         plen = s.length
+        sk_dev = sv_dev = None
         if self.paged:
             pages = self._slot_pages[slot_idx]
             need = -(-plen // self.page_size)
@@ -2031,6 +2075,15 @@ class LLMEngine:
             v_dev = self.cache["v"][:, ids].reshape(
                 self.cfg.n_layers, need * self.page_size,
                 self.cfg.n_kv_heads, self.cfg.head_dim)
+            if self.kv_quant:
+                # int8 pool: the per-token-per-head scale rows ride the
+                # same enqueued gather (wire v2 ships them alongside).
+                sk_dev = self.cache["ks"][:, ids].reshape(
+                    self.cfg.n_layers, need * self.page_size,
+                    self.cfg.n_kv_heads)
+                sv_dev = self.cache["vs"][:, ids].reshape(
+                    self.cfg.n_layers, need * self.page_size,
+                    self.cfg.n_kv_heads)
             # Ownership transfer: the slot's page refs back the payload
             # until the decode side acks — NOT freed, NOT on the table.
             self._handoff_holds[req.id] = (req, pages)
@@ -2042,7 +2095,8 @@ class LLMEngine:
             v_dev = self.cache["v"][:, slot_idx]
         self.slots[slot_idx] = None
         self._dstate.mark_slot(slot_idx)
-        self._pending_exports.append((req, k_dev, v_dev, plen))
+        self._pending_exports.append((req, k_dev, v_dev, sk_dev, sv_dev,
+                                      plen))
 
     def _flush_handoffs(self) -> int:
         """ONE batched device→host fetch for every export queued this
@@ -2053,15 +2107,19 @@ class LLMEngine:
         from kubeflow_tpu.serve.handoff import payload_from_export
 
         items, self._pending_exports = self._pending_exports, []
-        fetched = jax.device_get([(k, v) for _, k, v, _ in items])  # sync-point: one batched export fetch per admit round
+        fetched = jax.device_get(
+            [(k, v, sk, sv) for _, k, v, sk, sv, _ in items])  # sync-point: one batched export fetch per admit round
         now = time.monotonic()
-        for (req, _, _, plen), (k, v) in zip(items, fetched):
-            req.handoff = payload_from_export(req, np.asarray(k),
-                                              np.asarray(v), plen)
+        for (req, _, _, _, _, plen), (k, v, sk, sv) in zip(items, fetched):
+            req.handoff = payload_from_export(
+                req, np.asarray(k), np.asarray(v), plen,
+                kv_sk=None if sk is None else np.asarray(sk),
+                kv_sv=None if sv is None else np.asarray(sv))
             req.finish_reason = "handoff"
             req.finish_time = now
             self.metrics.observe(req)
-            self.metrics.note_handoff("exported")
+            self.metrics.note_handoff(
+                "exported", wire_bytes=req.handoff.wire_bytes)
             req.stream.put(None)
             req.done.set()
         return len(items)
@@ -2085,6 +2143,10 @@ class LLMEngine:
         if kv_k.dtype != dt:
             kv_k = kv_k.astype(dt)
             kv_v = kv_v.astype(dt)
+        kv_sk = None if p.kv_scale_k is None else np.asarray(
+            p.kv_scale_k, np.float32)
+        kv_sv = None if p.kv_scale_v is None else np.asarray(
+            p.kv_scale_v, np.float32)
         if self.paged:
             pg = self.page_size
             need = -(-plen // pg)
@@ -2110,9 +2172,26 @@ class LLMEngine:
                           cfg.head_dim)
                 pidx = np.full((n2,), self._num_pages, np.int32)
                 pidx[:len(fresh)] = fresh
-                self.cache = self._adopt_upload(
-                    self.cache, jnp.asarray(buf_k.reshape(shape5)),
-                    jnp.asarray(buf_v.reshape(shape5)), jnp.asarray(pidx))
+                if self.kv_quant:
+                    # Adoption rebuilds pages AND scales: the payload's
+                    # scale rows scatter into the same fresh pages.
+                    buf_sk = np.zeros(
+                        (cfg.n_layers, n2 * pg, cfg.n_kv_heads), np.float32)
+                    buf_sv = np.zeros_like(buf_sk)
+                    buf_sk[:, :plen - start] = kv_sk[:, start:plen]
+                    buf_sv[:, :plen - start] = kv_sv[:, start:plen]
+                    shape4 = (cfg.n_layers, n2, pg, cfg.n_kv_heads)
+                    self.cache = self._adopt_upload(
+                        self.cache, jnp.asarray(buf_k.reshape(shape5)),
+                        jnp.asarray(buf_v.reshape(shape5)),
+                        jnp.asarray(buf_sk.reshape(shape4)),
+                        jnp.asarray(buf_sv.reshape(shape4)),
+                        jnp.asarray(pidx))
+                else:
+                    self.cache = self._adopt_upload(
+                        self.cache, jnp.asarray(buf_k.reshape(shape5)),
+                        jnp.asarray(buf_v.reshape(shape5)),
+                        jnp.asarray(pidx))
             except Exception:
                 # A failed upload must not strand the refs just taken —
                 # the request fails loudly, the pool stays balanced.
@@ -2148,7 +2227,7 @@ class LLMEngine:
         self._dstate.mark_row(slot_idx)
         if self._draft_cfg is not None:
             self._draft_pos[slot_idx] = 0
-        self.metrics.note_handoff("adopted")
+        self.metrics.note_handoff("adopted", wire_bytes=p.wire_bytes)
         self._finish_if_done(slot_idx)
 
     def _drain_handoff_releases(self) -> int:
@@ -2195,14 +2274,16 @@ class LLMEngine:
         self.cache = self._kv_copy(self.cache, jnp.asarray(s),
                                    jnp.asarray(d))
 
-    def _kv_upload_pages(self, page_ids, k_blocks, v_blocks) -> None:
+    def _kv_upload_pages(self, page_ids, k_blocks, v_blocks,
+                         sk_blocks=None, sv_blocks=None) -> None:
         """Host→device promotion: per-page ``[L, pg, KV, Dh]`` blocks
         into ``page_ids`` through the same scatter handoff adoption
         uses — enqueued before the admit's chunk prefill, so program
         order guarantees the prefill's gather reads promoted content.
         One host copy: blobs pack straight into the pow2-padded buffer
         (pad columns stay uninitialized — their OOB ids drop the
-        write)."""
+        write). int8 pools promote the per-page scale rows
+        (``[L, pg, KV]``) through the same dispatch."""
         cfg = self.cfg
         pg = self.page_size
         dt = self.cache["k"].dtype
@@ -2218,9 +2299,25 @@ class LLMEngine:
             buf_v[:, j] = v_blocks[j]
         pidx = np.full((n2,), self._num_pages, np.int32)
         pidx[:n] = page_ids
-        self.cache = self._adopt_upload(
-            self.cache, jnp.asarray(buf_k), jnp.asarray(buf_v),
-            jnp.asarray(pidx))
+        if self.kv_quant:
+            if sk_blocks is None:
+                raise ValueError(
+                    "int8 pool promotion requires scale blocks (wire v2 "
+                    "blobs) — got a full-dtype batch")
+            buf_sk = np.empty((cfg.n_layers, n2, pg, cfg.n_kv_heads),
+                              np.float32)
+            buf_sv = np.empty_like(buf_sk)
+            for j in range(n):
+                buf_sk[:, j] = sk_blocks[j]
+                buf_sv[:, j] = sv_blocks[j]
+            self.cache = self._adopt_upload(
+                self.cache, jnp.asarray(buf_k), jnp.asarray(buf_v),
+                jnp.asarray(buf_sk), jnp.asarray(buf_sv),
+                jnp.asarray(pidx))
+        else:
+            self.cache = self._adopt_upload(
+                self.cache, jnp.asarray(buf_k), jnp.asarray(buf_v),
+                jnp.asarray(pidx))
 
     def _kv_fetch_pages(self, page_ids):
         """Demotion batch: device-side gather of the pages' planes —
@@ -2229,13 +2326,17 @@ class LLMEngine:
         does the blocking ``device_get``. Power-of-two padded (repeat
         the last id) so the gather's trace set stays log-bounded — an
         unpadded per-batch-size gather would retrace on the scheduler
-        thread and spike TTFT."""
+        thread and spike TTFT. int8 pools return 4 planes (k, v,
+        scale_k, scale_v); full-dtype pools return 2."""
         n = len(page_ids)
         n2 = 1
         while n2 < n:
             n2 *= 2
         padded = list(page_ids) + [page_ids[-1]] * (n2 - n)
         ids = jnp.asarray(np.asarray(padded, np.int32))
+        if self.kv_quant:
+            return (self.cache["k"][:, ids], self.cache["v"][:, ids],
+                    self.cache["ks"][:, ids], self.cache["vs"][:, ids])
         return self.cache["k"][:, ids], self.cache["v"][:, ids]
 
     def _kv_register(self, tokens, slot_idx: int, n_tokens: int) -> None:

@@ -176,6 +176,7 @@ class RadixPrefixIndex:
             "pages_demoted": 0, "pages_promoted": 0,
             "demote_batches": 0, "demote_dropped": 0,
             "host_evictions": 0, "evictions": 0,
+            "demote_wire_bytes": 0, "promote_wire_bytes": 0,
         }
         self._last_scan = 0.0         # lockfree: scheduler-confined
         self.last_promoted = 0        # lockfree: scheduler-confined
@@ -288,6 +289,12 @@ class RadixPrefixIndex:
                         pid = self._allocator.alloc(1, owner=owner)[0]
                     except PagePoolExhausted:
                         break
+                    if child.tier != TIER_HOST:
+                        # The alloc's eviction callback can cascade a
+                        # dropped subtree over ``child`` (same hazard as
+                        # the COW tail): its blob is gone — miss.
+                        self._allocator.free([pid])
+                        break
                     # Promotion: the node returns to the device tier; the
                     # fresh ref (alloc) is the matcher's sharer ref, and
                     # ``retained`` keeps the page cached after release.
@@ -349,6 +356,13 @@ class RadixPrefixIndex:
             fresh = self._allocator.alloc(1, owner=owner)[0]
         except PagePoolExhausted:
             return None
+        if src.tier not in (TIER_DEVICE, TIER_HOST):
+            # The alloc above reclaims ref-0 indexed pages through the
+            # eviction callback — and under pool pressure the coldest
+            # cached page is often ``src`` itself, which arrives here
+            # DEAD with page and blob cleared. Nothing left to copy.
+            self._allocator.free([fresh])
+            return None
         try:
             if src.tier == TIER_DEVICE:
                 self._copy_pages([src.page], [fresh])
@@ -365,14 +379,26 @@ class RadixPrefixIndex:
         """ONE batched host→device upload for ``items`` of
         ``(page_id, wire_blob)``. Blobs decode zero-copy; the engine's
         upload closure packs them into its padded buffer directly (one
-        host copy total on the admission path)."""
+        host copy total on the admission path). int8 blobs (wire v2)
+        carry their scale rows, which ride the same batched upload."""
         ids = [pid for pid, _ in items]
-        ks, vs = [], []
+        with self._lock:
+            self.stats["promote_wire_bytes"] += sum(
+                len(blob) for _, blob in items)
+        ks, vs, sks, svs = [], [], [], []
         for _, blob in items:
-            k, v = pages_from_wire(blob)
+            k, v, sk, sv = pages_from_wire(blob)
             ks.append(k)
             vs.append(v)
-        self._upload_pages(ids, ks, vs)
+            sks.append(sk)
+            svs.append(sv)
+        if any(s is not None for s in sks):
+            if any(s is None for s in sks):
+                raise ValueError(
+                    "mixed quantized/full-dtype blobs in one promote batch")
+            self._upload_pages(ids, ks, vs, sks, svs)
+        else:
+            self._upload_pages(ids, ks, vs)
 
     # -- registration --------------------------------------------------------
 
@@ -585,7 +611,13 @@ class RadixPrefixIndex:
             if not cands:
                 return 0
             ids = [n.page for n in cands]
-            k_dev, v_dev = self._fetch_pages(ids)
+            fetched = self._fetch_pages(ids)
+            # Quantized pools fetch 4 planes (k, v, scale_k, scale_v);
+            # full-dtype pools fetch 2.
+            if len(fetched) == 4:
+                k_dev, v_dev, ks_dev, vs_dev = fetched
+            else:
+                (k_dev, v_dev), ks_dev, vs_dev = fetched, None, None
             for n in cands:
                 self._by_page.pop(n.page, None)
                 n.page = None
@@ -593,7 +625,7 @@ class RadixPrefixIndex:
                 self._migrating += 1
             self._allocator.drop_cached(ids)
             self.stats["demote_batches"] += 1
-        self._queue.put((cands, k_dev, v_dev))
+        self._queue.put((cands, k_dev, v_dev, ks_dev, vs_dev))
         return len(ids)
 
     def _migrate_loop(self) -> None:
@@ -605,13 +637,15 @@ class RadixPrefixIndex:
             item = self._queue.get()
             if item is None:
                 return
-            nodes, k_dev, v_dev = item
+            nodes, k_dev, v_dev, ks_dev, vs_dev = item
             span = get_tracer().start_span(
                 "engine.kv_migrate", direction="demote", pages=len(nodes))
             try:
-                fetched = jax.device_get((k_dev, v_dev))  # sync-point: the migration thread owns this blocking fetch, never the scheduler
+                fetched = jax.device_get((k_dev, v_dev, ks_dev, vs_dev))  # sync-point: the migration thread owns this blocking fetch, never the scheduler
                 k = np.asarray(fetched[0])
                 v = np.asarray(fetched[1])
+                ks = None if fetched[2] is None else np.asarray(fetched[2])
+                vs = None if fetched[3] is None else np.asarray(fetched[3])
                 with self._lock:
                     for j, n in enumerate(nodes):
                         self._migrating -= 1
@@ -620,10 +654,19 @@ class RadixPrefixIndex:
                             # the content is unreachable — discard.
                             self.stats["demote_dropped"] += 1
                             continue
-                        n.blob = pages_to_wire(k[:, j], v[:, j])
+                        # Full-dtype pools call with the v1 positional
+                        # signature so (k, v)-shaped monkeypatch
+                        # wrappers (the seeded-wedge harnesses) survive.
+                        if ks is None:
+                            n.blob = pages_to_wire(k[:, j], v[:, j])
+                        else:
+                            n.blob = pages_to_wire(
+                                k[:, j], v[:, j],
+                                kv_sk=ks[:, j], kv_sv=vs[:, j])
                         n.tier = TIER_HOST
                         self._host_count += 1
                         self.stats["pages_demoted"] += 1
+                        self.stats["demote_wire_bytes"] += len(n.blob)
                 span.end("ok")
             except Exception as exc:
                 # A failed migration batch loses cached content (it was

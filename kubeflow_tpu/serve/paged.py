@@ -325,9 +325,12 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,  # trac
     (ops/paged_attention.py — one DMA per page).
 
     ``pool_ks``/``pool_vs`` ([P,pg,KV] f32, present iff the pool stores
-    int8): per-token-per-head dynamic scales. Writes quantize, the gather
-    reads int8 pages and dequantizes into the attention einsum's operand
-    read — the pool (the resident thing) holds 2× the tokens per byte."""
+    int8): per-token-per-head dynamic scales. Writes quantize; reads
+    either gather+dequantize into the attention einsum's operand
+    ("gather") or ride the direct-page-read kernel, which dequantizes in
+    VMEM ("pallas") — the pool (the resident thing) holds 2× the tokens
+    per byte either way, and the kernel path also halves the per-step KV
+    HBM read."""
     from kubeflow_tpu.serve.engine import _decode_attention
 
     dt = cfg.activation_dtype
@@ -363,11 +366,19 @@ def _paged_decode_block(bp, x, positions, lengths, live, pool_k, pool_v,  # trac
         nv = pool_v.at[pidx, off].set(vq, mode="drop")
         nks = pool_ks.at[pidx, off].set(ks, mode="drop")
         nvs = pool_vs.at[pidx, off].set(vs, mode="drop")
-        ck = dequantize_kv(paged_gather(nk, table),
-                           paged_gather(nks, table), dt)
-        cv = dequantize_kv(paged_gather(nv, table),
-                           paged_gather(nvs, table), dt)
-        attn = _decode_attention(q, ck, cv, lengths, cfg)
+        if attn_impl == "pallas":
+            from kubeflow_tpu.ops.paged_attention import (
+                paged_decode_attention,
+            )
+
+            attn = paged_decode_attention(q, nk, nv, table, lengths,
+                                          pool_ks=nks, pool_vs=nvs)
+        else:
+            ck = dequantize_kv(paged_gather(nk, table),
+                               paged_gather(nks, table), dt)
+            cv = dequantize_kv(paged_gather(nv, table),
+                               paged_gather(nvs, table), dt)
+            attn = _decode_attention(q, ck, cv, lengths, cfg)
     else:
         nk = pool_k.at[pidx, off].set(k[:, 0], mode="drop")
         nv = pool_v.at[pidx, off].set(v[:, 0], mode="drop")

@@ -27,17 +27,31 @@ logger = logging.getLogger("kubeflow_tpu.serve")
 
 
 def estimate_model_bytes(cfg: DecoderConfig, batching=None) -> int:
-    """Weights (param dtype) + the engine's slot KV cache (often dominant
-    for small models at long max_seq_len) + the packed LoRA adapter
+    """Weights (param dtype; int8-packed accounting when the batching
+    spec quantizes — a quantized engine booked at full-dtype bytes reads
+    ~2-4x its true residency, so the LRU would evict half the models it
+    could actually hold) + the engine's slot KV cache (often dominant
+    for small models at long max_seq_len; int8 pools price 1 byte + the
+    4/head_dim scale overhead per element) + the packed LoRA adapter
     buffers when the engine serves multi-tenant adapters (serve/lora.py
     — max_adapters slots of rank-r A/B factors per target)."""
-    param_bytes = cfg.num_params() * cfg.weight_dtype.itemsize
+    if batching is not None and getattr(batching, "quantize", None):
+        from kubeflow_tpu.ops.quantization import packed_param_bytes_estimate
+
+        param_bytes = packed_param_bytes_estimate(cfg)
+    else:
+        param_bytes = cfg.num_params() * cfg.weight_dtype.itemsize
     kv_bytes = 0
     lora_bytes = 0
     if batching is not None:
-        kv_bytes = (2 * cfg.n_layers * batching.max_batch_size
-                    * batching.max_seq_len * cfg.n_kv_heads * cfg.head_dim
-                    * cfg.activation_dtype.itemsize)
+        kv_tokens = (2 * cfg.n_layers * batching.max_batch_size
+                     * batching.max_seq_len * cfg.n_kv_heads)
+        if getattr(batching, "kv_cache_dtype", None) == "int8":
+            # int8 page payload + one f32 scale per token per kv head.
+            kv_bytes = kv_tokens * (cfg.head_dim + 4)
+        else:
+            kv_bytes = (kv_tokens * cfg.head_dim
+                        * cfg.activation_dtype.itemsize)
         lora = getattr(batching, "lora", None)
         if lora is not None and lora.max_adapters:
             from kubeflow_tpu.serve.lora import target_dims

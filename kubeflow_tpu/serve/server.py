@@ -530,6 +530,17 @@ def serving_metrics_registry(engines: list, *,
     handoffs_out = reg.counter("kftpu_engine_handoffs_exported_total")
     handoffs_in = reg.counter("kftpu_engine_handoffs_adopted_total")
     handoffs_bad = reg.counter("kftpu_engine_handoffs_failed_total")
+    # Quantized KV fabric (ops/quantization.py kv path): whether the
+    # pool stores int8, the pool's token density (the ~1.9x-at-equal-HBM
+    # claim's series), and the actual wire bytes moved by handoff export/
+    # adopt and tier demote/promote — int8+scales blobs read ~half the
+    # full-dtype bytes, and THESE counters are where that shows up.
+    kvq_enabled = reg.gauge("kftpu_engine_kv_quant_enabled")
+    kvq_density = reg.gauge("kftpu_engine_kv_quant_tokens_per_mib")
+    ho_bytes_out = reg.counter("kftpu_engine_kv_handoff_bytes_exported_total")
+    ho_bytes_in = reg.counter("kftpu_engine_kv_handoff_bytes_adopted_total")
+    wire_demote = reg.counter("kftpu_engine_kv_wire_bytes_demoted_total")
+    wire_promote = reg.counter("kftpu_engine_kv_wire_bytes_promoted_total")
     # Multi-tenant LoRA (serve/lora.py): which adapters are HOT on this
     # engine (one ``adapter=``-labeled sample per resident adapter — the
     # model-id router's placement signal; a 0 sample without the label
@@ -587,6 +598,16 @@ def serving_metrics_registry(engines: list, *,
         handoffs_out.inc(snap.get("handoffs_exported", 0), model=name)
         handoffs_in.inc(snap.get("handoffs_adopted", 0), model=name)
         handoffs_bad.inc(snap.get("handoffs_failed", 0), model=name)
+        # Contiguous-cache engines render 0/0: the series must exist on
+        # every replica (the loadgen attribution scrape pins the set).
+        density = engine.kv_pool_density()
+        kvq_enabled.set(density.get("quant", 0), model=name)
+        kvq_density.set(round(density.get("tokens_per_mib", 0.0), 1),
+                        model=name)
+        ho_bytes_out.inc(snap.get("handoff_bytes_exported", 0), model=name)
+        ho_bytes_in.inc(snap.get("handoff_bytes_adopted", 0), model=name)
+        wire_demote.inc(tier.get("demote_wire_bytes", 0), model=name)
+        wire_promote.inc(tier.get("promote_wire_bytes", 0), model=name)
         resident = engine.adapters_resident()
         for a in resident:
             adapters_resident.set(1, model=name, adapter=a)

@@ -170,6 +170,23 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 lora_rc=${PIPESTATUS[0]}
 grep -q '"lora_smoke": "ok"' /tmp/_smoke_lora.json || lora_rc=1
 
+echo "== quant smoke (int8 KV fabric: band + density + wire + kernel A/B) =="
+# Quantized-serving gate (ISSUE 16): int8-pool greedy decode must track
+# the full-dtype engine inside the declared tolerance band; the int8
+# prefill→v2-wire→decode path must be token-identical to the int8
+# unified engine; at head_dim=128 the int8 pool must hold >=1.9x the
+# resident KV tokens per MiB and ship <0.6x the handoff/demote wire
+# bytes; in-kernel dequant (pallas, interpret off-TPU) must match
+# gather+dequant token for token on the same int8 pool; a warmed int8
+# engine must replay decode + a handoff round trip with ZERO
+# steady-state recompiles (KFTPU_SANITIZE=refcount,recompile); quant
+# series must parse off the real exposition with per-owner refcounts
+# balanced. Writes BENCH_SERVE_r05.json (the quantized-serving round).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/quant_smoke.py | tee /tmp/_smoke_quant.json
+quant_rc=${PIPESTATUS[0]}
+grep -q '"quant_smoke": "ok"' /tmp/_smoke_quant.json || quant_rc=1
+
 echo "== contract smoke (static name-contract table vs a real serve run) =="
 # Cross-component contract gate (ISSUE 10): the kftpu lint --contracts-json
 # manifest must round-trip, and a serve run under KFTPU_SANITIZE=contract
@@ -180,5 +197,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 contract_rc=${PIPESTATUS[0]}
 grep -q '"contract_smoke": "ok"' /tmp/_smoke_contract.json || contract_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc prefix_cache rc=$prefix_cache_rc lora rc=$lora_rc contract rc=$contract_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$prefix_cache_rc" -eq 0 ] && [ "$lora_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc prefix_cache rc=$prefix_cache_rc lora rc=$lora_rc quant rc=$quant_rc contract rc=$contract_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$prefix_cache_rc" -eq 0 ] && [ "$lora_rc" -eq 0 ] && [ "$quant_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]

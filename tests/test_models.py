@@ -79,6 +79,7 @@ def test_remat_policies_same_loss():
     assert max(losses) - min(losses) < 1e-5
 
 
+@pytest.mark.slow  # tier-1 budget: two pallas grad traces A/B'd, ~8s
 def test_dots_flash_grads_match_unrematted():
     """The dots_flash policy (saved flash (o,lse) residuals) must not
     change gradients — only what the backward recomputes. Pallas impl so

@@ -585,7 +585,11 @@ class TestPipelineTensorParallel:
                       max_seq_len=64, dtype="float32",
                       pipeline_schedule=schedule)
 
-    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    @pytest.mark.parametrize("schedule", [
+        pytest.param("gpipe", marks=pytest.mark.slow),  # tier-1 budget:
+        # ~8s; 1f1b exercises the same pp x tp composition plus staging
+        "1f1b",
+    ])
     def test_pp_tp_matches_unstaged(self, schedule):
         from kubeflow_tpu.models.decoder import (
             decoder_loss, init_decoder_params)

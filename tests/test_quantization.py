@@ -117,10 +117,27 @@ def test_engine_rejects_bad_knobs():
     with pytest.raises(ValueError, match="paged"):
         LLMEngine(cfg, BatchingSpec(kv_cache_dtype="int8", paged=False,
                                     max_seq_len=128))
-    with pytest.raises(ValueError, match="gather"):
-        LLMEngine(cfg, BatchingSpec(kv_cache_dtype="int8", paged=True,
-                                    page_size=16, max_seq_len=128,
-                                    paged_attn_impl="pallas"))
+    # pallas + int8 is a SUPPORTED pair now (in-kernel dequant): the old
+    # "requires paged_attn_impl=gather" ban is gone.
+    eng = LLMEngine(cfg, BatchingSpec(kv_cache_dtype="int8", paged=True,
+                                      page_size=16, max_seq_len=128,
+                                      paged_attn_impl="pallas"))
+    assert eng.kv_quant and eng.paged_attn_impl == "pallas"
+
+
+def test_spec_allows_int8_kv_through_fabric():
+    """The two validator bans this feature removed, pinned OPEN: int8 KV
+    composes with disaggregated roles (the wire carries scale blobs) and
+    with the host tier (demote/promote batches carry them too)."""
+    from kubeflow_tpu.core.serving import BatchingSpec
+
+    # pydantic model_validator: construction IS validation.
+    BatchingSpec(kv_cache_dtype="int8", paged=True, page_size=16,
+                 max_seq_len=128, role="prefill")
+    BatchingSpec(kv_cache_dtype="int8", paged=True, page_size=16,
+                 max_seq_len=128, role="decode")
+    BatchingSpec(kv_cache_dtype="int8", paged=True, page_size=16,
+                 max_seq_len=128, host_kv_pages=32, prefix_index="radix")
 
 
 def test_kv_quantize_roundtrip():
@@ -133,6 +150,67 @@ def test_kv_quantize_roundtrip():
     assert np.all(err <= bound)
 
 
+def test_kv_quantize_extreme_magnitudes():
+    """Per-token-per-head scales keep relative error bounded across 12
+    orders of magnitude in the same batch — a per-tensor scale would
+    flush the small rows to zero."""
+    mags = np.asarray([1e-6, 1e-3, 1.0, 1e3, 1e6], np.float32)
+    x = (jax.random.normal(jax.random.PRNGKey(7), (5, 3, 8))
+         * mags[:, None, None])
+    q, s = quantize_kv(x)
+    deq = np.asarray(dequantize_kv(q, s, jnp.float32))
+    xn = np.asarray(x)
+    for i in range(5):
+        amax = np.abs(xn[i]).max()
+        # Round-to-nearest on a 127-step grid: error <= amax/254 per row.
+        assert np.abs(deq[i] - xn[i]).max() <= amax / 127, mags[i]
+
+
+def test_kv_quantize_zero_rows():
+    """All-zero K/V rows (padding, unwritten page tails) must survive
+    exactly — the 1e-8 scale floor guards the 0/0, and dequant returns
+    exact zeros, not NaN."""
+    x = jnp.zeros((3, 2, 16))
+    q, s = quantize_kv(x)
+    assert np.all(np.asarray(q) == 0)
+    assert np.all(np.asarray(s) > 0)          # floored, not 0 (no 0/0)
+    deq = np.asarray(dequantize_kv(q, s, jnp.float32))
+    assert np.all(deq == 0.0) and not np.any(np.isnan(deq))
+    # Mixed: one zero row among live rows stays exact.
+    x = x.at[1, 1, :].set(jnp.arange(16, dtype=jnp.float32))
+    q, s = quantize_kv(x)
+    deq = np.asarray(dequantize_kv(q, s, jnp.float32))
+    assert np.all(deq[0] == 0.0)
+    assert np.abs(deq[1, 1] - np.arange(16)).max() <= 15.0 / 254 + 1e-6
+
+
+@pytest.mark.parametrize("dh", [1, 3, 7, 17])
+def test_kv_quantize_odd_head_dims(dh):
+    """The scheme is shape-agnostic over head_dim (no lane-alignment
+    assumption leaks into the math)."""
+    x = jax.random.normal(jax.random.PRNGKey(11), (4, 2, dh)) * 2.5
+    q, s = quantize_kv(x)
+    assert q.shape == x.shape and s.shape == x.shape[:-1]
+    deq = np.asarray(dequantize_kv(q, s, jnp.float32))
+    bound = np.asarray(s)[..., None] / 2 + 1e-9
+    assert np.all(np.abs(deq - np.asarray(x)) <= bound)
+
+
+def test_packed_param_bytes_estimate_exact():
+    """The config-only estimate prices EXACTLY what quantize_params_int8
+    packs (the repository books placement off the estimate before any
+    params exist — drift here mis-sizes the LRU budget)."""
+    from kubeflow_tpu.ops.quantization import packed_param_bytes_estimate
+
+    for name in ("tiny", "tiny-moe"):
+        cfg = preset(name, param_dtype="float32")
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        real = packed_param_bytes(quantize_params_int8(params, cfg))
+        assert packed_param_bytes_estimate(cfg) == real, name
+
+
+@pytest.mark.slow  # tier-1 budget: ~8s; quant_smoke gates the int8 paged
+# e2e path (band + fabric identity) on every smoke run
 def test_paged_int8_kv_engine_e2e():
     """int8 paged pool serves greedy decode; outputs track the bf16 paged
     engine; pool bytes halve (+scale overhead)."""

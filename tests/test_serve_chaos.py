@@ -323,6 +323,7 @@ def test_chaos_qos_overload_sheds_batch_first(stack):
     audit_quiescent(a, b)
 
 
+@pytest.mark.slow  # tier-1 budget: sanitizer fleet under kill loop, ~8s
 def test_chaos_refcount_sanitizer_kill_mid_traffic(monkeypatch):
     """ISSUE 7: one chaos scenario end-to-end under
     ``KFTPU_SANITIZE=refcount`` — SIGKILL analog mid-traffic, then the
@@ -379,6 +380,8 @@ def test_chaos_refcount_sanitizer_kill_mid_traffic(monkeypatch):
                 pass
 
 
+@pytest.mark.slow  # tier-1 budget: ~8s; the handoff module's
+# unified-fallback test keeps the recompute lane in tier-1
 def test_chaos_prefill_kill_mid_handoff_unified_fallback(monkeypatch):
     """ISSUE 12: SIGKILL the PREFILL replica of a disaggregated fleet
     mid-handoff, under ``KFTPU_SANITIZE=refcount``. Invariants:
@@ -464,6 +467,7 @@ def test_chaos_prefill_kill_mid_handoff_unified_fallback(monkeypatch):
                 pass
 
 
+@pytest.mark.slow  # tier-1 budget: ~10s; COW-cancel also pinned by kvtier
 def test_chaos_cancel_while_shared(monkeypatch):
     """Tiered KV cache (ISSUE 13): cancel a request whose prefix pages
     are SHARED ref>0 with another in-flight request. The co-sharer must
@@ -578,6 +582,160 @@ def test_chaos_kill_mid_migration(monkeypatch):
             # Host-tier books: in-flight batches drain (the daemon
             # thread survives the server kill) and occupancy stays
             # consistent with the budget — no phantom pages.
+            tier = srv.engine._kvtier
+            tier.drain_migrations(timeout_s=10.0)
+            snap = tier.snapshot()
+            assert 0 <= snap["host_pages_resident"] <= 48
+            assert snap["migrating_pages"] == 0
+    finally:
+        router.stop()
+        for s in (a, b):
+            try:
+                s.stop()
+            except OSError:
+                pass
+
+
+@pytest.mark.slow
+def test_chaos_int8_prefill_kill_mid_handoff(monkeypatch):
+    """Quantized fabric under SIGKILL mid-handoff: an int8-pool prefill
+    replica dies between export and ack. The hold backed int8 pages AND
+    their scale rows — the per-owner audit must name ZERO leaks on both
+    replicas (scales share page identity, so a page freed is its scale
+    row freed), and the surviving int8 decode replica keeps serving
+    token-consistently with a fresh int8 reference engine."""
+    monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+    cfg = preset("tiny", vocab_size=512)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+
+    def spec(role):
+        return BatchingSpec(max_batch_size=2, max_seq_len=96,
+                            prefill_buckets=[32], paged=True, page_size=16,
+                            chunked_prefill_tokens=16, decode_steps=4,
+                            kv_cache_dtype="int8", role=role)
+
+    def mk(name, role):
+        srv = ModelServer(name, LLMEngine(cfg, spec(role), params=params),
+                          port=0)
+        srv.start()
+        return srv
+
+    pre, dec = mk("q-pre", "prefill"), mk("q-dec", "decode")
+    assert pre.engine.kv_quant and "ks" in pre.engine.cache
+    router = Router(queue_timeout=5.0, eject_threshold=2, eject_period=5.0,
+                    max_retries=2, upstream_timeout=30.0)
+    router.scrape_interval = 0.1
+    router.set_pools({"prefill": [pre.url], "decode": [dec.url]})
+    router.start()
+    try:
+        results = fire(router.url, 6, timeout_s=10.0)
+        assert set(results) <= EXPLICIT_STATUSES
+        assert results.count(200) >= 4, results
+        assert pre.engine.metrics.snapshot()["handoff_bytes_exported"] > 0
+        # Strand a mid-handoff hold (quantized pages + scale rows), then
+        # SIGKILL the prefill replica.
+        orphan = pre.engine.submit([7] * 24, SamplingParams(max_new_tokens=8),
+                                   handoff=True)
+        assert orphan.done.wait(20.0)
+        assert orphan.finish_reason == "handoff"
+        assert orphan.handoff.cache_dtype == "int8"
+        assert pre.engine.kv_pages_in_use() > 0
+        kill_model_server(pre)
+        time.sleep(0.5)
+        # Survivor still serves; unified fallback on the decode pool.
+        results = fire(router.url, 8, timeout_s=10.0)
+        assert set(results) <= EXPLICIT_STATUSES
+        assert results.count(200) >= 4, results
+        # Token consistency: the survivor's local decode matches a fresh
+        # int8 engine on the same prompt (its pool was never corrupted
+        # by the dead peer's half-shipped blob).
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        prompt = [3, 1, 4, 1, 5, 9] * 4
+        got = dec.engine.generate(list(prompt), sp)
+        want = LLMEngine(cfg, spec("unified"),
+                         params=params).generate(list(prompt), sp)
+        assert got == want, (got, want)
+        audit_quiescent(pre, dec)
+        for srv in (pre, dec):
+            alloc = srv.engine._allocator
+            assert alloc.stats["stamped_allocs"] > 0
+            assert alloc.leak_report_by_owner() == {}
+            alloc.assert_quiescent()
+    finally:
+        router.stop()
+        for s in (pre, dec):
+            try:
+                s.stop()
+            except OSError:
+                pass
+
+
+@pytest.mark.slow
+def test_chaos_int8_kill_mid_migration(monkeypatch):
+    """Quantized pool under SIGKILL mid-demotion: the migration batch in
+    flight carries int8 pages + scale rows (5-tuple queue items → v2
+    blobs). Device books must balance to zero per owner on both
+    replicas, the host tier stays within budget, and the survivor keeps
+    serving token-consistently."""
+    monkeypatch.setenv("KFTPU_SANITIZE", "refcount")
+    import kubeflow_tpu.serve.kvtier as kvtier
+
+    real_wire = kvtier.pages_to_wire
+
+    def slow_wire(k, v, **kw):
+        time.sleep(0.25)                # widen the mid-migration window
+        return real_wire(k, v, **kw)
+
+    monkeypatch.setattr(kvtier, "pages_to_wire", slow_wire)
+    cfg = preset("tiny", vocab_size=512)
+    params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+
+    def spec():
+        return BatchingSpec(max_batch_size=2, max_seq_len=96,
+                            prefill_buckets=[32], paged=True, page_size=16,
+                            chunked_prefill_tokens=16, decode_steps=4,
+                            kv_cache_dtype="int8",
+                            host_kv_pages=48, kv_demote_after_s=0.05)
+
+    def mk(name):
+        srv = ModelServer(name, LLMEngine(cfg, spec(), params=params),
+                          port=0)
+        srv.start()
+        return srv
+
+    a, b = mk("qmig-a"), mk("qmig-b")
+    router = Router(queue_timeout=5.0, eject_threshold=2, eject_period=0.4,
+                    max_retries=2, upstream_timeout=30.0)
+    router.set_backends({"latest": [b.url, a.url]})
+    router.start()
+    try:
+        results = fire(router.url, 8, timeout_s=6.0)
+        assert set(results) <= EXPLICIT_STATUSES
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            with b.engine._kvtier._lock:
+                migrating = b.engine._kvtier._migrating
+            if migrating > 0 or b.engine.kv_pages_host() > 0:
+                break
+            time.sleep(0.01)
+        assert migrating > 0 or b.engine.kv_pages_host() > 0, \
+            "no demotion ever started on b"
+        kill_model_server(b)
+        results = fire(router.url, 8, timeout_s=6.0)
+        assert set(results) <= EXPLICIT_STATUSES
+        assert results.count(200) >= 4, results
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        prompt = [2, 7, 1, 8, 2, 8] * 4
+        got = a.engine.generate(list(prompt), sp)
+        want = LLMEngine(cfg, spec(), params=params).generate(
+            list(prompt), sp)
+        assert got == want, (got, want)
+        audit_quiescent(a, b)
+        for srv in (a, b):
+            alloc = srv.engine._allocator
+            assert alloc.stats["stamped_allocs"] > 0
+            assert alloc.leak_report_by_owner() == {}
+            alloc.assert_quiescent()
             tier = srv.engine._kvtier
             tier.drain_migrations(timeout_s=10.0)
             snap = tier.snapshot()

@@ -176,6 +176,121 @@ def test_adoption_rejects_shape_and_budget_mismatch():
         dec.submit_handoff(bad_shape)
 
 
+@pytest.mark.slow   # ~20s: four engines, three prompts each
+def test_int8_greedy_token_identity_across_handoff():
+    """Tentpole pin: the quantized fabric end to end. An int8-pool
+    prefill engine exports v2 blobs (int8 pages + scale rows); the int8
+    decode engine adopts them and must reproduce the int8 UNIFIED
+    engine's greedy output token for token — same quantized KV, so the
+    wire/adopt rebuild cannot introduce any divergence."""
+    uni = engine(paged=True, kv_cache_dtype="int8")
+    pre = engine(role="prefill", paged=True, kv_cache_dtype="int8")
+    dec = engine(role="decode", paged=True, kv_cache_dtype="int8")
+    params = SamplingParams(max_new_tokens=12, temperature=0.0)
+    for prompt in PROMPTS:
+        want = uni.generate(prompt, params)
+        p_req = drive(pre, pre.submit(prompt, params))
+        assert p_req.finish_reason == "handoff"
+        payload = p_req.handoff
+        assert payload.cache_dtype == "int8"
+        assert payload.kv_k.dtype == np.int8
+        assert payload.kv_scale_k is not None
+        # The v2 wire round trip the HTTP path ships.
+        wire = payload.to_wire()
+        payload = HandoffPayload.from_wire(wire)
+        assert payload.cache_dtype == "int8"
+        d_req = drive(dec, dec.submit_handoff(payload))
+        got = [payload.first_token] + d_req.output_tokens
+        assert got == want, (prompt, got, want)
+        pre.complete_handoff(p_req.id)
+        # Wire savings: int8+scales vs the full-dtype payload for the
+        # same prompt (~0.625x at tiny's Dh=16; ~0.52x at Dh=128).
+        full = engine(role="prefill", paged=True)
+        f_req = drive(full, full.submit(prompt, params))
+        assert len(wire) < len(f_req.handoff.to_wire()) * 0.8
+        full.complete_handoff(f_req.id)
+    # Byte metrics flowed on both sides.
+    assert pre.metrics.snapshot()["handoff_bytes_exported"] > 0
+    assert dec.metrics.snapshot()["handoff_bytes_adopted"] > 0
+    drain(pre)
+    drain(dec)
+    pre._allocator.assert_quiescent()
+    dec._allocator.assert_quiescent()
+
+
+@pytest.mark.slow  # tier-1 budget: two engines + adoption round trips
+def test_int8_adopted_pages_register_prefix_for_reuse():
+    """Adoption rebuilds pages AND scale rows into the radix index: a
+    same-prefix re-adoption on the int8 decode engine hits cache."""
+    pre = engine(role="prefill", paged=True, kv_cache_dtype="int8")
+    dec = engine(role="decode", paged=True, kv_cache_dtype="int8")
+    prompt = list(range(1, 33))
+    params = SamplingParams(max_new_tokens=6, temperature=0.0)
+    p1 = drive(pre, pre.submit(prompt, params))
+    drive(dec, dec.submit_handoff(HandoffPayload.from_wire(
+        p1.handoff.to_wire())))
+    pre.complete_handoff(p1.id)
+    hits_before = dec._allocator.stats["prefix_hits"]
+    p2 = drive(pre, pre.submit(prompt, params, request_id="again"))
+    drive(dec, dec.submit_handoff(p2.handoff))
+    pre.complete_handoff(p2.id)
+    assert dec._allocator.stats["prefix_hits"] > hits_before
+    drain(pre)
+    drain(dec)
+    dec._allocator.assert_quiescent()
+
+
+@pytest.mark.slow  # tier-1 budget: four engines; negative path also covered by wire-v2 tests
+def test_adoption_rejects_cache_dtype_mismatch():
+    """A mixed fleet mid-rollout must fail LOUDLY, both directions: a
+    full-dtype payload on an int8 engine and vice versa."""
+    pre8 = engine(role="prefill", paged=True, kv_cache_dtype="int8")
+    pre16 = engine(role="prefill", paged=True)
+    dec8 = engine(role="decode", paged=True, kv_cache_dtype="int8")
+    dec16 = engine(role="decode", paged=True)
+    params = SamplingParams(max_new_tokens=4, temperature=0.0)
+    p8 = drive(pre8, pre8.submit(PROMPTS[0], params))
+    p16 = drive(pre16, pre16.submit(PROMPTS[0], params))
+    with pytest.raises(ValueError, match="cache-dtype mismatch"):
+        dec16.submit_handoff(p8.handoff)
+    with pytest.raises(ValueError, match="cache-dtype mismatch"):
+        dec8.submit_handoff(p16.handoff)
+    # The matched pairs still work.
+    drive(dec8, dec8.submit_handoff(p8.handoff))
+    drive(dec16, dec16.submit_handoff(p16.handoff))
+    pre8.complete_handoff(p8.id)
+    pre16.complete_handoff(p16.id)
+    for e in (pre8, pre16, dec8, dec16):
+        drain(e)
+        e._allocator.assert_quiescent()
+
+
+def test_wire_v2_rejects_malformed_scales():
+    """v2 validation: scales without int8 payload, one-sided scales, and
+    a scale shape that disagrees with the page shape all fail validate()
+    before anything ships."""
+    kv8 = np.ones((1, 2, 1, 4), np.int8)
+    sc = np.ones((1, 2, 1), np.float32)
+    base = dict(request_id="w", prompt_tokens=[1, 2], first_token=3,
+                max_new_tokens=2, temperature=0.0, top_k=0, top_p=1.0,
+                stop_token=None, qos="standard")
+    with pytest.raises(ValueError, match="pair"):
+        HandoffPayload(kv_k=kv8, kv_v=kv8, kv_scale_k=sc, **base).validate()
+    with pytest.raises(ValueError, match="int8"):
+        HandoffPayload(kv_k=kv8.astype(np.float32),
+                       kv_v=kv8.astype(np.float32),
+                       kv_scale_k=sc, kv_scale_v=sc, **base).validate()
+    with pytest.raises(ValueError, match="scale"):
+        HandoffPayload(kv_k=kv8, kv_v=kv8, kv_scale_k=sc[:, :1],
+                       kv_scale_v=sc[:, :1], **base).validate()
+    # Truncating the scale segment off a v2 blob is detected.
+    good = HandoffPayload(kv_k=kv8, kv_v=kv8, kv_scale_k=sc,
+                          kv_scale_v=sc, **base)
+    wire = good.to_wire()
+    with pytest.raises(ValueError, match="truncated"):
+        HandoffPayload.from_wire(wire[:-2])
+
+
 def test_wire_format_rejects_truncation():
     payload = HandoffPayload(
         request_id="w", prompt_tokens=[1, 2], first_token=3,

@@ -62,10 +62,11 @@ def specs(cfg):
 
 
 def mk_engine(cfg, params, *, paged: bool, lora_slots: int = 2,
-              max_new_room: int = 128):
+              max_new_room: int = 128, kv_dtype=None):
     b = BatchingSpec(
         max_batch_size=4, max_seq_len=max_new_room,
         prefill_buckets=[16, 64], paged=paged, page_size=16,
+        kv_cache_dtype=kv_dtype,
         lora=(LoRASpec(max_adapters=lora_slots, rank=4,
                        targets=ALL_TARGETS) if lora_slots else LoRASpec()))
     return LLMEngine(cfg, b, params=params)
@@ -131,6 +132,36 @@ class TestTokenIdentity:
         if paged:
             eng._allocator.assert_quiescent()
 
+    @pytest.mark.slow  # tier-1 budget: 3 merged-reference engines on an int8 pool
+    def test_int8_kv_every_adapter_matches_merged_reference(
+            self, cfg, params, specs):
+        """Tentpole pin (quantized base + f32 LoRA deltas): the int8
+        paged pool under multi-adapter decode. Adapter K/V deltas apply
+        BEFORE the pool write, so both the factored and the merged
+        engine quantize the same K/V values — greedy output must stay
+        token-identical through the int8 rounding, and base traffic
+        identical to a LoRA-free int8 engine."""
+        eng = mk_engine(cfg, params, paged=True, lora_slots=2,
+                        kv_dtype="int8")
+        for s in specs:
+            eng._lora.register(s)
+        base_ref = mk_engine(cfg, params, paged=True, lora_slots=0,
+                             kv_dtype="int8").generate(
+            PROMPT, SamplingParams(max_new_tokens=10))
+        base = eng.generate(PROMPT, SamplingParams(max_new_tokens=10))
+        assert base == base_ref, \
+            "base traffic must match a LoRA-free int8 engine"
+        for s in specs:
+            got = run_to_done(eng, eng.submit(
+                PROMPT, SamplingParams(max_new_tokens=10), adapter=s.name))
+            ref = mk_engine(cfg, merged_params(params, cfg, s), paged=True,
+                            lora_slots=0, kv_dtype="int8")
+            want = ref.generate(PROMPT, SamplingParams(max_new_tokens=10))
+            assert got == want, (s.name, got, want)
+            assert got != base, "adapter must actually change the output"
+        eng._lora.assert_quiescent()
+        eng._allocator.assert_quiescent()
+
     def test_mixed_batch_decodes_concurrently(self, cfg, params, specs,
                                               merged_refs, base_refs):
         """One BATCHED dispatch serves base + two different adapters in
@@ -173,6 +204,7 @@ class TestTokenIdentity:
 
 
 class TestPrefixIsolation:
+    @pytest.mark.slow  # tier-1 budget: ~9s; lora_smoke gates KV namespacing
     def test_adapters_never_share_kv(self, cfg, params, specs):
         """Same prompt under adapter A, adapter B, then A again and
         base, on a radix prefix-cache engine: only the same-adapter

@@ -127,6 +127,8 @@ class TestPagedAllocator:
 
 
 class TestPagedExactMatch:
+    @pytest.mark.slow  # tier-1 budget: ~12s; handoff/kvtier identity tests
+    # pin the same paged-vs-contiguous contract in tier-1
     def test_matches_contiguous_greedy(self, cfg, params):
         prompts = [[5, 17, 3, 99, 42], list(range(1, 50)), [7] * 20,
                    [9, 8, 7, 6, 5, 4]]
@@ -258,6 +260,7 @@ class TestPreemption:
 
 
 class TestReviewRegressions:
+    @pytest.mark.slow  # tier-1 budget: long-prompt chunked prefill, ~9s
     def test_chunk_window_crossing_max_len_via_prefix_hit(self, cfg, params):
         """Prefix hits start tail chunks at page — not chunk — alignment, so
         the final chunk's C-wide window can cross max_seq_len; the padded
@@ -404,6 +407,43 @@ class TestPagedAttentionKernel:
                                 paged_gather(pv, table), lengths, c)
         assert float(jnp.abs(out - ref).max()) < 2e-5
 
+    def test_int8_in_kernel_dequant_matches_gather_oracle(self, cfg):
+        """int8 pages + scale rows through the kernel's in-VMEM dequant
+        must match the gather+dequantize_kv oracle — same math, so the
+        only gap is fp32 accumulation order (~1e-6)."""
+        import dataclasses
+
+        from kubeflow_tpu.ops.paged_attention import paged_decode_attention
+        from kubeflow_tpu.ops.quantization import dequantize_kv, quantize_kv
+        from kubeflow_tpu.serve.engine import _decode_attention
+        from kubeflow_tpu.serve.paged import paged_gather
+
+        q, pk, pv, table, lengths = self._setup()
+        qk, sk = quantize_kv(pk)           # [P,pg,K,D] int8, [P,pg,K] f32
+        qv, sv = quantize_kv(pv)
+        out = paged_decode_attention(q, qk, qv, table, lengths,
+                                     pool_ks=sk, pool_vs=sv)
+        c = dataclasses.replace(cfg, n_heads=8, n_kv_heads=2, head_dim=16)
+        dk = dequantize_kv(qk, sk, jnp.float32)
+        dv = dequantize_kv(qv, sv, jnp.float32)
+        ref = _decode_attention(q, paged_gather(dk, table),
+                                paged_gather(dv, table), lengths, c)
+        assert float(jnp.abs(out - ref).max()) < 2e-5
+        # And the quantization itself stays within its error band of the
+        # full-precision attention (sanity that scales weren't dropped).
+        full = paged_decode_attention(q, pk, pv, table, lengths)
+        assert float(jnp.abs(out - full).max()) < 0.05
+
+    def test_int8_kernel_requires_scale_pair(self):
+        from kubeflow_tpu.ops.paged_attention import paged_decode_attention
+        from kubeflow_tpu.ops.quantization import quantize_kv
+
+        q, pk, pv, table, lengths = self._setup()
+        qk, sk = quantize_kv(pk)
+        qv, _ = quantize_kv(pv)
+        with pytest.raises(ValueError, match="together"):
+            paged_decode_attention(q, qk, qv, table, lengths, pool_ks=sk)
+
     def test_unmapped_and_partial_pages_masked(self):
         """Garbage in unmapped (-1) pages and beyond-length positions must
         not leak into the output: shrinking lengths changes results only
@@ -437,6 +477,29 @@ class TestPagedAttentionKernel:
                 max_batch_size=4, max_seq_len=128, paged=True, page_size=16,
                 chunked_prefill_tokens=32, paged_attn_impl=impl),
                 params=fparams)
+            reqs = [eng.submit(p, sp) for p in prompts]
+            run_all(eng, reqs)
+            return [list(r.output_tokens) for r in reqs]
+
+        assert run("pallas") == run("gather")
+
+    @pytest.mark.slow   # interpret-mode kernel e2e, ~15s
+    def test_engine_int8_pallas_matches_gather_end_to_end(self):
+        """int8 pool + in-kernel dequant vs int8 pool + gather+dequant:
+        both read the SAME quantized pages, so greedy outputs must be
+        token-identical (the dequant happens in different places but is
+        the same math; f32 config keeps the fp-accumulation gap far
+        below any argmax tie)."""
+        fcfg = preset("tiny", vocab_size=512, dtype="float32")
+        fparams = init_decoder_params(jax.random.PRNGKey(0), fcfg)
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        prompts = [[5, 17, 3, 99, 42], list(range(1, 40)), [7] * 20]
+
+        def run(impl):
+            eng = LLMEngine(fcfg, BatchingSpec(
+                max_batch_size=4, max_seq_len=128, paged=True, page_size=16,
+                chunked_prefill_tokens=32, kv_cache_dtype="int8",
+                paged_attn_impl=impl), params=fparams)
             reqs = [eng.submit(p, sp) for p in prompts]
             run_all(eng, reqs)
             return [list(r.output_tokens) for r in reqs]

@@ -104,6 +104,8 @@ def test_tp2_sampled_matches_single_device(cfg, params):
     assert got == want
 
 
+@pytest.mark.slow  # tier-1 budget: tp=2 paged engine compile, ~9s;
+# tp2_chunked_prefill keeps the sharded-identity lane in tier-1
 def test_tp2_paged_matches_single_device(cfg, params):
     dense = mk_engine(cfg, params, paged=True, page_size=16,
                       chunked_prefill_tokens=16)

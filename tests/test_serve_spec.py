@@ -108,6 +108,7 @@ class TestSpecExactMatch:
                           spec=SpeculativeSpec(mode="ngram", k=4))
         assert gen_all(eng, PROMPTS) == want
 
+    @pytest.mark.slow  # tier-1 budget: draft_model_paged keeps the lane, ~9s
     def test_draft_model_dense(self, cfg, params, want):
         eng = make_engine(cfg, params, spec=DRAFT)
         assert gen_all(eng, PROMPTS) == want
@@ -143,6 +144,7 @@ class TestSpecExactMatch:
                           spec=SpeculativeSpec(mode="ngram", k=8))
         assert gen_all(eng, PROMPTS) == want
 
+    @pytest.mark.slow  # tier-1 budget: three engines for one stop probe, ~9s
     def test_stop_token_inside_accepted_run(self, cfg, params):
         """A stop token appearing mid-round (inside the accepted prefix or
         as the bonus token) must truncate the emission exactly where the
@@ -227,6 +229,7 @@ class TestPagedRollback:
         assert req.done.is_set() and checked > 0
         self._assert_balanced(eng)
 
+    @pytest.mark.slow  # tier-1 budget: 48-token double prefill, ~14s
     def test_prefix_cache_pages_survive_rollback(self, cfg, params):
         """Rollback never frees registered prompt pages out from under the
         prefix cache: a second identical prompt still hits."""
