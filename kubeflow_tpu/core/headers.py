@@ -51,6 +51,30 @@ MODEL_HEADER = "X-Kftpu-Model"
 #: handoff (unified-fallback path: the replica decodes locally).
 DECODE_BACKEND_HEADER = "X-Kftpu-Decode-Backend"
 
+#: Fleet-wide KV fabric: comma-separated ALTERNATE decode backends for
+#: the handoff's bounded retry. The router stamps the primary decode
+#: target in ``DECODE_BACKEND_HEADER`` and up to two more healthy
+#: decode-pool members here; a prefill replica whose handoff POST
+#: fails retries (jittered exponential backoff, serve/retry.py) against
+#: a DIFFERENT replica from this list before degrading to local
+#: recompute. Absent/empty = no cross-replica retry (single-decode
+#: fleets, direct-to-replica traffic).
+DECODE_ALTS_HEADER = "X-Kftpu-Decode-Alts"
+
+#: Handoff capability negotiation: the KV cache dtype the payload's
+#: page bytes are encoded in (``int8`` for quantized pools, ``full``
+#: otherwise). Stamped on the handoff POST by the prefill side; the
+#: decode side REJECTS a mismatch with an explicit 409 BEFORE decoding
+#: the wire blob — a mixed-dtype fleet must fail the submit cleanly
+#: (prefill recomputes locally), never corrupt pages.
+HANDOFF_DTYPE_HEADER = "X-Kftpu-Kv-Dtype"
+
+#: Handoff wire-format version (serve/handoff.py: ``1`` = raw K/V
+#: planes, ``2`` = + per-token-per-head scale rows). A decode replica
+#: that doesn't speak the payload's version 409s at submit — the
+#: mixed-version-fleet half of the capability negotiation.
+HANDOFF_WIRE_HEADER = "X-Kftpu-Kv-Wire"
+
 #: Headers a transparent serving-path middlebox (the ChaosProxy, any
 #: future sidecar) MUST forward for the request-lifecycle machinery to
 #: keep working through it: deadline enforcement, QoS policy, trace
@@ -58,4 +82,6 @@ DECODE_BACKEND_HEADER = "X-Kftpu-Decode-Backend"
 #: ``kftpu lint`` X703 checks that every header exchanged on the
 #: serving path appears here.
 FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
-                   DECODE_BACKEND_HEADER, MODEL_HEADER)
+                   DECODE_BACKEND_HEADER, DECODE_ALTS_HEADER,
+                   MODEL_HEADER, HANDOFF_DTYPE_HEADER,
+                   HANDOFF_WIRE_HEADER)

@@ -236,6 +236,18 @@ class BatchingSpec(BaseModel):
     # per migration pass.
     kv_demote_after_s: float = 2.0
     kv_migrate_batch_pages: int = 32
+    # Remote-storage third tier (fleet-wide KV fabric, serve/kvtier.py):
+    # artifact-store root for KV spill blobs. Cold host-tier blobs idle
+    # past kv_remote_after_s publish there (content-addressed + registry-
+    # keyed by block chain), making a conversation's prefix resumable on
+    # ANY replica after engine death or scale-down drain. None falls back
+    # to $KFTPU_KV_REMOTE_ROOT; both unset = third tier off.
+    remote_kv_root: Optional[str] = None
+    kv_remote_after_s: Optional[float] = None  # default: 2× demote_after_s
+    # Per-match remote promote/probe deadline: a slower store degrades
+    # that admission to recompute instead of wedging it. None reads
+    # $KFTPU_KV_REMOTE_DEADLINE_S (default 0.5).
+    kv_remote_deadline_s: Optional[float] = None
     # Paged decode attention: "gather" (materialize pages, XLA attention —
     # 2× KV read), "pallas" (direct page reads via the paged-attention
     # kernel), or "auto" (pallas on TPU, gather elsewhere).
@@ -362,6 +374,12 @@ class BatchingSpec(BaseModel):
             raise ValueError(
                 "host_kv_pages requires prefix_index='radix' (the "
                 "flat hash has no tier lifecycle)")
+        if self.remote_kv_root and not self.host_kv_pages:
+            # The remote tier spills FROM the host tier (device pages
+            # demote host-first; the store never sees raw device reads).
+            raise ValueError(
+                "remote_kv_root requires host_kv_pages > 0 (the third "
+                "tier spills from the host tier, not the device)")
         if self.lora.max_adapters:
             if self.role != "unified":
                 # Handoff payloads carry KV only — the adopting engine

@@ -76,6 +76,19 @@ ATTRIBUTION_SERIES = (
     "kftpu_engine_kv_handoff_bytes_adopted_total",
     "kftpu_engine_kv_wire_bytes_demoted_total",
     "kftpu_engine_kv_wire_bytes_promoted_total",
+    # Fleet-wide KV fabric (ISSUE 17): the remote third tier + the
+    # cross-host handoff retry ladder. A wedged/slow store shows up as
+    # promote timeouts with pages stuck remote; a torn blob as corrupt
+    # rejections; a dying decode pool as handoffs retried then falling
+    # back to local recompute — the gate names the faulted phase.
+    "kftpu_engine_kv_pages_remote",
+    "kftpu_engine_kv_remote_demoted_bytes_total",
+    "kftpu_engine_kv_remote_promoted_bytes_total",
+    "kftpu_engine_kv_remote_promote_timeouts_total",
+    "kftpu_engine_kv_remote_blobs_corrupt_total",
+    "kftpu_engine_kv_tier_pressure",
+    "kftpu_engine_handoffs_retried_total",
+    "kftpu_engine_handoffs_fallback_total",
     # Multi-tenant LoRA (serve/lora.py): adapter residency + hot-load/
     # evict lifecycle — a multi_adapter regression names adapter churn
     # (loads/evictions climbing) instead of just the latency.
@@ -127,12 +140,26 @@ def engine_attribution(metrics_text: str) -> dict:
         elif name == "kftpu_engine_adapter_evictions_total":
             ad = out.setdefault("adapters", {})
             ad["evictions"] = ad.get("evictions", 0) + int(value)
+        elif name == "kftpu_engine_kv_tier_pressure":
+            # A ratio, not a count: int() would flatten 0.8 to 0. Max
+            # across engines — the most pressured replica is the story.
+            tier = out.setdefault("kv_tier", {})
+            tier["tier_pressure"] = max(tier.get("tier_pressure", 0.0),
+                                        round(value, 3))
         elif name.startswith("kftpu_engine_kv_"):
             key = name[len("kftpu_engine_kv_"):]
             if key.endswith("_total"):
                 key = key[:-len("_total")]
             tier = out.setdefault("kv_tier", {})
             tier[key] = tier.get(key, 0) + int(value)
+        elif name.startswith("kftpu_engine_handoffs_"):
+            # Cross-host handoff lifecycle (exported/adopted/failed/
+            # retried/fallback): a fleet fault names its handoff phase.
+            key = name[len("kftpu_engine_handoffs_"):]
+            if key.endswith("_total"):
+                key = key[:-len("_total")]
+            h = out.setdefault("handoff", {})
+            h[key] = h.get(key, 0) + int(value)
         elif name.startswith("kftpu_serving_qos_"):
             cls = labels.get("qos")
             if cls is None:

@@ -558,6 +558,13 @@ class EngineMetrics:
         self.handoffs_exported = 0      # guarded_by: _lock
         self.handoffs_adopted = 0       # guarded_by: _lock
         self.handoffs_failed = 0        # guarded_by: _lock
+        # Cross-host handoff failure budget (ISSUE 17): retried = a POST
+        # attempt failed and the relay moved to a DIFFERENT decode
+        # replica; fallback = every replica exhausted and the prefill
+        # recomputed locally (the terminal degrade — request resolved,
+        # never dropped).
+        self.handoffs_retried = 0       # guarded_by: _lock
+        self.handoffs_fallback = 0      # guarded_by: _lock
         # KV bytes shipped/received over the handoff wire (pages + scale
         # blobs) — with int8 pools these run at ~half the full-dtype
         # rate, the r05 wire-bytes claim's measured series.
@@ -625,8 +632,8 @@ class EngineMetrics:
 
     def note_handoff(self, event: str, wire_bytes: int = 0) -> None:
         """One handoff lifecycle event: ``exported`` | ``adopted`` |
-        ``failed`` — exports/adoptions also account their payload's KV
-        wire bytes."""
+        ``retried`` | ``fallback`` | ``failed`` — exports/adoptions also
+        account their payload's KV wire bytes."""
         with self._lock:
             if event == "exported":
                 self.handoffs_exported += 1
@@ -634,6 +641,10 @@ class EngineMetrics:
             elif event == "adopted":
                 self.handoffs_adopted += 1
                 self.handoff_bytes_adopted += wire_bytes
+            elif event == "retried":
+                self.handoffs_retried += 1
+            elif event == "fallback":
+                self.handoffs_fallback += 1
             else:
                 self.handoffs_failed += 1
 
@@ -731,6 +742,8 @@ class EngineMetrics:
                 "handoffs_exported": self.handoffs_exported,
                 "handoffs_adopted": self.handoffs_adopted,
                 "handoffs_failed": self.handoffs_failed,
+                "handoffs_retried": self.handoffs_retried,
+                "handoffs_fallback": self.handoffs_fallback,
                 "handoff_bytes_exported": self.handoff_bytes_exported,
                 "handoff_bytes_adopted": self.handoff_bytes_adopted,
             }
@@ -1097,10 +1110,18 @@ class LLMEngine:
             # with the dispatches that read their results).
             from kubeflow_tpu.serve.kvtier import RadixPrefixIndex
             from kubeflow_tpu.serve.paged import copy_pages
+            from kubeflow_tpu.serve.storage import kv_fabric_store
 
             self._kv_copy = jax.jit(
                 lambda c, s, d: self._pin(copy_pages(c, s, d)),
                 donate_argnums=(0,))
+            # Fleet-wide KV fabric third tier: the fabric signature folds
+            # every shape/dtype fact a wire blob depends on, so replicas
+            # of different models sharing a store root can never adopt
+            # each other's pages (the key simply won't match).
+            fabric_sig = (f"L{cfg.n_layers}.H{cfg.n_kv_heads}"
+                          f".D{cfg.head_dim}.P{self.page_size}"
+                          f".{'int8' if self.kv_quant else 'full'}")
             self._kvtier = RadixPrefixIndex(
                 self._allocator, self.page_size,
                 host_pages=int(b.host_kv_pages),
@@ -1109,7 +1130,11 @@ class LLMEngine:
                 copy_pages_fn=self._kv_copy_pages,
                 upload_pages_fn=self._kv_upload_pages,
                 fetch_pages_fn=self._kv_fetch_pages,
-                pressure_fn=self._kv_pressure)
+                pressure_fn=self._kv_pressure,
+                remote_store=kv_fabric_store(b.remote_kv_root),
+                remote_after_s=b.kv_remote_after_s,
+                remote_deadline_s=b.kv_remote_deadline_s,
+                fabric_sig=fabric_sig)
             # Pre-warm the COW-copy trace (a tail copy is always one
             # pow2-padded pair, so this ONE trace covers every live
             # COW): the first mid-traffic divergence must not show up
@@ -1364,6 +1389,29 @@ class LLMEngine:
         tier is off)."""
         return 0 if self._kvtier is None else \
             self._kvtier.host_pages_resident()
+
+    def kv_pages_remote(self) -> int:
+        """Pages this replica's radix tree currently indexes in the
+        remote store tier (0 when the third tier is off)."""
+        return 0 if self._kvtier is None else \
+            self._kvtier.remote_pages_resident()
+
+    def kv_tier_pressure(self) -> float:
+        """The tier's demotion-urgency ratio (>= 1.0 = urgent) — the
+        SAME folded signal the migration scan acts on, exported so the
+        split-pool SLO autoscaler sees third-tier pressure (a decode
+        pool churning KV through the store needs replicas, not just a
+        pool fighting its own TTFT target)."""
+        return 0.0 if self._kvtier is None else float(self._kvtier.pressure())
+
+    def drain_kv_to_remote(self, timeout_s: float = 10.0) -> int:
+        """Scale-down drain hook: demote + publish every cached prefix
+        this engine still holds to the remote tier so conversations
+        survive the replica leaving the fleet. Call when idle (the
+        ISVC controller drains traffic first). Returns pages published."""
+        if self._kvtier is None:
+            return 0
+        return self._kvtier.spill_all_to_remote(timeout_s)
 
     def kv_tier_stats(self) -> dict:
         """Radix/tier counters (empty dict on flat/contiguous engines):

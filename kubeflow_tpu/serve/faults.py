@@ -110,6 +110,97 @@ def kill_model_server(server) -> None:
     logger.info("killed model server %s (port %s)", server.name, server.port)
 
 
+class ChaosStore:
+    """Artifact-store fault middleman for the remote KV tier (ISSUE 17):
+    hand it to the tiered cache in place of the real ``ArtifactStore``
+    and turn knobs mid-traffic. The store is the fabric's third tier, so
+    its failure modes are serving incidents, not batch-job retries:
+
+    - ``wedge_promote()`` / ``unwedge()``: reads (``lookup``/
+      ``get_bytes``) block until released — a hung NFS/object-store
+      endpoint. The promote-with-deadline machinery must degrade the
+      match to recompute, never wedge admission.
+    - ``truncate_next(n)``: the next ``n`` ``get_bytes`` return the blob
+      cut in half — a torn write / partial read. The content-address
+      checksum must reject it (``remote_blobs_corrupt``) and degrade.
+    - ``fail_next(n)``: the next ``n`` calls raise ``OSError`` — the
+      retry-policy class of failure.
+
+    Writes (``put_bytes``/``register``) pass through un-faulted unless
+    ``fail_next`` is armed: the interesting spill-side faults are crash
+    faults (SIGKILL mid-demote), which the chaos tests inject by killing
+    the engine, not the store."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._lock = threading.Lock()
+        self._fail_remaining = 0
+        self._truncate_remaining = 0
+        self._wedged = threading.Event()
+        self._release = threading.Event()
+        self.stats = {"wedged_reads": 0, "truncated_reads": 0,
+                      "injected_errors": 0}
+
+    def wedge_promote(self) -> None:
+        self._release.clear()
+        self._wedged.set()
+
+    def unwedge(self) -> None:
+        self._wedged.clear()
+        self._release.set()
+
+    def truncate_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._truncate_remaining = int(n)
+
+    def fail_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._fail_remaining = int(n)
+
+    def _maybe_fail(self) -> None:
+        with self._lock:
+            if self._fail_remaining > 0:
+                self._fail_remaining -= 1
+                self.stats["injected_errors"] += 1
+                raise OSError("chaos: injected store fault")
+
+    def _maybe_wedge(self) -> None:
+        if self._wedged.is_set():
+            self.stats["wedged_reads"] += 1
+            self._release.wait()   # held until unwedge(); caller's
+            #                        deadline thread gave up long ago
+
+    # -- the ArtifactStore surface the KV tier drives -----------------------
+
+    def lookup(self, name: str, version: Optional[str] = None) -> str:
+        self._maybe_fail()
+        self._maybe_wedge()
+        return self.inner.lookup(name, version)
+
+    def get_bytes(self, uri: str) -> bytes:
+        self._maybe_fail()
+        self._maybe_wedge()
+        data = self.inner.get_bytes(uri)
+        with self._lock:
+            truncate = self._truncate_remaining > 0
+            if truncate:
+                self._truncate_remaining -= 1
+                self.stats["truncated_reads"] += 1
+        return data[:len(data) // 2] if truncate else data
+
+    def put_bytes(self, data: bytes) -> str:
+        self._maybe_fail()
+        return self.inner.put_bytes(data)
+
+    def register(self, name: str, version: str, uri: str) -> str:
+        self._maybe_fail()
+        return self.inner.register(name, version, uri)
+
+    def __getattr__(self, item):
+        # Anything else (GC sweeps, listing) hits the real store.
+        return getattr(self.inner, item)
+
+
 class ChaosProxy:
     """HTTP fault middleman: register ``proxy.url`` with the Router in
     place of the real replica URL, then turn fault knobs mid-traffic.
@@ -123,6 +214,11 @@ class ChaosProxy:
       with a closed connection, when unwedged or at ``stop()``.
     - ``drop()`` / ``undrop()``: close every new connection before any
       response byte — the router-visible shape of a dead process.
+    - ``drop_response()`` / ``undrop_response()``: forward the request
+      to the target, then close the connection WITHOUT relaying the
+      response — the dropped-ACK fault: a handoff's receiver adopted the
+      pages, but the sender never hears it (the ack-hold protocol's
+      reason to exist).
     """
 
     def __init__(self, target: str, host: str = "127.0.0.1", port: int = 0):
@@ -133,9 +229,10 @@ class ChaosProxy:
         self._lock = threading.Lock()
         self._wedged = threading.Event()
         self._dropped = threading.Event()
+        self._drop_response = threading.Event()
         self._release = threading.Event()   # set -> wedged requests exit
         self.stats = {"forwarded": 0, "injected_5xx": 0, "dropped": 0,
-                      "wedged": 0}
+                      "wedged": 0, "responses_dropped": 0}
         from kubeflow_tpu.serve.router import quiet_handle_error
 
         self.httpd = ThreadingHTTPServer((host, port), _chaos_handler(self))
@@ -166,6 +263,12 @@ class ChaosProxy:
 
     def undrop(self) -> None:
         self._dropped.clear()
+
+    def drop_response(self) -> None:
+        self._drop_response.set()
+
+    def undrop_response(self) -> None:
+        self._drop_response.clear()
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -255,6 +358,18 @@ def _chaos_handler(proxy: ChaosProxy):
                     pass
                 return
             proxy.stats["forwarded"] += 1
+            if proxy._drop_response.is_set():
+                # The target fully processed the request (a handoff
+                # receiver has ADOPTED the pages by now) — the caller
+                # just never hears the ack. Distinct from drop(): that
+                # fails before any byte reaches the target.
+                proxy.stats["responses_dropped"] += 1
+                self.close_connection = True
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))

@@ -73,6 +73,7 @@ _PROBE_SERIES = (
     "kftpu_serving_queue_delay_p95_ms",
     "kftpu_serving_qos_ttft_p95_ms",
     "kftpu_serving_qos_queue_delay_p95_ms",
+    "kftpu_engine_kv_tier_pressure",
 )
 
 
@@ -90,7 +91,8 @@ def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
                 return None
         out = {"ready": True, "in_flight": 0, "requests_total": 0,
                "ttft_p95_ms": None, "queue_delay_p95_ms": None,
-               "qos_ttft_p95_ms": {}, "qos_queue_delay_p95_ms": {}}
+               "qos_ttft_p95_ms": {}, "qos_queue_delay_p95_ms": {},
+               "kv_tier_pressure": 0.0}
         with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
             text = r.read().decode()
         try:
@@ -111,6 +113,13 @@ def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
             elif name == "kftpu_serving_queue_delay_p95_ms":
                 out["queue_delay_p95_ms"] = max(
                     out["queue_delay_p95_ms"] or 0.0, value)
+            elif name == "kftpu_engine_kv_tier_pressure":
+                # The engine's own demote-urgency ratio (pressure_fn
+                # fold: pool occupancy x queue delay x adapter waits,
+                # now including remote-tier churn) — the split-pool
+                # autoscaler folds it into the decode plan.
+                out["kv_tier_pressure"] = max(
+                    out["kv_tier_pressure"], value)
             elif name in ("kftpu_serving_qos_ttft_p95_ms",
                           "kftpu_serving_qos_queue_delay_p95_ms"):
                 cls = labels.get("qos")
@@ -521,6 +530,18 @@ class ISVCController:
             if not vals:
                 continue
             ratio = max(vals) / target
+            if role == "decode":
+                # Third-tier fold (ISSUE 17): a decode pool churning KV
+                # through the remote store is capacity-starved even when
+                # its TTFT still meets target — the engine's pressure_fn
+                # ratio (>= 1.0 = urgent) rides the probe, and the WORSE
+                # of the two signals drives the plan. Symmetric on the
+                # way down: high tier pressure blocks a scale-down that
+                # the latency signal alone would have taken.
+                pressure = max(
+                    (s.get("kv_tier_pressure") or 0.0) for s in sigs)
+                if pressure > ratio:
+                    ratio, sig_key = pressure, "kv_tier_pressure"
             cur = desired[role]
             if ratio > slo.scale_up_ratio and cur < pools.cap(role):
                 desired[role] = cur + 1

@@ -54,10 +54,30 @@ promotes BEFORE prefill admits: decode the blob (zero-copy
 reads the promoted content, so admission proceeds the same step with
 no wait state. Long-idle conversations stop pinning HBM and still skip
 their recompute.
+
+**Remote-storage third tier (ISSUE 17 — the fleet property).** Host
+blobs idle past ``remote_after_s`` spill PAST host RAM into the
+artifact store (pipelines/artifacts.py — content-addressed, so a
+blob's digest IS its checksum): the migration thread publishes the
+already-encoded wire blob and registers it under a name derived from
+``(fabric signature, namespace, block chain)``, so ANY replica serving
+the same model shape finds it by walking its own radix miss — a
+conversation's KV now survives its engine. A walk that runs out of
+in-memory nodes probes that registry for the next block; remote work
+is DEADLINE-BOUNDED per match (``remote_deadline_s``): a slow or
+unreachable store degrades to a shorter match (= recompute of the
+tail), surfaced in ``remote_promote_timeouts``, and never wedges
+admission. Fetched bytes are re-verified against the content address
+before a page is allocated — a truncated or corrupt blob is a miss
+plus ``remote_blobs_corrupt``, never corrupted pages. Crash ordering
+is publish→register→install: a SIGKILL mid-spill leaves at worst an
+UNREGISTERED blob, which the store's GC sweep (pipelines/gc.py)
+reconciles — zero orphans after the sweep, by construction.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import queue
 import threading
@@ -67,6 +87,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from kubeflow_tpu.serve.handoff import pages_from_wire, pages_to_wire
+from kubeflow_tpu.serve.retry import STORE_POLICY, call_with_retry, env_float
 
 logger = logging.getLogger("kubeflow_tpu.serve.kvtier")
 
@@ -74,6 +95,14 @@ TIER_DEVICE = "device"
 TIER_HOST = "host"
 TIER_MIGRATING = "migrating"   # gather enqueued, blob not installed yet
 TIER_DEAD = "dead"             # evicted; structure detached
+TIER_SPILLING = "spilling"     # host blob, remote publish in flight —
+                               # still matchable exactly like TIER_HOST
+TIER_REMOTE = "remote"         # blob field holds the cas:// uri
+
+#: Content-address scheme of the artifact store — a TIER_REMOTE node's
+#: ``blob`` is ``cas://<sha256hex>``; the hex part is the checksum the
+#: promote path re-verifies fetched bytes against.
+_CAS = "cas://"
 
 #: Partial (sub-page) leaves kept per parent: enough to hold a few
 #: divergent continuations of one prefix without making the tail scan a
@@ -139,12 +168,30 @@ class RadixPrefixIndex:
                  copy_pages_fn: Optional[Callable] = None,
                  upload_pages_fn: Optional[Callable] = None,
                  fetch_pages_fn: Optional[Callable] = None,
-                 pressure_fn: Optional[Callable[[], float]] = None):
+                 pressure_fn: Optional[Callable[[], float]] = None,
+                 remote_store=None,
+                 remote_after_s: Optional[float] = None,
+                 remote_deadline_s: Optional[float] = None,
+                 fabric_sig: str = ""):
         self._allocator = allocator
         self.page_size = int(page_size)
         self.host_pages = max(0, int(host_pages))
         self.demote_after_s = float(demote_after_s)
         self.migrate_batch_pages = max(1, int(migrate_batch_pages))
+        # Remote third tier (None = off): an ArtifactStore-shaped object
+        # (put_bytes/get_bytes/register/lookup). ``fabric_sig`` folds the
+        # cache geometry + dtype into every registry key so replicas of
+        # DIFFERENT model shapes can share one store root without ever
+        # adopting each other's pages.
+        self._remote_store = remote_store
+        self.remote_after_s = (float(remote_after_s)
+                               if remote_after_s is not None
+                               else 2.0 * self.demote_after_s)
+        self.remote_deadline_s = (float(remote_deadline_s)
+                                  if remote_deadline_s is not None
+                                  else env_float("KFTPU_KV_REMOTE_DEADLINE_S",
+                                                 0.5))
+        self.fabric_sig = str(fabric_sig)
         self._scan_interval = (float(scan_interval_s)
                                if scan_interval_s is not None
                                else max(self.demote_after_s / 4, 0.05))
@@ -169,6 +216,8 @@ class RadixPrefixIndex:
         self._lock = threading.RLock()
         self._host_count = 0          # guarded_by: _lock
         self._migrating = 0           # guarded_by: _lock
+        self._remote_count = 0        # guarded_by: _lock
+        self._spilling = 0            # guarded_by: _lock
         self.stats = {                # guarded_by: _lock
             "prefix_queries": 0, "prefix_hits": 0,
             "tokens_matched": 0, "tokens_cow": 0,
@@ -177,6 +226,14 @@ class RadixPrefixIndex:
             "demote_batches": 0, "demote_dropped": 0,
             "host_evictions": 0, "evictions": 0,
             "demote_wire_bytes": 0, "promote_wire_bytes": 0,
+            # Remote third tier: spill (host→store) / remote promote
+            # (store→device) traffic plus every degrade path, each with
+            # its own counter so attribution names the faulted phase.
+            "pages_demoted_remote": 0, "pages_promoted_remote": 0,
+            "remote_demote_bytes": 0, "remote_promote_bytes": 0,
+            "remote_promote_timeouts": 0, "remote_promote_errors": 0,
+            "remote_blobs_corrupt": 0, "remote_registry_hits": 0,
+            "remote_spill_errors": 0, "remote_spill_dropped": 0,
         }
         self._last_scan = 0.0         # lockfree: scheduler-confined
         self.last_promoted = 0        # lockfree: scheduler-confined
@@ -185,7 +242,7 @@ class RadixPrefixIndex:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         allocator.on_evict = self._on_evict
-        if self.host_pages > 0:
+        if self.host_pages > 0 or self._remote_store is not None:
             self._thread = threading.Thread(
                 target=self._migrate_loop, daemon=True, name="kv-migrate")
             self._thread.start()
@@ -205,11 +262,17 @@ class RadixPrefixIndex:
         with self._lock:
             return self._host_count
 
+    def remote_pages_resident(self) -> int:
+        with self._lock:
+            return self._remote_count
+
     def snapshot(self) -> dict:
         with self._lock:
             out = dict(self.stats)
             out["host_pages_resident"] = self._host_count
             out["migrating_pages"] = self._migrating
+            out["remote_pages_resident"] = self._remote_count
+            out["spilling_pages"] = self._spilling
         return out
 
     # -- match (admission path) ----------------------------------------------
@@ -279,17 +342,33 @@ class RadixPrefixIndex:
             now = time.monotonic()
             covered = 0
             node = self.root(namespace)
+            chain: tuple = ()
+            # One deadline for ALL remote-store work this match (probe +
+            # fetch): armed lazily at the first remote touch so hits
+            # that never leave memory pay nothing.
+            remote_deadline: Optional[float] = None
             while covered + pg <= cap:
-                child = node.children.get(tuple(tokens[covered:covered + pg]))
+                blk = tuple(tokens[covered:covered + pg])
+                child = node.children.get(blk)
+                if child is None and self._remote_store is not None:
+                    # Out of in-memory tree: another replica (or a dead
+                    # incarnation of this one) may have published this
+                    # block — the conversation-failover path.
+                    if remote_deadline is None:
+                        remote_deadline = (time.monotonic()
+                                           + self.remote_deadline_s)
+                    child = self._probe_remote_child(
+                        node, blk, namespace, chain,
+                        remote_deadline - time.monotonic())
                 if child is None or child.tier == TIER_MIGRATING \
                         or child.tier == TIER_DEAD:
                     break
-                if child.tier == TIER_HOST:
+                if child.tier in (TIER_HOST, TIER_SPILLING):
                     try:
                         pid = self._allocator.alloc(1, owner=owner)[0]
                     except PagePoolExhausted:
                         break
-                    if child.tier != TIER_HOST:
+                    if child.tier not in (TIER_HOST, TIER_SPILLING):
                         # The alloc's eviction callback can cascade a
                         # dropped subtree over ``child`` (same hazard as
                         # the COW tail): its blob is gone — miss.
@@ -298,6 +377,9 @@ class RadixPrefixIndex:
                     # Promotion: the node returns to the device tier; the
                     # fresh ref (alloc) is the matcher's sharer ref, and
                     # ``retained`` keeps the page cached after release.
+                    # A SPILLING node promotes identically — the in-
+                    # flight publish kept its own blob reference and its
+                    # install step discards on the tier check.
                     child.page = pid
                     child.tier = TIER_DEVICE
                     blob, child.blob = child.blob, None
@@ -306,6 +388,30 @@ class RadixPrefixIndex:
                     self._allocator.retained.add(pid)
                     promote.append((pid, blob))
                     self.stats["pages_promoted"] += 1
+                elif child.tier == TIER_REMOTE:
+                    if remote_deadline is None:
+                        remote_deadline = (time.monotonic()
+                                           + self.remote_deadline_s)
+                    blob = self._fetch_remote_blob(
+                        child.blob, remote_deadline - time.monotonic())
+                    if blob is None:
+                        break        # timed out / corrupt → shorter match
+                    try:
+                        pid = self._allocator.alloc(1, owner=owner)[0]
+                    except PagePoolExhausted:
+                        break
+                    if child.tier != TIER_REMOTE:
+                        self._allocator.free([pid])
+                        break
+                    child.page = pid
+                    child.tier = TIER_DEVICE
+                    child.blob = None
+                    self._remote_count -= 1
+                    self._by_page[pid] = child
+                    self._allocator.retained.add(pid)
+                    promote.append((pid, blob))
+                    self.stats["pages_promoted_remote"] += 1
+                    self.stats["remote_promote_bytes"] += len(blob)
                 else:
                     # Device hit (possibly still owned by a decoding
                     # request): one more sharer, stamped per owner.
@@ -313,6 +419,7 @@ class RadixPrefixIndex:
                 child.last_used = now
                 pages.append(child.page)
                 covered += pg
+                chain = chain + (blk,)
                 node = child
             # Sub-page tail: the query continues into (or diverges
             # inside) a cached block — copy only the shared part.
@@ -350,13 +457,13 @@ class RadixPrefixIndex:
         mid-migration."""
         from kubeflow_tpu.serve.paged import PagePoolExhausted
 
-        if src.tier not in (TIER_DEVICE, TIER_HOST):
+        if src.tier not in (TIER_DEVICE, TIER_HOST, TIER_SPILLING):
             return None
         try:
             fresh = self._allocator.alloc(1, owner=owner)[0]
         except PagePoolExhausted:
             return None
-        if src.tier not in (TIER_DEVICE, TIER_HOST):
+        if src.tier not in (TIER_DEVICE, TIER_HOST, TIER_SPILLING):
             # The alloc above reclaims ref-0 indexed pages through the
             # eviction callback — and under pool pressure the coldest
             # cached page is often ``src`` itself, which arrives here
@@ -399,6 +506,134 @@ class RadixPrefixIndex:
             self._upload_pages(ids, ks, vs, sks, svs)
         else:
             self._upload_pages(ids, ks, vs)
+
+    # -- remote third tier (fleet-wide KV fabric) ----------------------------
+
+    def _remote_key(self, namespace: str, chain: tuple) -> str:
+        """Registry name for one radix block chain. Deterministic across
+        replicas: same fabric signature + namespace + token blocks →
+        same name, which is what makes a dead engine's KV discoverable
+        by a survivor that never saw the original request."""
+        h = hashlib.sha256(
+            repr((self.fabric_sig, namespace, chain)).encode("utf-8"))
+        return "kv-" + h.hexdigest()[:40]
+
+    def _chain_of(self, node: _Node) -> Optional[tuple]:
+        # requires_lock: _lock
+        """Root-to-node block chain, or None if any hop is a partial
+        leaf (sub-page blocks are not remotely addressable — their
+        content is position-dependent within an unclaimed page)."""
+        chain: list = []
+        n = node
+        while n is not None and n.parent is not None:
+            if len(n.block) != self.page_size:
+                return None
+            chain.append(n.block)
+            n = n.parent
+        return tuple(reversed(chain))
+
+    def _namespace_of(self, node: _Node) -> str:
+        # requires_lock: _lock
+        n = node
+        while n.parent is not None:
+            n = n.parent
+        for ns, r in self._roots.items():
+            if r is n:
+                return ns
+        return ""
+
+    def _remote_call(self, fn: Callable, timeout_s: float):
+        """One store operation under a hard deadline. The store API has
+        no timeout of its own, so a wedged store (the seeded chaos
+        fault) is bounded by a sacrificial daemon thread: on timeout
+        the caller degrades to recompute and the thread dies with its
+        blocking call whenever the store unwedges. Returns
+        ``(ok, value_or_exception)``."""
+        if timeout_s <= 0:
+            return False, TimeoutError("remote KV deadline exhausted")
+        box: dict = {}
+
+        def run():
+            try:
+                box["v"] = fn()
+            # Not swallowed: relayed through the box and re-surfaced
+            # to the caller as (False, exc).
+            # lint: disable=C303
+            except BaseException as exc:
+                box["e"] = exc
+
+        t = threading.Thread(target=run, daemon=True, name="kv-remote-io")
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            return False, TimeoutError("remote KV store deadline")
+        if "e" in box:
+            return False, box["e"]
+        return True, box.get("v")
+
+    def _probe_remote_child(self, parent: _Node, blk: tuple,
+                            namespace: str, chain: tuple,
+                            budget_s: float) -> Optional[_Node]:
+        # requires_lock: _lock (held across the bounded store probe —
+        # the sacrificial thread never takes _lock, so no deadlock, and
+        # budget_s caps how long admission can stall on it)
+        key = self._remote_key(namespace, chain + (blk,))
+        ok, val = self._remote_call(
+            lambda: self._remote_store.lookup(key), budget_s)
+        if not ok:
+            if isinstance(val, TimeoutError):
+                self.stats["remote_promote_timeouts"] += 1
+            # FileNotFoundError = nobody published this chain: the
+            # ordinary cold-prompt miss, not a failure.
+            return None
+        child = _Node(blk, None, parent)
+        child.tier = TIER_REMOTE
+        child.blob = val               # the cas:// uri
+        parent.children[blk] = child
+        self._remote_count += 1
+        self.stats["nodes"] += 1
+        self.stats["remote_registry_hits"] += 1
+        return child
+
+    def _fetch_remote_blob(self, uri: str,
+                           budget_s: float) -> Optional[bytes]:
+        # requires_lock: _lock (bounded, same contract as the probe)
+        ok, val = self._remote_call(
+            lambda: self._remote_store.get_bytes(uri), budget_s)
+        if not ok:
+            if isinstance(val, TimeoutError):
+                self.stats["remote_promote_timeouts"] += 1
+            else:
+                self.stats["remote_promote_errors"] += 1
+            return None
+        blob = val
+        if uri.startswith(_CAS) and hashlib.sha256(blob).hexdigest() \
+                != uri[len(_CAS):]:
+            # Truncated/corrupt tier blob (the seeded fault): the
+            # content address IS the manifest checksum — reject before
+            # any page is allocated, degrade to recompute.
+            self.stats["remote_blobs_corrupt"] += 1
+            return None
+        return blob
+
+    def _remote_publish(self, blob: bytes, key: str) -> str:
+        """Publish one wire blob: CAS put, then registry bind. Runs on
+        the migration thread (or the synchronous drain) — never the
+        scheduler. Crash between put and register leaves an
+        unregistered blob for the GC sweep, never a dangling name."""
+        def op(_attempt):
+            uri = self._remote_store.put_bytes(blob)
+            try:
+                self._remote_store.register(key, "0", uri)
+            except ValueError:
+                # A racing replica bound this chain to its own
+                # (equivalent-content) blob first. Keep OUR uri locally
+                # — the bytes exist either way; the registry simply
+                # points survivors at the first writer's copy.
+                pass
+            return uri
+        return call_with_retry(op, policy=STORE_POLICY,
+                               retry_on=(OSError,))
 
     # -- registration --------------------------------------------------------
 
@@ -512,9 +747,15 @@ class RadixPrefixIndex:
                     # Still shared by a live request: the sharer keeps
                     # its reference; the page just stops being indexed.
                     self._allocator.retained.discard(n.page)
-            elif n.tier == TIER_HOST:
+            elif n.tier in (TIER_HOST, TIER_SPILLING):
                 n.blob = None
                 self._host_count -= 1
+            elif n.tier == TIER_REMOTE:
+                # The store blob stays — it is a fleet asset other
+                # replicas may still promote from; unreferenced blobs
+                # are the GC sweep's job, not the tree's.
+                n.blob = None
+                self._remote_count -= 1
             n.tier = TIER_DEAD       # a mid-migration install discards
             n.page = None
             n.children = {}
@@ -549,7 +790,7 @@ class RadixPrefixIndex:
     # -- demotion (scheduler side) + migration thread ------------------------
 
     def tick(self, now: Optional[float] = None, *,
-             busy: bool = False) -> int:
+             busy: bool = False, force: bool = False) -> int:
         """Periodic demotion scan (called from the engine's scheduler
         step): pick cold sharer-free device pages LRU, enqueue ONE
         batched device-side gather, free the device pages (program
@@ -567,8 +808,9 @@ class RadixPrefixIndex:
         if self.host_pages <= 0 or self._fetch_pages is None:
             return 0
         now = time.monotonic() if now is None else now
-        if now - self._last_scan < self._scan_interval:
+        if not force and now - self._last_scan < self._scan_interval:
             return 0
+        self._spill_scan(now, force=force)
         # Pressure demotion: when memory is about to be reclaimed
         # destructively (LRU eviction would DESTROY cached content),
         # demote to host first, age threshold be damned. The pressure
@@ -580,7 +822,7 @@ class RadixPrefixIndex:
         # and never while foreground work would queue behind the
         # bookkeeping.
         urgent = self.pressure() >= 1.0
-        if busy and not urgent:
+        if busy and not urgent and not force:
             return 0
         self._last_scan = now
         with self._lock:
@@ -590,7 +832,8 @@ class RadixPrefixIndex:
             # arrival will match would buy one free page at the cost of
             # a promotion round-trip under an already-dry pool — the
             # churn spiral, not a rescue.
-            floor = (2 * self._scan_interval if urgent
+            floor = (0.0 if force
+                     else 2 * self._scan_interval if urgent
                      else self.demote_after_s)
             for p in self._allocator.reclaimable_lru():
                 node = self._by_page.get(p)
@@ -605,9 +848,15 @@ class RadixPrefixIndex:
                 return 0
             room = self.host_pages - self._host_count - self._migrating
             if len(cands) > room:
-                self._evict_host_lru(len(cands) - room)
-                room = self.host_pages - self._host_count - self._migrating
-                cands = cands[:max(room, 0)]
+                if force:
+                    # Drain mode: NEVER destroy host content to make
+                    # room — the next pass's spill frees it losslessly.
+                    cands = cands[:max(room, 0)]
+                else:
+                    self._evict_host_lru(len(cands) - room)
+                    room = (self.host_pages - self._host_count
+                            - self._migrating)
+                    cands = cands[:max(room, 0)]
             if not cands:
                 return 0
             ids = [n.page for n in cands]
@@ -625,8 +874,39 @@ class RadixPrefixIndex:
                 self._migrating += 1
             self._allocator.drop_cached(ids)
             self.stats["demote_batches"] += 1
-        self._queue.put((cands, k_dev, v_dev, ks_dev, vs_dev))
+        self._queue.put(("demote", cands, k_dev, v_dev, ks_dev, vs_dev))
         return len(ids)
+
+    def _spill_scan(self, now: float, *, force: bool = False) -> None:
+        """Aging spill host→store: full-block host blobs idle past
+        ``remote_after_s`` hand off to the migration thread for publish.
+        Spill is PROACTIVE (fires with host room to spare) — the point
+        is failover durability, not just capacity: a conversation's KV
+        must already be in the store when its engine dies."""
+        if self._remote_store is None:
+            return
+        spills: list = []
+        with self._lock:
+            for node in self._iter_nodes():
+                if node.tier != TIER_HOST:
+                    continue
+                if not force and now - node.last_used < self.remote_after_s:
+                    continue
+                chain = self._chain_of(node)
+                if chain is None:
+                    continue       # partial leaves stay host-tier
+                ns = self._namespace_of(node)
+                node.tier = TIER_SPILLING
+                self._spilling += 1
+                # The blob rides the queue item by value: a promote or
+                # eviction racing the publish clears ``node.blob``
+                # without invalidating the in-flight bytes.
+                spills.append((node, node.blob,
+                               self._remote_key(ns, chain)))
+                if len(spills) >= self.migrate_batch_pages:
+                    break
+        if spills:
+            self._queue.put(("spill", spills))
 
     def _migrate_loop(self) -> None:
         import jax
@@ -637,7 +917,10 @@ class RadixPrefixIndex:
             item = self._queue.get()
             if item is None:
                 return
-            nodes, k_dev, v_dev, ks_dev, vs_dev = item
+            if item[0] == "spill":
+                self._run_spill(item[1], get_tracer())
+                continue
+            _, nodes, k_dev, v_dev, ks_dev, vs_dev = item
             span = get_tracer().start_span(
                 "engine.kv_migrate", direction="demote", pages=len(nodes))
             try:
@@ -675,12 +958,85 @@ class RadixPrefixIndex:
                 logger.error("kv migration batch failed: %s", exc)
                 span.end("error")
 
+    def _run_spill(self, spills: list, tracer) -> None:
+        """Migration-thread half of the host→store spill: publish each
+        blob (CAS put + registry bind, retried under STORE_POLICY), then
+        install TIER_REMOTE under the lock — or discard if a promote or
+        eviction won the race. Publish failures put the node BACK on the
+        host tier with a refreshed clock, so a dead store degrades to
+        'third tier off' instead of a retry hot-loop."""
+        span = tracer.start_span(
+            "engine.kv_migrate", direction="spill", pages=len(spills))
+        errors = 0
+        for node, blob, key in spills:
+            try:
+                uri = self._remote_publish(blob, key)
+            except Exception as exc:
+                errors += 1
+                with self._lock:
+                    self._spilling -= 1
+                    if node.tier == TIER_SPILLING:
+                        node.tier = TIER_HOST
+                        node.last_used = time.monotonic()
+                    self.stats["remote_spill_errors"] += 1
+                logger.error("kv remote spill failed: %s", exc)
+                continue
+            with self._lock:
+                self._spilling -= 1
+                if node.tier != TIER_SPILLING:
+                    # Promoted or evicted while the publish was in
+                    # flight: the registered blob stays valid fleet
+                    # content; only this node's transition is void.
+                    self.stats["remote_spill_dropped"] += 1
+                    continue
+                node.blob = uri
+                node.tier = TIER_REMOTE
+                self._host_count -= 1
+                self._remote_count += 1
+                self.stats["pages_demoted_remote"] += 1
+                self.stats["remote_demote_bytes"] += len(blob)
+        span.end("error" if errors else "ok")
+
+    def spill_all_to_remote(self, timeout_s: float = 10.0) -> int:
+        """Scale-down drain hook: push EVERY publishable cached page out
+        to the store — forced demote passes (device→host, age floor 0)
+        interleaved with forced spills (host→store) until nothing moves
+        — so a replica leaving the fleet strands no conversation.
+        Scheduler-confined (call with the engine idle/draining).
+        Returns pages published."""
+        if self._remote_store is None:
+            return 0
+        before = self.snapshot()["pages_demoted_remote"]
+        done = before
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._fetch_pages is not None and self.host_pages > 0:
+                moved = self.tick(force=True)
+            else:
+                # Host tier only (no demote machinery wired): still
+                # publish what it holds.
+                moved = 0
+                self._spill_scan(time.monotonic(), force=True)
+            # The forced tick also force-spilled the host tier
+            # (_spill_scan(force=True)); wait both halves out.
+            try:
+                self.drain_migrations(
+                    max(deadline - time.monotonic(), 0.01))
+            except TimeoutError:
+                break
+            now_done = self.snapshot()["pages_demoted_remote"]
+            if not moved and now_done == done:
+                break        # only unpublishable content (partials) left
+            done = now_done
+        return done - before
+
     def drain_migrations(self, timeout_s: float = 5.0) -> None:
-        """Test/audit hook: wait until no demotion batch is in flight."""
+        """Test/audit hook: wait until no demotion batch or remote
+        spill is in flight."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._lock:
-                if self._migrating == 0:
+                if self._migrating == 0 and self._spilling == 0:
                     return
             time.sleep(0.005)
         raise TimeoutError("kv migration batches still in flight")

@@ -1,0 +1,131 @@
+"""Shared retry/backoff policy for the fleet-wide KV fabric.
+
+Before ISSUE 17 every cross-host failure path rolled its own loop:
+the router retried connect failures with zero backoff, the handoff
+relay had no retry at all (one POST then recompute), and the remote
+KV tier had nothing to retry with. One policy object now owns the
+arithmetic — bounded attempts, exponential backoff, a jitter band so
+a fleet of replicas retrying the same dead peer doesn't thundering-herd
+it, a hard cap so attempt counts can't compound into minutes — and
+every caller states its failure budget as data instead of control flow.
+
+Timeout knobs (read once per call site, documented in README
+"Fleet-wide KV fabric"):
+
+- ``KFTPU_HANDOFF_CONNECT_S``: TCP connect + request-send budget for a
+  cross-host handoff POST.  # contract: env knob
+- ``KFTPU_HANDOFF_ACK_S``: how long the prefill side holds its pages
+  waiting for the decode ack before treating the peer as dead.
+  # contract: env knob
+- ``KFTPU_HANDOFF_RETRIES``: additional decode replicas to try after
+  the first handoff target fails (each attempt goes to a DIFFERENT
+  replica; exhausting them degrades to local recompute).
+  # contract: env knob
+- ``KFTPU_KV_REMOTE_DEADLINE_S``: remote-tier promote deadline — a
+  fetch slower than this degrades to recompute instead of wedging
+  admission.  # contract: env knob
+- ``KFTPU_KV_REMOTE_ROOT``: artifact-store root for the remote KV
+  tier (unset = third tier off).  # contract: env knob
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+def env_float(name: str, default: float) -> float:
+    """One env-knob read: unparseable values fall back loudly-ish
+    (the default) rather than crashing a serving replica at import."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff with bounded attempts.
+
+    ``attempts`` is the TOTAL number of tries (first try included);
+    ``base_s`` the backoff before the second try; each further backoff
+    doubles, capped at ``cap_s``; ``jitter_frac`` widens every delay to
+    a uniform band ``[d*(1-j), d*(1+j)]`` (still capped) so synchronized
+    failures desynchronize on the first retry."""
+
+    attempts: int = 3
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    jitter_frac: float = 0.5
+
+    def delay_s(self, failures: int,
+                rng: Optional[random.Random] = None) -> float:
+        """Backoff to sleep after the ``failures``-th failure (1-based:
+        the delay between try N and try N+1 has ``failures == N``)."""
+        if failures <= 0:
+            return 0.0
+        d = min(self.base_s * (2.0 ** (failures - 1)), self.cap_s)
+        j = max(0.0, min(float(self.jitter_frac), 1.0))
+        if j:
+            r = (rng or random).uniform(1.0 - j, 1.0 + j)
+            d *= r
+        return min(d, self.cap_s)
+
+    def delays(self, rng: Optional[random.Random] = None) -> list[float]:
+        """Every backoff this policy will sleep, in order (length
+        ``attempts - 1``) — the unit-testable surface."""
+        return [self.delay_s(i, rng) for i in range(1, self.attempts)]
+
+
+def call_with_retry(fn: Callable, *, policy: RetryPolicy,
+                    retry_on: tuple = (OSError,),
+                    on_retry: Optional[Callable] = None,
+                    sleep: Callable[[float], None] = time.sleep,
+                    rng: Optional[random.Random] = None):
+    """Run ``fn(attempt)`` under ``policy``. ``fn`` receives the 0-based
+    attempt index so callers can target a DIFFERENT peer per attempt
+    (the cross-host handoff contract: never hammer the replica that
+    just failed). Exhausted attempts re-raise the LAST exception —
+    give-up is the caller's signal to take its terminal fallback
+    (recompute), never a silent None."""
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, policy.attempts)):
+        if attempt:
+            if on_retry is not None:
+                on_retry(attempt, last)
+            sleep(policy.delay_s(attempt, rng))
+        try:
+            return fn(attempt)
+        except retry_on as exc:      # noqa: PERF203 — the retry loop
+            last = exc
+    assert last is not None
+    raise last
+
+
+#: Cross-host handoff failure budget: the POST targets a different
+#: decode replica each attempt, so attempts = 1 + KFTPU_HANDOFF_RETRIES.
+def handoff_policy() -> RetryPolicy:
+    return RetryPolicy(attempts=1 + max(0, env_int("KFTPU_HANDOFF_RETRIES",
+                                                   2)),
+                       base_s=0.05, cap_s=1.0, jitter_frac=0.5)
+
+
+#: Remote-store I/O (spill put / registry probe): tiny budget — the
+#: promote deadline bounds the whole operation anyway.
+STORE_POLICY = RetryPolicy(attempts=2, base_s=0.02, cap_s=0.2,
+                           jitter_frac=0.5)
+
+#: Router /metrics scrape probe: one quick second chance before the
+#: scrape-failure counter advances toward ejection.
+PROBE_POLICY = RetryPolicy(attempts=2, base_s=0.05, cap_s=0.2,
+                           jitter_frac=0.5)

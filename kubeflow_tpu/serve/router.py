@@ -36,6 +36,7 @@ Request-lifecycle hardening (Envoy-analog, TPU-native):
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import random
@@ -52,14 +53,15 @@ from typing import Optional
 # (core/headers.py); DEADLINE_HEADER/QOS_HEADER are re-exported here for
 # the router's historical importers (scripts, tests, grpc_server).
 from kubeflow_tpu.core.headers import (
-    DEADLINE_HEADER, DECODE_BACKEND_HEADER, MODEL_HEADER, QOS_HEADER,
-    TRACE_HEADER,
+    DEADLINE_HEADER, DECODE_ALTS_HEADER, DECODE_BACKEND_HEADER,
+    MODEL_HEADER, QOS_HEADER, TRACE_HEADER,
 )
 from kubeflow_tpu.obs.registry import (
     MetricsRegistry, contract_note_header, contract_note_series,
     parse_exposition,
 )
 from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
+from kubeflow_tpu.serve.retry import PROBE_POLICY, call_with_retry
 
 #: Engine series the token-aware router scrapes off every pooled
 #: backend's /metrics for placement — the router's half of the
@@ -73,13 +75,56 @@ from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
 #: load, so the router also scrapes ``kv_pages_cached`` and prefers —
 #: between equally-loaded decode backends — the one holding MORE
 #: cached prefix content (its prefix-hit odds are higher).
+#: ``kv_pages_remote`` (fleet-wide KV fabric, ISSUE 17) is scraped so
+#: placement can see how much of a backend's prefix content already
+#: spilled to the shared remote tier — informational today (any replica
+#: can promote remote pages), but it keeps the gauge two-sided.
 ROUTER_SCRAPE_SERIES = (
     "kftpu_engine_pending_prefill_tokens",
     "kftpu_engine_kv_pages_resident",
     "kftpu_engine_kv_pages_cached",
+    "kftpu_engine_kv_pages_remote",
     "kftpu_engine_adapters_resident",
     "kftpu_serving_in_flight",
 )
+
+
+def _rendezvous(key: str, url: str) -> int:
+    """Rendezvous (highest-random-weight) score of ``url`` for an
+    affinity ``key``: every router instance independently agrees on the
+    same preferred backend with no shared state, and removing a backend
+    only remaps the keys that hashed to it (no global reshuffle)."""
+    digest = hashlib.sha256(f"{key}|{url}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def _affinity_key(path: str, body: Optional[bytes]) -> Optional[str]:
+    """Radix-prefix affinity key for a generation request: the head of
+    the prompt (or the first chat message). Multi-turn conversations
+    share their prompt head verbatim — the system prompt / first turn —
+    so hashing it routes every turn of a session to the SAME decode
+    replica, whose radix tree still holds the session's prefix pages.
+    64 chars is plenty to separate sessions and cheap to hash; requests
+    without a recognizable prompt get no affinity (pure load placement).
+    """
+    if not body or not path.startswith(("/v1/completions",
+                                        "/v1/chat/completions")):
+        return None
+    try:
+        req = json.loads(body)
+    except ValueError:
+        return None
+    head = ""
+    if isinstance(req.get("prompt"), str):
+        head = req["prompt"]
+    # "messages" is the CLIENT-authored OpenAI chat field; no in-repo
+    # writer produces request bodies.
+    # lint: disable=X705
+    elif isinstance(req.get("messages"), list) and req["messages"]:
+        first = req["messages"][0]
+        if isinstance(first, dict):
+            head = str(first.get("content") or "")
+    return head[:64] or None
 
 
 def quiet_handle_error(httpd) -> None:
@@ -141,7 +186,8 @@ class Router:
                       "panic_picks": 0, "panic_total": 0, "probe_total": 0,
                       "queue_timeouts": 0,
                       "deadline_exhausted": 0,
-                      "disagg_picks": 0, "disagg_fallbacks": 0}
+                      "disagg_picks": 0, "disagg_fallbacks": 0,
+                      "affinity_hits": 0, "affinity_misses": 0}
         # Disaggregated fleet mode (set_pools): role -> backend urls,
         # plus the freshest scraped placement signals per backend.
         self._pools: dict[str, list[str]] = {}     # guarded_by: _lock
@@ -239,10 +285,16 @@ class Router:
         with self._lock:
             urls = [u for urls in self._pools.values() for u in urls]
         for url in dict.fromkeys(urls):
-            try:
+            def _fetch(_attempt, url=url):
                 with urllib.request.urlopen(url + "/metrics",
                                             timeout=1.0) as r:
-                    text = r.read().decode()
+                    return r.read().decode()
+
+            try:
+                # Shared backoff policy (serve/retry.py): one transient
+                # scrape hiccup must not advance a backend toward
+                # scrape-origin ejection.
+                text = call_with_retry(_fetch, policy=PROBE_POLICY)
             except OSError:
                 with self._lock:
                     self._scrape_fails[url] = \
@@ -262,8 +314,8 @@ class Router:
     @staticmethod
     def _parse_signals(text: str) -> Optional[dict]:
         out = {"pending_prefill_tokens": 0.0, "kv_pages_resident": 0.0,
-               "kv_pages_cached": 0.0, "in_flight": 0.0,
-               "adapters": frozenset()}
+               "kv_pages_cached": 0.0, "kv_pages_remote": 0.0,
+               "in_flight": 0.0, "adapters": frozenset()}
         adapters: set[str] = set()
         try:
             samples = parse_exposition(text)
@@ -280,6 +332,8 @@ class Router:
                 out["kv_pages_resident"] += value
             elif name == "kftpu_engine_kv_pages_cached":
                 out["kv_pages_cached"] += value
+            elif name == "kftpu_engine_kv_pages_remote":
+                out["kv_pages_remote"] += value
             elif name == "kftpu_engine_adapters_resident":
                 # Which LoRA adapters are HOT on this backend: the
                 # model-id routing signal (one adapter-labeled sample
@@ -298,13 +352,20 @@ class Router:
                 if u not in exclude and u not in self._draining
                 and self._ejected_until.get(u, 0.0) <= now]
 
-    def pick_disaggregated(self, exclude: frozenset = frozenset()
+    def pick_disaggregated(self, exclude: frozenset = frozenset(), *,
+                           affinity: Optional[str] = None
                            ) -> tuple[Optional[str], Optional[str]]:
         """Token-aware placement: ``(backend, decode_target)``.
 
         Healthy prefill AND decode pools → the least-pending-prefill-
         tokens prefill backend carries the request, stamped with the
-        least-resident-KV-pages decode backend for its handoff. A pool
+        least-resident-KV-pages decode backend for its handoff. An
+        ``affinity`` key (the request's prompt head) overrides the
+        load-based decode pick with its rendezvous-hash preferred
+        replica WHEN that replica is healthy — every turn of a session
+        lands where the session's radix prefix is warm — and falls
+        through to load placement (``affinity_misses``) when it is not:
+        affinity is a cache hint, never a health exemption. A pool
         with no healthy member → unified fallback: any healthy backend
         (unified first, then decode, then prefill — every role serves a
         whole request locally), no handoff header. Everything ejected →
@@ -339,6 +400,20 @@ class Router:
                         key=lambda u: (sig(u).get("kv_pages_resident", 0.0),
                                        sig(u).get("in_flight", 0.0),
                                        -sig(u).get("kv_pages_cached", 0.0)))
+                if affinity:
+                    # The preferred replica is computed over the WHOLE
+                    # decode pool (not just the healthy slice): a key
+                    # must keep preferring its home replica through a
+                    # transient ejection, so a miss here means "home is
+                    # down, go cold elsewhere", not a silent remap.
+                    pool = self._pools.get("decode", ())
+                    home = max(pool, key=lambda u: _rendezvous(
+                        affinity, u)) if pool else None
+                    if home is not None and home in decodes:
+                        d = home
+                        self.stats["affinity_hits"] += 1
+                    else:
+                        self.stats["affinity_misses"] += 1
                 self.stats["disagg_picks"] += 1
                 return p, d
             for pool in ("unified", "decode", "prefill"):
@@ -356,6 +431,21 @@ class Router:
                            key=lambda u: self._ejected_until.get(u, 0.0)), \
                     None
             return None, None
+
+    def decode_alternates(self, primary: Optional[str],
+                          exclude: frozenset = frozenset(), *,
+                          n: int = 2) -> tuple[str, ...]:
+        """Up to ``n`` healthy decode-pool members besides ``primary`` —
+        the prefill replica's retry ladder (``X-Kftpu-Decode-Alts``):
+        when its handoff to the primary decode target fails it retries
+        against these, in order, before degrading to local recompute.
+        Stamped by the router because only the router knows pool health;
+        the prefill replica never guesses at fleet membership."""
+        now = time.monotonic()
+        with self._lock:
+            ok = self._healthy_locked(self._pools.get("decode", ()),
+                                      exclude, now)
+        return tuple(u for u in ok if u != primary)[:n]
 
     # -- outlier ejection / draining ----------------------------------------
 
@@ -630,8 +720,11 @@ def _make_handler(router: Router):
                     # BOTH hops here — the prefill backend that carries
                     # the request and the decode backend its KV hands
                     # off to (stamped on the forwarded request below).
+                    # The prompt head rides along as the prefix-affinity
+                    # key so a session's turns chase their warm replica.
                     backend, decode_target = router.pick_disaggregated(
-                        exclude=frozenset(tried))
+                        exclude=frozenset(tried),
+                        affinity=_affinity_key(self.path, body))
                 elif first_attempt:
                     # Only the first pick parks (scale-from-zero): a retry
                     # already had a live-looking rotation moments ago, so a
@@ -675,8 +768,14 @@ def _make_handler(router: Router):
                     fwd_headers[QOS_HEADER] = self.headers[QOS_HEADER]
                 if decode_target:
                     # Handoff placement: the prefill replica POSTs its
-                    # KV to exactly this decode-pool member.
+                    # KV to exactly this decode-pool member — and the
+                    # alternates ladder it may retry against when that
+                    # member dies between this pick and the handoff.
                     fwd_headers[DECODE_BACKEND_HEADER] = decode_target
+                    alts = router.decode_alternates(
+                        decode_target, frozenset(tried))
+                    if alts:
+                        fwd_headers[DECODE_ALTS_HEADER] = ",".join(alts)
                 if model_id:
                     # The replica resolves the model id itself (adapter
                     # hot-load on miss, 404 on unknown).

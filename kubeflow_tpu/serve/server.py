@@ -39,7 +39,8 @@ from typing import Any, Optional
 from urllib.parse import urlparse
 
 from kubeflow_tpu.core.headers import (
-    DEADLINE_HEADER, DECODE_BACKEND_HEADER, MODEL_HEADER, QOS_HEADER,
+    DEADLINE_HEADER, DECODE_ALTS_HEADER, DECODE_BACKEND_HEADER,
+    HANDOFF_DTYPE_HEADER, HANDOFF_WIRE_HEADER, MODEL_HEADER, QOS_HEADER,
     TRACE_HEADER,
 )
 from kubeflow_tpu.obs.registry import MetricsRegistry, contract_note_header
@@ -49,8 +50,16 @@ from kubeflow_tpu.serve.engine import (
     EngineOverloaded, HOST_GAP_BUCKETS, LLMEngine, QUEUE_DELAY_BUCKETS,
     Request, SamplingParams,
 )
+from kubeflow_tpu.serve.retry import (
+    call_with_retry, env_float, handoff_policy,
+)
 from kubeflow_tpu.serve.router import quiet_handle_error
 from kubeflow_tpu.serve.tokenizer import Tokenizer, get_tokenizer
+
+#: Handoff wire versions this server can adopt (serve/handoff.py): v1 =
+#: raw K/V planes, v2 = + int8 scale rows. A payload tagged with
+#: anything else 409s at submit — the mixed-version-fleet guard.
+SUPPORTED_HANDOFF_WIRE = ("1", "2")
 
 
 def _raise_for_reaped(req: Request) -> None:
@@ -75,13 +84,29 @@ def open_handoff(decode_url: str, payload, *, chat: bool, qos: str,
     once the decode side ACKED (HTTP 200 — the payload bytes are in its
     memory, so the prefill side may release its page hold). Raises
     OSError on anything short of an ack, which is the caller's signal to
-    ``fail_handoff`` and recompute locally."""
+    ``fail_handoff`` and recompute locally.
+
+    Cross-host hardening (ISSUE 17): connect+send and ack-wait carry
+    SEPARATE budgets ($KFTPU_HANDOFF_CONNECT_S / $KFTPU_HANDOFF_ACK_S —
+    a dead host fails the connect in seconds; a live-but-wedged decode
+    replica fails the ack wait without holding the prefill's pages for
+    the whole request deadline), and the POST declares its cache dtype
+    and wire version so a mixed-version fleet REJECTS at submit (409 →
+    OSError here → retry elsewhere / recompute) instead of corrupting
+    pages."""
+    connect_s = min(env_float("KFTPU_HANDOFF_CONNECT_S", 5.0), timeout)
+    ack_s = min(env_float("KFTPU_HANDOFF_ACK_S", 30.0), timeout)
     parsed = urlparse(decode_url)
     conn = http.client.HTTPConnection(parsed.hostname or "127.0.0.1",
-                                      parsed.port or 80, timeout=timeout)
+                                      parsed.port or 80, timeout=connect_s)
     headers = {"Content-Type": "application/octet-stream",
-               QOS_HEADER: qos}
+               QOS_HEADER: qos,
+               HANDOFF_DTYPE_HEADER: payload.cache_dtype or "full",
+               HANDOFF_WIRE_HEADER:
+                   "2" if payload.cache_dtype else "1"}
     contract_note_header(QOS_HEADER, direction="set")
+    contract_note_header(HANDOFF_DTYPE_HEADER, direction="set")
+    contract_note_header(HANDOFF_WIRE_HEADER, direction="set")
     if trace_hdr:
         headers[TRACE_HEADER] = trace_hdr
         contract_note_header(TRACE_HEADER, direction="set")
@@ -91,6 +116,8 @@ def open_handoff(decode_url: str, payload, *, chat: bool, qos: str,
     path = "/v1/handoff" + ("?chat=1" if chat else "")
     try:
         conn.request("POST", path, body=payload.to_wire(), headers=headers)
+        if conn.sock is not None:
+            conn.sock.settimeout(ack_s)     # ack-hold budget
         resp = conn.getresponse()
     except (OSError, http.client.HTTPException) as exc:
         conn.close()
@@ -101,7 +128,40 @@ def open_handoff(decode_url: str, payload, *, chat: bool, qos: str,
         raise OSError(
             f"handoff to {decode_url} rejected: HTTP {resp.status} "
             f"{body[:200]!r}")
+    if conn.sock is not None:
+        # Acked: the token relay may legitimately idle between decode
+        # chunks — fall back to the request-wide budget.
+        conn.sock.settimeout(timeout)
     return conn, resp
+
+
+def open_handoff_with_retry(engine, candidates: list, payload, *,
+                            chat: bool, qos: str, trace_fn,
+                            deadline_s: Optional[float], timeout: float):
+    """Bounded cross-replica handoff retry: attempt ``candidates`` in
+    order under the shared jittered-backoff policy (serve/retry.py),
+    each attempt a DIFFERENT decode replica — never hammer the one that
+    just failed. Returns ``(url, conn, resp)`` on the first ack; raises
+    the last OSError once every candidate (or the attempt budget) is
+    exhausted — the caller's signal to take the terminal fallback
+    (fail_handoff + local recompute, never a dropped request)."""
+    from dataclasses import replace
+
+    policy = handoff_policy()
+    policy = replace(policy, attempts=max(
+        1, min(policy.attempts, len(candidates))))
+
+    def attempt(i: int):
+        url = candidates[i]
+        conn, resp = open_handoff(url, payload, chat=chat, qos=qos,
+                                  trace_hdr=trace_fn(), deadline_s=deadline_s,
+                                  timeout=timeout)
+        return url, conn, resp
+
+    def on_retry(_attempt: int, _exc) -> None:
+        engine.metrics.note_handoff("retried")
+
+    return call_with_retry(attempt, policy=policy, on_retry=on_retry)
 
 
 def iter_sse_data(resp):
@@ -310,7 +370,8 @@ class ModelServer:
                       strict: bool = False,
                       deadline_s: Optional[float] = None,
                       qos: str = QOS_DEFAULT,
-                      decode_url: Optional[str] = None) -> tuple[str, "Request"]:
+                      decode_url: Optional[str] = None,
+                      decode_alts: tuple = ()) -> tuple[str, "Request"]:
         """Pre-hop → tokenize → engine → detokenize → post-hop: the one
         generation path every protocol surface (REST v1/v2, OpenAI, gRPC)
         shares.
@@ -354,7 +415,7 @@ class ModelServer:
             if req.finish_reason == "handoff":
                 text = self._relay_handoff_text(
                     engine, tokenizer, req, toks, body, decode_url,
-                    qos=qos, timeout=timeout)
+                    qos=qos, timeout=timeout, decode_alts=decode_alts)
             else:
                 _raise_for_reaped(req)
                 with tracer.span("server.detokenize", tokens=len(out)):
@@ -366,22 +427,28 @@ class ModelServer:
 
     def _relay_handoff_text(self, engine, tokenizer, req, toks: list[int],
                             body: dict, decode_url: str, *, qos: str,
-                            timeout: float) -> str:
+                            timeout: float, decode_alts: tuple = ()) -> str:
         """Non-streaming half of the handoff relay: POST the payload,
         join the decode replica's token pieces after the locally-sampled
-        first token. Failure before the ack = recompute locally
-        (handoff contract: failure costs a prefill, never the request)."""
+        first token. Failure before the ack retries a DIFFERENT decode
+        replica (router-stamped alternates, jittered backoff); exhausted
+        alternates = recompute locally (handoff contract: failure costs
+        a prefill, never the request)."""
         tracer = get_tracer()
         deadline = time.monotonic() + timeout
+        candidates = [decode_url] + [u for u in decode_alts
+                                     if u and u != decode_url]
         with tracer.span("engine.handoff", backend=decode_url,
                          request=req.id) as sp:
             try:
-                conn, resp = open_handoff(
-                    decode_url, req.handoff, chat=False, qos=qos,
-                    trace_hdr=tracer.inject(sp),
+                used_url, conn, resp = open_handoff_with_retry(
+                    engine, candidates, req.handoff, chat=False, qos=qos,
+                    trace_fn=lambda: tracer.inject(sp),
                     deadline_s=timeout, timeout=timeout + 5.0)
+                sp.set_attrs(backend=used_url)
             except OSError as exc:
                 sp.set_attrs(error=str(exc), fallback="recompute")
+                engine.metrics.note_handoff("fallback")
                 engine.fail_handoff(req.id)
                 return self._recompute_locally(engine, tokenizer, req,
                                                toks, body, qos=qos,
@@ -530,6 +597,24 @@ def serving_metrics_registry(engines: list, *,
     handoffs_out = reg.counter("kftpu_engine_handoffs_exported_total")
     handoffs_in = reg.counter("kftpu_engine_handoffs_adopted_total")
     handoffs_bad = reg.counter("kftpu_engine_handoffs_failed_total")
+    # Fleet-wide KV fabric (ISSUE 17): the remote third tier's occupancy
+    # and store traffic, its degrade paths (deadline/corrupt — each one
+    # is a request that RESOLVED via recompute), the tier-pressure ratio
+    # the autoscaler folds, and the cross-host handoff failure budget
+    # (retried = moved to another decode replica; fallback = recomputed
+    # locally after exhausting them).
+    pages_remote = reg.gauge("kftpu_engine_kv_pages_remote")
+    remote_demote_b = reg.counter(
+        "kftpu_engine_kv_remote_demoted_bytes_total")
+    remote_promote_b = reg.counter(
+        "kftpu_engine_kv_remote_promoted_bytes_total")
+    remote_timeouts = reg.counter(
+        "kftpu_engine_kv_remote_promote_timeouts_total")
+    remote_corrupt = reg.counter(
+        "kftpu_engine_kv_remote_blobs_corrupt_total")
+    tier_pressure = reg.gauge("kftpu_engine_kv_tier_pressure")
+    handoffs_retried = reg.counter("kftpu_engine_handoffs_retried_total")
+    handoffs_fb = reg.counter("kftpu_engine_handoffs_fallback_total")
     # Quantized KV fabric (ops/quantization.py kv path): whether the
     # pool stores int8, the pool's token density (the ~1.9x-at-equal-HBM
     # claim's series), and the actual wire bytes moved by handoff export/
@@ -598,6 +683,16 @@ def serving_metrics_registry(engines: list, *,
         handoffs_out.inc(snap.get("handoffs_exported", 0), model=name)
         handoffs_in.inc(snap.get("handoffs_adopted", 0), model=name)
         handoffs_bad.inc(snap.get("handoffs_failed", 0), model=name)
+        handoffs_retried.inc(snap.get("handoffs_retried", 0), model=name)
+        handoffs_fb.inc(snap.get("handoffs_fallback", 0), model=name)
+        pages_remote.set(engine.kv_pages_remote(), model=name)
+        remote_demote_b.inc(tier.get("remote_demote_bytes", 0), model=name)
+        remote_promote_b.inc(tier.get("remote_promote_bytes", 0),
+                             model=name)
+        remote_timeouts.inc(tier.get("remote_promote_timeouts", 0),
+                            model=name)
+        remote_corrupt.inc(tier.get("remote_blobs_corrupt", 0), model=name)
+        tier_pressure.set(round(engine.kv_tier_pressure(), 3), model=name)
         # Contiguous-cache engines render 0/0: the series must exist on
         # every replica (the loadgen attribution scrape pins the set).
         density = engine.kv_pool_density()
@@ -786,13 +881,21 @@ def _make_handler(server: ModelServer):
             url = self.headers.get(DECODE_BACKEND_HEADER)
             return url.strip() if url else None
 
+        def _decode_alts(self) -> tuple:
+            """Alternate decode backends for the handoff's bounded
+            cross-replica retry (router-stamped; absent = no retry)."""
+            contract_note_header(DECODE_ALTS_HEADER, direction="read")
+            raw = self.headers.get(DECODE_ALTS_HEADER) or ""
+            return tuple(u.strip() for u in raw.split(",") if u.strip())
+
         def _generate_text(self, prompt: str, body: dict,
                            model: Optional[str],
                            strict: bool = False) -> tuple[str, Request]:
             return server.generate_text(prompt, body, model, strict=strict,
                                         deadline_s=self._deadline_s(),
                                         qos=self._qos(body),
-                                        decode_url=self._decode_backend())
+                                        decode_url=self._decode_backend(),
+                                        decode_alts=self._decode_alts())
 
         def _v1_predict(self, body: dict, model: str) -> None:
             instances = body.get("instances")
@@ -952,7 +1055,8 @@ def _make_handler(server: ModelServer):
                 if wants_handoff:
                     return self._stream_disaggregated(
                         engine, tokenizer, req, toks, body, decode_url,
-                        chat=chat, model=model, timeout=timeout)
+                        chat=chat, model=model, timeout=timeout,
+                        decode_alts=self._decode_alts())
                 self._stream_tokens(req, tokenizer, chat=chat, model=model,
                                     timeout=timeout)
 
@@ -960,7 +1064,8 @@ def _make_handler(server: ModelServer):
                                   toks: list[int], body: dict,
                                   decode_url: str, *, chat: bool,
                                   model: Optional[str],
-                                  timeout: float) -> None:
+                                  timeout: float,
+                                  decode_alts: tuple = ()) -> None:
             """Streaming handoff relay. The client's SSE response opens
             only AFTER the decode side acks (or the fallback engages) —
             a prefill replica dying mid-handoff therefore dies with
@@ -982,16 +1087,22 @@ def _make_handler(server: ModelServer):
                 _raise_for_reaped(req)
                 raise RuntimeError(
                     f"request {req.id} ended {req.finish_reason!r}")
+            candidates = [decode_url] + [u for u in decode_alts
+                                         if u and u != decode_url]
             with tracer.span("engine.handoff", backend=decode_url,
                              request=req.id) as sp:
                 try:
-                    conn, resp = open_handoff(
-                        decode_url, req.handoff, chat=chat,
-                        qos=self._qos(body), trace_hdr=tracer.inject(sp),
+                    used_url, conn, resp = open_handoff_with_retry(
+                        engine, candidates, req.handoff, chat=chat,
+                        qos=self._qos(body),
+                        trace_fn=lambda: tracer.inject(sp),
                         deadline_s=timeout, timeout=timeout + 5.0)
+                    sp.set_attrs(backend=used_url)
                 except OSError as exc:
-                    # Never acked: recompute locally (failure = recompute).
+                    # Every replica exhausted, never acked: recompute
+                    # locally (failure = recompute, never a drop).
                     sp.set_attrs(error=str(exc), fallback="recompute")
+                    engine.metrics.note_handoff("fallback")
                     engine.fail_handoff(req.id)
                     req2 = engine.submit(
                         toks, server.sampling_from(body, tokenizer),
@@ -1046,15 +1157,38 @@ def _make_handler(server: ModelServer):
                     400, {"error": "handoff needs a single-engine server"})
             from kubeflow_tpu.serve.handoff import HandoffPayload
 
+            # Capability negotiation BEFORE touching the wire blob
+            # (ISSUE 17): a mixed-version or mixed-dtype fleet must
+            # reject the submit cleanly — an explicit 409 the prefill
+            # side turns into retry-elsewhere/recompute — never decode
+            # bytes it would misinterpret into corrupt pages.
+            contract_note_header(HANDOFF_WIRE_HEADER, direction="read")
+            contract_note_header(HANDOFF_DTYPE_HEADER, direction="read")
+            wire_v = (self.headers.get(HANDOFF_WIRE_HEADER) or "").strip()
+            if wire_v and wire_v not in SUPPORTED_HANDOFF_WIRE:
+                return self._json(409, {
+                    "error": f"handoff wire version {wire_v!r} not "
+                             f"supported (speaks {SUPPORTED_HANDOFF_WIRE})"})
+            dtype = (self.headers.get(HANDOFF_DTYPE_HEADER) or "").strip()
+            want = "int8" if server.engine.kv_quant else "full"
+            if dtype and dtype != want:
+                return self._json(409, {
+                    "error": f"handoff cache-dtype mismatch: payload is "
+                             f"{dtype!r}, this pool stores {want!r}"})
             n = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(n)
             chat = "chat=1" in (self.path.split("?", 1) + [""])[1]
             payload = HandoffPayload.from_wire(raw)
             deadline_s = self._deadline_s()
             timeout = deadline_s if deadline_s is not None else 300.0
-            req = server.engine.submit_handoff(
-                payload, deadline=time.monotonic() + timeout,
-                trace_parent=get_tracer().current())
+            try:
+                req = server.engine.submit_handoff(
+                    payload, deadline=time.monotonic() + timeout,
+                    trace_parent=get_tracer().current())
+            except ValueError as exc:
+                # submit_handoff's own validation (shape/dtype/deadline)
+                # is the headerless fleet's backstop — same clean reject.
+                return self._json(409, {"error": str(exc)})
             self._stream_tokens(req, server.tokenizer, chat=chat,
                                 model=None, timeout=timeout,
                                 with_token_ids=True)

@@ -48,6 +48,27 @@ def load_params(storage_uri: Optional[str], cfg: DecoderConfig, *,
         "(hermetic build: file://, artifact:// and random:// only)")
 
 
+def kv_fabric_store(root: Optional[str] = None):
+    """The fleet-wide KV fabric's remote tier store (ISSUE 17), or None
+    when the third tier is off. Resolution order: explicit ``root``
+    (BatchingSpec.remote_kv_root) → $KFTPU_KV_REMOTE_ROOT. Deliberately
+    SEPARATE from $KFTPU_ARTIFACT_ROOT's default chain: KV spill blobs
+    are high-churn ephemera on a GC clock, and pointing them at the
+    model/pipeline store by accident would make model GC sweeps race
+    serving traffic. Same ArtifactStore type though — content-addressed
+    blobs (the digest is the checksum the promote path verifies) and a
+    registry the failover survivors probe by chain key."""
+    import os
+
+    from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+
+    # contract: env knob — KFTPU_KV_REMOTE_ROOT (unset = third tier off)
+    root = root or os.environ.get("KFTPU_KV_REMOTE_ROOT") or None
+    if not root:
+        return None
+    return ArtifactStore(root)
+
+
 def _load_orbax(path: str, cfg: DecoderConfig) -> Params:
     """Topology-agnostic restore: a trainer checkpoint carries the SAVING
     mesh's shardings, and a bare ``restore(step)`` demands those devices

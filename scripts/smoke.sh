@@ -187,6 +187,21 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 quant_rc=${PIPESTATUS[0]}
 grep -q '"quant_smoke": "ok"' /tmp/_smoke_quant.json || quant_rc=1
 
+echo "== fleet kv smoke (cross-host handoff + remote-tier failover) =="
+# Fleet-wide KV fabric gate (ISSUE 17): completions through a real
+# prefill→HTTP-handoff→decode pair must be byte-identical to the
+# unified reference with zero fallbacks; conversations drained to the
+# artifact store must resume on a DIFFERENT replica token-identically
+# AND with better TTFT p95 than cold recompute; a post-warm remote-tier
+# resume and handoff round trip must compile NOTHING
+# (KFTPU_SANITIZE=refcount,recompile); fabric series must parse off the
+# real exposition with per-owner refcounts balanced. Writes
+# BENCH_SERVE_r06.json (the fleet-KV bench round).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/fleet_kv_smoke.py | tee /tmp/_smoke_fleet_kv.json
+fleet_kv_rc=${PIPESTATUS[0]}
+grep -q '"fleet_kv_smoke": "ok"' /tmp/_smoke_fleet_kv.json || fleet_kv_rc=1
+
 echo "== contract smoke (static name-contract table vs a real serve run) =="
 # Cross-component contract gate (ISSUE 10): the kftpu lint --contracts-json
 # manifest must round-trip, and a serve run under KFTPU_SANITIZE=contract
@@ -197,5 +212,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 contract_rc=${PIPESTATUS[0]}
 grep -q '"contract_smoke": "ok"' /tmp/_smoke_contract.json || contract_rc=1
 
-echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc prefix_cache rc=$prefix_cache_rc lora rc=$lora_rc quant rc=$quant_rc contract rc=$contract_rc =="
-[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$prefix_cache_rc" -eq 0 ] && [ "$lora_rc" -eq 0 ] && [ "$quant_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]
+echo "== smoke: lint rc=$lint_rc tests rc=$rc bench rc=$bench_rc chaos rc=$chaos_rc obs rc=$obs_rc hotloop rc=$hotloop_rc recompile rc=$recompile_rc train_chaos rc=$train_chaos_rc autoscale rc=$autoscale_rc serve_perf rc=$serve_perf_rc disagg rc=$disagg_rc prefix_cache rc=$prefix_cache_rc lora rc=$lora_rc quant rc=$quant_rc fleet_kv rc=$fleet_kv_rc contract rc=$contract_rc =="
+[ "$lint_rc" -eq 0 ] && [ "$rc" -eq 0 ] && [ "$bench_rc" -eq 0 ] && [ "$chaos_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] && [ "$hotloop_rc" -eq 0 ] && [ "$recompile_rc" -eq 0 ] && [ "$train_chaos_rc" -eq 0 ] && [ "$autoscale_rc" -eq 0 ] && [ "$serve_perf_rc" -eq 0 ] && [ "$disagg_rc" -eq 0 ] && [ "$prefix_cache_rc" -eq 0 ] && [ "$lora_rc" -eq 0 ] && [ "$quant_rc" -eq 0 ] && [ "$fleet_kv_rc" -eq 0 ] && [ "$contract_rc" -eq 0 ]

@@ -1997,9 +1997,13 @@ class TestContractSeededRegressions:
         fresh = _new_findings_prog(
             "kubeflow_tpu/core/headers.py",
             "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,\n"
-            "                   DECODE_BACKEND_HEADER, MODEL_HEADER)",
+            "                   DECODE_BACKEND_HEADER, DECODE_ALTS_HEADER,\n"
+            "                   MODEL_HEADER, HANDOFF_DTYPE_HEADER,\n"
+            "                   HANDOFF_WIRE_HEADER)",
             "FORWARD_HEADERS = (DEADLINE_HEADER, QOS_HEADER,\n"
-            "                   DECODE_BACKEND_HEADER, MODEL_HEADER)")
+            "                   DECODE_BACKEND_HEADER, DECODE_ALTS_HEADER,\n"
+            "                   MODEL_HEADER, HANDOFF_DTYPE_HEADER,\n"
+            "                   HANDOFF_WIRE_HEADER)")
         assert len(fresh) == 1
         f = fresh[0]
         assert f.rule == "X703" and "X-Kftpu-Trace" in f.message

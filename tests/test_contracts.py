@@ -22,7 +22,8 @@ import jax
 from kubeflow_tpu.analysis import core as analysis_core
 from kubeflow_tpu.analysis import rules_contracts
 from kubeflow_tpu.core.headers import (
-    DEADLINE_HEADER, DECODE_BACKEND_HEADER, FORWARD_HEADERS, QOS_HEADER,
+    DEADLINE_HEADER, DECODE_ALTS_HEADER, DECODE_BACKEND_HEADER,
+    FORWARD_HEADERS, HANDOFF_DTYPE_HEADER, HANDOFF_WIRE_HEADER, QOS_HEADER,
     TRACE_HEADER, USER_HEADER,
 )
 from kubeflow_tpu.core.serving import BatchingSpec
@@ -119,7 +120,8 @@ class TestHeaderModule:
 
         assert set(FORWARD_HEADERS) == {
             DEADLINE_HEADER, QOS_HEADER, TRACE_HEADER,
-            DECODE_BACKEND_HEADER, MODEL_HEADER}
+            DECODE_BACKEND_HEADER, DECODE_ALTS_HEADER, MODEL_HEADER,
+            HANDOFF_DTYPE_HEADER, HANDOFF_WIRE_HEADER}
 
     def test_chaos_proxy_forwards_the_whole_list(self):
         """The ChaosProxy's forward-list is DERIVED from core/headers —
@@ -162,6 +164,9 @@ class TestHeaderModule:
                          DEADLINE_HEADER: "1000",
                          QOS_HEADER: "interactive",
                          DECODE_BACKEND_HEADER: "http://127.0.0.1:1",
+                         DECODE_ALTS_HEADER: "http://127.0.0.1:2",
+                         HANDOFF_DTYPE_HEADER: "int8",
+                         HANDOFF_WIRE_HEADER: "2",
                          "X-Kftpu-Model": "tenant-a",
                          TRACE_HEADER: "ab" * 16 + "-" + "cd" * 8})
             with urllib.request.urlopen(req, timeout=10) as r:

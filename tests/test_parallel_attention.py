@@ -304,6 +304,7 @@ class TestModelPipelineParallel:
             lambda p, t: decoder_loss(p, t, cfg, mesh=mesh))(params, tokens)
         assert abs(float(ref) - float(out)) < 1e-4 * max(1.0, abs(float(ref)))
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 17): slowest fast tests re-marked
     def test_train_step_on_pp_mesh(self):
         from kubeflow_tpu.models.config import preset
         from kubeflow_tpu.runtime.mesh import build_mesh
@@ -644,6 +645,7 @@ class TestPipelineTensorParallel:
 
 
 class TestShardedFlashTraining:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 17): slowest fast tests re-marked
     def test_pallas_train_step_matches_xla_on_mesh(self):
         """attn_impl='pallas' on a dp×fsdp×tp mesh: the flash kernel runs
         per-shard under shard_map (Mosaic can't be GSPMD-partitioned — the

@@ -251,6 +251,263 @@ class TestHostTier:
         assert len(blob) < len(full) * 0.55, (len(blob), len(full))
 
 
+class TestRemoteTier:
+    """Third tier (ISSUE 17): cold sharer-free radix subtrees spill past
+    host RAM into the artifact store as manifest-checksummed blobs, and
+    ANY index with the same fabric signature can adopt them through the
+    registry — the conversation-failover substrate. Every store fault
+    degrades to a clean miss (= recompute upstream), never a wedge."""
+
+    SIG = "L2.H1.D2.P4.full"
+
+    def _mk(self, store, **kw):
+        kw.setdefault("host_pages", 8)
+        kw.setdefault("demote_after_s", 0.01)
+        kw.setdefault("scan_interval_s", 0.0)
+        kw.setdefault("remote_after_s", 0.0)
+        kw.setdefault("fabric_sig", self.SIG)
+        return mk_index(remote_store=store, **kw)
+
+    def _spill(self, idx, alloc, toks):
+        """Insert → release → demote-to-host → spill-to-remote."""
+        pages = alloc.alloc(len(toks) // PG, owner="a")
+        idx.insert(toks, pages, len(toks))
+        alloc.free(list(reversed(pages)))
+        idx.tick(now=time.monotonic() + 1)       # device -> host
+        idx.drain_migrations()
+        idx.tick(now=time.monotonic() + 10)      # host -> remote
+        idx.drain_migrations()
+        return pages
+
+    def test_spill_then_promote_roundtrip(self, tmp_path):
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+
+        store = ArtifactStore(str(tmp_path))
+        idx, alloc, dev = self._mk(store)
+        try:
+            toks = list(range(1, 13))
+            pages = self._spill(idx, alloc, toks)
+            assert idx.remote_pages_resident() == 3
+            assert idx.host_pages_resident() == 0
+            snap = idx.snapshot()
+            assert snap["pages_demoted_remote"] == 3
+            assert snap["remote_demote_bytes"] > 0
+            # Promotion on a radix hit: store fetch, checksum verify,
+            # fresh device pages carrying the EXACT original bytes.
+            hit, covered = idx.match_and_acquire(toks + [99], owner="b")
+            assert covered == 12 and len(hit) == 3
+            assert idx.remote_pages_resident() == 0
+            snap = idx.snapshot()
+            assert snap["pages_promoted_remote"] == 3
+            assert snap["remote_promote_bytes"] > 0
+            ids, k, v = dev.uploads[-1]
+            assert ids == hit
+            np.testing.assert_array_equal(k[0], dev.page_block(pages[0]))
+            alloc.free(hit)
+            alloc.assert_quiescent()
+        finally:
+            idx.close()
+
+    def test_cross_index_failover_via_registry(self, tmp_path):
+        """A fresh index on a DIFFERENT host (same store, same fabric
+        signature) adopts the spilled subtree through the registry —
+        next turn after a SIGKILL lands on a survivor and reuses the
+        stored prefix instead of recomputing."""
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+
+        store = ArtifactStore(str(tmp_path))
+        toks = list(range(1, 13))
+        idx_a, alloc_a, dev_a = self._mk(store)
+        try:
+            pages_a = self._spill(idx_a, alloc_a, toks)
+        finally:
+            idx_a.close()                        # the "killed" engine
+        idx_b, alloc_b, dev_b = self._mk(store)
+        try:
+            hit, covered = idx_b.match_and_acquire(toks + [99], owner="b")
+            assert covered == 12 and len(hit) == 3
+            snap = idx_b.snapshot()
+            assert snap["remote_registry_hits"] >= 3
+            assert snap["pages_promoted_remote"] == 3
+            # B uploaded A's bytes: the conversation content crossed
+            # hosts via the store, not via any live connection.
+            ids, k, v = dev_b.uploads[-1]
+            np.testing.assert_array_equal(
+                k[0], dev_a.page_block(pages_a[0]))
+            alloc_b.free(hit)
+            alloc_b.assert_quiescent()
+        finally:
+            idx_b.close()
+
+    def test_fabric_sig_mismatch_never_adopts(self, tmp_path):
+        """Registry keys fold the fabric signature: a mixed-version
+        fleet (different layout/dtype) gets a clean miss, never a blob
+        interpreted under the wrong shape."""
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+
+        store = ArtifactStore(str(tmp_path))
+        toks = list(range(1, 13))
+        idx_a, alloc_a, _ = self._mk(store)
+        try:
+            self._spill(idx_a, alloc_a, toks)
+        finally:
+            idx_a.close()
+        idx_b, alloc_b, _ = self._mk(store, fabric_sig="L2.H1.D2.P4.int8")
+        try:
+            hit, covered = idx_b.match_and_acquire(toks + [99], owner="b")
+            assert covered == 0 and hit == []
+            assert idx_b.snapshot()["remote_registry_hits"] == 0
+            alloc_b.assert_quiescent()
+        finally:
+            idx_b.close()
+
+    def test_truncated_blob_rejected_by_checksum(self, tmp_path):
+        """Torn write / partial read: the content-address IS the
+        checksum, so a truncated blob is rejected (counted corrupt) and
+        the match degrades to a clean miss — never corrupted pages."""
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+        from kubeflow_tpu.serve.faults import ChaosStore
+
+        chaos = ChaosStore(ArtifactStore(str(tmp_path)))
+        toks = list(range(1, 13))
+        idx_a, alloc_a, _ = self._mk(chaos)
+        try:
+            self._spill(idx_a, alloc_a, toks)
+        finally:
+            idx_a.close()
+        idx_b, alloc_b, _ = self._mk(chaos)
+        try:
+            chaos.truncate_next(8)
+            hit, covered = idx_b.match_and_acquire(toks + [99], owner="b")
+            assert covered == 0 and hit == []
+            assert idx_b.snapshot()["remote_blobs_corrupt"] >= 1
+            assert chaos.stats["truncated_reads"] >= 1
+            alloc_b.assert_quiescent()
+        finally:
+            chaos.truncate_next(0)
+            idx_b.close()
+
+    def test_wedged_store_degrades_within_deadline(self, tmp_path):
+        """Hung store endpoint mid-promote: the per-match deadline
+        bounds the stall and the match degrades to recompute — admission
+        never wedges on the third tier."""
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+        from kubeflow_tpu.serve.faults import ChaosStore
+
+        chaos = ChaosStore(ArtifactStore(str(tmp_path)))
+        toks = list(range(1, 13))
+        idx_a, alloc_a, _ = self._mk(chaos)
+        try:
+            self._spill(idx_a, alloc_a, toks)
+        finally:
+            idx_a.close()
+        idx_b, alloc_b, _ = self._mk(chaos, remote_deadline_s=0.15)
+        try:
+            chaos.wedge_promote()
+            t0 = time.monotonic()
+            hit, covered = idx_b.match_and_acquire(toks + [99], owner="b")
+            elapsed = time.monotonic() - t0
+            assert covered == 0 and hit == []
+            assert elapsed < 2.0, f"match wedged for {elapsed:.2f}s"
+            assert idx_b.snapshot()["remote_promote_timeouts"] >= 1
+            assert chaos.stats["wedged_reads"] >= 1
+            alloc_b.assert_quiescent()
+        finally:
+            chaos.unwedge()
+            idx_b.close()
+
+    def test_spill_publish_failure_reverts_to_host(self, tmp_path):
+        """Unreachable store at demote time: the page stays in the host
+        tier (content never lost) and the NEXT match still promotes it
+        from host RAM."""
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+        from kubeflow_tpu.serve.faults import ChaosStore
+
+        chaos = ChaosStore(ArtifactStore(str(tmp_path)))
+        # Long remote_after_s: the background scan must not race the
+        # fault arming — only our explicit future-now tick spills.
+        idx, alloc, _ = self._mk(chaos, remote_after_s=60.0)
+        try:
+            toks = list(range(1, 13))
+            pages = alloc.alloc(3, owner="a")
+            idx.insert(toks, pages, 12)
+            alloc.free(list(reversed(pages)))
+            idx.tick(now=time.monotonic() + 1)
+            idx.drain_migrations()               # hosted
+            chaos.fail_next(100)                 # store goes dark
+            idx.tick(now=time.monotonic() + 120)
+            idx.drain_migrations()
+            chaos.fail_next(0)
+            assert idx.remote_pages_resident() == 0
+            assert idx.host_pages_resident() == 3
+            assert idx.snapshot()["remote_spill_errors"] >= 1
+            hit, covered = idx.match_and_acquire(toks + [99], owner="b")
+            assert covered == 12
+            assert idx.snapshot()["pages_promoted"] == 3
+            alloc.free(hit)
+            alloc.assert_quiescent()
+        finally:
+            idx.close()
+
+    def test_spill_all_to_remote_forced(self, tmp_path):
+        """The drain-for-failover entry point: force everything resident
+        out to the store regardless of idle timers, so a terminating
+        replica's conversations survive it."""
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+
+        store = ArtifactStore(str(tmp_path))
+        idx, alloc, _ = self._mk(store, demote_after_s=60.0,
+                                 remote_after_s=60.0)
+        try:
+            toks = list(range(1, 13))
+            pages = alloc.alloc(3, owner="a")
+            idx.insert(toks, pages, 12)
+            alloc.free(list(reversed(pages)))
+            assert idx.spill_all_to_remote() == 3
+            assert idx.remote_pages_resident() == 3
+            assert alloc.cached() == 0
+            hit, covered = idx.match_and_acquire(toks + [99], owner="b")
+            assert covered == 12
+            alloc.free(hit)
+            alloc.assert_quiescent()
+        finally:
+            idx.close()
+
+    def test_gc_sweeps_orphans_keeps_registered(self, tmp_path):
+        """SIGKILL mid-demote leaves a published-but-unregistered blob
+        (the crash window is publish→register). The register-only GC
+        sweep reclaims it; registered spill blobs stay promotable."""
+        import os
+
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+        from kubeflow_tpu.pipelines.gc import collect_garbage
+
+        store = ArtifactStore(str(tmp_path))
+        toks = list(range(1, 13))
+        idx_a, alloc_a, _ = self._mk(store)
+        try:
+            self._spill(idx_a, alloc_a, toks)
+        finally:
+            idx_a.close()
+        # The crash window: bytes published, register never ran.
+        orphan = store.put_bytes(b"kv blob from an engine killed mid-demote")
+        past = time.time() - 3600
+        os.utime(store.path_for(orphan), (past, past))
+        report = collect_garbage(store, None, min_age_s=600.0)
+        assert report["swept_blobs"] == 1
+        assert not store.exists(orphan)
+        # Registered blobs survived the sweep and still promote.
+        idx_b, alloc_b, _ = self._mk(store)
+        try:
+            hit, covered = idx_b.match_and_acquire(toks + [99], owner="b")
+            assert covered == 12
+            assert idx_b.snapshot()["pages_promoted_remote"] == 3
+            alloc_b.free(hit)
+            alloc_b.assert_quiescent()
+        finally:
+            idx_b.close()
+
+
 # -- engine level --------------------------------------------------------------
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,134 @@
+"""Shared retry/backoff helper (serve/retry.py, ISSUE 17): the one
+policy object every fleet failure path states its budget with. Pins the
+arithmetic — jitter band, exponential growth, hard cap, give-up — and
+the call contract (0-based attempt index, re-raised last exception)."""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.serve.retry import (
+    PROBE_POLICY, STORE_POLICY, RetryPolicy, call_with_retry, env_float,
+    env_int, handoff_policy,
+)
+
+
+class TestRetryPolicy:
+    def test_delays_length_is_attempts_minus_one(self):
+        p = RetryPolicy(attempts=4, base_s=0.1, cap_s=10.0, jitter_frac=0.0)
+        assert p.delays() == [0.1, 0.2, 0.4]
+
+    def test_jitter_band_bounds(self):
+        """Every delay lands in [d*(1-j), d*(1+j)] for the un-jittered
+        exponential d — sampled wide enough to catch a bad band."""
+        p = RetryPolicy(attempts=3, base_s=0.1, cap_s=10.0, jitter_frac=0.5)
+        rng = random.Random(7)
+        for _ in range(500):
+            d1 = p.delay_s(1, rng)
+            d2 = p.delay_s(2, rng)
+            assert 0.05 <= d1 <= 0.15, d1
+            assert 0.10 <= d2 <= 0.30, d2
+
+    def test_jitter_actually_desynchronizes(self):
+        p = RetryPolicy(attempts=2, base_s=0.1, cap_s=1.0, jitter_frac=0.5)
+        rng = random.Random(3)
+        assert len({p.delay_s(1, rng) for _ in range(50)}) > 10
+
+    def test_cap_applies_even_with_jitter(self):
+        """The cap is a HARD ceiling: jitter widens the band but can
+        never push a delay past cap_s (a fleet-wide retry storm must
+        stay bounded)."""
+        p = RetryPolicy(attempts=10, base_s=1.0, cap_s=2.0, jitter_frac=0.9)
+        rng = random.Random(11)
+        for failures in range(1, 10):
+            for _ in range(100):
+                assert p.delay_s(failures, rng) <= 2.0
+
+    def test_zero_failures_means_zero_delay(self):
+        assert RetryPolicy().delay_s(0) == 0.0
+
+
+class TestCallWithRetry:
+    def test_passes_attempt_index_and_succeeds(self):
+        """fn receives the 0-based attempt index — the cross-host
+        handoff uses it to target a DIFFERENT replica per attempt."""
+        seen = []
+
+        def fn(attempt):
+            seen.append(attempt)
+            if attempt < 2:
+                raise OSError("down")
+            return "ok"
+
+        p = RetryPolicy(attempts=3, base_s=0.0, jitter_frac=0.0)
+        assert call_with_retry(fn, policy=p, sleep=lambda s: None) == "ok"
+        assert seen == [0, 1, 2]
+
+    def test_exhaustion_reraises_last_exception(self):
+        """Give-up is a signal (the caller's terminal fallback fires),
+        never a silent None."""
+        def fn(attempt):
+            raise OSError(f"fail {attempt}")
+
+        p = RetryPolicy(attempts=3, base_s=0.0, jitter_frac=0.0)
+        with pytest.raises(OSError, match="fail 2"):
+            call_with_retry(fn, policy=p, sleep=lambda s: None)
+
+    def test_non_retryable_exception_propagates_immediately(self):
+        calls = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            raise ValueError("not transient")
+
+        p = RetryPolicy(attempts=5, base_s=0.0, jitter_frac=0.0)
+        with pytest.raises(ValueError):
+            call_with_retry(fn, policy=p, sleep=lambda s: None)
+        assert calls == [0]
+
+    def test_on_retry_fires_between_attempts_with_last_exc(self):
+        notes = []
+
+        def fn(attempt):
+            raise OSError("x")
+
+        p = RetryPolicy(attempts=3, base_s=0.0, jitter_frac=0.0)
+        with pytest.raises(OSError):
+            call_with_retry(fn, policy=p, sleep=lambda s: None,
+                            on_retry=lambda a, e: notes.append((a, str(e))))
+        assert notes == [(1, "x"), (2, "x")]
+
+    def test_sleeps_follow_the_policy(self):
+        slept = []
+
+        def fn(attempt):
+            raise OSError("x")
+
+        p = RetryPolicy(attempts=3, base_s=0.1, cap_s=10.0, jitter_frac=0.0)
+        with pytest.raises(OSError):
+            call_with_retry(fn, policy=p, sleep=slept.append)
+        assert slept == [0.1, 0.2]
+
+
+class TestEnvKnobs:
+    def test_handoff_policy_reads_retry_knob(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_HANDOFF_RETRIES", "4")
+        assert handoff_policy().attempts == 5
+        monkeypatch.setenv("KFTPU_HANDOFF_RETRIES", "0")
+        assert handoff_policy().attempts == 1   # never zero tries
+        monkeypatch.delenv("KFTPU_HANDOFF_RETRIES")
+        assert handoff_policy().attempts == 3   # default: 2 retries
+
+    def test_env_parsers_fall_back_on_garbage(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_TEST_KNOB", "not-a-number")
+        assert env_float("KFTPU_TEST_KNOB", 1.5) == 1.5
+        assert env_int("KFTPU_TEST_KNOB", 7) == 7
+        monkeypatch.setenv("KFTPU_TEST_KNOB", "2.5")
+        assert env_float("KFTPU_TEST_KNOB", 1.5) == 2.5
+
+    def test_shared_policies_are_bounded(self):
+        """The store/probe budgets stay tiny: both sit on latency paths
+        that their own deadlines must dominate."""
+        for p in (STORE_POLICY, PROBE_POLICY):
+            assert p.attempts <= 3
+            assert max(p.delays(random.Random(0))) <= p.cap_s <= 0.5

@@ -416,3 +416,145 @@ def test_scrape_failure_ejects_pool_member(router, backends):
     assert backend == a.url
     assert decode == b.url, "dead decode member still picked"
     assert router.snapshot()["ejections"] >= 1
+
+
+# -- prefix affinity + decode alternates (ISSUE 17) ---------------------------
+
+def test_prefix_affinity_pins_decode_replica(router, backends):
+    """The same affinity key lands on the same decode replica every
+    pick, overriding load signals — a session's turns chase the replica
+    whose radix tree holds their prefix."""
+    from kubeflow_tpu.serve.router import _rendezvous
+
+    a, b = backends
+    router.set_pools({"prefill": [a.url], "decode": [a.url, b.url]},
+                     scrape=False)
+    # Make the affinity home the LOAD-WORSE replica, so following it is
+    # observably affinity, not the load tiebreak.
+    home = max([a.url, b.url], key=lambda u: _rendezvous("sess", u))
+    other = b.url if home == a.url else a.url
+    router.note_signals(home, {"kv_pages_resident": 500, "in_flight": 5})
+    router.note_signals(other, {"kv_pages_resident": 0, "in_flight": 0})
+    for _ in range(6):
+        _, decode = router.pick_disaggregated(affinity="sess")
+        assert decode == home, "affinity did not pin the warm replica"
+    assert router.snapshot()["affinity_hits"] >= 6
+    # No key → pure load placement.
+    _, decode = router.pick_disaggregated()
+    assert decode == other
+
+
+def test_prefix_affinity_falls_through_on_unhealth(router, backends):
+    """Affinity is a cache hint, never a health exemption: an ejected
+    home replica misses and the pick degrades to load placement."""
+    from kubeflow_tpu.serve.router import _rendezvous
+
+    a, b = backends
+    router.set_pools({"prefill": [a.url], "decode": [a.url, b.url]},
+                     scrape=False)
+    home = max([a.url, b.url], key=lambda u: _rendezvous("sess", u))
+    other = b.url if home == a.url else a.url
+    for _ in range(router.eject_threshold):
+        router.note_backend_failure(home, connect=True)
+    _, decode = router.pick_disaggregated(affinity="sess")
+    assert decode == other, "ejected home replica still picked"
+    assert router.snapshot()["affinity_misses"] >= 1
+
+
+def test_decode_alternates_are_healthy_non_primary(router, backends):
+    a, b = backends
+    dead = dead_url()
+    router.set_pools({"prefill": [a.url], "decode": [a.url, b.url, dead]},
+                     scrape=False)
+    for _ in range(router.eject_threshold):
+        router.note_backend_failure(dead, connect=True)
+    alts = router.decode_alternates(a.url)
+    assert alts == (b.url,), "alternates must exclude primary + ejected"
+    assert router.decode_alternates(None) in ((a.url, b.url),
+                                              (b.url, a.url))
+
+
+def test_affinity_key_extraction():
+    from kubeflow_tpu.serve.router import _affinity_key
+
+    body = json.dumps({"prompt": "sys: " + "x" * 100}).encode()
+    key = _affinity_key("/v1/completions", body)
+    assert key is not None and len(key) == 64
+    chat = json.dumps({"messages": [
+        {"role": "system", "content": "you are helpful"},
+        {"role": "user", "content": "hi"}]}).encode()
+    assert _affinity_key("/v1/chat/completions", chat) == "you are helpful"
+    assert _affinity_key("/v1/embeddings", body) is None
+    assert _affinity_key("/v1/completions", b"not json") is None
+    assert _affinity_key("/v1/completions", None) is None
+    assert _affinity_key("/v1/completions", b'{"prompt": ""}') is None
+
+
+def test_proxy_stamps_decode_alts_header(router, backends):
+    """A disaggregated pick forwards the retry ladder on
+    X-Kftpu-Decode-Alts: every healthy decode member except the primary
+    target, so the prefill replica can retry a died-mid-handoff peer."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubeflow_tpu.core.headers import (
+        DECODE_ALTS_HEADER, DECODE_BACKEND_HEADER,
+    )
+
+    seen = {}
+
+    class Capture(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            seen["decode"] = self.headers.get(DECODE_BACKEND_HEADER)
+            seen["alts"] = self.headers.get(DECODE_ALTS_HEADER)
+            n = int(self.headers.get("Content-Length", 0))
+            if n:
+                self.rfile.read(n)
+            data = _json.dumps({"backend": "capture"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Capture)
+    httpd.daemon_threads = True
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    cap_url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    a, b = backends
+    try:
+        router.set_pools({"prefill": [cap_url], "decode": [a.url, b.url]},
+                         scrape=False)
+        status, _ = ask(router.url)
+        assert status == 200
+        assert seen["decode"] in (a.url, b.url)
+        expect_alt = b.url if seen["decode"] == a.url else a.url
+        assert seen["alts"] == expect_alt
+        # One-member decode pool → no alternates header at all.
+        router.set_pools({"prefill": [cap_url], "decode": [b.url]},
+                         scrape=False)
+        seen.clear()
+        status, _ = ask(router.url)
+        assert status == 200
+        assert seen["alts"] is None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_remote_pages_signal_scraped(router, backends):
+    """kftpu_engine_kv_pages_remote rides the scrape into the signal
+    table (the consumer half of the two-sided gauge)."""
+    text = ("# TYPE kftpu_engine_kv_pages_remote gauge\n"
+            "kftpu_engine_kv_pages_remote 7\n"
+            "# TYPE kftpu_serving_in_flight gauge\n"
+            "kftpu_serving_in_flight 1\n")
+    sig = Router._parse_signals(text)
+    assert sig is not None
+    assert sig["kv_pages_remote"] == 7.0

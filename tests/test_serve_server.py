@@ -229,6 +229,7 @@ class TestMultiModel:
         yield srv
         srv.stop()
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 17): slowest fast tests re-marked
     def test_index_and_lazy_load(self, repo_server):
         code, out = http(repo_server, "GET", "/v2/repository/index")
         assert code == 200

@@ -113,6 +113,7 @@ class TestCLIP:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 17): slowest fast tests re-marked
     def test_sharded_matches_single(self):
         params = init_clip_params(jax.random.PRNGKey(0), TINY_CLIP)
         batch = jax.tree.map(jnp.asarray, clip_batch(TINY_CLIP, 8, 0))
@@ -124,6 +125,7 @@ class TestCLIP:
 
 
 class TestVisionViaPipelines:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 17): slowest fast tests re-marked
     def test_vit_training_pipeline(self, tmp_path):
         """BASELINE config 4: a KFP-analog pipeline whose component trains
         ViT and hands metrics downstream."""
