@@ -95,6 +95,24 @@ ATTRIBUTION_SERIES = (
     "kftpu_engine_adapters_resident",
     "kftpu_engine_adapter_loads_total",
     "kftpu_engine_adapter_evictions_total",
+    # Fleet observability plane (obs/fleet.py): stitcher / history /
+    # burn-rate health rendered through the same exposition grammar
+    # (``fleet_obs_registry``). A run whose hop attribution looks thin
+    # names its cause here — spans dropped at drain, skewed clocks, a
+    # starved scrape loop — instead of reading as "the fleet was fast".
+    "kftpu_fleet_spans_total",
+    "kftpu_fleet_spans_duplicate_total",
+    "kftpu_fleet_drain_errors_total",
+    "kftpu_fleet_traces_stitched",
+    "kftpu_fleet_clock_skew_ms",
+    "kftpu_fleet_hops_total",
+    "kftpu_fleet_hop_wire_ms",
+    "kftpu_obs_history_points",
+    "kftpu_obs_history_scrapes_total",
+    "kftpu_obs_history_scrape_errors_total",
+    "kftpu_obs_slo_burn_rate",
+    "kftpu_obs_slo_alert",
+    "kftpu_obs_flight_dumps_total",
 )
 
 #: Engine span-name prefix → report phase keys (obs.trace owns the
@@ -160,6 +178,25 @@ def engine_attribution(metrics_text: str) -> dict:
                 key = key[:-len("_total")]
             h = out.setdefault("handoff", {})
             h[key] = h.get(key, 0) + int(value)
+        elif name.startswith("kftpu_fleet_") \
+                or name.startswith("kftpu_obs_"):
+            # Fleet observability plane (obs/fleet.py): stitcher +
+            # history + burn-rate health. Counters sum across sources;
+            # gauges keep the worst (max) sample — the most skewed
+            # clock / hottest burn rate is the story.
+            fl = out.setdefault("fleet_obs", {})
+            key = name[len("kftpu_fleet_"):] \
+                if name.startswith("kftpu_fleet_") \
+                else name[len("kftpu_obs_"):]
+            if key == "slo_alert":
+                al = fl.setdefault("slo_alerts", {})
+                cls = labels.get("class", "")
+                al[cls] = max(al.get(cls, 0), int(value))
+            elif key.endswith("_total"):
+                key = key[:-len("_total")]
+                fl[key] = fl.get(key, 0) + int(value)
+            else:
+                fl[key] = max(fl.get(key, 0.0), round(value, 3))
         elif name.startswith("kftpu_serving_qos_"):
             cls = labels.get("qos")
             if cls is None:
@@ -211,8 +248,44 @@ def phase_breakdown(trace_ids, tracer: Optional[Tracer] = None) -> dict:
     return out
 
 
+def hop_breakdown(trace_ids, collector) -> dict:
+    """Aggregate stitched cross-process hop wire times (``obs.fleet``
+    stitcher output) to per-kind p50/p95 across the given traces —
+    the fleet-level sibling of ``phase_breakdown``: route / handoff /
+    failover wire milliseconds next to the engine-phase percentiles.
+
+    ``collector`` is a ``FleetTraceCollector`` (duck-typed: anything
+    with ``hops(trace_id)``). ``non_monotone_hops`` counts hops whose
+    skew-corrected child interval escapes its parent — a nonzero count
+    means the clock-offset handshake failed, so the wire numbers for
+    that source are suspect."""
+    per_kind: dict[str, list[float]] = {}
+    covered = 0
+    non_monotone = 0
+    for tid in trace_ids:
+        if not tid:
+            continue
+        hops = collector.hops(tid)
+        if not hops:
+            continue
+        covered += 1
+        for hop in hops:
+            per_kind.setdefault(hop["kind"], []).append(hop["wire_ms"])
+            if not hop.get("monotone", True):
+                non_monotone += 1
+    out: dict = {"trace_coverage": covered,
+                 "requests_traced": sum(1 for t in trace_ids if t),
+                 "non_monotone_hops": non_monotone}
+    for kind, xs in sorted(per_kind.items()):
+        out[kind] = {"hops": len(xs),
+                     "wire_ms_p50": round(stats.quantile(xs, 0.5), 3),
+                     "wire_ms_p95": round(stats.quantile(xs, 0.95), 3)}
+    return out
+
+
 def build_report(run: ScenarioRun, *, metrics_text: Optional[str] = None,
-                 tracer: Optional[Tracer] = None) -> dict:
+                 tracer: Optional[Tracer] = None,
+                 collector=None) -> dict:
     """One scenario's full attribution report (see module docstring)."""
     sc = run.scenario
     outs = run.outcomes
@@ -305,6 +378,12 @@ def build_report(run: ScenarioRun, *, metrics_text: Optional[str] = None,
         report["engine"] = engine_attribution(metrics_text)
     report["phases"] = phase_breakdown(
         [o.trace_id for o in outs], tracer=tracer)
+    if collector is not None:
+        # Fleet-stitched hop attribution (obs/fleet.py): the wire time
+        # BETWEEN processes — router→server, handoff, failover — that
+        # no single engine's phase spans can see.
+        report["fleet_hops"] = hop_breakdown(
+            [o.trace_id for o in outs], collector)
     return report
 
 

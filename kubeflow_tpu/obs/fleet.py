@@ -1,0 +1,1044 @@
+"""Fleet observability plane: cross-host trace stitching, metrics
+time-series history, SLO burn-rate monitoring, and a flight recorder.
+
+PR 17 made the data plane fleet-wide (router → prefill → handoff →
+decode → failover replica → remote KV tier) while every ``Tracer`` ring
+and ``/metrics`` exposition stayed per-process and point-in-time. This
+module is the read side of that fleet:
+
+- **Trace stitching** — every process exports its completed spans
+  (``spans_export_payload`` behind ``/debug/spans/export`` on the model
+  server and ``/-/router/debug/spans/export`` on the router);
+  ``FleetTraceCollector`` drains those endpoints and joins spans by
+  trace id into ONE causal tree per request, spanning the router hop,
+  the prefill replica, the KV handoff wire, the decode replica and any
+  failover retry. Drains are at-least-once (dedup by span id), clock
+  skew is corrected per source with an NTP-style offset estimated from
+  the export handshake (``offset = remote_now − (t_send+t_recv)/2``),
+  and per-hop wire time is attributed from the corrected parent/child
+  edges (``wire_out = child.start − parent.start``, ``wire_back =
+  parent.end − child.end``).
+
+- **Metrics history** — ``MetricsHistory`` polls each replica's real
+  ``/metrics`` exposition through the one ``parse_exposition`` grammar
+  and keeps a bounded ring of points per (replica, series, labels),
+  answering latest/mean/delta/rate and histogram-percentile-over-window
+  queries within a declared retention. ``HistoryProbe`` is the
+  autoscaler seam: a drop-in for ``isvc_controller.default_probe`` that
+  folds the SAME samples through the SAME fold (``signals_from_samples``)
+  so autoscaler decisions are identical to live-scrape mode — the seam
+  ROADMAP item 5's predictive mode plugs into. The router's seam is
+  ``Router.set_metrics_source(history.latest_text)``.
+
+- **SLO burn rate** — ``SloBurnRateMonitor`` evaluates per-class
+  TTFT/queue-delay utilization against targets over a fast AND a slow
+  window (multi-window burn-rate alerting: both must burn > threshold,
+  so a single hiccup cannot page and a slow leak cannot hide).
+
+- **Flight recorder** — ``FlightRecorder`` snapshots the last N seconds
+  of history plus the stitched traces to the workdir as ONE JSON file
+  ``kftpu trace`` can re-load (top-level ``"traces"`` key), on engine
+  stop or sanitizer failure — every chaos scenario leaves a post-mortem
+  artifact that survives the processes that produced it.
+
+Import discipline: this module depends only on ``obs.*`` + stdlib so the
+serving layer can import it at module level without cycles; the one
+``serve`` touch (``signals_from_samples``) is imported lazily inside
+``HistoryProbe.__call__``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+import urllib.request
+from collections import OrderedDict, deque
+from typing import Callable, Optional
+
+from kubeflow_tpu.obs.registry import MetricsRegistry, parse_exposition
+from kubeflow_tpu.obs.stats import percentile
+from kubeflow_tpu.obs.trace import (
+    format_trace_tree, get_tracer, phase_durations,
+)
+
+logger = logging.getLogger("kubeflow_tpu.obs.fleet")
+
+#: Drain endpoints (the span-export twins of ``/debug/traces``).
+SPANS_EXPORT_PATH = "/debug/spans/export"
+ROUTER_SPANS_EXPORT_PATH = "/-/router/debug/spans/export"
+
+
+# -- span export (the per-process drain payload) ----------------------------
+
+def spans_export_payload(tracer=None, *, process: Optional[str] = None,
+                         limit: int = 128) -> dict:
+    """The ``/debug/spans/export`` response body: every COMPLETED span in
+    this process's tracer ring (open spans are still being written by
+    their owning layer and export on a later drain), plus the process
+    identity and the export-time wall clock. ``now`` is the skew
+    handshake: the collector brackets the GET with its own clock and
+    estimates this process's offset NTP-style — no new header, no
+    protocol change. Export is a READ of the ring, so repeated drains
+    re-send the same spans; the collector dedups by span id
+    (at-least-once delivery, exactly-once stitching)."""
+    t = tracer or get_tracer()
+    spans: list[dict] = []
+    for rec in t.traces(limit=limit):
+        spans.extend(rec["spans"])
+    return {
+        "process": {"name": process or f"pid:{os.getpid()}",
+                    "pid": os.getpid()},
+        "now": time.time(),
+        "spans": spans,
+    }
+
+
+# -- stitching --------------------------------------------------------------
+
+def span_process(span: dict, by_id: dict, cache: dict) -> str:
+    """Which process a span ran in. Intrinsic identity first (router
+    spans are named ``router.*``; server spans carry a ``server`` attr),
+    then inherited from the parent (engine spans run in their server's
+    process), then the drain source that delivered it. Intrinsic beats
+    delivery because an in-process test fleet shares one tracer ring —
+    every source delivers every span — while a real fleet's sources and
+    intrinsics agree."""
+    sid = span.get("span_id")
+    if sid in cache:
+        return cache[sid]
+    cache[sid] = "?"          # cycle guard (malformed parent loops)
+    name = span.get("name", "")
+    attrs = span.get("attrs") or {}
+    proc: Optional[str] = None
+    if name.startswith("router."):
+        proc = "router"
+    elif attrs.get("server"):
+        proc = f"server:{attrs['server']}"
+    if proc is None:
+        parent = by_id.get(span.get("parent_id"))
+        if parent is not None:
+            proc = span_process(parent, by_id, cache)
+        else:
+            proc = span.get("source") or "?"
+    cache[sid] = proc
+    return proc
+
+
+def stitch_hops(spans: list[dict]) -> list[dict]:
+    """Cross-process hops in a stitched span list: every parent→child
+    edge whose processes differ, with wire-time attribution from the
+    (skew-corrected) timestamps. ``wire_out`` is the request's time on
+    the wire (child started after the parent sent it), clamped at 0 — a
+    negative residue after correction is clock noise, not negative
+    latency. ``wire_back`` is the response leg, present only for
+    synchronous hops where the parent outlived the child; an async hop
+    (a KV handoff acked mid-stream, the child outliving its parent) has
+    no response leg to attribute and reports ``wire_back_ms: None``.
+    ``monotone`` records whether the corrected ordering is CAUSAL — the
+    child cannot start before its parent sent it (5 ms tolerance) — the
+    skew-correction acceptance signal the fleet smoke asserts.
+
+    Hop kinds: ``route`` (router → replica), ``handoff`` (prefill's KV
+    export → decode's adoption), ``failover`` (a route or handoff hop
+    whose parent span saw a ``connect_failure`` first — the SIGKILL
+    path, at either layer), ``rpc`` (anything else that crossed
+    processes)."""
+    by_id = {s["span_id"]: s for s in spans}
+    cache: dict = {}
+    hops: list[dict] = []
+    for s in sorted(spans, key=lambda s: s.get("start") or 0.0):
+        parent = by_id.get(s.get("parent_id"))
+        if parent is None:
+            continue
+        src = span_process(parent, by_id, cache)
+        dst = span_process(s, by_id, cache)
+        if src == dst:
+            continue
+        pname = parent.get("name", "")
+        retried = any(ev.get("name") == "connect_failure"
+                      for ev in parent.get("events") or [])
+        if pname == "engine.handoff":
+            # A handoff whose placed decode replica died en route lands
+            # on a retry alternate — that hop IS the failover.
+            kind = "failover" if retried else "handoff"
+        elif pname.startswith("router."):
+            kind = "failover" if retried else "route"
+        else:
+            kind = "rpc"
+        p_start, p_end = parent.get("start"), parent.get("end")
+        c_start, c_end = s.get("start"), s.get("end")
+        wire_out = wire_back = None
+        monotone = True
+        if p_start is not None and c_start is not None:
+            wire_out = max((c_start - p_start) * 1e3, 0.0)
+            monotone = c_start >= p_start - 5e-3
+        if (p_end is not None and c_end is not None
+                and p_end >= c_end - 5e-3):
+            # Synchronous hop: the parent waited for the child, so the
+            # tail is the response's wire time. An async parent (handoff
+            # acked mid-stream) has no response leg to attribute.
+            wire_back = max((p_end - c_end) * 1e3, 0.0)
+        wire = (wire_out or 0.0) + (wire_back or 0.0)
+        hops.append({
+            "kind": kind,
+            "from": src, "to": dst,
+            "parent_span": pname, "child_span": s.get("name", ""),
+            "wire_out_ms": None if wire_out is None else round(wire_out, 3),
+            "wire_back_ms": (None if wire_back is None
+                             else round(wire_back, 3)),
+            "wire_ms": round(wire, 3),
+            "monotone": monotone,
+        })
+    return hops
+
+
+class FleetTraceCollector:
+    """Joins per-process span exports into fleet-wide causal trees.
+
+    ``add_source`` registers a drain endpoint; ``drain()`` GETs each one,
+    estimates the source's clock offset from the request bracket, and
+    ``ingest``s the payload (tests call ``ingest`` directly with
+    synthetic payloads and injected offsets — that is where the ±5 s
+    skew cases are pinned). A source that fails to answer is counted and
+    skipped, never fatal: a replica that died before export is exactly
+    the missing-middle-hop case the stitcher must tolerate (its
+    children surface as top-level orphans in the rendered tree)."""
+
+    def __init__(self, *, max_traces: int = 256, timeout: float = 2.0,
+                 fetch: Optional[Callable[[str], dict]] = None):
+        self.timeout = timeout
+        self._fetch = fetch
+        self._lock = threading.Lock()
+        # trace_id -> {"spans": [dict], "root": dict|None,
+        #              "ids": set, "sources": set}     guarded_by: _lock
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self._max_traces = max_traces
+        self._sources: "OrderedDict[str, dict]" = OrderedDict()
+        self.stats = {"spans": 0, "duplicates": 0,     # guarded_by: _lock
+                      "drains": 0, "drain_errors": 0}
+
+    # -- sources / drain ---------------------------------------------------
+
+    def add_source(self, name: str, url: str) -> None:
+        """Register a drain endpoint (full URL of the export path)."""
+        with self._lock:
+            self._sources[name] = {"url": url, "offset_s": 0.0,
+                                   "spans": 0, "duplicates": 0,
+                                   "errors": 0}
+
+    def sources(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._sources.items()}
+
+    def _get(self, url: str) -> dict:
+        if self._fetch is not None:
+            return self._fetch(url)
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def drain(self) -> int:
+        """One at-least-once pass over every source; returns the number
+        of NEW spans stitched in. Per-source clock offset is re-estimated
+        on every drain from the export handshake."""
+        with self._lock:
+            items = [(n, s["url"]) for n, s in self._sources.items()]
+            self.stats["drains"] += 1
+        new = 0
+        for name, url in items:
+            t_send = time.time()
+            try:
+                payload = self._get(url)
+            except (OSError, ValueError) as exc:
+                # The dead-replica case: count it, keep stitching what
+                # the survivors exported.
+                logger.debug("span drain from %s failed: %s", name, exc)
+                with self._lock:
+                    self.stats["drain_errors"] += 1
+                    if name in self._sources:
+                        self._sources[name]["errors"] += 1
+                continue
+            t_recv = time.time()
+            offset = None
+            remote_now = payload.get("now")
+            if isinstance(remote_now, (int, float)):
+                offset = remote_now - (t_send + t_recv) / 2.0
+            new += self.ingest(payload, source=name, offset_s=offset)
+        return new
+
+    # -- ingest ------------------------------------------------------------
+
+    def ingest(self, payload: dict, *, source: Optional[str] = None,
+               offset_s: Optional[float] = None) -> int:
+        """Stitch one export payload. ``offset_s`` is the source clock's
+        estimated lead over the collector clock; corrected span times are
+        ``t − offset_s`` so all sources land on the collector timeline.
+        Duplicate (trace_id, span_id) pairs — re-drains, or multiple
+        in-process sources sharing one tracer ring — are dropped, first
+        delivery wins."""
+        if source is None:
+            source = (payload.get("process") or {}).get("name") or "?"
+        off = 0.0 if offset_s is None else float(offset_s)
+        new = 0
+        with self._lock:
+            src_stats = self._sources.get(source)
+            if src_stats is None:
+                # ingest() without add_source (tests): track it anyway.
+                src_stats = {"url": None, "offset_s": 0.0, "spans": 0,
+                             "duplicates": 0, "errors": 0}
+                self._sources[source] = src_stats
+            if offset_s is not None:
+                src_stats["offset_s"] = off
+            for span in payload.get("spans") or []:
+                tid = span.get("trace_id")
+                sid = span.get("span_id")
+                if not tid or not sid:
+                    continue
+                rec = self._traces.get(tid)
+                if rec is None:
+                    rec = {"spans": [], "root": None, "ids": set(),
+                           "sources": set()}
+                    self._traces[tid] = rec
+                    while len(self._traces) > self._max_traces:
+                        self._traces.popitem(last=False)
+                if sid in rec["ids"]:
+                    self.stats["duplicates"] += 1
+                    src_stats["duplicates"] += 1
+                    continue
+                rec["ids"].add(sid)
+                rec["sources"].add(source)
+                corrected = dict(span)
+                if corrected.get("start") is not None:
+                    corrected["start"] = corrected["start"] - off
+                if corrected.get("end") is not None:
+                    corrected["end"] = corrected["end"] - off
+                corrected["source"] = source
+                corrected["clock_offset_ms"] = round(off * 1e3, 3)
+                rec["spans"].append(corrected)
+                if corrected.get("parent_id") is None:
+                    rec["root"] = corrected
+                self._traces.move_to_end(tid)
+                self.stats["spans"] += 1
+                src_stats["spans"] += 1
+                new += 1
+        return new
+
+    # -- read surfaces -----------------------------------------------------
+
+    def trace(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._traces.get(trace_id)
+            if rec is None:
+                return None
+            spans = list(rec["spans"])
+            out = {"trace_id": trace_id, "root": rec["root"],
+                   "spans": spans, "sources": sorted(rec["sources"])}
+        out["hops"] = stitch_hops(spans)
+        return out
+
+    def traces(self, limit: int = 64) -> list[dict]:
+        """Stitched traces, newest-activity first, each with its hop
+        list. Shape-compatible with ``Tracer.traces`` so every existing
+        renderer (``format_dump``, ``kftpu trace``) works unchanged."""
+        with self._lock:
+            tids = list(self._traces.keys())
+        tids.reverse()
+        out = []
+        for tid in tids[:limit]:
+            t = self.trace(tid)
+            if t is not None:
+                out.append(t)
+        return out
+
+    def hops(self, trace_id: Optional[str] = None) -> list[dict]:
+        """All hops of one trace, or of every stitched trace."""
+        if trace_id is not None:
+            t = self.trace(trace_id)
+            return t["hops"] if t else []
+        return [h for t in self.traces(limit=self._max_traces)
+                for h in t["hops"]]
+
+    def format_tree(self, trace_id: str) -> str:
+        t = self.trace(trace_id)
+        return format_trace_tree(t["spans"]) if t else ""
+
+    def to_dump(self, limit: int = 64) -> dict:
+        """A ``{"traces": [...]}`` document — the exact shape
+        ``/debug/traces`` serves and ``kftpu trace`` pretty-prints, with
+        the engine-phase rollup attached per trace."""
+        traces = self.traces(limit=limit)
+        for t in traces:
+            phases = phase_durations(t["spans"])
+            if phases:
+                t["phases"] = phases
+        return {"traces": traces}
+
+    def export_chrome(self, trace_id: Optional[str] = None) -> dict:
+        """Chrome/Perfetto export of the STITCHED view: one pid lane per
+        fleet process (router, each replica), so the cross-host request
+        reads as one timeline with the wire gaps visible between lanes."""
+        selected = ([self.trace(trace_id)] if trace_id is not None
+                    else self.traces())
+        pids: dict = {}
+        events: list[dict] = []
+        by_id_cache: dict = {}
+        for t in selected:
+            if not t:
+                continue
+            by_id = {s["span_id"]: s for s in t["spans"]}
+            for s in t["spans"]:
+                if s.get("end") is None:
+                    continue
+                proc = span_process(s, by_id, by_id_cache)
+                if proc not in pids:
+                    pids[proc] = len(pids) + 1
+                    events.append({
+                        "name": "process_name", "ph": "M", "pid": pids[proc],
+                        "args": {"name": proc},
+                    })
+                sid = s["span_id"]
+                try:
+                    tid = int(sid[:6], 16)
+                except ValueError:      # synthetic (non-hex) span ids
+                    tid = int.from_bytes(sid.encode()[:4], "big")
+                events.append({
+                    "name": s["name"], "cat": "kftpu-fleet", "ph": "X",
+                    "ts": s["start"] * 1e6,
+                    "dur": (s["end"] - s["start"]) * 1e6,
+                    "pid": pids[proc],
+                    "tid": tid,
+                    "args": {**(s.get("attrs") or {}),
+                             "trace_id": s["trace_id"],
+                             "status": s.get("status", "ok"),
+                             "source": s.get("source", "?")},
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- metrics time-series history --------------------------------------------
+
+class MetricsHistory:
+    """Bounded time-series rings over each replica's real ``/metrics``.
+
+    One scrape pass (``scrape_once`` / the background loop) fetches every
+    registered target's exposition, parses it through the one
+    ``parse_exposition`` grammar, and appends ``(t, value)`` to the ring
+    keyed by (replica, series name, sorted labels). Retention is dual:
+    ``max_points`` bounds memory per series, ``retention_s`` bounds what
+    queries may answer from (older points are pruned on append and
+    filtered on read) — a query window beyond retention answers from
+    whatever the ring still holds, honestly shorter.
+
+    The last RAW parsed sample list (and raw exposition text) per
+    replica is kept verbatim: ``HistoryProbe`` folds it through the
+    autoscaler's own ``signals_from_samples`` and the router's
+    history-backed signal source re-parses the text, so both consumers
+    see byte-identical data to a live scrape."""
+
+    def __init__(self, *, retention_s: float = 300.0,
+                 max_points: int = 2048, interval_s: float = 1.0,
+                 timeout: float = 2.0,
+                 fetch: Optional[Callable[[str], str]] = None):
+        self.retention_s = retention_s
+        self.max_points = max_points
+        self.interval_s = interval_s
+        self.timeout = timeout
+        self._fetch = fetch
+        self._lock = threading.Lock()
+        self._targets: "OrderedDict[str, str]" = OrderedDict()
+        # (replica, name, labels_tuple) -> deque[(t, v)]   guarded_by: _lock
+        self._series: dict = {}
+        self._latest_samples: dict = {}              # guarded_by: _lock
+        self._latest_text: dict = {}                 # guarded_by: _lock
+        self._latest_at: dict = {}                   # guarded_by: _lock
+        self.stats = {"scrapes": 0, "scrape_errors": 0}  # guarded_by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- targets / scraping ------------------------------------------------
+
+    def add_target(self, replica: str, url: str) -> None:
+        """Register one replica's metrics URL (full URL, idempotent)."""
+        with self._lock:
+            self._targets[replica] = url
+
+    def targets(self) -> dict:
+        with self._lock:
+            return dict(self._targets)
+
+    def _get_text(self, url: str) -> str:
+        if self._fetch is not None:
+            return self._fetch(url)
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return r.read().decode()
+
+    def scrape_target(self, replica: str,
+                      now: Optional[float] = None) -> bool:
+        with self._lock:
+            url = self._targets.get(replica)
+            self.stats["scrapes"] += 1
+        if url is None:
+            return False
+        try:
+            text = self._get_text(url)
+            samples = parse_exposition(text)
+        except (OSError, ValueError) as exc:
+            logger.debug("history scrape of %s failed: %s", replica, exc)
+            with self._lock:
+                self.stats["scrape_errors"] += 1
+            return False
+        self.record(replica, samples, now=now, text=text)
+        return True
+
+    def scrape_once(self, now: Optional[float] = None) -> int:
+        """One pass over every target; returns how many answered."""
+        with self._lock:
+            replicas = list(self._targets)
+        return sum(1 for r in replicas if self.scrape_target(r, now=now))
+
+    def record(self, replica: str, samples, now: Optional[float] = None,
+               text: Optional[str] = None) -> None:
+        """Append one parsed sample set (the test/injection surface —
+        production goes through ``scrape_target``)."""
+        t = time.time() if now is None else now
+        horizon = t - self.retention_s
+        with self._lock:
+            for name, labels, value in samples:
+                key = (replica, name, tuple(sorted(labels.items())))
+                ring = self._series.get(key)
+                if ring is None:
+                    ring = deque(maxlen=self.max_points)
+                    self._series[key] = ring
+                ring.append((t, float(value)))
+                while ring and ring[0][0] < horizon:
+                    ring.popleft()
+            self._latest_samples[replica] = list(samples)
+            if text is not None:
+                self._latest_text[replica] = text
+            self._latest_at[replica] = t
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kftpu-metrics-history")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.scrape_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- raw read side (probe / router seams) ------------------------------
+
+    def latest_samples(self, replica: str) -> Optional[list]:
+        with self._lock:
+            got = self._latest_samples.get(replica)
+            return list(got) if got is not None else None
+
+    def latest_text(self, replica: str) -> Optional[str]:
+        """Raw exposition text of the newest scrape — the router's
+        history-backed signal source (``Router.set_metrics_source``)."""
+        with self._lock:
+            return self._latest_text.get(replica)
+
+    def age_s(self, replica: str,
+              now: Optional[float] = None) -> Optional[float]:
+        t = time.time() if now is None else now
+        with self._lock:
+            at = self._latest_at.get(replica)
+        return None if at is None else max(t - at, 0.0)
+
+    def points_total(self, replica: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(len(ring) for key, ring in self._series.items()
+                       if replica is None or key[0] == replica)
+
+    def replicas(self) -> list[str]:
+        """Every replica the history has data for — scrape targets plus
+        replicas fed through ``record`` directly (tests, piggy-backed
+        feeds) — so consumers like the burn-rate monitor see both."""
+        with self._lock:
+            return sorted(set(self._targets) | set(self._latest_at))
+
+    # -- window queries ----------------------------------------------------
+
+    def _matching(self, replica: str, name: str,
+                  labels: Optional[dict]) -> list:
+        """Series rings matching (replica, name) whose labels contain
+        every given (k, v) pair. guarded_by: _lock (caller holds)."""
+        want = (labels or {}).items()
+        out = []
+        for (rep, nm, lbl), ring in self._series.items():
+            if rep != replica or nm != name:
+                continue
+            have = dict(lbl)
+            if all(have.get(k) == v for k, v in want):
+                out.append((have, ring))
+        return out
+
+    def _window(self, ring, now: float, window_s: float) -> list:
+        lo = now - min(window_s, self.retention_s)
+        return [(t, v) for t, v in ring if lo <= t <= now]
+
+    def latest(self, replica: str, name: str,
+               labels: Optional[dict] = None) -> Optional[float]:
+        """Newest value; multiple matching label sets fold to the WORST
+        (max) — the same pessimistic fold the autoscaler probe uses."""
+        with self._lock:
+            vals = [ring[-1][1]
+                    for _, ring in self._matching(replica, name, labels)
+                    if ring]
+        return max(vals) if vals else None
+
+    def window_mean(self, replica: str, name: str, window_s: float, *,
+                    labels: Optional[dict] = None,
+                    now: Optional[float] = None) -> Optional[float]:
+        t = time.time() if now is None else now
+        with self._lock:
+            pts = [p for _, ring in self._matching(replica, name, labels)
+                   for p in self._window(ring, t, window_s)]
+        if not pts:
+            return None
+        return sum(v for _, v in pts) / len(pts)
+
+    def delta(self, replica: str, name: str, window_s: float, *,
+              labels: Optional[dict] = None,
+              now: Optional[float] = None) -> Optional[float]:
+        """Counter increase over the window, summed across matching
+        label sets (last − first per series, each clamped at 0 so a
+        replica restart reads as no progress, not negative progress)."""
+        t = time.time() if now is None else now
+        with self._lock:
+            series = self._matching(replica, name, labels)
+            total = None
+            for _, ring in series:
+                pts = self._window(ring, t, window_s)
+                if len(pts) < 2:
+                    continue
+                total = (total or 0.0) + max(pts[-1][1] - pts[0][1], 0.0)
+        return total
+
+    def rate(self, replica: str, name: str, window_s: float, *,
+             labels: Optional[dict] = None,
+             now: Optional[float] = None) -> Optional[float]:
+        """Per-second counter rate over the actually-covered span."""
+        t = time.time() if now is None else now
+        with self._lock:
+            series = self._matching(replica, name, labels)
+            best_span = 0.0
+            total = None
+            for _, ring in series:
+                pts = self._window(ring, t, window_s)
+                if len(pts) < 2:
+                    continue
+                total = (total or 0.0) + max(pts[-1][1] - pts[0][1], 0.0)
+                best_span = max(best_span, pts[-1][0] - pts[0][0])
+        if total is None or best_span <= 0.0:
+            return None
+        return total / best_span
+
+    def percentile_over_window(self, replica: str, name: str, p: float,
+                               window_s: float, *,
+                               labels: Optional[dict] = None,
+                               now: Optional[float] = None
+                               ) -> Optional[float]:
+        """Histogram quantile over the window from the ``<name>_bucket``
+        cumulative counters: per-``le`` delta, then linear interpolation
+        inside the bucket holding the target rank (the standard
+        histogram_quantile estimator, in the histogram's native unit).
+        None when the window saw no observations."""
+        t = time.time() if now is None else now
+        with self._lock:
+            series = self._matching(replica, f"{name}_bucket", labels)
+            deltas: dict = {}
+            for have, ring in series:
+                le_raw = have.get("le")
+                if le_raw is None:
+                    continue
+                le = math.inf if le_raw in ("+Inf", "inf") else float(le_raw)
+                pts = self._window(ring, t, window_s)
+                if len(pts) < 2:
+                    continue
+                deltas[le] = deltas.get(le, 0.0) + max(
+                    pts[-1][1] - pts[0][1], 0.0)
+        if not deltas or math.inf not in deltas:
+            return None
+        total = deltas[math.inf]
+        if total <= 0.0:
+            return None
+        rank = max(min(p / 100.0, 1.0), 0.0) * total
+        prev_le, prev_cum = 0.0, 0.0
+        for le in sorted(deltas):
+            # Bucket counters are CUMULATIVE in le, so the per-le window
+            # delta is too — clamp against the running max so a scrape
+            # race can't fabricate a decreasing CDF.
+            cum = max(deltas[le], prev_cum)
+            if cum >= rank:
+                if le is math.inf:
+                    return prev_le
+                bucket = cum - prev_cum
+                if bucket <= 0.0:
+                    return le
+                return prev_le + (le - prev_le) * (
+                    (rank - prev_cum) / bucket)
+            prev_le, prev_cum = (0.0 if le is math.inf else le), cum
+        return prev_le
+
+
+class HistoryProbe:
+    """Drop-in for ``isvc_controller.default_probe`` answering from the
+    history substrate. Liveness is still a live ``/healthz`` hit (a
+    history ring must never vouch for a dead process); the SIGNALS come
+    from the newest recorded sample set, folded through the autoscaler's
+    own ``signals_from_samples`` — so on steady traffic the autoscaler's
+    decisions are identical to live-scrape mode (pinned in tests), and
+    ROADMAP item 5's predictive mode has one seam to extend: answer from
+    a forecast over the ring instead of the newest point."""
+
+    def __init__(self, history: MetricsHistory, *, max_age_s: float = 2.0,
+                 timeout: float = 0.5):
+        self.history = history
+        self.max_age_s = max_age_s
+        self.timeout = timeout
+
+    def __call__(self, url: str) -> Optional[dict]:
+        from kubeflow_tpu.serve.isvc_controller import signals_from_samples
+
+        try:
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=self.timeout) as r:
+                if r.status != 200:
+                    return None
+        except OSError:
+            return None
+        replica = url
+        if replica not in self.history.targets():
+            self.history.add_target(replica, url + "/metrics")
+        age = self.history.age_s(replica)
+        if age is None or age > self.max_age_s:
+            self.history.scrape_target(replica)
+        samples = self.history.latest_samples(replica)
+        # No scrape ever landed: ready but blind — the same shape
+        # default_probe returns on an unparseable exposition.
+        return signals_from_samples(samples or ())
+
+
+# -- SLO burn-rate monitor --------------------------------------------------
+
+#: The latency series the burn-rate monitor folds against SLO targets —
+#: the monitor's half of the engine↔obs metrics contract (same two-sided
+#: idiom as the autoscaler's ``_PROBE_SERIES``).
+BURN_RATE_SERIES = (
+    "kftpu_serving_qos_ttft_p95_ms",
+    "kftpu_serving_qos_queue_delay_p95_ms",
+    "kftpu_serving_ttft_p95_ms",
+    "kftpu_serving_queue_delay_p95_ms",
+)
+
+
+class SloBurnRateMonitor:
+    """Multi-window burn-rate evaluation over the history rings.
+
+    For each class, burn = window-mean of the observed p95 latency
+    divided by its SLO target, taken as the WORST across replicas and
+    across the TTFT/queue-delay signals. The alert requires BOTH the
+    fast window (is it burning NOW?) and the slow window (has it burned
+    long enough to matter?) above threshold — the standard multi-window
+    discipline: a single straggler request cannot page, and a sustained
+    breach cannot hide behind one good minute."""
+
+    def __init__(self, history: MetricsHistory, targets: dict, *,
+                 fast_window_s: float = 30.0, slow_window_s: float = 300.0,
+                 threshold: float = 1.0):
+        self.history = history
+        #: class -> {"ttft_p95_ms": target, "queue_delay_p95_ms": target}
+        self.targets = {cls: dict(t) for cls, t in targets.items()}
+        self.fast_window_s = fast_window_s
+        self.slow_window_s = slow_window_s
+        self.threshold = threshold
+        self._lock = threading.Lock()
+        self._state: dict = {}                       # guarded_by: _lock
+
+    def _class_burn(self, cls: str, spec: dict, window_s: float,
+                    now: Optional[float]) -> Optional[float]:
+        per_qos = {
+            "ttft_p95_ms": "kftpu_serving_qos_ttft_p95_ms",
+            "queue_delay_p95_ms": "kftpu_serving_qos_queue_delay_p95_ms",
+        }
+        aggregate = {
+            "ttft_p95_ms": "kftpu_serving_ttft_p95_ms",
+            "queue_delay_p95_ms": "kftpu_serving_queue_delay_p95_ms",
+        }
+        worst: Optional[float] = None
+        for key, series in per_qos.items():
+            target = spec.get(key)
+            if not target:
+                continue
+            for replica in self.history.replicas() or [""]:
+                seen = self.history.window_mean(
+                    replica, series, window_s,
+                    labels={"qos": cls}, now=now)
+                if seen is None:
+                    # Per-class signal absent (e.g. a class that took no
+                    # traffic yet): the aggregate p95 stands in.
+                    seen = self.history.window_mean(
+                        replica, aggregate[key], window_s, now=now)
+                if seen is None:
+                    continue
+                burn = seen / target
+                worst = burn if worst is None else max(worst, burn)
+        return worst
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """One evaluation pass: per-class fast/slow burn + alert state,
+        also retained for ``state()`` / the registry render."""
+        out: dict = {}
+        for cls, spec in self.targets.items():
+            fast = self._class_burn(cls, spec, self.fast_window_s, now)
+            slow = self._class_burn(cls, spec, self.slow_window_s, now)
+            alert = (fast is not None and slow is not None
+                     and fast > self.threshold and slow > self.threshold)
+            out[cls] = {"fast": fast, "slow": slow, "alert": alert}
+        with self._lock:
+            self._state = out
+        return out
+
+    def state(self) -> dict:
+        with self._lock:
+            return {cls: dict(v) for cls, v in self._state.items()}
+
+    def alerting(self) -> list[str]:
+        """Classes currently in alert (after the last ``evaluate``)."""
+        return sorted(cls for cls, v in self.state().items() if v["alert"])
+
+
+# -- flight recorder --------------------------------------------------------
+
+class FlightRecorder:
+    """Crash-surviving post-mortem snapshots: the last ``window_s`` of
+    metrics history + the stitched fleet traces + the burn-rate state,
+    written atomically (tmp + rename) to the workdir as one JSON document
+    whose top-level ``"traces"`` key makes it directly re-loadable by
+    ``kftpu trace`` — the dump IS a trace dump, with the history riding
+    in a ``"flight_recorder"`` sidecar key. Bounded at ``keep`` files
+    (oldest pruned), so a crash loop cannot fill the disk."""
+
+    def __init__(self, workdir: str, *, window_s: float = 60.0,
+                 keep: int = 8,
+                 history: Optional[MetricsHistory] = None,
+                 collector: Optional[FleetTraceCollector] = None,
+                 monitor: Optional[SloBurnRateMonitor] = None,
+                 tracer=None):
+        self.workdir = workdir
+        self.window_s = window_s
+        self.keep = keep
+        self.history = history
+        self.collector = collector
+        self.monitor = monitor
+        self.tracer = tracer
+        self._lock = threading.Lock()
+        self._seq = 0                                # guarded_by: _lock
+        self.dumps_total = 0                         # guarded_by: _lock
+
+    def attach(self, *, history: Optional[MetricsHistory] = None,
+               collector: Optional[FleetTraceCollector] = None,
+               monitor: Optional[SloBurnRateMonitor] = None) -> None:
+        """Late-bind the fleet objects (the env-var-created recorder
+        exists before the harness builds its collector/history)."""
+        if history is not None:
+            self.history = history
+        if collector is not None:
+            self.collector = collector
+        if monitor is not None:
+            self.monitor = monitor
+
+    def _history_window(self, now: float) -> list[dict]:
+        if self.history is None:
+            return []
+        lo = now - self.window_s
+        out = []
+        with self.history._lock:
+            for (rep, name, lbl), ring in self.history._series.items():
+                pts = [[round(t, 6), v] for t, v in ring if t >= lo]
+                if pts:
+                    out.append({"replica": rep, "name": name,
+                                "labels": dict(lbl), "points": pts})
+        return out
+
+    def snapshot(self, reason: str) -> Optional[str]:
+        """Write one dump; returns its path (None on write failure —
+        a full disk must not turn an engine stop into a crash)."""
+        now = time.time()
+        if self.collector is not None:
+            doc = self.collector.to_dump()
+        else:
+            t = self.tracer or get_tracer()
+            doc = {"traces": t.traces()}
+        doc["flight_recorder"] = {
+            "reason": reason,
+            "written_unix": round(now, 3),
+            "window_s": self.window_s,
+            "pid": os.getpid(),
+            "history": self._history_window(now),
+            "slo": self.monitor.state() if self.monitor else {},
+        }
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        name = f"flight-{int(now)}-{os.getpid()}-{seq}-{reason}.json"
+        path = os.path.join(self.workdir, name)
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(self.workdir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)
+        except OSError as exc:
+            logger.warning("flight recorder dump failed: %s", exc)
+            return None
+        with self._lock:
+            self.dumps_total += 1
+        self._prune()
+        logger.info("flight recorder: wrote %s (%s)", path, reason)
+        return path
+
+    def _prune(self) -> None:
+        try:
+            dumps = sorted(
+                f for f in os.listdir(self.workdir)
+                if f.startswith("flight-") and f.endswith(".json"))
+            for stale in dumps[:-self.keep] if self.keep > 0 else dumps:
+                os.remove(os.path.join(self.workdir, stale))
+        except OSError as exc:
+            logger.debug("flight recorder prune failed: %s", exc)
+
+    def dumps(self) -> list[str]:
+        try:
+            return sorted(
+                os.path.join(self.workdir, f)
+                for f in os.listdir(self.workdir)
+                if f.startswith("flight-") and f.endswith(".json"))
+        except OSError:
+            return []
+
+
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def install_flight_recorder(rec: Optional[FlightRecorder]
+                            ) -> Optional[FlightRecorder]:
+    """Install the process-wide recorder (None uninstalls); returns the
+    previous one so tests can restore."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        prev = _RECORDER
+        _RECORDER = rec
+    return prev
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    """The process-wide recorder, if any. When none was installed but
+    ``$KFTPU_FLIGHT_DIR`` names a directory, one is auto-created there —
+    the zero-wiring path: export the variable and every engine stop /
+    sanitizer failure in the process leaves a dump."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        if _RECORDER is None:
+            # contract: env knob — operator/deployment-set, not in-repo
+            flight_dir = os.environ.get("KFTPU_FLIGHT_DIR")
+            if flight_dir:
+                _RECORDER = FlightRecorder(flight_dir)
+        return _RECORDER
+
+
+# -- fleet observability registry -------------------------------------------
+
+def fleet_obs_registry(*, collector: Optional[FleetTraceCollector] = None,
+                       history: Optional[MetricsHistory] = None,
+                       monitor: Optional[SloBurnRateMonitor] = None,
+                       recorder: Optional[FlightRecorder] = None
+                       ) -> MetricsRegistry:
+    """Render the fleet plane's own state as ``kftpu_fleet_*`` /
+    ``kftpu_obs_*`` series through the shared exposition path — one
+    definition site per series (built fresh per render, the
+    ``serving_metrics_registry`` pattern)."""
+    reg = MetricsRegistry()
+    spans_total = reg.counter("kftpu_fleet_spans_total")
+    dup_total = reg.counter("kftpu_fleet_spans_duplicate_total")
+    drain_errors = reg.counter("kftpu_fleet_drain_errors_total")
+    stitched = reg.gauge("kftpu_fleet_traces_stitched")
+    skew = reg.gauge("kftpu_fleet_clock_skew_ms")
+    hops_total = reg.counter("kftpu_fleet_hops_total")
+    hop_wire = reg.gauge("kftpu_fleet_hop_wire_ms")
+    hist_points = reg.gauge("kftpu_obs_history_points")
+    scrapes = reg.counter("kftpu_obs_history_scrapes_total")
+    scrape_errors = reg.counter("kftpu_obs_history_scrape_errors_total")
+    burn = reg.gauge("kftpu_obs_slo_burn_rate")
+    alert = reg.gauge("kftpu_obs_slo_alert")
+    dumps = reg.counter("kftpu_obs_flight_dumps_total")
+    srcs = collector.sources() if collector is not None else {}
+    for src, st in srcs.items():
+        spans_total.inc(st["spans"], source=src)
+        skew.set(round(st["offset_s"] * 1e3, 3), source=src)
+    dup_total.inc(collector.stats["duplicates"] if collector is not None
+                  else 0)
+    drain_errors.inc(collector.stats["drain_errors"]
+                     if collector is not None else 0)
+    traces = collector.traces(limit=collector._max_traces) \
+        if collector is not None else []
+    stitched.set(len(traces))
+    wires: dict = {}
+    for t in traces:
+        for h in t["hops"]:
+            wires.setdefault(h["kind"], []).append(h["wire_ms"])
+    for kind, ws in sorted(wires.items()):
+        hops_total.inc(len(ws), kind=kind)
+        hop_wire.set(round(percentile(ws, 95), 3), kind=kind)
+    # Baseline samples (the kftpu_engine_adapters_resident idiom): a
+    # labeled family renders an unlabeled 0 while it has no members, so
+    # every cataloged series exists from the first render — dashboards
+    # and the attribution join never see a hole.
+    if not srcs:
+        spans_total.inc(0)
+        skew.set(0.0)
+    if not wires:
+        hops_total.inc(0)
+        hop_wire.set(0.0)
+    replicas = history.replicas() if history is not None else []
+    for replica in replicas:
+        hist_points.set(history.points_total(replica), replica=replica)
+    if not replicas:
+        hist_points.set(0)
+    scrapes.inc(history.stats["scrapes"] if history is not None else 0)
+    scrape_errors.inc(history.stats["scrape_errors"]
+                      if history is not None else 0)
+    state = monitor.state() if monitor is not None else {}
+    burn_emitted = False
+    for cls, st in sorted(state.items()):
+        for window in ("fast", "slow"):
+            if st[window] is not None:
+                burn.set(round(st[window], 4), window=window,
+                         **{"class": cls})
+                burn_emitted = True
+        alert.set(1 if st["alert"] else 0, **{"class": cls})
+    if not burn_emitted:
+        burn.set(0.0)
+    if not state:
+        alert.set(0)
+    # Always emitted (0 when no recorder is installed): "no dumps yet"
+    # must be distinguishable from "the recorder never rendered".
+    dumps.inc(recorder.dumps_total if recorder is not None else 0)
+    return reg

@@ -469,9 +469,19 @@ def format_trace_tree(spans: list[dict]) -> str:
 
 def format_dump(doc: dict) -> str:
     """Pretty-print a trace dump file: either a ``/debug/traces`` JSON
-    body ({"traces": [...]}) or a Chrome export ({"traceEvents": [...]})."""
+    body ({"traces": [...]}) or a Chrome export ({"traceEvents": [...]}).
+    Flight-recorder dumps (obs/fleet.py) are ``{"traces": [...]}``
+    documents with a ``flight_recorder`` sidecar — they render like any
+    trace dump, prefixed with the snapshot's reason/window header."""
     if "traces" in doc:
         out = []
+        fr = doc.get("flight_recorder")
+        if fr:
+            out.append(
+                f"flight recorder: reason={fr.get('reason')} "
+                f"window={fr.get('window_s')}s "
+                f"history_series={len(fr.get('history') or [])} "
+                f"written_unix={fr.get('written_unix')}")
         for t in doc["traces"]:
             root = t.get("root") or {}
             dur = root.get("duration_ms")

@@ -3163,6 +3163,19 @@ class LLMEngine:
                     timeout)
             else:
                 self._thread = None
+        # Flight recorder (obs/fleet.py): every engine stop — and, more
+        # importantly, every sanitizer-flagged stop — leaves a
+        # post-mortem dump when a recorder is installed (or
+        # $KFTPU_FLIGHT_DIR is exported). Zero work otherwise.
+        try:
+            from kubeflow_tpu.obs.fleet import flight_recorder
+
+            rec = flight_recorder()
+            if rec is not None:
+                rec.snapshot("sanitizer" if rep.get("steady_count")
+                             else "engine_stop")
+        except Exception as exc:   # a dump failure must not fail stop()
+            logger.warning("flight recorder snapshot failed: %s", exc)
         return self.stopped_clean
 
     # -- convenience -----------------------------------------------------------

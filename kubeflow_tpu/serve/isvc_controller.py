@@ -77,6 +77,48 @@ _PROBE_SERIES = (
 )
 
 
+def signals_from_samples(samples) -> dict:
+    """Fold one parsed ``/metrics`` sample set into the probe's signal
+    dict — the ONE autoscaling-signal fold, shared by ``default_probe``
+    (live scrape) and ``obs.fleet.HistoryProbe`` (history-backed), so
+    the two signal sources can never produce different autoscaler
+    decisions from the same exposition. Empty ``samples`` returns the
+    ready-but-blind defaults (the unparseable-exposition shape)."""
+    out = {"ready": True, "in_flight": 0, "requests_total": 0,
+           "ttft_p95_ms": None, "queue_delay_p95_ms": None,
+           "qos_ttft_p95_ms": {}, "qos_queue_delay_p95_ms": {},
+           "kv_tier_pressure": 0.0}
+    for name, labels, value in samples:
+        if name in _PROBE_SERIES:
+            # Contract audit: this scrape CONSUMED the series (no-op
+            # unless KFTPU_SANITIZE=contract).
+            contract_note_series(name, "consumed")
+        if name == "kftpu_serving_in_flight":
+            out["in_flight"] = int(value)
+        elif name == "kftpu_serving_requests_total":
+            out["requests_total"] += int(value)
+        elif name == "kftpu_serving_ttft_p95_ms":
+            out["ttft_p95_ms"] = max(out["ttft_p95_ms"] or 0.0, value)
+        elif name == "kftpu_serving_queue_delay_p95_ms":
+            out["queue_delay_p95_ms"] = max(
+                out["queue_delay_p95_ms"] or 0.0, value)
+        elif name == "kftpu_engine_kv_tier_pressure":
+            # The engine's own demote-urgency ratio (pressure_fn
+            # fold: pool occupancy x queue delay x adapter waits,
+            # now including remote-tier churn) — the split-pool
+            # autoscaler folds it into the decode plan.
+            out["kv_tier_pressure"] = max(
+                out["kv_tier_pressure"], value)
+        elif name in ("kftpu_serving_qos_ttft_p95_ms",
+                      "kftpu_serving_qos_queue_delay_p95_ms"):
+            cls = labels.get("qos")
+            if cls:
+                key = ("qos_ttft_p95_ms" if name.endswith("ttft_p95_ms")
+                       else "qos_queue_delay_p95_ms")
+                out[key][cls] = max(out[key].get(cls, 0.0), value)
+    return out
+
+
 def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
     """GET /healthz + scrape autoscaling signals from /metrics. None = not
     ready. Beyond the concurrency gauges, the probe carries the engine's
@@ -89,45 +131,14 @@ def default_probe(url: str, timeout: float = 0.5) -> Optional[dict]:
         with urllib.request.urlopen(url + "/healthz", timeout=timeout) as r:
             if r.status != 200:
                 return None
-        out = {"ready": True, "in_flight": 0, "requests_total": 0,
-               "ttft_p95_ms": None, "queue_delay_p95_ms": None,
-               "qos_ttft_p95_ms": {}, "qos_queue_delay_p95_ms": {},
-               "kv_tier_pressure": 0.0}
         with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
             text = r.read().decode()
         try:
             samples = parse_exposition(text)
         except ValueError:
-            return out     # unparseable exposition: ready, but blind
-        for name, labels, value in samples:
-            if name in _PROBE_SERIES:
-                # Contract audit: this scrape CONSUMED the series (no-op
-                # unless KFTPU_SANITIZE=contract).
-                contract_note_series(name, "consumed")
-            if name == "kftpu_serving_in_flight":
-                out["in_flight"] = int(value)
-            elif name == "kftpu_serving_requests_total":
-                out["requests_total"] += int(value)
-            elif name == "kftpu_serving_ttft_p95_ms":
-                out["ttft_p95_ms"] = max(out["ttft_p95_ms"] or 0.0, value)
-            elif name == "kftpu_serving_queue_delay_p95_ms":
-                out["queue_delay_p95_ms"] = max(
-                    out["queue_delay_p95_ms"] or 0.0, value)
-            elif name == "kftpu_engine_kv_tier_pressure":
-                # The engine's own demote-urgency ratio (pressure_fn
-                # fold: pool occupancy x queue delay x adapter waits,
-                # now including remote-tier churn) — the split-pool
-                # autoscaler folds it into the decode plan.
-                out["kv_tier_pressure"] = max(
-                    out["kv_tier_pressure"], value)
-            elif name in ("kftpu_serving_qos_ttft_p95_ms",
-                          "kftpu_serving_qos_queue_delay_p95_ms"):
-                cls = labels.get("qos")
-                if cls:
-                    key = ("qos_ttft_p95_ms" if name.endswith("ttft_p95_ms")
-                           else "qos_queue_delay_p95_ms")
-                    out[key][cls] = max(out[key].get(cls, 0.0), value)
-        return out
+            # Unparseable exposition: ready, but blind.
+            return signals_from_samples(())
+        return signals_from_samples(samples)
     except OSError:
         return None
 

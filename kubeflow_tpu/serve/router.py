@@ -47,7 +47,7 @@ import traceback
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 # Header names come from the one module that owns every X-Kftpu-* name
 # (core/headers.py); DEADLINE_HEADER/QOS_HEADER are re-exported here for
@@ -59,6 +59,9 @@ from kubeflow_tpu.core.headers import (
 from kubeflow_tpu.obs.registry import (
     MetricsRegistry, contract_note_header, contract_note_series,
     parse_exposition,
+)
+from kubeflow_tpu.obs.fleet import (
+    ROUTER_SPANS_EXPORT_PATH, spans_export_payload,
 )
 from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
 from kubeflow_tpu.serve.retry import PROBE_POLICY, call_with_retry
@@ -141,9 +144,11 @@ def quiet_handle_error(httpd) -> None:
 
     httpd.handle_error = handle_error
 
-#: Local (non-proxied) router endpoints.
+#: Local (non-proxied) router endpoints. The spans-export path is owned
+#: by obs/fleet.py (the collector registers it as a drain source).
 ROUTER_METRICS_PATH = "/-/router/metrics"
 ROUTER_TRACES_PATH = "/-/router/debug/traces"
+ROUTER_SPANS_PATH = ROUTER_SPANS_EXPORT_PATH
 
 
 class Router:
@@ -199,6 +204,10 @@ class Router:
         # Kept separate from the request-failure counter so a healthy
         # scrape can never launder real traffic failures.
         self._scrape_fails: dict[str, int] = {}    # guarded_by: _lock
+        # Optional history-backed signal source (obs/fleet.py): maps a
+        # backend url to its newest exposition text, replacing the HTTP
+        # fetch when set. lockfree: assigned once at wiring time.
+        self._metrics_source: Optional[Callable[[str], Optional[str]]] = None
         self.scrape_interval = 0.25
         self._scrape_stop = threading.Event()
         self._scrape_thread: Optional[threading.Thread] = None
@@ -264,6 +273,16 @@ class Router:
         with self._lock:
             self._signals[url] = dict(signals)
 
+    def set_metrics_source(self, source: Optional[
+            Callable[[str], Optional[str]]]) -> None:
+        """Install a history-backed signal source: ``source(url)``
+        returns the backend's newest ``/metrics`` exposition text (e.g.
+        ``MetricsHistory.latest_text``) or None to fall back to a live
+        HTTP fetch. The scrape loop's PARSE and placement fold are
+        unchanged — only where the bytes come from moves, so routing
+        decisions on steady traffic are identical either way."""
+        self._metrics_source = source
+
     def start_signal_scrape(self) -> None:
         if self._scrape_thread is not None and \
                 self._scrape_thread.is_alive():
@@ -285,6 +304,16 @@ class Router:
         with self._lock:
             urls = [u for urls in self._pools.values() for u in urls]
         for url in dict.fromkeys(urls):
+            if self._metrics_source is not None:
+                text = self._metrics_source(url)
+                if text is not None:
+                    sig = self._parse_signals(text)
+                    if sig is not None:
+                        self.note_signals(url, sig)
+                    continue
+                # History has nothing for this backend (yet): fall
+                # through to the live fetch below.
+
             def _fetch(_attempt, url=url):
                 with urllib.request.urlopen(url + "/metrics",
                                             timeout=1.0) as r:
@@ -659,6 +688,12 @@ def _make_handler(router: Router):
             if self.path.split("?", 1)[0] == ROUTER_TRACES_PATH:
                 return self._send(
                     200, json.dumps(debug_traces_payload(self.path),
+                                    default=str).encode())
+            if self.path.split("?", 1)[0] == ROUTER_SPANS_PATH:
+                # Fleet-trace drain (obs/fleet.py) — observability, not
+                # traffic: must not feed the KPA activity clock either.
+                return self._send(
+                    200, json.dumps(spans_export_payload(process="router"),
                                     default=str).encode())
             router.note_activity()
             try:

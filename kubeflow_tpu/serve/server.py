@@ -43,6 +43,7 @@ from kubeflow_tpu.core.headers import (
     HANDOFF_DTYPE_HEADER, HANDOFF_WIRE_HEADER, MODEL_HEADER, QOS_HEADER,
     TRACE_HEADER,
 )
+from kubeflow_tpu.obs.fleet import spans_export_payload
 from kubeflow_tpu.obs.registry import MetricsRegistry, contract_note_header
 from kubeflow_tpu.obs.trace import debug_traces_payload, get_tracer
 from kubeflow_tpu.core.serving import QOS_DEFAULT
@@ -446,6 +447,12 @@ class ModelServer:
                     trace_fn=lambda: tracer.inject(sp),
                     deadline_s=timeout, timeout=timeout + 5.0)
                 sp.set_attrs(backend=used_url)
+                if used_url != decode_url:
+                    # The placed decode replica died between pick and
+                    # handoff; the fleet stitcher reads this event to
+                    # attribute the hop as a failover, not a clean
+                    # handoff.
+                    sp.add_event("connect_failure", backend=decode_url)
             except OSError as exc:
                 sp.set_attrs(error=str(exc), fallback="recompute")
                 engine.metrics.note_handoff("fallback")
@@ -769,6 +776,11 @@ def _make_handler(server: ModelServer):
                 return
             if self.path.startswith("/debug/traces"):
                 return self._json(200, debug_traces_payload(self.path))
+            if self.path.startswith("/debug/spans/export"):
+                # Fleet-trace drain (obs/fleet.py): completed spans +
+                # this process's clock, for cross-host stitching.
+                return self._json(200, spans_export_payload(
+                    process=f"server:{server.name}"))
             if self.path == "/v1/models":
                 self._json(200, {"models": server.model_names()})
                 return
@@ -1098,6 +1110,12 @@ def _make_handler(server: ModelServer):
                         trace_fn=lambda: tracer.inject(sp),
                         deadline_s=timeout, timeout=timeout + 5.0)
                     sp.set_attrs(backend=used_url)
+                    if used_url != decode_url:
+                        # Placed decode replica died between pick and
+                        # handoff — mark the span so the fleet stitcher
+                        # attributes this hop as a failover.
+                        sp.add_event("connect_failure",
+                                     backend=decode_url)
                 except OSError as exc:
                     # Every replica exhausted, never acked: recompute
                     # locally (failure = recompute, never a drop).

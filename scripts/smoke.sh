@@ -202,6 +202,22 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 fleet_kv_rc=${PIPESTATUS[0]}
 grep -q '"fleet_kv_smoke": "ok"' /tmp/_smoke_fleet_kv.json || fleet_kv_rc=1
 
+echo "== fleet trace smoke (cross-host stitching + burn-rate + recorder) =="
+# Fleet-observability gate (ISSUE 18): a 1-prefill + 2-decode fleet behind
+# the router with one decode replica SIGKILLed mid-scenario must stitch
+# into ONE causal trace spanning router→prefill→handoff→decode INCLUDING
+# the failover hop, with per-hop wire attribution and skew-corrected
+# monotone orderings; the metrics history must accumulate real /metrics
+# points; a seeded SLO breach must raise the burn-rate alert while a
+# clean run must not; all fleet/obs series must parse off the registry
+# render; engine stop must leave a re-loadable flight-recorder dump; and
+# the fleet hops must join into the loadgen report (zero leaked pages,
+# zero open spans).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/fleet_trace_smoke.py | tee /tmp/_smoke_fleet_trace.json
+fleet_trace_rc=${PIPESTATUS[0]}
+grep -q '"fleet_trace_smoke": "ok"' /tmp/_smoke_fleet_trace.json || fleet_trace_rc=1
+
 echo "== contract smoke (static name-contract table vs a real serve run) =="
 # Cross-component contract gate (ISSUE 10): the kftpu lint --contracts-json
 # manifest must round-trip, and a serve run under KFTPU_SANITIZE=contract

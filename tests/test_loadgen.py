@@ -559,15 +559,23 @@ class TestEndToEnd:
 
     def test_attribution_series_all_produced(self, scenario_engine):
         """The loadgen's scrape set must exist in a REAL rendered
-        serving exposition — the producer half of the contract the X7xx
-        lint checks statically (a renamed engine series fails here even
-        if the AST extraction drifts)."""
+        exposition — the producer half of the contract the X7xx lint
+        checks statically (a renamed engine series fails here even if
+        the AST extraction drifts). Two producers: the model server's
+        registry (engine/serving series) and the fleet observability
+        registry (kftpu_fleet_*/kftpu_obs_* — obs/fleet.py)."""
         engine, cfg = scenario_engine
+        from kubeflow_tpu.obs.fleet import (
+            FleetTraceCollector, MetricsHistory, fleet_obs_registry,
+        )
         from kubeflow_tpu.obs.registry import parse_exposition
         from kubeflow_tpu.serve.server import serving_metrics_registry
 
         text = serving_metrics_registry([("pin", engine)]).render()
         names = {n for n, _, _ in parse_exposition(text)}
+        fleet = fleet_obs_registry(collector=FleetTraceCollector(),
+                                   history=MetricsHistory()).render()
+        names |= {n for n, _, _ in parse_exposition(fleet)}
         missing = [s for s in ATTRIBUTION_SERIES if s not in names]
         assert not missing, f"attribution series not rendered: {missing}"
 

@@ -1,0 +1,473 @@
+"""Fleet observability plane (obs/fleet.py): cross-host trace stitching
+edge cases (skewed clocks, missing middle hop, duplicate delivery,
+orphan children), hop-kind classification, metrics history window
+queries + retention, the HistoryProbe ≡ default_probe equivalence the
+autoscaler seam guarantees, multi-window burn-rate alerting, and the
+flight-recorder round trip through ``kftpu trace``'s loader."""
+
+import http.server
+import json
+import math
+import threading
+
+import pytest
+
+from kubeflow_tpu.obs import fleet
+from kubeflow_tpu.obs.fleet import (
+    FleetTraceCollector, FlightRecorder, HistoryProbe, MetricsHistory,
+    SloBurnRateMonitor, spans_export_payload,
+)
+from kubeflow_tpu.obs.trace import Tracer, format_dump
+
+T0 = 1_700_000_000.0
+
+
+def mk_span(sid, parent, name, start, end, trace_id="T1", attrs=None,
+            events=None):
+    return {"trace_id": trace_id, "span_id": sid, "parent_id": parent,
+            "name": name, "start": start, "end": end,
+            "duration_ms": round((end - start) * 1e3, 3), "status": "ok",
+            "attrs": attrs or {}, "events": events or []}
+
+
+def router_payload(now=T0, events=None):
+    return {"process": {"name": "router", "pid": 1}, "now": now,
+            "spans": [mk_span("r1", None, "router.request", T0, T0 + 1.0,
+                              attrs={"path": "/v1/completions",
+                                     "backend": "b1", "code": 200},
+                              events=events)]}
+
+
+def server_payload(name, skew=0.0, now=None):
+    """One replica's export: server.request + nested engine phases,
+    every timestamp shifted by that replica's clock skew."""
+    spans = [mk_span("s1", "r1", "server.request", T0 + 0.1, T0 + 0.9,
+                     attrs={"path": "/v1/completions", "server": name}),
+             mk_span("e1", "s1", "engine.prefill", T0 + 0.2, T0 + 0.4),
+             mk_span("e2", "s1", "engine.decode", T0 + 0.4, T0 + 0.8)]
+    for s in spans:
+        s["start"] += skew
+        s["end"] += skew
+    return {"process": {"name": name, "pid": 2},
+            "now": (T0 + skew) if now is None else now, "spans": spans}
+
+
+# -- stitching edge cases -----------------------------------------------------
+
+@pytest.mark.parametrize("skew", [5.0, -5.0])
+def test_skewed_clock_corrected_to_monotone_hops(skew):
+    c = FleetTraceCollector()
+    c.ingest(router_payload(), source="router", offset_s=0.0)
+    c.ingest(server_payload("srv-a", skew=skew), source="server:srv-a",
+             offset_s=skew)
+    tr = c.trace("T1")
+    assert len(tr["spans"]) == 4
+    assert tr["sources"] == ["router", "server:srv-a"]
+    # Corrected timeline: the server span sits back inside its parent.
+    by_id = {s["span_id"]: s for s in tr["spans"]}
+    assert by_id["s1"]["start"] == pytest.approx(T0 + 0.1)
+    assert by_id["s1"]["clock_offset_ms"] == pytest.approx(skew * 1e3)
+    hops = tr["hops"]
+    assert [h["kind"] for h in hops] == ["route"]
+    assert all(h["monotone"] for h in hops)
+    assert hops[0]["wire_out_ms"] == pytest.approx(100.0, abs=1.0)
+    assert hops[0]["wire_back_ms"] == pytest.approx(100.0, abs=1.0)
+
+
+def test_uncorrected_skew_flags_non_monotone():
+    c = FleetTraceCollector()
+    c.ingest(router_payload(), source="router")
+    # Skewed clock, NO offset: the stitcher must not pretend causality.
+    c.ingest(server_payload("srv-a", skew=-5.0), source="server:srv-a")
+    hops = c.hops("T1")
+    assert hops and not hops[0]["monotone"]
+    # Clamped attribution: noise never reads as negative latency.
+    assert hops[0]["wire_out_ms"] == 0.0
+
+
+def test_missing_middle_hop_renders_orphans_top_level():
+    """Prefill replica SIGKILLed before export: its server.request span
+    never arrives, but the engine spans relayed elsewhere still stitch —
+    as top-level orphans, not silently dropped."""
+    c = FleetTraceCollector()
+    c.ingest(router_payload(), source="router")
+    payload = server_payload("srv-a")
+    payload["spans"] = [s for s in payload["spans"]
+                        if s["span_id"] != "s1"]     # the dead middle
+    c.ingest(payload, source="server:srv-a")
+    tr = c.trace("T1")
+    assert {s["span_id"] for s in tr["spans"]} == {"r1", "e1", "e2"}
+    tree = c.format_tree("T1")
+    # Orphans print at top level (their parent id resolves to nothing).
+    assert tree.splitlines()[0].startswith("router.request")
+    assert any(line.startswith("engine.prefill") for line in
+               tree.splitlines())
+    # No cross-process edge can be attributed through the hole.
+    assert c.hops("T1") == []
+
+
+def test_duplicate_delivery_stitches_exactly_once():
+    c = FleetTraceCollector()
+    n1 = c.ingest(router_payload(), source="router")
+    n2 = c.ingest(router_payload(), source="router")       # re-drain
+    n3 = c.ingest(router_payload(), source="router-2")     # other source
+    assert (n1, n2, n3) == (1, 0, 0)
+    assert len(c.trace("T1")["spans"]) == 1
+    assert c.stats["duplicates"] == 2
+    assert c.sources()["router-2"]["duplicates"] == 1
+
+
+def test_orphan_child_waits_without_breaking_the_tree():
+    c = FleetTraceCollector()
+    c.ingest({"process": {"name": "server:srv-a", "pid": 2}, "now": T0,
+              "spans": [mk_span("e9", "nope", "engine.decode",
+                                T0, T0 + 0.5)]}, source="server:srv-a")
+    tr = c.trace("T1")
+    assert tr["root"] is None
+    assert tr["hops"] == []
+    assert c.format_tree("T1").startswith("engine.decode")
+
+
+def test_hop_kinds_handoff_and_failover():
+    c = FleetTraceCollector()
+    # Router saw a connect failure (the SIGKILL path) before rerouting.
+    c.ingest(router_payload(events=[{"name": "connect_failure",
+                                     "t": T0 + 0.05, "attrs": {}}]),
+             source="router")
+    c.ingest(server_payload("srv-b"), source="server:srv-b")
+    # Prefill's handoff span parents the decode replica's adoption work.
+    c.ingest({"process": {"name": "server:srv-b", "pid": 2}, "now": T0,
+              "spans": [mk_span("h1", "s1", "engine.handoff",
+                                T0 + 0.45, T0 + 0.6,
+                                attrs={"backend": "http://d:1",
+                                       "request": "req-1"})]},
+             source="server:srv-b")
+    c.ingest({"process": {"name": "server:srv-c", "pid": 3}, "now": T0,
+              "spans": [mk_span("a1", "h1", "server.request",
+                                T0 + 0.48, T0 + 0.58,
+                                attrs={"path": "/v1/kv/adopt",
+                                       "server": "srv-c"})]},
+             source="server:srv-c")
+    kinds = {h["kind"]: h for h in c.hops("T1")}
+    assert set(kinds) == {"failover", "handoff"}
+    assert kinds["failover"]["to"] == "server:srv-b"
+    assert kinds["handoff"]["from"] == "server:srv-b"
+    assert kinds["handoff"]["to"] == "server:srv-c"
+    assert all(h["monotone"] for h in kinds.values())
+
+
+def test_handoff_retry_classifies_as_failover():
+    """A handoff whose placed decode replica died lands on the retry
+    alternate — the stitcher must call that hop a failover."""
+    c = FleetTraceCollector()
+    c.ingest({"process": {"name": "server:pre", "pid": 2}, "now": T0,
+              "spans": [
+                  mk_span("s1", None, "server.request", T0, T0 + 1.0,
+                          attrs={"server": "pre"}),
+                  mk_span("h1", "s1", "engine.handoff", T0 + 0.4, T0 + 0.9,
+                          attrs={"backend": "http://dec2:1"},
+                          events=[{"name": "connect_failure",
+                                   "t": T0 + 0.45,
+                                   "backend": "http://dec1:1"}])]},
+             source="server:pre")
+    c.ingest({"process": {"name": "server:dec2", "pid": 3}, "now": T0,
+              "spans": [mk_span("a1", "h1", "server.request",
+                                T0 + 0.5, T0 + 0.85,
+                                attrs={"server": "dec2"})]},
+             source="server:dec2")
+    hops = c.hops("T1")
+    assert [h["kind"] for h in hops] == ["failover"]
+    assert hops[0]["from"] == "server:pre"
+    assert hops[0]["to"] == "server:dec2"
+
+
+def test_drain_estimates_offset_and_survives_dead_source():
+    payloads = {"http://a/export": server_payload("srv-a", skew=5.0,
+                                                  now=None)}
+
+    def fetch(url):
+        if url not in payloads:
+            raise OSError("connection refused")
+        p = dict(payloads[url])
+        p["now"] = __import__("time").time() + 5.0   # clock runs 5s fast
+        return p
+
+    c = FleetTraceCollector(fetch=fetch)
+    c.add_source("server:srv-a", "http://a/export")
+    c.add_source("server:dead", "http://dead/export")
+    assert c.drain() == 3
+    assert c.stats["drain_errors"] == 1
+    assert c.sources()["server:dead"]["errors"] == 1
+    assert c.sources()["server:srv-a"]["offset_s"] == pytest.approx(
+        5.0, abs=0.5)
+
+
+def test_spans_export_payload_completed_only():
+    t = Tracer()
+    with t.span("router.request", path="/x"):
+        pass
+    with t.span("open-span"):
+        payload = spans_export_payload(t, process="router")
+        names = [s["name"] for s in payload["spans"]]
+        assert "open-span" not in names        # still being written
+    assert payload["process"]["name"] == "router"
+    assert isinstance(payload["now"], float)
+    assert t.open_spans() == 0
+
+
+def test_chrome_export_one_lane_per_process():
+    c = FleetTraceCollector()
+    c.ingest(router_payload(), source="router")
+    c.ingest(server_payload("srv-a"), source="server:srv-a")
+    doc = c.export_chrome("T1")
+    meta = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+            if e["ph"] == "M"}
+    assert set(meta) == {"router", "server:srv-a"}
+    lanes = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert lanes == set(meta.values())
+
+
+# -- metrics history ----------------------------------------------------------
+
+def test_history_window_queries_and_retention():
+    h = MetricsHistory(retention_s=30.0, max_points=8)
+    for i in range(12):
+        h.record("r0", [("kftpu_serving_requests_total", {}, 10.0 * i),
+                        ("kftpu_serving_ttft_p95_ms", {}, 5.0 + i)],
+                 now=T0 + i)
+    assert h.latest("r0", "kftpu_serving_ttft_p95_ms") == 16.0
+    # Window is inclusive at the horizon: [T0+7, T0+11] -> values 12..16.
+    assert h.window_mean("r0", "kftpu_serving_ttft_p95_ms", 4.0,
+                         now=T0 + 11) == pytest.approx(14.0)
+    # Counter delta/rate over the covered window.
+    assert h.delta("r0", "kftpu_serving_requests_total", 5.0,
+                   now=T0 + 11) == pytest.approx(50.0)
+    assert h.rate("r0", "kftpu_serving_requests_total", 5.0,
+                  now=T0 + 11) == pytest.approx(10.0)
+    # max_points bounds the ring; replicas() sees record()-fed feeds.
+    assert h.points_total("r0") == 16
+    assert h.replicas() == ["r0"]
+    # Beyond retention: answers from what the ring holds, never invents.
+    assert h.window_mean("r0", "kftpu_serving_ttft_p95_ms", 1e6,
+                         now=T0 + 11) is not None
+    assert h.latest("r0", "kftpu_nope") is None
+
+
+def test_history_percentile_over_window_from_buckets():
+    h = MetricsHistory()
+    name = "kftpu_serving_ttft_ms"
+    h.record("r0", [(name + "_bucket", {"le": "10"}, 0.0),
+                    (name + "_bucket", {"le": "50"}, 0.0),
+                    (name + "_bucket", {"le": "+Inf"}, 0.0)], now=T0)
+    h.record("r0", [(name + "_bucket", {"le": "10"}, 5.0),
+                    (name + "_bucket", {"le": "50"}, 9.0),
+                    (name + "_bucket", {"le": "+Inf"}, 10.0)], now=T0 + 10)
+    p50 = h.percentile_over_window("r0", name, 50.0, 60.0, now=T0 + 10)
+    assert p50 == pytest.approx(10.0, abs=0.01)
+    p95 = h.percentile_over_window("r0", name, 95.0, 60.0, now=T0 + 10)
+    assert 10.0 < p95 <= 50.0
+    # The overflow bucket caps interpolation at the last finite edge.
+    p100 = h.percentile_over_window("r0", name, 100.0, 60.0, now=T0 + 10)
+    assert p100 == pytest.approx(50.0)
+    assert h.percentile_over_window("r0", "kftpu_nope", 95.0, 60.0,
+                                    now=T0 + 10) is None
+
+
+def test_history_scrape_via_fetch_injection():
+    text = ("kftpu_serving_requests_total 7\n"
+            "kftpu_serving_ttft_p95_ms 12.5\n")
+    h = MetricsHistory(fetch=lambda url: text)
+    h.add_target("r0", "http://r0/metrics")
+    assert h.scrape_once() == 1
+    assert h.latest_text("r0") == text
+    assert h.latest("r0", "kftpu_serving_requests_total") == 7.0
+    assert h.stats["scrapes"] == 1
+
+    def boom(url):
+        raise OSError("down")
+
+    h2 = MetricsHistory(fetch=boom)
+    h2.add_target("r0", "http://r0/metrics")
+    assert h2.scrape_once() == 0
+    assert h2.stats["scrape_errors"] == 1
+
+
+# -- the autoscaler seam: HistoryProbe ≡ default_probe ------------------------
+
+class _Exposition(http.server.BaseHTTPRequestHandler):
+    METRICS = ("kftpu_serving_requests_total 42\n"
+               "kftpu_serving_requests_in_flight 3\n"
+               "kftpu_serving_ttft_p95_ms 12.5\n"
+               "kftpu_serving_queue_delay_p95_ms 4.0\n"
+               'kftpu_serving_qos_ttft_p95_ms{qos="interactive"} 9.5\n')
+
+    def do_GET(self):
+        body = (self.METRICS if self.path == "/metrics" else "ok").encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_history_probe_matches_default_probe():
+    """The ISSUE's drop-in guarantee: on steady traffic the autoscaler
+    sees byte-identical signals from the history substrate as from a
+    live scrape — decisions (a pure fold of the signals) can't differ."""
+    from kubeflow_tpu.serve.isvc_controller import default_probe
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _Exposition)
+    thr = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thr.start()
+    try:
+        url = "http://127.0.0.1:%d" % httpd.server_address[1]
+        live = default_probe(url)
+        hist = HistoryProbe(MetricsHistory())(url)
+        assert live is not None
+        assert hist == live
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thr.join(timeout=5.0)
+    # And a dead replica is a dead replica, whatever the ring remembers.
+    h = MetricsHistory()
+    h.record(url, [("kftpu_serving_requests_total", {}, 42.0)])
+    assert HistoryProbe(h, timeout=0.2)(url) is None
+
+
+# -- burn-rate monitor --------------------------------------------------------
+
+def _seeded_history(values, *, qos=None, series="kftpu_serving_ttft_p95_ms"):
+    h = MetricsHistory()
+    labels = {"qos": qos} if qos else {}
+    name = ("kftpu_serving_qos_ttft_p95_ms" if qos else series)
+    for i, v in enumerate(values):
+        h.record("r0", [(name, labels, float(v))], now=T0 + i)
+    return h
+
+
+def test_burn_rate_requires_both_windows():
+    targets = {"interactive": {"ttft_p95_ms": 10.0}}
+    # Sustained breach: both windows burn -> alert.
+    hot = SloBurnRateMonitor(_seeded_history([30.0] * 20), targets,
+                             fast_window_s=5.0, slow_window_s=20.0)
+    st = hot.evaluate(now=T0 + 19)
+    assert st["interactive"]["alert"] and hot.alerting() == ["interactive"]
+    assert st["interactive"]["fast"] == pytest.approx(3.0)
+    # One bad minute in a healthy day: fast burns, slow doesn't -> page
+    # suppressed (the multi-window discipline).
+    spike = SloBurnRateMonitor(
+        _seeded_history([1.0] * 15 + [30.0] * 5), targets,
+        fast_window_s=5.0, slow_window_s=1000.0)
+    st = spike.evaluate(now=T0 + 19)
+    assert st["interactive"]["fast"] > 1.0 > st["interactive"]["slow"]
+    assert not st["interactive"]["alert"]
+    # Clean run stays silent.
+    clean = SloBurnRateMonitor(_seeded_history([2.0] * 20), targets,
+                               fast_window_s=5.0, slow_window_s=20.0)
+    assert not clean.evaluate(now=T0 + 19)["interactive"]["alert"]
+
+
+def test_burn_rate_prefers_per_class_series():
+    targets = {"interactive": {"ttft_p95_ms": 10.0}}
+    h = _seeded_history([30.0] * 10, qos="interactive")
+    # Aggregate says healthy; the interactive class is burning.
+    for i in range(10):
+        h.record("r0", [("kftpu_serving_ttft_p95_ms", {}, 1.0)], now=T0 + i)
+    mon = SloBurnRateMonitor(h, targets, fast_window_s=5.0,
+                             slow_window_s=10.0)
+    assert mon.evaluate(now=T0 + 9)["interactive"]["alert"]
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_flight_recorder_roundtrip_and_prune(tmp_path):
+    import time as _time
+
+    now = _time.time()
+    c = FleetTraceCollector()
+    c.ingest(router_payload(), source="router")
+    h = MetricsHistory()
+    h.record("r0", [("kftpu_serving_ttft_p95_ms", {}, 12.0)], now=now - 1)
+    h.record("r0", [("kftpu_serving_ttft_p95_ms", {}, 14.0)], now=now)
+    mon = SloBurnRateMonitor(h, {"interactive": {"ttft_p95_ms": 1.0}},
+                             fast_window_s=30.0, slow_window_s=60.0)
+    mon.evaluate()
+    rec = FlightRecorder(str(tmp_path), window_s=60.0, keep=2,
+                         history=h, collector=c, monitor=mon)
+    paths = [rec.snapshot("unit") for _ in range(3)]
+    assert all(paths)
+    assert len(rec.dumps()) == 2                  # pruned to keep=
+    doc = json.loads((tmp_path / rec.dumps()[-1].rsplit("/", 1)[-1])
+                     .read_text())
+    fr = doc["flight_recorder"]
+    assert fr["reason"] == "unit"
+    assert fr["slo"]["interactive"]["alert"]
+    hist = {(s["replica"], s["name"]) for s in fr["history"]}
+    assert ("r0", "kftpu_serving_ttft_p95_ms") in hist
+    assert len([s for s in fr["history"]
+                if s["name"] == "kftpu_serving_ttft_p95_ms"][0]
+               ["points"]) == 2
+    # The dump is a {"traces": ...} doc: kftpu trace re-loads it.
+    rendered = format_dump(doc)
+    assert rendered.startswith("flight recorder: reason=unit")
+    assert "router.request" in rendered
+
+
+def test_install_flight_recorder_module_seam(tmp_path):
+    rec = FlightRecorder(str(tmp_path))
+    prev = fleet.install_flight_recorder(rec)
+    try:
+        assert fleet.flight_recorder() is rec
+    finally:
+        fleet.install_flight_recorder(prev)
+
+
+# -- the metrics contract -----------------------------------------------------
+
+#: Consumption side of every series ``fleet_obs_registry`` produces —
+#: the same two-sided X7xx idiom as ``_PROBE_SERIES``.
+FLEET_OBS_SERIES = (
+    "kftpu_fleet_spans_total",
+    "kftpu_fleet_spans_duplicate_total",
+    "kftpu_fleet_drain_errors_total",
+    "kftpu_fleet_traces_stitched",
+    "kftpu_fleet_clock_skew_ms",
+    "kftpu_fleet_hops_total",
+    "kftpu_fleet_hop_wire_ms",
+    "kftpu_obs_history_points",
+    "kftpu_obs_history_scrapes_total",
+    "kftpu_obs_history_scrape_errors_total",
+    "kftpu_obs_slo_burn_rate",
+    "kftpu_obs_slo_alert",
+    "kftpu_obs_flight_dumps_total",
+)
+
+
+def test_fleet_obs_registry_covers_the_catalog():
+    c = FleetTraceCollector()
+    c.ingest(router_payload(), source="router")
+    c.ingest(server_payload("srv-a", skew=2.0), source="server:srv-a",
+             offset_s=2.0)
+    h = MetricsHistory(fetch=lambda url: (
+        "kftpu_serving_requests_total 1\n"
+        "kftpu_serving_ttft_p95_ms 25.0\n"))
+    h.add_target("r0", "http://r0/metrics")
+    h.scrape_once()
+    mon = SloBurnRateMonitor(h, {"interactive": {"ttft_p95_ms": 10.0}})
+    mon.evaluate()
+    reg = fleet.fleet_obs_registry(collector=c, history=h, monitor=mon)
+    text = reg.render()
+    from kubeflow_tpu.obs.registry import parse_exposition
+
+    produced = {name for name, _, _ in parse_exposition(text)}
+    for name in FLEET_OBS_SERIES:
+        assert name in produced, f"{name} missing from the fleet registry"
+    by_key = {(n, tuple(sorted(l.items()))): v
+              for n, l, v in parse_exposition(text)}
+    assert by_key[("kftpu_fleet_traces_stitched", ())] == 1.0
+    assert by_key[("kftpu_fleet_clock_skew_ms",
+                   (("source", "server:srv-a"),))] == pytest.approx(2000.0)
+    assert by_key[("kftpu_fleet_hops_total", (("kind", "route"),))] == 1.0

@@ -450,15 +450,23 @@ def test_prefix_affinity_falls_through_on_unhealth(router, backends):
     from kubeflow_tpu.serve.router import _rendezvous
 
     a, b = backends
-    router.set_pools({"prefill": [a.url], "decode": [a.url, b.url]},
-                     scrape=False)
-    home = max([a.url, b.url], key=lambda u: _rendezvous("sess", u))
-    other = b.url if home == a.url else a.url
-    for _ in range(router.eject_threshold):
-        router.note_backend_failure(home, connect=True)
-    _, decode = router.pick_disaggregated(affinity="sess")
-    assert decode == other, "ejected home replica still picked"
-    assert router.snapshot()["affinity_misses"] >= 1
+    # Dedicated prefill member: if the decode home doubled as the only
+    # prefill replica, ejecting it would collapse the pick into the
+    # unified fallback (decode=None by contract) whenever the
+    # port-dependent rendezvous hash happened to land home there.
+    pre = EchoBackend("pre")
+    try:
+        router.set_pools({"prefill": [pre.url],
+                          "decode": [a.url, b.url]}, scrape=False)
+        home = max([a.url, b.url], key=lambda u: _rendezvous("sess", u))
+        other = b.url if home == a.url else a.url
+        for _ in range(router.eject_threshold):
+            router.note_backend_failure(home, connect=True)
+        _, decode = router.pick_disaggregated(affinity="sess")
+        assert decode == other, "ejected home replica still picked"
+        assert router.snapshot()["affinity_misses"] >= 1
+    finally:
+        pre.stop()
 
 
 def test_decode_alternates_are_healthy_non_primary(router, backends):
