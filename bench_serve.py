@@ -91,7 +91,7 @@ def _drive(engine, prompts, params, concurrency):
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        t.join(timeout=600.0)   # generous: clients exit once their requests drain
     return time.perf_counter() - t_start, results
 
 

@@ -12,8 +12,12 @@ call graph + resource-pairing primitives, ``--changed``),
 ``rules_contracts.py`` for the cross-component name-contract family
 (X7xx: metric series produced vs consumed, ``X-Kftpu-*`` headers set vs
 read, ``KFTPU_*`` env vars, status fields — ``--contracts-json`` dumps
-the extracted table). The runtime cross-checks (``KFTPU_SANITIZE=
-refcount|lockorder|recompile|contract``) live in
+the extracted table), and ``rules_liveness.py`` for the
+distributed-liveness family (T8xx: unbounded blocking calls, ad-hoc
+retry loops, leaked/unreapable threads, deadline-propagation drift —
+``# blocking-ok: <reason>`` closes deliberate waits). The runtime
+cross-checks (``KFTPU_SANITIZE=
+refcount|lockorder|recompile|contract|threads``) live in
 ``kubeflow_tpu/runtime/sanitize.py``.
 """
 

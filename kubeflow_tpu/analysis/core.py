@@ -44,6 +44,11 @@ directly above):
   nothing in the tree sets, a value exported for code outside the lint
   scan — and the X7xx cross-component contract rules accept it with the
   stated reason on record.
+- ``# blocking-ok: <reason>`` — on a blocking call site (or the line
+  above): this call is DELIBERATELY unbounded — a fault injector's
+  wedge, a final reap after terminate, a durability wait whose caller
+  owns the deadline — and the T8xx liveness rules accept it with the
+  stated reason on record.
 - ``# lint: disable=D101[,C301...]`` — suppress specific rules on this
   line.
 
@@ -128,6 +133,7 @@ _ANNOT_RES = {
     "mesh_context": re.compile(r"#\s*mesh-context:\s*(\S.*)"),
     "retrace_ok": re.compile(r"#\s*retrace-ok:\s*(\S.*)"),
     "contract": re.compile(r"#\s*contract:\s*(\S.*)"),
+    "blocking_ok": re.compile(r"#\s*blocking-ok:\s*(\S.*)"),
 }
 _DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)")
 
@@ -196,7 +202,16 @@ class Module:
         for node in self._nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
-                    aliases[a.asname or a.name.split(".")[0]] = a.name
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        # ``import urllib.request`` binds the TOP package
+                        # name only; the attribute chain already spells
+                        # the rest (mapping urllib -> urllib.request
+                        # would double the segment:
+                        # urllib.request.request.urlopen).
+                        top = a.name.split(".")[0]
+                        aliases.setdefault(top, top)
             elif isinstance(node, ast.ImportFrom) and node.module:
                 for a in node.names:
                     aliases[a.asname or a.name] = f"{node.module}.{a.name}"
@@ -802,7 +817,7 @@ def _load_rules() -> None:
     _loaded = True
     from kubeflow_tpu.analysis import (  # noqa: F401  (registration import)
         rules_compile, rules_concurrency, rules_contracts, rules_device,
-        rules_metrics, rules_resources, rules_sharding,
+        rules_liveness, rules_metrics, rules_resources, rules_sharding,
     )
 
 
@@ -1098,7 +1113,7 @@ def changed_files(base: str = "HEAD",
 
     def git(*args: str) -> list[str]:
         proc = subprocess.run(["git", *args], cwd=root,
-                              capture_output=True, text=True)
+                              capture_output=True, text=True, timeout=60)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"git {' '.join(args)} failed: {proc.stderr.strip()}")

@@ -1,0 +1,646 @@
+"""Family T — distributed-liveness rules (ISSUE 20 tentpole).
+
+PRs 16-18 made the platform genuinely distributed — cross-host KV
+handoff with hand-tuned connect/ack budgets, a background ``kv-migrate``
+thread, scrape threads, dispatcher threads — and the recurring chaos bug
+class is always the same: an unbounded blocking call or an orphaned
+background thread wedges a replica. These rules enforce statically the
+liveness discipline PR 17 applied by hand to exactly one path; the
+runtime half is ``KFTPU_SANITIZE=threads`` (runtime/sanitize.py), which
+stamps every thread with its creation site and asserts quiescence at
+engine/server/router stop.
+
+- T801 ``unbounded-blocking-call``: socket/HTTP (``urlopen``,
+  ``http.client``, ``socket.create_connection``), ``Queue.get``,
+  ``Condition``/``Event``/``Popen.wait``, ``subprocess.*`` and
+  ``Thread.join`` in production code with no timeout/deadline argument.
+  Wrapper-aware one level: a call into a local/imported def that takes a
+  ``timeout``/``deadline`` parameter defaulting to None and threads it
+  into a blocking call must pass that argument.
+  ``# blocking-ok: <reason>`` closes a deliberate site.
+- T802 ``ad-hoc-retry-loop``: a loop whose body sleeps
+  (``time.sleep``) and swallows-and-retries an exception around a call,
+  without going through ``serve/retry.py::call_with_retry`` — the
+  blessed helper with jittered backoff and a bounded attempt budget.
+- T803 ``leaked-thread``: a ``threading.Thread`` stored on ``self`` in
+  a class whose stop/close/shutdown surface never joins it (plus the
+  function-local variant via the shared ``core.leaky_allocs`` pairing
+  primitive — a non-daemon local thread that no path joins).
+- T804 ``thread-lifecycle``: (a) a non-daemon background thread created
+  in a class with no stop/close/shutdown surface at all — nothing can
+  ever reap it; (b) an UNBOUNDED (T801-class) blocking call made while
+  a lock is held — tightening C302 with the timeout fact for the
+  attr-based waits (queue gets, generic ``.wait()``/``.join()``) C302's
+  fixed call set misses. Held-lock sites report here or as C302, never
+  also as T801 (one finding per defect).
+- T805 ``deadline-propagation-drift``: a scope (handler class or
+  function) that reads the ``X-Kftpu-Deadline-Ms`` header — resolved
+  through the X-family header extraction, cross-module via the Program —
+  but issues a downstream network call with a FIXED literal timeout
+  instead of a budget derived from the deadline (a missing timeout is
+  T801's finding; a constant one is drift).
+
+All T-rules skip test files and honor ``# blocking-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from kubeflow_tpu.analysis.core import (
+    Finding, Module, Rule, leaky_allocs, register,
+)
+from kubeflow_tpu.analysis.rules_concurrency import (
+    _ClassModel, _self_attr_name, class_models,
+)
+from kubeflow_tpu.analysis.rules_concurrency import (
+    BlockingCallUnderLock as _C302,
+)
+from kubeflow_tpu.analysis.rules_contracts import _extract, _resolve_pending
+from kubeflow_tpu.analysis.rules_resources import _attr_chain, _is_test_path
+
+# Argument spellings that count as a bound (this codebase's vocabulary).
+_TIMEOUT_KWARGS = {
+    "timeout", "timeout_s", "timeout_ms", "deadline", "deadline_s",
+    "deadline_ms", "budget", "budget_s", "grace_s",
+}
+# Direct primitives: qualname -> positional index of the timeout arg
+# (None = keyword-only in practice).
+_NET_POS: dict[str, Optional[int]] = {
+    "urllib.request.urlopen": 2,
+    "socket.create_connection": 1,
+    "http.client.HTTPConnection": 2,
+    "http.client.HTTPSConnection": 2,
+    "requests.get": None,
+    "requests.post": None,
+    "requests.request": None,
+}
+_SUBPROC = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+}
+_THREAD_TYPES = {"threading.Thread", "threading.Timer"}
+_STOP_SURFACE = {
+    "stop", "close", "shutdown", "terminate", "join", "quit",
+    "__exit__", "__del__",
+}
+
+
+def _bounded(call: ast.Call, pos_idx: Optional[int] = None) -> bool:
+    """The call carries a timeout/deadline argument (an explicit
+    ``timeout=None`` does NOT count; a ``**kwargs`` splat does — we
+    cannot see inside it and presuming a bound never invents a
+    finding)."""
+    for kw in call.keywords:
+        if kw.arg is None:
+            return True
+        if kw.arg in _TIMEOUT_KWARGS:
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    if pos_idx is not None and len(call.args) > pos_idx:
+        return True
+    return False
+
+
+def _queueish(recv: str) -> bool:
+    last = recv.split(".")[-1].lower()
+    return "queue" in last or last == "q" or last.endswith("_q")
+
+
+def _unbounded_blocking(mod: Module, call: ast.Call) -> Optional[str]:
+    """Description of why this call can block forever, or None."""
+    qn = mod.qualname(call.func)
+    if qn in _NET_POS:
+        if not _bounded(call, _NET_POS[qn]):
+            return f"'{qn}(...)' with no timeout"
+        return None
+    if qn in _SUBPROC:
+        if not _bounded(call):
+            return f"'{qn}(...)' with no timeout"
+        return None
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    meth = call.func.attr
+    recv = _attr_chain(call.func.value)
+    if meth == "join" and not call.args and not _bounded(call):
+        # str.join / os.path.join always take an argument, so a zero-arg
+        # join is a thread/process/pool join.
+        return f"'{recv or '...'}.join()' with no timeout"
+    if meth == "wait" and not call.args and not _bounded(call):
+        # Event/Condition/Popen/grpc-event wait; a bounded wait passes
+        # the timeout positionally (first arg) or by keyword.
+        return f"'{recv or '...'}.wait()' with no timeout"
+    if meth == "communicate" and not call.args and not _bounded(call):
+        return f"'{recv or '...'}.communicate()' with no timeout"
+    if meth == "get" and _queueish(recv) and not call.args \
+            and not _bounded(call) and not _nonblocking(call):
+        return f"'{recv}.get()' with no timeout"
+    if meth == "put" and _queueish(recv) and not _bounded(call) \
+            and any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True for kw in call.keywords):
+        # put blocks only on a bounded queue; an explicit block=True is
+        # the author saying this one is.
+        return f"'{recv}.put(..., block=True)' with no timeout"
+    return None
+
+
+def _nonblocking(call: ast.Call) -> bool:
+    return any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False for kw in call.keywords)
+
+
+_BLOCKING_ATTRS = {"wait", "join", "get", "communicate", "put"}
+
+
+def _param_flows_to_blocking(mod: Module, target: ast.AST,
+                             tparam: str) -> bool:
+    """Some call inside ``target`` passes the ``tparam`` Name (as an arg
+    or keyword) to a known blocking primitive."""
+    for n in ast.walk(target):
+        if not (isinstance(n, ast.Name) and n.id == tparam):
+            continue
+        cur = getattr(n, "_parent", None)
+        if isinstance(cur, ast.keyword):
+            cur = getattr(cur, "_parent", None)
+        if not isinstance(cur, ast.Call):
+            continue
+        qn = mod.qualname(cur.func)
+        if qn in _NET_POS or qn in _SUBPROC:
+            return True
+        if isinstance(cur.func, ast.Attribute) \
+                and cur.func.attr in _BLOCKING_ATTRS:
+            return True
+    return False
+
+
+def _wrapper_unbounded(mod: Module, call: ast.Call,
+                       fn: Optional[ast.AST]) -> Optional[str]:
+    """One-level wrapper resolution: the call targets a def that takes a
+    timeout-ish parameter defaulting to None and threads it into some
+    call in its body — the call site must pass that argument (a non-None
+    default means the wrapper is bounded by default)."""
+    if _bounded(call):
+        return None
+    target: Optional[ast.AST] = None
+    tmod = mod
+    if mod.program is not None and fn is not None:
+        got = mod.program.resolve_call(mod, call, fn)
+        if got is not None:
+            tmod, target = got
+    elif fn is not None:
+        target = mod.callgraph.resolve_call(call, fn)
+    if not isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    params = list(target.args.posonlyargs) + list(target.args.args)
+    names = [p.arg for p in params] + [p.arg for p in target.args.kwonlyargs]
+    tparam = next((n for n in names if n in _TIMEOUT_KWARGS), None)
+    if tparam is None:
+        return None
+    # default value of the timeout parameter
+    defaults = dict(zip([p.arg for p in params[len(params)
+                                               - len(target.args.defaults):]],
+                        target.args.defaults))
+    defaults.update({p.arg: d for p, d in zip(target.args.kwonlyargs,
+                                              target.args.kw_defaults)
+                     if d is not None})
+    dflt = defaults.get(tparam)
+    if dflt is not None and not (isinstance(dflt, ast.Constant)
+                                 and dflt.value is None):
+        return None         # bounded by default
+    # The wrapper must thread the budget into an actual BLOCKING
+    # primitive ('urlopen(url, timeout=timeout)') — forwarding it into a
+    # dataclass / another wrapper ('Request(deadline=deadline)') is
+    # plumbing, not a wait this call site could wedge on.
+    if not _param_flows_to_blocking(tmod, target, tparam):
+        return None
+    # A wrapper that BRANCHES on `param is None` has designed "None =
+    # don't block / no deadline" semantics (controller's non-blocking
+    # event drain, submit's optional request deadline) — the default is
+    # a choice, not an oversight.
+    for n in ast.walk(target):
+        if isinstance(n, ast.Compare) and isinstance(n.left, ast.Name) \
+                and n.left.id == tparam \
+                and any(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in n.ops) \
+                and any(isinstance(c, ast.Constant) and c.value is None
+                        for c in n.comparators):
+            return None
+    # positional pass? (offset 1 when the target is a bound method)
+    idx = next((i for i, p in enumerate(params) if p.arg == tparam), None)
+    if idx is not None:
+        off = 1 if params and params[0].arg in ("self", "cls") \
+            and isinstance(call.func, ast.Attribute) else 0
+        if len(call.args) > idx - off:
+            return None
+    return (f"call to '{target.name}(...)' without its '{tparam}' "
+            "argument (defaults to unbounded)")
+
+
+def _lock_held_calls(mod: Module) -> dict[int, tuple[frozenset,
+                                                     "_ClassModel"]]:
+    """id(call) -> (held locks, class model) for every call made while a
+    class lock is lexically held — the C302 traversal, shared by
+    T801 (skip: the sharper under-lock rules own those sites) and
+    T804(b). Memoized on the module."""
+    def build(m: Module) -> dict:
+        out: dict[int, tuple[frozenset, _ClassModel]] = {}
+
+        def visit(cm: _ClassModel, node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, ast.With):
+                extra = set()
+                for item in node.items:
+                    a = _self_attr_name(item.context_expr)
+                    if a and a in cm.lock_attrs:
+                        extra.add(cm._canonical_lock(a))
+                inner = frozenset(held | extra)
+                for child in node.body:
+                    visit(cm, child, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return
+            if held and isinstance(node, ast.Call):
+                out[id(node)] = (held, cm)
+            for child in ast.iter_child_nodes(node):
+                visit(cm, child, held)
+
+        for cm in class_models(m):
+            if not cm.lock_attrs:
+                continue
+            for name, fn in cm.methods.items():
+                base = cm._method_locks(name, fn)
+                for stmt in fn.body:
+                    visit(cm, stmt, base)
+        return out
+
+    return mod.memo("t_lock_held_calls", build)
+
+
+def _blocking_ok(mod: Module, node: ast.AST) -> bool:
+    return mod.annotation(node, "blocking_ok") is not None
+
+
+@register
+class UnboundedBlockingCall(Rule):
+    id = "T801"
+    name = "unbounded-blocking-call"
+    doc = ("network / queue / wait / join / subprocess call with no "
+           "timeout or deadline — one wedged peer stalls this component "
+           "forever; pass a bound or annotate '# blocking-ok: <reason>'")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if _is_test_path(mod.relpath):
+            return
+        held = _lock_held_calls(mod)
+        for call in mod.walk(ast.Call):
+            if id(call) in held:
+                continue        # C302 / T804(b) own held-lock sites
+            desc = _unbounded_blocking(mod, call)
+            if desc is None:
+                fn = mod.enclosing_function(call)
+                desc = _wrapper_unbounded(mod, call, fn)
+            if desc is None or _blocking_ok(mod, call):
+                continue
+            yield mod.finding(
+                self, call,
+                f"unbounded blocking call: {desc}; a wedged peer stalls "
+                "this component forever — pass a timeout/deadline or "
+                "annotate '# blocking-ok: <reason>'")
+
+
+@register
+class AdHocRetryLoop(Rule):
+    id = "T802"
+    name = "ad-hoc-retry-loop"
+    doc = ("loop body sleeps and swallows-and-retries an exception "
+           "without going through serve/retry.py::call_with_retry "
+           "(jittered backoff, bounded attempts)")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if _is_test_path(mod.relpath):
+            return
+        if mod.relpath.replace("\\", "/").endswith("serve/retry.py"):
+            return              # the blessed helper itself
+        for loop in mod.walk(ast.While, ast.For):
+            if mod.line_annotation(loop.lineno, "blocking_ok") is not None \
+                    or mod.line_annotation(loop.lineno - 1, "blocking_ok") \
+                    is not None:
+                continue
+            sleeps = blessed = False
+            retried: Optional[ast.Try] = None
+            for node in ast.walk(loop):
+                if not isinstance(node, (ast.Call, ast.Try)):
+                    continue
+                if isinstance(node, ast.Try):
+                    if retried is None and self._retries(node):
+                        retried = node
+                    continue
+                qn = mod.qualname(node.func) or ""
+                if qn == "time.sleep":
+                    sleeps = True
+                elif qn.split(".")[-1] in ("call_with_retry", "RetryPolicy"):
+                    blessed = True
+            if sleeps and retried is not None and not blessed:
+                yield mod.finding(
+                    self, loop,
+                    "ad-hoc retry loop (time.sleep + swallow-and-retry "
+                    f"except at line {retried.lineno}); use "
+                    "serve/retry.py::call_with_retry — jittered backoff, "
+                    "bounded attempts, injectable sleep")
+
+    @staticmethod
+    def _retries(node: ast.Try) -> bool:
+        """A handler execution can fall through (reach the next loop
+        iteration) and the guarded body actually calls something."""
+        if not node.handlers:
+            return False
+        if not any(isinstance(n, ast.Call)
+                   for stmt in node.body for n in ast.walk(stmt)):
+            return False
+        for h in node.handlers:
+            if not h.body:
+                return True
+            last = h.body[-1]
+            if not isinstance(last, (ast.Raise, ast.Return, ast.Break)):
+                return True
+        return False
+
+
+@register
+class LeakedThread(Rule):
+    id = "T803"
+    name = "leaked-thread"
+    doc = ("threading.Thread stored on self in a class whose "
+           "stop/close/shutdown surface never joins it, or a non-daemon "
+           "local thread no path joins (core.leaky_allocs pairing)")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if _is_test_path(mod.relpath):
+            return
+        yield from self._class_threads(mod)
+        yield from self._local_threads(mod)
+
+    # -- self.X = threading.Thread(...) -----------------------------------
+
+    def _class_threads(self, mod: Module) -> Iterable[Finding]:
+        for cm in class_models(mod):
+            sites: dict[str, ast.Call] = {}
+            joined: set[str] = set()
+            for fn in cm.methods.values():
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Call) \
+                            and mod.qualname(node.value.func) \
+                            in _THREAD_TYPES:
+                        for t in node.targets:
+                            attr = _self_attr_name(t)
+                            if attr:
+                                sites.setdefault(attr, node.value)
+                    elif isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "join":
+                        attr = _self_attr_name(node.func.value)
+                        if attr:
+                            joined.add(attr)
+            if not sites:
+                continue
+            stop_methods = sorted(set(cm.methods) & _STOP_SURFACE)
+            if not stop_methods:
+                continue        # no stop surface at all: T804's finding
+            for attr, site in sorted(sites.items()):
+                if attr in joined or _blocking_ok(mod, site):
+                    continue
+                yield mod.finding(
+                    self, site,
+                    f"'{cm.cls.name}.{attr}' is a background thread but "
+                    f"the stop surface ({', '.join(stop_methods)}) never "
+                    f"joins it — the thread outlives the component; join "
+                    "it (with a timeout) in stop/close",
+                    symbol=f"{cm.cls.name}.{attr}")
+
+    # -- t = threading.Thread(...) in a function ---------------------------
+
+    def _local_threads(self, mod: Module) -> Iterable[Finding]:
+        def is_thread(call: ast.Call) -> bool:
+            if mod.qualname(call.func) not in _THREAD_TYPES:
+                return False
+            return not any(
+                kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in call.keywords)
+
+        def releases(stmt: ast.stmt, var: str) -> bool:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in ("join", "append",
+                                                   "add", "extend"):
+                        tgt = node.func.value if node.func.attr == "join" \
+                            else None
+                        if isinstance(tgt, ast.Name) and tgt.id == var:
+                            return True
+                    if any(isinstance(a, ast.Name) and a.id == var
+                           for a in node.args):
+                        return True
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, (ast.Subscript, ast.Attribute)):
+                            for sub in ast.walk(node.value):
+                                if isinstance(sub, ast.Name) \
+                                        and sub.id == var:
+                                    return True
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name) and sub.id == var:
+                            return True
+            return False
+
+        # Methods of classes with NO stop surface: T804(a) owns every
+        # thread ctor there (one finding per defect).
+        t804_owned = {
+            id(fn) for cm in class_models(mod)
+            if not set(cm.methods) & _STOP_SURFACE
+            for fn in cm.methods.values()}
+        for fn in mod.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+            if id(fn) in t804_owned:
+                continue
+            joins = {
+                n.func.value.id for n in ast.walk(fn)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "join"
+                and isinstance(n.func.value, ast.Name)}
+            for alloc, var, risky in leaky_allocs(fn, is_thread, releases):
+                if var in joins or self._escapes(fn, var) \
+                        or _blocking_ok(mod, alloc):
+                    continue
+                yield mod.finding(
+                    self, alloc,
+                    f"non-daemon thread '{var}' started in '{fn.name}' "
+                    "is never joined on any path — it outlives the "
+                    "function; join it (with a timeout) or make it "
+                    "daemon")
+
+    @staticmethod
+    def _escapes(fn: ast.AST, var: str) -> bool:
+        """The thread object leaves the function — returned, stored into
+        a container/attribute, or handed to another call — so someone
+        else owns the join (the path-sensitive leaky_allocs pairing
+        would still flag a risky call BETWEEN ctor and escape, which for
+        threads is noise: a failed start() never ran)."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if any(isinstance(a, ast.Name) and a.id == var
+                       for a in node.args):
+                    return True
+                if any(isinstance(kw.value, ast.Name)
+                       and kw.value.id == var for kw in node.keywords):
+                    return True
+            elif isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+            elif isinstance(node, ast.Assign):
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name) and sub.id == var:
+                            return True
+        return False
+
+
+@register
+class ThreadLifecycle(Rule):
+    id = "T804"
+    name = "thread-lifecycle"
+    doc = ("non-daemon thread in a class with no stop surface (nothing "
+           "can ever reap it), or an UNBOUNDED blocking call while a "
+           "lock is held (C302 tightened with the timeout fact)")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if _is_test_path(mod.relpath):
+            return
+        yield from self._no_stop_surface(mod)
+        yield from self._unbounded_under_lock(mod)
+
+    def _no_stop_surface(self, mod: Module) -> Iterable[Finding]:
+        for cm in class_models(mod):
+            if set(cm.methods) & _STOP_SURFACE:
+                continue
+            for fn in cm.methods.values():
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call) \
+                            or mod.qualname(node.func) not in _THREAD_TYPES:
+                        continue
+                    if any(kw.arg == "daemon"
+                           and isinstance(kw.value, ast.Constant)
+                           and kw.value.value is True
+                           for kw in node.keywords):
+                        continue
+                    if _blocking_ok(mod, node):
+                        continue
+                    yield mod.finding(
+                        self, node,
+                        f"non-daemon thread created in "
+                        f"'{cm.cls.name}', which has no "
+                        "stop/close/shutdown surface — nothing can ever "
+                        "reap it; add a stop() that joins, or make it "
+                        "daemon with an owned stop event")
+
+    def _unbounded_under_lock(self, mod: Module) -> Iterable[Finding]:
+        for call_id, (held, cm) in _lock_held_calls(mod).items():
+            call = self._call_by_id(mod, call_id)
+            if call is None:
+                continue
+            if _C302._blocking(mod, cm, call) is not None:
+                continue        # C302 reports that site
+            desc = _unbounded_blocking(mod, call)
+            if desc is None or _blocking_ok(mod, call):
+                continue
+            yield mod.finding(
+                self, call,
+                f"unbounded blocking call ({desc}) while holding "
+                f"{sorted('self.' + h for h in held)} — every thread "
+                "needing the lock wedges with it; bound the wait or "
+                "move it outside the lock")
+
+    @staticmethod
+    def _call_by_id(mod: Module, call_id: int) -> Optional[ast.Call]:
+        for n in mod.walk(ast.Call):
+            if id(n) == call_id:
+                return n
+        return None
+
+
+@register
+class DeadlinePropagationDrift(Rule):
+    id = "T805"
+    name = "deadline-propagation-drift"
+    doc = ("scope reads the X-Kftpu-Deadline-Ms header but issues a "
+           "downstream network call with a FIXED literal timeout — the "
+           "caller's budget is ignored; derive the bound from the "
+           "deadline (serve/router.py::_budget_s)")
+
+    _PREFIX = "x-kftpu-deadline"
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if _is_test_path(mod.relpath):
+            return
+        ex = _extract(mod)
+        reads = [n for v, n in ex["headers_read"]
+                 if v.lower().startswith(self._PREFIX)]
+        for qual, direction, node in ex["headers_pending"]:
+            if direction != "read":
+                continue
+            val = _resolve_pending(mod.program, qual)
+            if val is not None and val.lower().startswith(self._PREFIX):
+                reads.append(node)
+        if not reads:
+            return
+        scopes: list[ast.AST] = []
+        for n in reads:
+            scope = self._scope_of(mod, n)
+            if scope is not None and scope not in scopes:
+                scopes.append(scope)
+        seen: set[int] = set()
+        for scope in scopes:
+            label = getattr(scope, "name", "<module>")
+            for call in ast.walk(scope):
+                if not isinstance(call, ast.Call) or id(call) in seen:
+                    continue
+                seen.add(id(call))
+                qn = mod.qualname(call.func)
+                if qn not in _NET_POS:
+                    continue
+                fixed = self._fixed_timeout(call, _NET_POS[qn])
+                if fixed is None or _blocking_ok(mod, call):
+                    continue
+                yield mod.finding(
+                    self, call,
+                    f"'{label}' reads the deadline header but calls "
+                    f"'{qn}' with a fixed timeout={fixed} — the "
+                    "caller's budget is ignored; derive the bound from "
+                    "the deadline (see serve/router.py::_budget_s)")
+
+    @staticmethod
+    def _scope_of(mod: Module, node: ast.AST) -> Optional[ast.AST]:
+        cur = getattr(node, "_parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = getattr(cur, "_parent", None)
+        return mod.enclosing_function(node)
+
+    @staticmethod
+    def _fixed_timeout(call: ast.Call,
+                       pos_idx: Optional[int]) -> Optional[object]:
+        """The literal constant bound this call passes, or None when the
+        bound is missing (T801's finding) or derived (an expression)."""
+        for kw in call.keywords:
+            if kw.arg in _TIMEOUT_KWARGS \
+                    and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is not None:
+                return kw.value.value
+        if pos_idx is not None and len(call.args) > pos_idx \
+                and isinstance(call.args[pos_idx], ast.Constant):
+            return call.args[pos_idx].value
+        return None

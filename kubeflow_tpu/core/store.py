@@ -307,6 +307,7 @@ class Watch:
 
     def __iter__(self) -> Iterator[WatchEvent]:
         while not self._ended:
+            # blocking-ok: stream ends via the None sentinel pushed on close/drop (apiserver-watch idiom); bounded consumers use next(timeout=)/drain()
             ev = self._watcher.q.get()
             if ev is None:
                 self._ended = True

@@ -129,6 +129,9 @@ class Heartbeat:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1.0)
+            self._thread = None
 
 
 def bootstrap_worker(wenv: Optional[WorkerEnv] = None):

@@ -104,7 +104,7 @@ class LocalProcessManager:
                 h.popen.wait(timeout=grace_seconds)
             except subprocess.TimeoutExpired:
                 self.signal(name, signal.SIGKILL)
-                h.popen.wait()
+                h.popen.wait()  # blocking-ok: final reap after SIGKILL — the kernel guarantees exit
         return h.poll()
 
     def reap(self, name: str) -> None:

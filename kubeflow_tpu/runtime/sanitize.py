@@ -34,6 +34,19 @@
   (``kftpu lint --contracts-json``). The dynamic half of the X7xx
   cross-component contract rules: a series name the AST extractor
   cannot see (built dynamically) shows up here as *undeclared*.
+- ``threads``: a thread-lifecycle sanitizer (``install_thread_sanitizer``)
+  wraps ``threading.Thread`` so every thread APPLICATION code creates is
+  stamped with its creation site and an owner (the refcount sanitizer's
+  owner idiom: an explicit ``thread_owner(...)`` scope, else the bound
+  target's class, else inherited from the creating thread).
+  ``thread_report()`` lists the live tracked threads,
+  ``thread_leak_report_by_owner()`` groups them, and
+  ``assert_threads_quiescent()`` — asserted at engine/server/router
+  stop — raises ``ThreadLeakError`` naming each leaked thread's name,
+  owner, and creation site. Library-internal threads (jax pools,
+  executor workers, socketserver handlers) are deliberately untracked:
+  quiescence is asserted over the threads THIS codebase starts. The
+  dynamic half of the T8xx liveness rules.
 - ``all``: everything above.
 
 This module is stdlib-only (no jax): the watchdogs must be installable
@@ -48,14 +61,17 @@ console handler (root stays at WARNING).
 from __future__ import annotations
 
 import _thread
+import contextlib
 import logging
 import os
 import sys
 import threading
-from typing import Optional
+import time
+import weakref
+from typing import Iterable, Optional
 
 _KNOWN_MODES = frozenset({"transfer", "refcount", "lockorder",
-                          "recompile", "contract"})
+                          "recompile", "contract", "threads"})
 
 
 def sanitize_modes() -> frozenset:
@@ -574,11 +590,234 @@ def contract_diff(report: dict, static_doc: dict) -> dict:
     return out
 
 
+# -- thread-lifecycle sanitizer ------------------------------------------------
+
+
+class ThreadLeakError(AssertionError):
+    """Tracked threads survived a quiescence point; each is named with
+    its creation site and owner — the T803/T804 leak, caught live."""
+
+
+_STDLIB_DIR = os.path.dirname(os.__file__)
+
+
+def _is_app_file(fname: str) -> bool:
+    """Application code: not stdlib, not an installed library, not a
+    synthesized frame. Threads libraries start (executor workers, jax
+    pools, socketserver handlers) are their business to reap."""
+    return ("site-packages" not in fname
+            and "dist-packages" not in fname
+            and not fname.startswith(("<", _STDLIB_DIR)))
+
+
+def _creator_site() -> tuple[str, bool]:
+    """(``file:line``, is_app_code) of the nearest frame outside this
+    module and the threading machinery — who constructed the thread."""
+    frame = sys._getframe(1)
+    for _ in range(32):
+        if frame is None:
+            break
+        fname = frame.f_code.co_filename
+        if fname != __file__ \
+                and "threading" not in os.path.basename(fname):
+            return (f"{os.path.basename(fname)}:{frame.f_lineno}",
+                    _is_app_file(fname))
+        frame = frame.f_back
+    return "<unknown>", False
+
+
+class _ThreadSanitizer:
+    """State for the ``threads`` mode: the per-creating-thread owner
+    label (``thread_owner`` scopes) and the tracked-thread view. There
+    is no registry — ``threading.enumerate()`` already holds every live
+    thread, and dead threads need no bookkeeping to forget."""
+
+    def __init__(self):
+        self._tls = threading.local()
+
+    def current_owner(self) -> Optional[str]:
+        return getattr(self._tls, "owner", None)
+
+    @contextlib.contextmanager
+    def owner_scope(self, owner: str):
+        prev = getattr(self._tls, "owner", None)
+        self._tls.owner = owner
+        try:
+            yield
+        finally:
+            self._tls.owner = prev
+
+    @staticmethod
+    def tracked() -> list:
+        me = threading.current_thread()
+        return [t for t in threading.enumerate()
+                if t is not me and t.is_alive()
+                and getattr(t, "_kftpu_site", None) is not None]
+
+    def stamp(self, t) -> None:
+        site, app = _creator_site()
+        if not app:
+            return              # library-internal thread: untracked
+        target = getattr(t, "_target", None)
+        owner_obj = getattr(target, "__self__", None) \
+            if target is not None else None
+        owner = self.current_owner()
+        if owner is None and owner_obj is not None:
+            owner = type(owner_obj).__name__
+        if owner is None:
+            owner = getattr(threading.current_thread(),
+                            "_kftpu_owner", None)     # inherit
+        if owner is None:
+            owner = site.split(":")[0]
+        t._kftpu_site = site
+        t._kftpu_owner = owner
+        t._kftpu_created = time.monotonic()
+        if owner_obj is not None:
+            try:
+                t._kftpu_owner_ref = weakref.ref(owner_obj)
+            except TypeError:
+                t._kftpu_owner_ref = None
+        else:
+            t._kftpu_owner_ref = None
+
+
+_thread_san: Optional[_ThreadSanitizer] = None
+_thread_orig: Optional[type] = None
+
+
+def install_thread_sanitizer() -> _ThreadSanitizer:
+    """Patch ``threading.Thread`` so every thread created AFTER this call
+    is stamped at construction. Idempotent; returns the active
+    sanitizer. (``threading.Timer`` subclassed ``Thread`` at interpreter
+    start, so Timers bypass the stamp — they carry their own interval
+    bound.)"""
+    global _thread_san, _thread_orig
+    if _thread_san is not None:
+        return _thread_san
+    san = _ThreadSanitizer()
+    orig = threading.Thread
+
+    class _StampedThread(orig):        # type: ignore[valid-type, misc]
+        def __init__(self, *args, **kwargs):
+            # NOT super(): stdlib subclasses fixed at interpreter start
+            # (threading.Timer) call the module-global ``Thread.__init__
+            # (self)`` — their self is an ``orig`` instance, not ours.
+            orig.__init__(self, *args, **kwargs)
+            if _thread_san is not None and isinstance(self, _StampedThread):
+                _thread_san.stamp(self)
+
+    _StampedThread.__name__ = "Thread"
+    _StampedThread.__qualname__ = "Thread"
+    threading.Thread = _StampedThread      # type: ignore[misc]
+    _thread_orig = orig
+    _thread_san = san
+    return san
+
+
+def uninstall_thread_sanitizer() -> None:
+    """Restore the real Thread class. Threads created while installed
+    keep their stamps (harmless attributes on dead-soon objects)."""
+    global _thread_san, _thread_orig
+    if _thread_orig is not None:
+        threading.Thread = _thread_orig    # type: ignore[misc]
+        _thread_orig = None
+    _thread_san = None
+
+
+def thread_sanitizer() -> Optional[_ThreadSanitizer]:
+    return _thread_san
+
+
+def thread_owner(owner: str):
+    """Context manager labelling every thread the CURRENT thread creates
+    inside the scope — the refcount sanitizer's owner idiom applied to
+    thread creation. No-op context when the mode is off."""
+    if _thread_san is None:
+        return contextlib.nullcontext()
+    return _thread_san.owner_scope(owner)
+
+
+def thread_report() -> list:
+    """Live tracked threads: ``[{name, owner, site, daemon, age_s}]``.
+    Empty when the sanitizer is not installed."""
+    if _thread_san is None:
+        return []
+    now = time.monotonic()
+    return [{"name": t.name,
+             "owner": getattr(t, "_kftpu_owner", "<unknown>"),
+             "site": getattr(t, "_kftpu_site", "<unknown>"),
+             "daemon": t.daemon,
+             "age_s": round(now - getattr(t, "_kftpu_created", now), 3)}
+            for t in _ThreadSanitizer.tracked()]
+
+
+def thread_leak_report_by_owner() -> dict:
+    """``thread_report()`` grouped by owner — which component forgot to
+    join what."""
+    out: dict[str, list] = {}
+    for entry in thread_report():
+        out.setdefault(entry["owner"], []).append(entry)
+    return out
+
+
+def _quiescence_pool(owner, threads: Optional[Iterable]) -> list:
+    me = threading.current_thread()
+    pool = [t for t in (threads if threads is not None
+                        else _ThreadSanitizer.tracked()) if t is not None]
+    out = []
+    for t in pool:
+        if t is me or not t.is_alive():
+            continue
+        if owner is None:
+            out.append(t)
+        elif isinstance(owner, str):
+            if getattr(t, "_kftpu_owner", None) == owner:
+                out.append(t)
+        else:
+            ref = getattr(t, "_kftpu_owner_ref", None)
+            if ref is not None and ref() is owner:
+                out.append(t)
+    return out
+
+
+def assert_threads_quiescent(owner=None, *, grace_s: float = 5.0,
+                             threads: Optional[Iterable] = None) -> None:
+    """Raise ``ThreadLeakError`` if tracked threads are still alive after
+    ``grace_s``. ``owner=None`` audits every tracked thread; a string
+    matches the stamped owner label; any other object matches threads
+    whose bound target method belongs to that instance (identity).
+    ``threads=`` audits an explicit iterable instead of the tracked set
+    (stamped or not). No-op when the sanitizer is not installed —
+    stop paths call this unconditionally."""
+    if _thread_san is None:
+        return
+    deadline = time.monotonic() + max(grace_s, 0.0)
+    leaked = _quiescence_pool(owner, threads)
+    while leaked:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        # Join rather than spin: the leaker exiting wakes us immediately.
+        leaked[0].join(timeout=min(0.2, remaining))
+        leaked = _quiescence_pool(owner, threads)
+    if not leaked:
+        return
+    lines = [
+        f"  '{t.name}' (owner={getattr(t, '_kftpu_owner', '<unstamped>')}, "
+        f"created at {getattr(t, '_kftpu_site', '<unstamped>')}, "
+        f"daemon={t.daemon})" for t in leaked]
+    raise ThreadLeakError(
+        f"{len(leaked)} thread(s) still alive after {grace_s:.1f}s "
+        "quiescence grace — each names its creation site (the static "
+        "T803/T804 rules model exactly this):\n" + "\n".join(lines))
+
+
 def maybe_install() -> None:
     """Called from ``kubeflow_tpu/__init__`` so ``KFTPU_SANITIZE=
-    lockorder`` / ``=recompile`` / ``=contract`` cover every lock the
-    platform creates, every compile it dispatches, and every name
-    exchange it performs, whatever the entry point."""
+    lockorder`` / ``=recompile`` / ``=contract`` / ``=threads`` cover
+    every lock the platform creates, every compile it dispatches, every
+    name exchange it performs, and every thread it starts, whatever the
+    entry point."""
     modes = sanitize_modes()
     if "lockorder" in modes:
         install_lockorder_watchdog()
@@ -586,3 +825,5 @@ def maybe_install() -> None:
         install_recompile_watchdog()
     if "contract" in modes:
         install_contract_auditor()
+    if "threads" in modes:
+        install_thread_sanitizer()

@@ -3139,7 +3139,9 @@ class LLMEngine:
         dispatch-site attribution — the decode hot loop is supposed to
         hold a FIXED trace set once warm (the F6xx contract), and a
         recompile storm here erases the pipelined-dispatch win."""
-        from kubeflow_tpu.runtime.sanitize import recompile_report
+        from kubeflow_tpu.runtime.sanitize import (
+            assert_threads_quiescent, recompile_report,
+        )
 
         rep = recompile_report()
         if rep.get("steady_count"):
@@ -3163,6 +3165,10 @@ class LLMEngine:
                     timeout)
             else:
                 self._thread = None
+        # KFTPU_SANITIZE=threads: every thread whose target is bound to
+        # THIS engine must be dead now — a survivor raises with its
+        # creation site. No-op when the mode is off.
+        assert_threads_quiescent(owner=self, grace_s=timeout)
         # Flight recorder (obs/fleet.py): every engine stop — and, more
         # importantly, every sanitizer-flagged stop — leaves a
         # post-mortem dump when a recorder is installed (or

@@ -167,8 +167,7 @@ class ChaosStore:
     def _maybe_wedge(self) -> None:
         if self._wedged.is_set():
             self.stats["wedged_reads"] += 1
-            self._release.wait()   # held until unwedge(); caller's
-            #                        deadline thread gave up long ago
+            self._release.wait()   # blocking-ok: deliberate wedge fault — held until unwedge(); the caller's deadline thread gave up long ago
 
     # -- the ArtifactStore surface the KV tier drives -----------------------
 
@@ -303,7 +302,7 @@ def _chaos_handler(proxy: ChaosProxy):
                 return
             if proxy._wedged.is_set():
                 proxy.stats["wedged"] += 1
-                proxy._release.wait()        # hold until unwedged/stopped
+                proxy._release.wait()        # blocking-ok: deliberate wedge fault — held until unwedged/stopped
                 self.close_connection = True
                 try:
                     self.connection.close()

@@ -77,7 +77,7 @@ class GRPCInferenceServer:
         self._started.set()
 
     def stop(self, grace: float = 2.0) -> None:
-        self.server.stop(grace).wait()
+        self.server.stop(grace).wait(grace + 1.0)
 
     @property
     def target(self) -> str:

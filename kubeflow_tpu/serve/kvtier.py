@@ -914,7 +914,13 @@ class RadixPrefixIndex:
         from kubeflow_tpu.obs.trace import get_tracer
 
         while not self._stop.is_set():
-            item = self._queue.get()
+            try:
+                # Bounded get (T801): close() pushes a None sentinel, but
+                # the timeout guarantees the stop flag is rechecked even
+                # if the sentinel is lost to a racing drain.
+                item = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
             if item is None:
                 return
             if item[0] == "spill":
@@ -1042,6 +1048,8 @@ class RadixPrefixIndex:
         raise TimeoutError("kv migration batches still in flight")
 
     def close(self) -> None:
+        from kubeflow_tpu.runtime.sanitize import assert_threads_quiescent
+
         self._stop.set()
         if self._thread is not None:
             self._queue.put(None)
@@ -1049,3 +1057,7 @@ class RadixPrefixIndex:
             self._thread = None
         if getattr(self._allocator, "on_evict", None) is self._on_evict:
             self._allocator.on_evict = None
+        # KFTPU_SANITIZE=threads: the kv-migrate thread binds to this
+        # tier — a survivor raises with its creation site. No-op when
+        # the mode is off.
+        assert_threads_quiescent(owner=self, grace_s=5.0)

@@ -641,6 +641,8 @@ class Router:
         self._thread.start()
 
     def stop(self) -> None:
+        from kubeflow_tpu.runtime.sanitize import assert_threads_quiescent
+
         self._scrape_stop.set()
         if self._scrape_thread is not None:
             self._scrape_thread.join(timeout=5.0)
@@ -650,8 +652,16 @@ class Router:
             self._cond.notify_all()   # release every parked request
         self.httpd.shutdown()
         self.httpd.server_close()
+        httpd_thread = self._thread
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+            self._thread = None
+        # KFTPU_SANITIZE=threads: the scrape loop binds to this router
+        # (owner identity); the serve thread binds to httpd, so it is
+        # audited explicitly. No-op when the mode is off.
+        assert_threads_quiescent(owner=self, grace_s=5.0)
+        if httpd_thread is not None:
+            assert_threads_quiescent(threads=(httpd_thread,), grace_s=5.0)
 
 
 def _make_handler(router: Router):

@@ -234,10 +234,16 @@ class ModelServer:
             self.grpc_server.start()
 
     def stop(self) -> None:
+        from kubeflow_tpu.runtime.sanitize import assert_threads_quiescent
+
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+            # KFTPU_SANITIZE=threads: the serve thread must be dead now
+            # (its target binds to httpd, so audit it explicitly).
+            assert_threads_quiescent(threads=(self._thread,), grace_s=5.0)
+            self._thread = None
         if self.grpc_server is not None:
             self.grpc_server.stop()
         if self.engine is not None:

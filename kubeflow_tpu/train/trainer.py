@@ -373,6 +373,7 @@ class Trainer:
                 if mgr is None:
                     continue
                 try:
+                    # blocking-ok: drain the async save at run end — durability outranks prompt exit
                     mgr.wait()
                     mgr.close()
                 except Exception:
@@ -420,7 +421,7 @@ class Trainer:
         if mgr is not None:
             saved = self.save(step, force=True, manager=mgr)
             try:
-                mgr.wait()          # durable before we die, or it never was
+                mgr.wait()          # blocking-ok: durable before we die, or it never was
             except Exception:
                 logger.exception("emergency checkpoint wait failed")
                 saved = False

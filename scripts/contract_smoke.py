@@ -50,7 +50,7 @@ def static_manifest() -> tuple[dict, list[str]]:
     proc = subprocess.run(
         [sys.executable, "-m", "kubeflow_tpu.analysis",
          "--contracts-json", *SCAN],
-        capture_output=True, text=True, cwd=REPO)
+        capture_output=True, text=True, cwd=REPO, timeout=600)
     if proc.returncode != 0:
         return {}, [f"--contracts-json failed: {proc.stderr.strip()}"]
     try:

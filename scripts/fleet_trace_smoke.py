@@ -47,6 +47,16 @@ sys.path.insert(0, REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+# ISSUE 20: the whole gate runs under the thread sanitizer — every
+# threading.Thread created by app code is stamped with its creation
+# site/owner, the component stop() paths assert per-owner quiescence,
+# and step 10 proves the PROCESS ends quiescent even after the SIGKILL
+# chaos. Set BEFORE any kubeflow_tpu import so maybe_install sees it.
+_san = os.environ.get("KFTPU_SANITIZE", "")
+if "threads" not in _san.split(","):
+    os.environ["KFTPU_SANITIZE"] = ",".join(
+        x for x in (_san, "threads") if x)
+
 #: Fleet-plane series this gate consumes off the rendered fleet
 #: registry — the consumer half of the kftpu_fleet_*/kftpu_obs_*
 #: metric contract (X7xx).
@@ -175,7 +185,7 @@ def main() -> int:
         run = run_scenario(ServerTarget(router.url), sc,
                            vocab_size=cfg.vocab_size, max_prompt_len=30,
                            tracer=tracer)
-        killer.join()
+        killer.join(timeout=10.0)
         ok_outs = [o for o in run.outcomes if o.ok]
         result["requests"] = {"offered": len(run.outcomes),
                               "completed": len(ok_outs)}
@@ -339,6 +349,26 @@ def main() -> int:
         if tracer.open_spans():
             return fail(f"{tracer.open_spans()} leaked open spans")
         result["hygiene"] = "ok"
+
+        # 10) Liveness (ISSUE 20): orderly stop of every component —
+        #     the stop() paths each assert their own threads quiescent
+        #     under KFTPU_SANITIZE=threads — then the fleet-wide
+        #     backstop: no stamped thread anywhere survives the stops,
+        #     including anything the SIGKILL chaos stranded. The finally
+        #     block's stops become no-ops (every path is idempotent).
+        from kubeflow_tpu.runtime import sanitize
+
+        if sanitize.thread_sanitizer() is None:
+            return fail("thread sanitizer not installed — "
+                        "KFTPU_SANITIZE=threads did not take")
+        router.stop()
+        for srv in servers:
+            srv.stop()
+        sanitize.assert_threads_quiescent(grace_s=10.0)
+        leaked = sanitize.thread_leak_report_by_owner()
+        if leaked:
+            return fail(f"threads survived orderly stop: {leaked}")
+        result["thread_sanitizer"] = {"mode": "threads", "leaked": 0}
 
         result["fleet_trace_smoke"] = "ok"
         print(json.dumps(result, indent=2))

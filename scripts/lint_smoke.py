@@ -12,9 +12,13 @@ router lock pair (R503), a fire-and-forget trainer checkpoint save
 (R504), a weak-type scalar riding into the dense decode dispatch (F602),
 a fresh tuple in its static num_steps position (F604), a renamed
 autoscaler-scraped series (X701, linted under the full package Program
-so the cross-component table sees the real producers), and a typoed
-header literal (X703) — so a rule that silently stops firing fails the
-gate too, not just the test suite.
+so the cross-component table sees the real producers), a typoed
+header literal (X703), and the ISSUE-20 liveness family: a router
+metrics probe stripped of its timeout (T801), an inline sleep-retry
+loop (T802), the kv-migrate join dropped from KVTier.close (T803), a
+queue get under the router lock (T804), and the relay's derived
+``timeout=remaining`` hardened to a literal (T805) — so a rule that
+silently stops firing fails the gate too, not just the test suite.
 
 Prints one JSON object; ``"lint_smoke": "ok"`` is the pass marker
 smoke.sh greps for. Findings render as ``file:line:col`` so they are
@@ -181,6 +185,53 @@ def _seeded_regressions() -> list[str]:
          _PAGED_CALL.replace(" key, k_steps, mode)", " 0.5, k_steps, mode)")),
         "F602", "self._paged_decode_n")
 
+    # Family T: strip the scrape probe's timeout — the exact unbounded
+    # urlopen class that wedged a router behind a SIGKILLed replica.
+    new_findings(
+        "kubeflow_tpu/serve/router.py",
+        ('with urllib.request.urlopen(url + "/metrics",\n'
+         '                                            timeout=1.0) as r:',
+         'with urllib.request.urlopen(url + "/metrics") as r:'),
+        "T801", "urllib.request.urlopen")
+    # Family T: an inline sleep-and-swallow retry loop instead of the
+    # blessed serve/retry.py::call_with_retry helper.
+    new_findings(
+        "kubeflow_tpu/serve/handoff.py",
+        [("import json\n", "import json\nimport time\n"),
+         ("    def validate(self) -> None:\n"
+          "        if self.kv_k.shape != self.kv_v.shape:\n",
+          "    def validate(self) -> None:\n"
+          "        attempt = 0\n"
+          "        while attempt < 5:\n"
+          "            try:\n"
+          "                json.loads(\"{}\")\n"
+          "                break\n"
+          "            except ValueError:\n"
+          "                attempt += 1\n"
+          "                time.sleep(0.05)\n"
+          "        if self.kv_k.shape != self.kv_v.shape:\n")],
+        "T802", "call_with_retry")
+    # Family T: drop the kv-migrate join from KVTier.close — the thread
+    # outlives the tier (the leak KFTPU_SANITIZE=threads catches live).
+    new_findings(
+        "kubeflow_tpu/serve/kvtier.py",
+        ("            self._queue.put(None)\n"
+         "            self._thread.join(timeout=5.0)\n"
+         "            self._thread = None\n",
+         "            self._queue.put(None)\n"
+         "            self._thread = None\n"),
+        "T803", "_thread")
+    # Family T: an unbounded queue get while holding the router lock —
+    # the attr-based wait C302's fixed call set misses.
+    new_findings(
+        "kubeflow_tpu/serve/router.py",
+        ("    def note_activity(self) -> None:\n",
+         "    def _drain_locked(self):\n"
+         "        with self._lock:\n"
+         "            return self._retire_q.get()\n\n"
+         "    def note_activity(self) -> None:\n"),
+        "T804", "while holding")
+
     def new_findings_prog(path: str, old: str, new: str, rule: str,
                           needle: str) -> None:
         """The X-family variant: lint the mutated module under the FULL
@@ -217,6 +268,15 @@ def _seeded_regressions() -> list[str]:
         "raw = self.headers.get(QOS_HEADER) or body.get(\"qos\")",
         "raw = self.headers.get(\"X-Kftpu-Qoss\") or body.get(\"qos\")",
         "X703", "X-Kftpu-Qoss")
+    # Family T: the relay forwards the caller's remaining budget today —
+    # harden it to a literal and the handler scope that READS the
+    # deadline header (resolved through the Program-wide header table)
+    # now ignores it.
+    new_findings_prog(
+        "kubeflow_tpu/serve/router.py",
+        "resp = urllib.request.urlopen(req, timeout=remaining)",
+        "resp = urllib.request.urlopen(req, timeout=30.0)",
+        "T805", "timeout=30.0")
     return fails
 
 

@@ -91,6 +91,21 @@ def main() -> int:
     )
     from kubeflow_tpu.operator.faults import FaultInjector
     from kubeflow_tpu.runtime.topology import Cluster, SliceTopology
+    from kubeflow_tpu.serve.retry import RetryPolicy, call_with_retry
+
+    def set_suspend(cp, name: str, value: bool) -> None:
+        """Flip run_policy.suspend through the optimistic-concurrency
+        store, retrying lost ConflictError races via the blessed helper
+        (T802: no ad-hoc sleep loops)."""
+        def attempt(_attempt: int) -> None:
+            fresh = cp.get_job(name)
+            fresh.spec.run_policy.suspend = value
+            cp.store.update(fresh)
+        call_with_retry(
+            attempt,
+            policy=RetryPolicy(attempts=20, base_s=0.05, cap_s=0.05,
+                               jitter_frac=0.0),
+            retry_on=(ConflictError,))
 
     base = tempfile.mkdtemp(prefix="kftpu-train-chaos-")
     cp = ControlPlane(ControlPlaneConfig(
@@ -140,28 +155,14 @@ def main() -> int:
         _wait(cp, "fallb",
               lambda j: (j.status.metrics.last_checkpoint_step or 0) >= 12,
               240, "two committed interval saves")
-        for _ in range(20):
-            fresh = cp.get_job("fallb")
-            fresh.spec.run_policy.suspend = True
-            try:
-                cp.store.update(fresh)
-                break
-            except ConflictError:
-                time.sleep(0.05)
+        set_suspend(cp, "fallb", True)
         cp.wait_for(job, "Suspended", timeout=120)
         deadline = time.time() + 60
         while cp.runtime.procman.alive() and time.time() < deadline:
             time.sleep(0.1)     # teardown emergency save must land first
         target = inj.corrupt_latest_checkpoint("default/fallb")
         check("corrupt_target_found", target is not None, detail=target)
-        for _ in range(20):
-            fresh = cp.get_job("fallb")
-            fresh.spec.run_policy.suspend = False
-            try:
-                cp.store.update(fresh)
-                break
-            except ConflictError:
-                time.sleep(0.05)
+        set_suspend(cp, "fallb", False)
         done = cp.wait_for(job, "Succeeded", timeout=420)
         led = _ledger(cp, "fallb")
         log = _log(cp, "fallb")

@@ -230,7 +230,8 @@ class TestUnlockedSharedMutation:
             "        self._lock = threading.Lock()\n"
             "        self._items = []\n"
             "    def start(self):\n"
-            "        threading.Thread(target=self._run).start()\n"
+            "        threading.Thread(target=self._run,\n"
+            "                         daemon=True).start()\n"
             "    def _run(self):\n"
             "        self._items.append(1)\n"
             "    def results(self):\n"
@@ -247,7 +248,8 @@ class TestUnlockedSharedMutation:
             "        self._lock = threading.Lock()\n"
             "        self._items = []\n"
             "    def start(self):\n"
-            "        threading.Thread(target=self._run).start()\n"
+            "        threading.Thread(target=self._run,\n"
+            "                         daemon=True).start()\n"
             "    def _run(self):\n"
             "        with self._lock:\n"
             "            self._items.append(1)\n"
@@ -313,7 +315,8 @@ class TestUnlockedSharedMutation:
             "        self._lock = threading.Lock()\n"
             "        self._items = []  # lockfree: scheduler-confined\n"
             "    def start(self):\n"
-            "        threading.Thread(target=self._run).start()\n"
+            "        threading.Thread(target=self._run,\n"
+            "                         daemon=True).start()\n"
             "    def _run(self):\n"
             "        self._items.append(1)\n"
             "    def results(self):\n"
@@ -1133,7 +1136,8 @@ class TestRegistry:
                 "C301", "C302", "C303", "M201", "M202", "M203",
                 "S401", "S402", "S403", "S404", "S405",
                 "R501", "R502", "R503", "R504",
-                "F601", "F602", "F603", "F604", "F605"} <= ids
+                "F601", "F602", "F603", "F604", "F605",
+                "T801", "T802", "T803", "T804", "T805"} <= ids
 
     def test_parse_error_is_reported_not_raised(self, tmp_path):
         bad = tmp_path / "bad.py"
@@ -1781,6 +1785,282 @@ class TestStatusFieldDrift:
         assert xrules({"kubeflow_tpu/op/r.py": src}) == []
 
 
+# -- Family T: distributed liveness (ISSUE 20) ---------------------------------
+
+
+class TestUnboundedBlockingCall:
+    def test_urlopen_without_timeout(self):
+        src = ("import urllib.request\n"
+               "def probe(url):\n"
+               "    with urllib.request.urlopen(url) as r:\n"
+               "        return r.read()\n")
+        assert rules_of(src) == ["T801"]
+
+    def test_explicit_timeout_none_still_fires(self):
+        src = ("import urllib.request\n"
+               "def probe(url):\n"
+               "    return urllib.request.urlopen(url, timeout=None)\n")
+        assert rules_of(src) == ["T801"]
+
+    def test_bounded_urlopen_is_clean(self):
+        src = ("import urllib.request\n"
+               "def probe(url):\n"
+               "    return urllib.request.urlopen(url, timeout=1.0)\n")
+        assert rules_of(src) == []
+
+    def test_queueish_get_and_zero_arg_wait(self):
+        src = ("def pump(self):\n"
+               "    item = self._work_q.get()\n"
+               "    self._done.wait()\n")
+        assert rules_of(src) == ["T801", "T801"]
+
+    def test_bounded_get_nonblocking_get_and_str_join_clean(self):
+        src = ("def pump(self, parts):\n"
+               "    a = self._work_q.get(timeout=1.0)\n"
+               "    b = self._work_q.get(block=False)\n"
+               "    return ','.join(parts)\n")
+        assert rules_of(src) == []
+
+    def test_subprocess_without_timeout(self):
+        src = ("import subprocess\n"
+               "def run(cmd):\n"
+               "    return subprocess.check_output(cmd)\n")
+        assert rules_of(src) == ["T801"]
+
+    def test_blocking_ok_annotation_closes_it(self):
+        src = ("def pump(self):\n"
+               "    # blocking-ok: close() pushes a None sentinel\n"
+               "    return self._work_q.get()\n")
+        assert rules_of(src) == []
+
+    def test_wrapper_default_none_without_arg(self):
+        """Call into a local wrapper whose timeout defaults to None and
+        flows into urlopen: the call site must pass the budget."""
+        src = ("import urllib.request\n"
+               "def fetch(url, timeout=None):\n"
+               "    return urllib.request.urlopen(url, timeout=timeout)\n"
+               "def probe(url):\n"
+               "    return fetch(url)\n")
+        assert rules_of(src) == ["T801"]
+
+    def test_wrapper_called_with_budget_is_clean(self):
+        src = ("import urllib.request\n"
+               "def fetch(url, timeout=None):\n"
+               "    return urllib.request.urlopen(url, timeout=timeout)\n"
+               "def probe(url):\n"
+               "    return fetch(url, timeout=2.0)\n")
+        assert rules_of(src) == []
+
+    def test_wrapper_branching_on_none_is_designed(self):
+        """A wrapper that BRANCHES on ``timeout is None`` has designed
+        None-semantics (non-blocking drain): the default is a choice."""
+        src = ("import urllib.request\n"
+               "def fetch(url, timeout=None):\n"
+               "    if timeout is None:\n"
+               "        return None\n"
+               "    return urllib.request.urlopen(url, timeout=timeout)\n"
+               "def probe(url):\n"
+               "    return fetch(url)\n")
+        assert rules_of(src) == []
+
+    def test_wrapper_plumbing_not_blocking_is_clean(self):
+        """Forwarding the budget into a dataclass/other wrapper is
+        plumbing, not a wait this call site could wedge on."""
+        src = ("def submit(self, prompt, deadline=None):\n"
+               "    return self._mk_request(prompt, deadline=deadline)\n"
+               "def caller(self, prompt):\n"
+               "    return self.submit(prompt)\n")
+        assert rules_of(src) == []
+
+    def test_test_paths_exempt(self):
+        src = ("import urllib.request\n"
+               "def test_probe(url):\n"
+               "    return urllib.request.urlopen(url)\n")
+        assert rules_of(src, "tests/test_fixture_t.py") == []
+
+
+class TestAdHocRetryLoop:
+    RETRY = ("import time\n"
+             "def nudge(cp):\n"
+             "    for _ in range(20):\n"
+             "        try:\n"
+             "            cp.patch({'x': 1})\n"
+             "            break\n"
+             "        except OSError:\n"
+             "            time.sleep(0.05)\n")
+
+    def test_sleep_and_swallow_loop(self):
+        assert rules_of(self.RETRY) == ["T802"]
+
+    def test_blessed_helper_is_clean(self):
+        src = ("from kubeflow_tpu.serve.retry import call_with_retry\n"
+               "def nudge(cp):\n"
+               "    call_with_retry(lambda a: cp.patch({'x': 1}),\n"
+               "                    retry_on=(OSError,))\n")
+        assert rules_of(src) == []
+
+    def test_reraising_handler_is_clean(self):
+        src = self.RETRY.replace("            time.sleep(0.05)\n",
+                                 "            time.sleep(0.05)\n"
+                                 "            raise\n")
+        assert rules_of(src) == []
+
+    def test_sleep_without_retry_is_clean(self):
+        src = ("import time\n"
+               "def poll(pred):\n"
+               "    while not pred():\n"
+               "        time.sleep(0.05)\n")
+        assert rules_of(src) == []
+
+    def test_blocking_ok_on_loop_closes_it(self):
+        src = self.RETRY.replace(
+            "    for _ in range(20):\n",
+            "    # blocking-ok: startup-only conflict window\n"
+            "    for _ in range(20):\n")
+        assert rules_of(src) == []
+
+
+_T_CLASS = ("import threading\n"
+            "class Pump:\n"
+            "    def start(self):\n"
+            "        self._thread = threading.Thread(target=self._loop)\n"
+            "        self._thread.start()\n"
+            "    def _loop(self):\n"
+            "        pass\n")
+
+
+class TestLeakedThread:
+    def test_stop_surface_never_joins(self):
+        src = _T_CLASS + ("    def stop(self):\n"
+                          "        self._stop.set()\n")
+        fs = lint_source(src, "kubeflow_tpu/serve/fixture.py")
+        assert [f.rule for f in fs] == ["T803"]
+        assert "Pump._thread" in fs[0].message
+
+    def test_joining_stop_is_clean(self):
+        src = _T_CLASS + ("    def stop(self):\n"
+                          "        self._thread.join(timeout=5.0)\n")
+        assert rules_of(src) == []
+
+    def test_local_thread_never_joined(self):
+        src = ("import threading\n"
+               "def run(work):\n"
+               "    t = threading.Thread(target=work)\n"
+               "    t.start()\n"
+               "    return 1\n")
+        assert rules_of(src) == ["T803"]
+
+    def test_local_joined_daemon_or_escaping_clean(self):
+        src = ("import threading\n"
+               "def a(work):\n"
+               "    t = threading.Thread(target=work)\n"
+               "    t.start()\n"
+               "    t.join(timeout=5.0)\n"
+               "def b(work):\n"
+               "    t = threading.Thread(target=work, daemon=True)\n"
+               "    t.start()\n"
+               "def c(work, sink):\n"
+               "    t = threading.Thread(target=work)\n"
+               "    t.start()\n"
+               "    sink.append(t)\n"
+               "def d(work):\n"
+               "    t = threading.Thread(target=work)\n"
+               "    t.start()\n"
+               "    return t\n")
+        assert rules_of(src) == []
+
+
+class TestThreadLifecycle:
+    def test_thread_in_class_without_stop_surface(self):
+        src = ("import threading\n"
+               "class Fire:\n"
+               "    def launch(self):\n"
+               "        t = threading.Thread(target=self._loop)\n"
+               "        t.start()\n"
+               "    def _loop(self):\n"
+               "        pass\n")
+        fs = lint_source(src, "kubeflow_tpu/serve/fixture.py")
+        assert [f.rule for f in fs] == ["T804"]
+        assert "'Fire'" in fs[0].message
+        assert "stop/close/shutdown" in fs[0].message
+
+    def test_daemon_thread_without_stop_surface_is_clean(self):
+        src = ("import threading\n"
+               "class Fire:\n"
+               "    def launch(self):\n"
+               "        t = threading.Thread(target=self._loop,\n"
+               "                             daemon=True)\n"
+               "        t.start()\n"
+               "    def _loop(self):\n"
+               "        pass\n")
+        assert rules_of(src) == []
+
+    def test_unbounded_queue_get_under_lock(self):
+        """The attr-based wait C302's fixed call set misses: held-lock
+        sites report as T804, never ALSO as T801."""
+        src = ("import threading\n"
+               "class R:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def drain(self):\n"
+               "        with self._lock:\n"
+               "            return self._work_q.get()\n")
+        fs = lint_source(src, "kubeflow_tpu/serve/fixture.py")
+        assert [f.rule for f in fs] == ["T804"]
+        assert "while holding" in fs[0].message
+
+    def test_bounded_get_under_lock_is_clean(self):
+        src = ("import threading\n"
+               "class R:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def drain(self):\n"
+               "        with self._lock:\n"
+               "            return self._work_q.get(timeout=1.0)\n")
+        assert rules_of(src) == []
+
+    def test_c302_site_not_double_reported(self):
+        """urlopen under a lock is C302's finding — T804 must not also
+        fire on it."""
+        src = ("import threading\n"
+               "import urllib.request\n"
+               "class R:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def fetch(self, url):\n"
+               "        with self._lock:\n"
+               "            return urllib.request.urlopen(url, timeout=1)\n")
+        assert rules_of(src) == ["C302"]
+
+
+class TestDeadlinePropagationDrift:
+    _H = ("import urllib.request\n"
+          "class Handler:\n"
+          "    def _budget_s(self):\n"
+          "        return self.headers.get('X-Kftpu-Deadline-Ms')\n")
+
+    def test_fixed_literal_timeout_in_reading_scope(self):
+        src = self._H + (
+            "    def relay(self, req):\n"
+            "        return urllib.request.urlopen(req, timeout=30.0)\n")
+        fs = lint_source(src, "kubeflow_tpu/serve/fixture.py")
+        assert [f.rule for f in fs] == ["T805"]
+        assert "timeout=30.0" in fs[0].message
+
+    def test_derived_timeout_is_clean(self):
+        src = self._H + (
+            "    def relay(self, req, remaining):\n"
+            "        return urllib.request.urlopen(req, timeout=remaining)\n")
+        assert rules_of(src) == []
+
+    def test_scope_without_deadline_read_is_clean(self):
+        src = ("import urllib.request\n"
+               "class Other:\n"
+               "    def relay(self, req):\n"
+               "        return urllib.request.urlopen(req, timeout=30.0)\n")
+        assert rules_of(src) == []
+
+
 # -- seeded regressions against the REAL codebase (acceptance criteria) --------
 
 
@@ -2017,6 +2297,81 @@ class TestContractSeededRegressions:
             '"KFTPU_REPLICA_IDX": str(self.replica_index)')
         assert {f.rule for f in fresh} == {"X704"}
         assert any("KFTPU_REPLICA_IDX" in f.message for f in fresh)
+
+
+class TestLivenessSeededRegressions:
+    def test_stripped_probe_timeout_is_caught(self):
+        """Removing the router metrics probe's urlopen timeout — the
+        exact unbounded wait that wedged a router behind a SIGKILLed
+        replica — produces exactly one T801."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/router.py",
+            'with urllib.request.urlopen(url + "/metrics",\n'
+            '                                            timeout=1.0) as r:',
+            'with urllib.request.urlopen(url + "/metrics") as r:')
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "T801" and "urllib.request.urlopen" in f.message
+
+    def test_inline_retry_loop_is_caught(self):
+        """An inline sleep-and-swallow retry loop instead of the blessed
+        serve/retry.py helper produces exactly one T802."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/handoff.py",
+            "    def validate(self) -> None:\n",
+            "    def validate(self) -> None:\n"
+            "        import time\n"
+            "        for _ in range(5):\n"
+            "            try:\n"
+            "                self.kv_len\n"
+            "                len(self.prompt_tokens)\n"
+            "                break\n"
+            "            except ValueError:\n"
+            "                time.sleep(0.05)\n")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "T802" and "call_with_retry" in f.message
+
+    def test_dropped_kv_migrate_join_is_caught(self):
+        """Dropping the kv-migrate join from the tiered cache's close()
+        produces exactly one T803 — the leak KFTPU_SANITIZE=threads
+        would catch live at stop."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/kvtier.py",
+            "            self._queue.put(None)\n"
+            "            self._thread.join(timeout=5.0)\n",
+            "            self._queue.put(None)\n")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "T803" and "._thread" in f.message
+
+    def test_queue_get_under_router_lock_is_caught(self):
+        """An unbounded queue get while holding the router lock — the
+        attr-based wait C302's fixed call set misses — produces exactly
+        one T804 (and NOT also a T801: one finding per defect)."""
+        fresh = _new_findings(
+            "kubeflow_tpu/serve/router.py",
+            "    def note_activity(self) -> None:\n",
+            "    def _drain_locked(self):\n"
+            "        with self._lock:\n"
+            "            return self._retire_q.get()\n\n"
+            "    def note_activity(self) -> None:\n")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "T804" and "while holding" in f.message
+
+    def test_fixed_relay_timeout_is_caught(self):
+        """Hardening the relay's derived ``timeout=remaining`` to a
+        literal — while the handler scope reads the deadline header,
+        resolved through the Program-wide header table — produces
+        exactly one T805."""
+        fresh = _new_findings_prog(
+            "kubeflow_tpu/serve/router.py",
+            "resp = urllib.request.urlopen(req, timeout=remaining)",
+            "resp = urllib.request.urlopen(req, timeout=30.0)")
+        assert len(fresh) == 1
+        f = fresh[0]
+        assert f.rule == "T805" and "timeout=30.0" in f.message
 
 
 # -- self-scan + CLI -----------------------------------------------------------

@@ -392,6 +392,8 @@ class TestServeDecodeIdentity:
 # -- recompile stability -------------------------------------------------------
 
 class TestRecompileStability:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20): ~9s; steady-state
+    # recompile discipline is also gated by the F6xx sanitizer tests
     def test_warmed_fused_train_step_zero_steady_recompiles(self):
         """KFTPU_SANITIZE=recompile over a warmed fused-kernel train step:
         every compile lands in warmup, none after (the F6xx runtime

@@ -58,6 +58,8 @@ def test_fused_matches_optax_chain(clip_active, mu_dtype):
             atol=1e-6 if mu_dtype is None else 1e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 20): ~8s; leaf math vs optax
+# stays fast via test_fused_matches_optax_chain
 def test_fused_trains_in_the_real_step(tmp_path):
     """setup_train with fused=True: state init/shardings/step all work and
     the loss goes down — the structural integration, not just leaf math."""

@@ -40,7 +40,7 @@ class TestModeParsing:
         assert sanitize_modes() == {"refcount", "lockorder"}
         monkeypatch.setenv("KFTPU_SANITIZE", "all")
         assert sanitize_modes() == {"transfer", "refcount", "lockorder",
-                                    "recompile", "contract"}
+                                    "recompile", "contract", "threads"}
 
     def test_recompile_and_contract_are_named_modes(self, monkeypatch):
         # neither must degrade to the legacy transfer fallback
@@ -48,6 +48,10 @@ class TestModeParsing:
         assert sanitize_modes() == {"recompile"}
         monkeypatch.setenv("KFTPU_SANITIZE", "contract")
         assert sanitize_modes() == {"contract"}
+
+    def test_threads_is_a_named_mode(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_SANITIZE", "threads")
+        assert sanitize_modes() == {"threads"}
 
     def test_unknown_token_degrades_to_transfer(self, monkeypatch):
         # pre-ISSUE-7 setups used arbitrary truthy values for the
@@ -510,3 +514,126 @@ class TestContractAuditor:
             assert "kftpu_hooked" in contract_report()["series_produced"]
         finally:
             uninstall_contract_auditor()
+
+
+# -- thread sanitizer (the dynamic half of the T8xx rules, ISSUE 20) -----------
+
+
+class TestThreadSanitizer:
+    @pytest.fixture()
+    def san(self):
+        san = sanitize.install_thread_sanitizer()
+        try:
+            yield san
+        finally:
+            sanitize.uninstall_thread_sanitizer()
+
+    def test_stamp_site_and_owner_from_bound_target(self, san):
+        class Comp:
+            def _loop(self, ev):
+                ev.wait(5.0)
+
+        comp = Comp()
+        ev = threading.Event()
+        t = threading.Thread(target=comp._loop, args=(ev,))
+        t.start()
+        try:
+            mine = [r for r in sanitize.thread_report()
+                    if r["owner"] == "Comp"]
+            assert mine, sanitize.thread_report()
+            assert "test_sanitizers.py" in mine[0]["site"]
+            assert mine[0]["daemon"] is False
+        finally:
+            ev.set()
+            t.join(timeout=5.0)
+
+    def test_owner_scope_labels_unbound_targets(self, san):
+        ev = threading.Event()
+        with sanitize.thread_owner("scrape-loop"):
+            t = threading.Thread(target=ev.wait, args=(5.0,))
+        t.start()
+        try:
+            rep = sanitize.thread_leak_report_by_owner()
+            assert "scrape-loop" in rep, rep
+            assert len(rep["scrape-loop"]) == 1
+        finally:
+            ev.set()
+            t.join(timeout=5.0)
+
+    def test_quiescence_raises_with_site_then_clears(self, san):
+        class Comp:
+            def _loop(self, ev):
+                ev.wait(10.0)
+
+        comp = Comp()
+        ev = threading.Event()
+        t = threading.Thread(target=comp._loop, args=(ev,))
+        t.start()
+        try:
+            with pytest.raises(sanitize.ThreadLeakError) as exc:
+                sanitize.assert_threads_quiescent(owner=comp, grace_s=0.2)
+            assert "Comp" in str(exc.value)
+            assert "test_sanitizers.py" in str(exc.value)
+        finally:
+            ev.set()
+            t.join(timeout=5.0)
+        # the same assert passes once the thread is joined
+        sanitize.assert_threads_quiescent(owner=comp, grace_s=1.0)
+
+    def test_owner_filter_ignores_other_components(self, san):
+        class A:
+            def _loop(self, ev):
+                ev.wait(10.0)
+
+        a, other = A(), A()
+        ev = threading.Event()
+        t = threading.Thread(target=a._loop, args=(ev,))
+        t.start()
+        try:
+            # `other` owns nothing: its stop-side assert must not trip
+            # on a's still-running thread
+            sanitize.assert_threads_quiescent(owner=other, grace_s=0.2)
+        finally:
+            ev.set()
+            t.join(timeout=5.0)
+
+    def test_explicit_thread_list_audit(self, san):
+        ev = threading.Event()
+        t = threading.Thread(target=ev.wait, args=(10.0,))
+        t.start()
+        try:
+            with pytest.raises(sanitize.ThreadLeakError):
+                sanitize.assert_threads_quiescent(threads=(t,),
+                                                  grace_s=0.2)
+        finally:
+            ev.set()
+            t.join(timeout=5.0)
+        sanitize.assert_threads_quiescent(threads=(t,), grace_s=1.0)
+
+    def test_timer_subclass_still_constructs(self, san):
+        # threading.Timer calls the module-global Thread.__init__ on a
+        # non-subtype self; the patched class must tolerate it
+        tm = threading.Timer(60.0, lambda: None)
+        tm.cancel()
+
+    def test_install_is_idempotent_and_uninstall_restores(self):
+        orig = threading.Thread
+        san1 = sanitize.install_thread_sanitizer()
+        try:
+            assert sanitize.install_thread_sanitizer() is san1
+            assert threading.Thread is not orig
+            assert threading.Thread.__name__ == "Thread"
+        finally:
+            sanitize.uninstall_thread_sanitizer()
+        assert threading.Thread is orig
+        assert sanitize.thread_sanitizer() is None
+        assert sanitize.thread_report() == []
+        sanitize.assert_threads_quiescent()          # no-op when off
+
+    def test_maybe_install_threads_mode(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_SANITIZE", "threads")
+        try:
+            sanitize.maybe_install()
+            assert sanitize.thread_sanitizer() is not None
+        finally:
+            sanitize.uninstall_thread_sanitizer()

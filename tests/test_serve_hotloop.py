@@ -80,6 +80,8 @@ class TestTokenIdentity:
         assert gen_all(eng, PROMPTS) == want
         assert eng.decode_rounds > 0
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20): ~11s; test_spec_paged
+    # keeps a fast pipelined-vs-off paged identity check in this class
     def test_paged(self, cfg, params, want):
         off = make_engine(cfg, params, pipelined=False, paged=True)
         on = make_engine(cfg, params, pipelined=True, paged=True)
@@ -267,6 +269,8 @@ class TestFirstTokenBatching:
         assert eng.first_token_fetches == before + 1
         run_all(eng, reqs)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20): ~8s; the one-fetch
+    # accounting stays fast via test_chunked_completions_share_one_fetch
     def test_batched_first_tokens_match_reference(self, cfg, params):
         """The batched sampler path must not perturb greedy outputs."""
         want = gen_all(make_engine(cfg, params, pipelined=False),

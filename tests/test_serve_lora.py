@@ -379,6 +379,8 @@ class TestEngineLifecycle:
         assert eng._lora.stats["evictions"] == 1
         eng._lora.assert_quiescent()
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20): ~9s; quiescence +
+    # refcount discipline stays fast via the other lifecycle tests
     def test_cancel_releases_adapter_ref(self, cfg, params, specs):
         eng = mk_engine(cfg, params, paged=True, lora_slots=2)
         eng._lora.register(specs[0])

@@ -175,6 +175,8 @@ class TestPagedExactMatch:
 
 
 class TestPrefixCaching:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20): ~10s;
+    # test_identical_prompt_twice_exact keeps prefix reuse fast-covered
     def test_shared_prefix_reuses_pages(self, cfg, params):
         system = list(range(40, 90))         # 50-token shared "system prompt"
         sp = SamplingParams(max_new_tokens=6, temperature=0.0)

@@ -114,6 +114,8 @@ class TestSpecExactMatch:
         assert gen_all(eng, PROMPTS) == want
         assert eng.metrics.snapshot()["spec_rounds"] > 0
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20): ~8s; draft-model exact
+    # match stays fast via test_draft_model_dense
     def test_draft_model_paged(self, cfg, params, want):
         eng = make_engine(cfg, params, paged=True, spec=DRAFT)
         assert gen_all(eng, PROMPTS) == want
@@ -172,6 +174,8 @@ class TestSpecExactMatch:
             out = gen_all(eng, [TEMPLATED[0]], max_new=n)
             assert len(out[0]) == n
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20): ~10s; the fallback
+    # branch itself is cheap — the cost is the sampled decode
     def test_sampling_traffic_falls_back_to_plain(self, cfg, params):
         eng = make_engine(cfg, params, spec=SpeculativeSpec(mode="ngram", k=4))
         sp = SamplingParams(max_new_tokens=6, temperature=1.2, top_k=20)
